@@ -1,0 +1,11 @@
+"""filodb_tpu — a TPU-native, Prometheus-compatible, in-memory time-series database.
+
+A ground-up JAX/XLA re-design with the capabilities of FiloDB (reference:
+filodb.coordinator / filodb.core / filodb.memory / filodb.query Scala modules):
+columnar compressed storage, PromQL distributed query execution, sharded ingestion
+with checkpointed recovery, durable persistence, downsampling, HTTP API.
+
+See ARCHITECTURE.md for the design mapping.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU mesh so sharding/collective paths are
+exercised without TPU hardware (ref test strategy: akka-multi-node-testkit runs multi-node
+behavior in one process — coordinator/src/multi-jvm/)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
