@@ -9,3 +9,11 @@ See ARCHITECTURE.md for the design mapping.
 """
 
 __version__ = "0.1.0"
+
+# Epoch-millisecond timestamps are int64 end-to-end (device searchsorted included),
+# so 64-bit mode is required. All library arrays specify dtypes explicitly; value
+# columns stay f32 on device unless a store is configured for f64 parity runs.
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_enable_x64", True)
+

@@ -1,0 +1,176 @@
+"""Device-resident columnar series store — the HBM equivalent of the off-heap chunk
+substrate.
+
+Reference mapping:
+  - memory/.../BlockManager.scala + MemFactory.scala (off-heap blocks, reclaim)
+      -> preallocated padded device arrays, amortized compaction instead of blocks
+  - core/.../memstore/TimeSeriesPartition.scala (write buffers -> frozen chunks)
+      -> host staging buffers -> one batched device scatter per flush group
+  - memory/.../data/ChunkMap.scala (per-partition chunk index)
+      -> not needed: each series is a contiguous sorted row [series, capacity]
+
+Layout per (shard, schema): ``ts[S, C] int64`` (pad = +sentinel), ``val[S, C]``
+(f32 by default; f64 for parity testing), ``n[S] int32`` valid counts. All query
+kernels read these arrays directly; ingest appends via an out-of-bounds-dropping
+scatter with donated buffers (in-place HBM update, no realloc).
+
+Why not compressed chunks in HBM? The reference compresses to fit ~1M series in a
+1GB JVM heap. A TPU chip has 16GB+ HBM: 1M series x 1k samples x (8B ts + 4B val)
+fits raw, and raw arrays keep the query path a pure gather/reduce. Compression
+(NibblePack & co) lives at the persistence/wire layer (core/store.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TS_PAD = np.int64(1) << np.int64(62)   # sentinel > any real timestamp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _scatter_append(ts, val, n, rows, cols, new_ts, new_val, counts_add):
+    ts = ts.at[rows, cols].set(new_ts, mode="drop")
+    val = val.at[rows, cols].set(new_val, mode="drop")
+    n = n + counts_add
+    return ts, val, n
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _compact(ts, val, n, cutoff):
+    """Drop samples with ts < cutoff by shifting each series row left (one gather)."""
+    S, C = ts.shape
+    k = jax.vmap(lambda row: jnp.searchsorted(row, cutoff, side="left"))(ts)  # [S]
+    idx = jnp.arange(C)[None, :] + k[:, None]                                 # [S, C]
+    valid = idx < C
+    idx = jnp.where(valid, idx, C - 1)
+    new_ts = jnp.where(valid, jnp.take_along_axis(ts, idx, axis=1), TS_PAD)
+    new_val = jnp.where(valid, jnp.take_along_axis(val, idx, axis=1), 0)
+    new_n = jnp.maximum(n - k.astype(n.dtype), 0)
+    # re-pad anything beyond the new count (handles rows where k > old n)
+    pos = jnp.arange(C)[None, :]
+    new_ts = jnp.where(pos < new_n[:, None], new_ts, TS_PAD)
+    return new_ts, new_val, new_n
+
+
+def _pad_size(m: int) -> int:
+    """Bucket flush sizes to powers of two to bound jit recompilations."""
+    size = 1024
+    while size < m:
+        size *= 2
+    return size
+
+
+@dataclass
+class SeriesStoreStats:
+    samples_appended: int = 0
+    out_of_order_dropped: int = 0
+    capacity_dropped: int = 0
+    compactions: int = 0
+
+
+class SeriesStore:
+    """One shard's device store for a non-histogram schema value column."""
+
+    def __init__(self, max_series: int, capacity: int, dtype=jnp.float32,
+                 device=None):
+        self.S = max_series
+        self.C = capacity
+        self.dtype = dtype
+        dev = device or jax.devices()[0]
+        self.ts = jax.device_put(jnp.full((max_series, capacity), TS_PAD, jnp.int64), dev)
+        self.val = jax.device_put(jnp.zeros((max_series, capacity), dtype), dev)
+        self.n = jax.device_put(jnp.zeros(max_series, jnp.int32), dev)
+        # host mirrors: ingest-path bookkeeping without device->host syncs
+        self.n_host = np.zeros(max_series, np.int32)
+        self.last_ts = np.full(max_series, -(1 << 62), np.int64)
+        self.stats = SeriesStoreStats()
+
+    # -- ingest -------------------------------------------------------------
+
+    def append(self, part_ids: np.ndarray, ts: np.ndarray, values: np.ndarray) -> int:
+        """Batched append of samples (one flush group). Samples must be presented
+        in ingest order; per-series out-of-order or over-capacity samples drop
+        (reference behavior: TimeSeriesPartition drops out-of-order rows).
+        Returns the number of samples actually written."""
+        if len(part_ids) == 0:
+            return 0
+        part_ids = np.asarray(part_ids, np.int32)
+        ts = np.asarray(ts, np.int64)
+        # stable sort by series, then position within batch = running offset
+        order = np.argsort(part_ids, kind="stable")
+        r = part_ids[order]
+        t = ts[order]
+        v = np.asarray(values)[order]
+        # out-of-order detection: a sample must exceed both the stored last_ts and
+        # the running max of earlier in-batch samples of its series (fast path when
+        # nothing violates — the common time-ordered-stream case)
+        prev_t = np.concatenate([[0], t[:-1]])
+        same_series = np.concatenate([[False], np.diff(r) == 0])
+        viol = (t <= self.last_ts[r]) | (same_series & (t <= prev_t))
+        keep = ~viol
+        if viol.any():
+            # slow path: exact per-series running-max filter, only for violators
+            for s in np.unique(r[viol]):
+                mask = r == s
+                tt = t[mask]
+                run = self.last_ts[s]
+                kk = np.empty(len(tt), bool)
+                for i, x in enumerate(tt):
+                    kk[i] = x > run
+                    if kk[i]:
+                        run = x
+                keep[mask] = kk
+            self.stats.out_of_order_dropped += int((~keep).sum())
+            r, t, v = r[keep], t[keep], v[keep]
+        # running occurrence index within the (filtered) sorted batch -> dense cols
+        boundaries = np.concatenate([[0], np.nonzero(np.diff(r))[0] + 1])
+        occ = np.arange(len(r)) - np.repeat(
+            boundaries, np.diff(np.concatenate([boundaries, [len(r)]])))
+        cols = self.n_host[r] + occ
+        over = cols >= self.C
+        if over.any():
+            self.stats.capacity_dropped += int(over.sum())
+            r, t, v, cols = r[~over], t[~over], v[~over], cols[~over]
+        m = len(r)
+        if m == 0:
+            return 0
+        # host bookkeeping
+        np.maximum.at(self.last_ts, r, t)
+        counts = np.bincount(r, minlength=self.S).astype(np.int32)
+        self.n_host += counts
+        # pad to bucketed size; padded rows use row index S => dropped by scatter
+        P = _pad_size(m)
+        rp = np.full(P, self.S, np.int32); rp[:m] = r
+        cp = np.zeros(P, np.int32); cp[:m] = cols
+        tp = np.zeros(P, np.int64); tp[:m] = t
+        vp = np.zeros(P, np.asarray(v).dtype); vp[:m] = v
+        self.ts, self.val, self.n = _scatter_append(
+            self.ts, self.val, self.n,
+            jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),
+            jnp.asarray(vp).astype(self.dtype), jnp.asarray(counts))
+        self.stats.samples_appended += m
+        return m
+
+    def compact(self, cutoff_ts: int) -> None:
+        """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
+        by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
+        self.ts, self.val, self.n = _compact(self.ts, self.val, self.n,
+                                             jnp.int64(cutoff_ts))
+        self.n_host = np.array(self.n)  # fresh writable host copy
+        self.stats.compactions += 1
+
+    # -- query access -------------------------------------------------------
+
+    def arrays(self):
+        """(ts[S,C], val[S,C], n[S]) device arrays for query kernels."""
+        return self.ts, self.val, self.n
+
+    def series_snapshot(self, part_id: int):
+        """Host copy of one series (tests/debug)."""
+        cnt = int(self.n_host[part_id])
+        return (np.asarray(self.ts[part_id, :cnt]), np.asarray(self.val[part_id, :cnt]))

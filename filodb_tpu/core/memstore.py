@@ -1,0 +1,183 @@
+"""TimeSeriesMemStore: per-dataset shards wiring ingest -> part-key index -> HBM store.
+
+Reference: core/.../memstore/TimeSeriesMemStore.scala (shard map, ingestStream),
+TimeSeriesShard.scala (the heart: partition set, Lucene index, ingest loop
+:459/:1183, flush pipeline :771-:1048, recovery, eviction).
+
+TPU-native shape of the same responsibilities:
+  - partition lookup: host dict part-key-bytes -> part_id, resolved once per
+    *distinct label set per container* (not per sample; the container's part_idx
+    indirection makes sample->part_id a single vectorized numpy gather)
+  - ingest: host staging buffers -> batched device scatter when the staging
+    threshold is reached (one XLA call per flush, not per record)
+  - flush groups & offset watermarks: group = part_id % num_groups; the group
+    watermark advances when the group's staged samples land on device (and, once a
+    ChunkSink is attached, when they are durably flushed) — recovery replays the
+    bus from min(watermark), skipping below-watermark rows per group (ref:
+    TimeSeriesShard.scala:180-184, doc/ingestion.md "Recovery and Persistence")
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .chunkstore import SeriesStore
+from .filters import Filter
+from .partkey_index import PartKeyIndex
+from .record import RecordContainer
+from .schemas import Schema, Schemas, part_key_of
+
+
+@dataclass
+class StoreConfig:
+    """Per-dataset store tuning (ref: core/.../store/IngestionConfig.scala + the
+    store {} block of conf/timeseries-dev-source.conf)."""
+    max_series_per_shard: int = 1 << 20
+    samples_per_series: int = 1024          # device row capacity (ring via compaction)
+    flush_batch_size: int = 65536           # staged samples triggering a device flush
+    groups_per_shard: int = 16
+    retention_ms: int = 3 * 3600 * 1000
+    dtype: str = "float32"
+
+
+@dataclass
+class ShardStats:
+    rows_ingested: int = 0
+    series_created: int = 0
+    unknown_schema_dropped: int = 0
+
+
+class TimeSeriesShard:
+    """All state for one shard of one dataset."""
+
+    def __init__(self, dataset: str, schema: Schema, shard_num: int, config: StoreConfig,
+                 device=None):
+        import jax.numpy as jnp
+        self.dataset = dataset
+        self.schema = schema
+        self.shard_num = shard_num
+        self.config = config
+        self.index = PartKeyIndex()
+        self._part_key_to_id: dict[bytes, int] = {}
+        dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+        self.store = SeriesStore(config.max_series_per_shard, config.samples_per_series,
+                                 dtype=dtype, device=device)
+        # staging buffers (host)
+        self._stage_pid: list[np.ndarray] = []
+        self._stage_ts: list[np.ndarray] = []
+        self._stage_val: list[np.ndarray] = []
+        self._staged = 0
+        # per-group ingest offset watermarks (ref: checkpoint per flush group)
+        self.group_watermarks = np.full(config.groups_per_shard, -1, np.int64)
+        self._pending_offset = -1
+        self.stats = ShardStats()
+
+    # -- partition resolution ----------------------------------------------
+
+    def _resolve_part_ids(self, container: RecordContainer) -> np.ndarray:
+        """Map the container's distinct label sets to dense part ids, creating
+        new partitions (and index entries) as needed."""
+        mapping = np.empty(len(container.label_sets), np.int32)
+        first_ts = int(container.ts.min()) if len(container) else 0
+        for i, labels in enumerate(container.label_sets):
+            pk = part_key_of(labels, self.schema.options)
+            pid = self._part_key_to_id.get(pk)
+            if pid is None:
+                pid = len(self.index)
+                self._part_key_to_id[pk] = pid
+                self.index.add_part_key(pid, labels, start_time=first_ts)
+                self.stats.series_created += 1
+            mapping[i] = pid
+        return mapping[container.part_idx]
+
+    # -- ingest -------------------------------------------------------------
+
+    def ingest(self, container: RecordContainer, offset: int = -1) -> None:
+        if container.schema.schema_id != self.schema.schema_id:
+            self.stats.unknown_schema_dropped += len(container)
+            return
+        pids = self._resolve_part_ids(container)
+        self._stage_pid.append(pids)
+        self._stage_ts.append(container.ts)
+        self._stage_val.append(container.values)
+        self._staged += len(container)
+        self._pending_offset = max(self._pending_offset, offset)
+        self.stats.rows_ingested += len(container)
+        if self._staged >= self.config.flush_batch_size:
+            self.flush()
+
+    def flush(self) -> int:
+        """Push staged samples to the device store; advance group watermarks."""
+        if not self._staged:
+            return 0
+        pids = np.concatenate(self._stage_pid)
+        ts = np.concatenate(self._stage_ts)
+        vals = np.concatenate(self._stage_val)
+        self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
+        self._staged = 0
+        written = self.store.append(pids, ts, vals)
+        if self._pending_offset >= 0:
+            self.group_watermarks[:] = self._pending_offset
+        # capacity pressure -> compact out data older than retention
+        if self.store.n_host.max(initial=0) >= self.config.samples_per_series:
+            cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
+            self.store.compact(cutoff)
+        return written
+
+    # -- queries ------------------------------------------------------------
+
+    def part_ids_from_filters(self, filters: list[Filter], start: int, end: int,
+                              limit: int | None = None) -> np.ndarray:
+        self.flush()
+        return self.index.part_ids_from_filters(filters, start, end, limit)
+
+    def label_values(self, label: str, filters=None, top_k=None) -> list[str]:
+        return self.index.label_values(label, filters, top_k=top_k)
+
+    def label_names(self, filters=None) -> list[str]:
+        return self.index.label_names(filters)
+
+    @property
+    def num_series(self) -> int:
+        return len(self.index)
+
+
+class TimeSeriesMemStore:
+    """Dataset -> shards facade (ref: MemStore.scala trait + TimeSeriesMemStore)."""
+
+    def __init__(self, schemas: Schemas | None = None):
+        self.schemas = schemas or Schemas()
+        self._shards: dict[tuple[str, int], TimeSeriesShard] = {}
+        self._configs: dict[str, StoreConfig] = {}
+        self._dataset_schema: dict[str, Schema] = {}
+
+    def setup(self, dataset: str, schema: Schema | str, shard: int,
+              config: StoreConfig | None = None, device=None) -> TimeSeriesShard:
+        if isinstance(schema, str):
+            schema = self.schemas[schema]
+        cfg = config or self._configs.get(dataset) or StoreConfig()
+        self._configs[dataset] = cfg
+        self._dataset_schema[dataset] = schema
+        key = (dataset, shard)
+        if key in self._shards:
+            raise ValueError(f"shard {shard} of {dataset} already set up")
+        s = TimeSeriesShard(dataset, schema, shard, cfg, device=device)
+        self._shards[key] = s
+        return s
+
+    def shard(self, dataset: str, shard: int) -> TimeSeriesShard:
+        return self._shards[(dataset, shard)]
+
+    def shards_of(self, dataset: str) -> list[TimeSeriesShard]:
+        return [s for (d, _), s in sorted(self._shards.items()) if d == dataset]
+
+    def ingest(self, dataset: str, shard: int, container: RecordContainer,
+               offset: int = -1) -> None:
+        self._shards[(dataset, shard)].ingest(container, offset)
+
+    def flush_all(self, dataset: str | None = None) -> None:
+        for (d, _), s in self._shards.items():
+            if dataset is None or d == dataset:
+                s.flush()

@@ -1,0 +1,176 @@
+"""Part-key tag index: label filters -> partition ids (the Lucene equivalent).
+
+Reference: core/.../memstore/PartKeyLuceneIndex.scala:34,68 — an MMap Lucene index
+of part-key tags with startTime/endTime per partition, regex/prefix filters, top-k
+label values, and partIdsEndedBefore for purge.
+
+TPU-native design: the index is host-side (tag matching has no device analog) and
+must not bottleneck 1M-series workloads (ref bar: PartKeyIndexBenchmark). Postings
+are kept as append lists compacted lazily into sorted int32 numpy arrays; filter
+evaluation is numpy set algebra (intersect/union/setdiff) over postings, with regex
+applied per *distinct label value* (not per series).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+
+import numpy as np
+
+from .filters import Equals, EqualsRegex, Filter, In, NotEquals, NotEqualsRegex
+
+_EMPTY = np.empty(0, dtype=np.int32)
+
+
+class _Postings:
+    """Append-friendly posting list with lazy sorted-array compaction."""
+
+    __slots__ = ("_new", "_arr")
+
+    def __init__(self):
+        self._new: list[int] = []
+        self._arr: np.ndarray = _EMPTY
+
+    def add(self, part_id: int) -> None:
+        self._new.append(part_id)
+
+    def array(self) -> np.ndarray:
+        if self._new:
+            fresh = np.asarray(self._new, dtype=np.int32)
+            # part ids are assigned in increasing order, so appends are presorted
+            self._arr = np.concatenate([self._arr, fresh]) if len(self._arr) else fresh
+            self._new = []
+        return self._arr
+
+    def __len__(self) -> int:
+        return len(self._arr) + len(self._new)
+
+
+class PartKeyIndex:
+    """Inverted index over one shard's partitions."""
+
+    def __init__(self):
+        # label name -> label value -> postings
+        self._inv: dict[str, dict[str, _Postings]] = defaultdict(dict)
+        self._labels: list[dict[str, str]] = []       # part_id -> label dict
+        self._start: list[int] = []                    # part_id -> first sample ts (ms)
+        self._end: list[int] = []                      # part_id -> last sample ts / MAX while live
+
+    LIVE_END = np.iinfo(np.int64).max
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def add_part_key(self, part_id: int, labels: dict[str, str], start_time: int,
+                     end_time: int = LIVE_END) -> None:
+        assert part_id == len(self._labels), "part ids must be assigned densely in order"
+        self._labels.append(labels)
+        self._start.append(start_time)
+        self._end.append(end_time)
+        for name, value in labels.items():
+            p = self._inv[name].get(value)
+            if p is None:
+                p = self._inv[name][value] = _Postings()
+            p.add(part_id)
+
+    def update_end_time(self, part_id: int, end_time: int) -> None:
+        self._end[part_id] = end_time
+
+    def start_time(self, part_id: int) -> int:
+        return self._start[part_id]
+
+    def end_time(self, part_id: int) -> int:
+        return self._end[part_id]
+
+    def labels_of(self, part_id: int) -> dict[str, str]:
+        return self._labels[part_id]
+
+    # ---- queries ----------------------------------------------------------
+
+    def _postings_for(self, f: Filter) -> np.ndarray:
+        """Union of postings whose label value satisfies the (positive) filter."""
+        vals = self._inv.get(f.label)
+        if not vals:
+            return _EMPTY
+        if isinstance(f, Equals):
+            p = vals.get(f.value)
+            return p.array() if p else _EMPTY
+        if isinstance(f, In):
+            arrs = [vals[v].array() for v in f.values if v in vals]
+        elif isinstance(f, (EqualsRegex, NotEqualsRegex)):
+            # applied per distinct value; NotEqualsRegex handled by caller via complement
+            import re
+            pat = re.compile(f.pattern)
+            arrs = [p.array() for v, p in vals.items() if pat.fullmatch(v)]
+        elif isinstance(f, NotEquals):
+            arrs = [p.array() for v, p in vals.items() if v != f.value]
+        else:  # pragma: no cover
+            raise TypeError(f)
+        if not arrs:
+            return _EMPTY
+        return np.unique(np.concatenate(arrs)) if len(arrs) > 1 else arrs[0]
+
+    def part_ids_from_filters(self, filters: list[Filter], start_time: int,
+                              end_time: int, limit: int | None = None) -> np.ndarray:
+        """Part ids matching all filters and alive in [start_time, end_time]."""
+        result: np.ndarray | None = None
+        negations: list[Filter] = []
+        for f in filters:
+            if isinstance(f, (NotEquals, NotEqualsRegex)):
+                negations.append(f)
+                continue
+            p = self._postings_for(f)
+            result = p if result is None else np.intersect1d(result, p, assume_unique=True)
+            if result is not None and len(result) == 0:
+                return _EMPTY
+        if result is None:
+            result = np.arange(len(self._labels), dtype=np.int32)
+        for f in negations:
+            # series *lacking* the label entirely also match a negative filter
+            pos = self._postings_for(
+                Equals(f.label, f.value) if isinstance(f, NotEquals) else EqualsRegex(f.label, f.pattern)
+            )
+            result = np.setdiff1d(result, pos, assume_unique=True)
+        if len(result):
+            starts = np.asarray(self._start, dtype=np.int64)[result]
+            ends = np.asarray(self._end, dtype=np.int64)[result]
+            result = result[(starts <= end_time) & (ends >= start_time)]
+        if limit is not None:
+            result = result[:limit]
+        return result.astype(np.int32)
+
+    def part_ids_ended_before(self, ts: int) -> np.ndarray:
+        """For purge (ref: PartKeyLuceneIndex.partIdsEndedBefore)."""
+        ends = np.asarray(self._end, dtype=np.int64)
+        return np.nonzero(ends < ts)[0].astype(np.int32)
+
+    def label_values(self, label: str, filters: list[Filter] | None = None,
+                     start_time: int = 0, end_time: int = 1 << 62,
+                     top_k: int | None = None) -> list[str]:
+        """Distinct values of ``label``; top-k by series count when requested
+        (ref: PartKeyLuceneIndex indexValues top-k terms)."""
+        vals = self._inv.get(label)
+        if not vals:
+            return []
+        if filters:
+            matching = self.part_ids_from_filters(filters, start_time, end_time)
+            counts = Counter()
+            for v, p in vals.items():
+                c = len(np.intersect1d(p.array(), matching, assume_unique=True))
+                if c:
+                    counts[v] = c
+        else:
+            counts = Counter({v: len(p) for v, p in vals.items()})
+        if top_k is not None:
+            return [v for v, _ in counts.most_common(top_k)]
+        return sorted(counts)
+
+    def label_names(self, filters: list[Filter] | None = None,
+                    start_time: int = 0, end_time: int = 1 << 62) -> list[str]:
+        if not filters:
+            return sorted(self._inv)
+        matching = self.part_ids_from_filters(filters, start_time, end_time)
+        names: set[str] = set()
+        for pid in matching.tolist():
+            names.update(self._labels[pid])
+        return sorted(names)

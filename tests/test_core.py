@@ -1,0 +1,142 @@
+"""Core layer tests: schemas, record containers, part-key index, device store,
+memstore ingest round-trip (ref test models: TimeSeriesMemStoreSpec,
+PartKeyLuceneIndexSpec — run against in-process fakes, no services)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import filters as F
+from filodb_tpu.core.chunkstore import SeriesStore
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.partkey_index import PartKeyIndex
+from filodb_tpu.core.record import RecordBuilder, RecordContainer
+from filodb_tpu.core.schemas import GAUGE, Schemas, part_key_of
+
+
+def make_container(n_series=5, n_samples=20, metric="heap_usage0", start=1_000_000):
+    b = RecordBuilder(GAUGE)
+    for t in range(n_samples):
+        for s in range(n_series):
+            b.add({"_metric_": metric, "_ws_": "demo", "_ns_": "app", "host": f"h{s}"},
+                  start + t * 10_000, float(s * 100 + t))
+    return b.build()
+
+
+def test_schema_registry_ids_stable():
+    ss = Schemas()
+    assert ss["gauge"] is GAUGE
+    assert ss[GAUGE.schema_id] is GAUGE
+    assert GAUGE.schema_id != ss["prom-counter"].schema_id
+
+
+def test_part_key_canonical_order():
+    a = part_key_of({"b": "2", "a": "1"})
+    b = part_key_of({"a": "1", "b": "2"})
+    assert a == b
+
+
+def test_record_container_roundtrip():
+    rc = make_container()
+    buf = rc.to_bytes()
+    back = RecordContainer.from_bytes(buf, Schemas())
+    np.testing.assert_array_equal(back.ts, rc.ts)
+    np.testing.assert_array_equal(back.values, rc.values)
+    np.testing.assert_array_equal(back.part_hash, rc.part_hash)
+    assert back.label_sets == rc.label_sets
+    assert back.schema.name == "gauge"
+
+
+def test_partkey_index_filters():
+    idx = PartKeyIndex()
+    for i in range(10):
+        idx.add_part_key(i, {"_metric_": "cpu", "host": f"h{i % 3}", "dc": "us"}, start_time=0)
+    got = idx.part_ids_from_filters([F.Equals("host", "h1")], 0, 10**15)
+    np.testing.assert_array_equal(got, [1, 4, 7])
+    got = idx.part_ids_from_filters([F.EqualsRegex("host", "h[01]")], 0, 10**15)
+    np.testing.assert_array_equal(got, [0, 1, 3, 4, 6, 7, 9])
+    got = idx.part_ids_from_filters([F.NotEquals("host", "h0")], 0, 10**15)
+    np.testing.assert_array_equal(got, [1, 2, 4, 5, 7, 8])
+    got = idx.part_ids_from_filters([F.Equals("dc", "us"), F.In("host", ("h2",))], 0, 10**15)
+    np.testing.assert_array_equal(got, [2, 5, 8])
+    # negative filter matches series lacking the label
+    got = idx.part_ids_from_filters([F.NotEquals("missing", "x")], 0, 10**15)
+    assert len(got) == 10
+
+
+def test_partkey_index_time_range_and_topk():
+    idx = PartKeyIndex()
+    idx.add_part_key(0, {"m": "a"}, start_time=100)
+    idx.add_part_key(1, {"m": "a"}, start_time=500)
+    idx.update_end_time(0, 400)
+    got = idx.part_ids_from_filters([F.Equals("m", "a")], 450, 600)
+    np.testing.assert_array_equal(got, [1])
+    idx2 = PartKeyIndex()
+    for i in range(9):
+        idx2.add_part_key(i, {"host": f"h{i % 3}", "rare": "r" if i == 0 else "c"}, 0)
+    assert idx2.label_values("rare", top_k=1) == ["c"]
+    assert idx2.label_names() == ["host", "rare"]
+
+
+def test_series_store_append_and_snapshot():
+    st = SeriesStore(max_series=8, capacity=16)
+    pids = np.array([0, 1, 0, 1, 2], np.int32)
+    ts = np.array([10, 10, 20, 20, 10], np.int64)
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert st.append(pids, ts, vals) == 5
+    t0, v0 = st.series_snapshot(0)
+    np.testing.assert_array_equal(t0, [10, 20])
+    np.testing.assert_array_equal(v0, [1.0, 3.0])
+    # second batch appends after the first
+    st.append(np.array([0], np.int32), np.array([30], np.int64), np.array([9.0]))
+    t0, v0 = st.series_snapshot(0)
+    np.testing.assert_array_equal(t0, [10, 20, 30])
+
+
+def test_series_store_out_of_order_dropped():
+    st = SeriesStore(max_series=4, capacity=8)
+    st.append(np.array([0, 0], np.int32), np.array([100, 50], np.int64), np.array([1.0, 2.0]))
+    t0, _ = st.series_snapshot(0)
+    np.testing.assert_array_equal(t0, [100])
+    assert st.stats.out_of_order_dropped == 1
+    # also vs stored last_ts in a later batch
+    st.append(np.array([0], np.int32), np.array([80], np.int64), np.array([3.0]))
+    assert st.stats.out_of_order_dropped == 2
+    # tricky case: [10, 5, 7] -> only 10 survives
+    st.append(np.array([1, 1, 1], np.int32), np.array([10, 5, 7], np.int64),
+              np.array([1.0, 2.0, 3.0]))
+    t1, _ = st.series_snapshot(1)
+    np.testing.assert_array_equal(t1, [10])
+
+
+def test_series_store_compaction():
+    st = SeriesStore(max_series=2, capacity=8)
+    st.append(np.zeros(8, np.int32), np.arange(8, dtype=np.int64) * 10 + 10,
+              np.arange(8, dtype=np.float64))
+    st.compact(cutoff_ts=45)
+    t0, v0 = st.series_snapshot(0)
+    np.testing.assert_array_equal(t0, [50, 60, 70, 80])
+    np.testing.assert_array_equal(v0, [4.0, 5.0, 6.0, 7.0])
+    # can append again after compaction
+    st.append(np.array([0], np.int32), np.array([90], np.int64), np.array([8.0]))
+    t0, _ = st.series_snapshot(0)
+    np.testing.assert_array_equal(t0, [50, 60, 70, 80, 90])
+
+
+def test_memstore_ingest_query_roundtrip():
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=64, samples_per_series=64, flush_batch_size=10**9)
+    shard = ms.setup("prometheus", "gauge", 0, cfg)
+    shard.ingest(make_container(n_series=5, n_samples=20), offset=123)
+    pids = shard.part_ids_from_filters([F.Equals("_metric_", "heap_usage0")], 0, 10**15)
+    assert len(pids) == 5
+    assert shard.num_series == 5
+    ts, vals = shard.store.series_snapshot(int(pids[0]))
+    assert len(ts) == 20
+    assert shard.group_watermarks.min() == 123
+    assert shard.label_values("host") == [f"h{i}" for i in range(5)]
+    # same series keep their ids on re-ingest
+    shard.ingest(make_container(n_series=5, n_samples=3, start=2_000_000))
+    shard.flush()
+    assert shard.num_series == 5
+    ts, _ = shard.store.series_snapshot(int(pids[0]))
+    assert len(ts) == 23
