@@ -1,0 +1,174 @@
+"""Cross-series aggregation: the map/reduce over [P, T] result matrices.
+
+Reference: query/.../exec/AggrOverRangeVectors.scala (RowAggregator framework:
+Sum/Min/Max/Count/Avg/Stddev/Stdvar/TopK/BottomK/CountValues/Quantile with
+map -> reduce -> present phases, plus the row-major ``fastReduce`` path).
+
+TPU-native shape: grouping labels are resolved host-side to dense group ids [P];
+the reduce is one ``segment_sum``-family call over the series axis — the same
+O(P*T) data-parallel pass regardless of group count. Across shards the partial
+[G, T] matrices reduce further via ``psum`` on the mesh (parallel/).
+
+NaN convention: NaN marks a missing sample; aggregates exclude NaN and emit NaN
+for groups with no present samples at a step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BASIC_OPS = ("sum", "min", "max", "avg", "count", "stddev", "stdvar", "group")
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def segment_aggregate(op: str, values, group_ids, num_groups: int):
+    """values [P, T] f64 (NaN=missing), group_ids int32 [P] -> [G, T].
+
+    For avg/stddev/stdvar returns the *present* final value; for mesh-distributed
+    reduces use ``partial_aggregate``/``combine_partials`` instead so partial sums
+    survive the cross-shard psum.
+    """
+    parts = partial_aggregate(op, values, group_ids, num_groups)
+    return present_partials(op, parts)
+
+
+def partial_aggregate(op: str, values, group_ids, num_groups: int):
+    """Map phase: per-group partial state tensors, each [G, T] (ref: RowAggregator
+    .map/.reduceAggregate). Partials are psum/min/max-combinable across shards."""
+    present = ~jnp.isnan(values)
+    zeroed = jnp.where(present, values, 0.0)
+    cnt = jax.ops.segment_sum(present.astype(jnp.float64), group_ids, num_groups)
+    if op == "count":
+        return {"count": cnt}
+    if op == "sum":
+        return {"sum": jax.ops.segment_sum(zeroed, group_ids, num_groups), "count": cnt}
+    if op == "min":
+        v = jnp.where(present, values, jnp.inf)
+        return {"min": jax.ops.segment_min(v, group_ids, num_groups), "count": cnt}
+    if op == "max":
+        v = jnp.where(present, values, -jnp.inf)
+        return {"max": jax.ops.segment_max(v, group_ids, num_groups), "count": cnt}
+    if op == "avg":
+        return {"sum": jax.ops.segment_sum(zeroed, group_ids, num_groups), "count": cnt}
+    if op in ("stddev", "stdvar"):
+        return {
+            "sum": jax.ops.segment_sum(zeroed, group_ids, num_groups),
+            "sumsq": jax.ops.segment_sum(zeroed * zeroed, group_ids, num_groups),
+            "count": cnt,
+        }
+    if op == "group":
+        return {"count": cnt}
+    raise ValueError(f"not a basic segment op: {op}")
+
+
+def combine_partials(op: str, a: dict, b: dict) -> dict:
+    """Reduce phase across shards (host or psum path)."""
+    out = {}
+    for k in a:
+        if k == "min":
+            out[k] = jnp.minimum(a[k], b[k])
+        elif k == "max":
+            out[k] = jnp.maximum(a[k], b[k])
+        else:
+            out[k] = a[k] + b[k]
+    return out
+
+
+def present_partials(op: str, parts: dict):
+    """Present phase: partial state -> final [G, T] values (NaN where empty)."""
+    cnt = parts["count"]
+    empty = cnt == 0
+    if op == "count":
+        return jnp.where(empty, jnp.nan, cnt)
+    if op == "group":
+        return jnp.where(empty, jnp.nan, 1.0)
+    if op == "sum":
+        return jnp.where(empty, jnp.nan, parts["sum"])
+    if op == "min":
+        return jnp.where(empty, jnp.nan, parts["min"])
+    if op == "max":
+        return jnp.where(empty, jnp.nan, parts["max"])
+    if op == "avg":
+        return jnp.where(empty, jnp.nan, parts["sum"] / cnt)
+    if op in ("stddev", "stdvar"):
+        mean = parts["sum"] / cnt
+        var = jnp.maximum(parts["sumsq"] / cnt - mean * mean, 0.0)
+        r = var if op == "stdvar" else jnp.sqrt(var)
+        return jnp.where(empty, jnp.nan, r)
+    raise ValueError(op)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def topk_mask(values, group_ids, num_groups: int, k: int, bottom: bool = False):
+    """Per-step top-k filter: True where values[p, t] is among the k largest
+    (smallest for bottomk) present values of its group at step t.
+
+    Rank-within-group computed by counting, per element, how many group members
+    beat it — O(P^2 T) pairwise within groups would be too big, so we instead
+    compute per-element rank via sort: argsort per column with a composite key
+    (group, -value) and positional counting.
+    """
+    P, T = values.shape
+    neg = jnp.where(jnp.isnan(values), -jnp.inf if not bottom else jnp.inf, values)
+    sortval = -neg if not bottom else neg
+    # composite sort: primary group, secondary value
+    order = jnp.lexsort((sortval, group_ids[:, None] * jnp.ones((1, T), jnp.int32)), axis=0)
+    # rank within group: position since the group's first row in sorted order
+    g_sorted = jnp.take_along_axis(group_ids[:, None] * jnp.ones((1, T), jnp.int32), order, axis=0)
+    idx = jnp.arange(P)[:, None] * jnp.ones((1, T), jnp.int32)
+    # first occurrence index of each group per column
+    is_first = jnp.concatenate([jnp.ones((1, T), bool), g_sorted[1:] != g_sorted[:-1]], axis=0)
+    first_pos = jnp.where(is_first, idx, 0)
+    first_pos = jax.lax.associative_scan(jnp.maximum, first_pos, axis=0)
+    rank_sorted = idx - first_pos
+    # scatter ranks back to original row positions
+    rank = _scatter_rows(rank_sorted, order, P)
+    present = ~jnp.isnan(values)
+    return (rank < k) & present
+
+
+def _scatter_rows(src, order, P):
+    """out[order[i, t], t] = src[i, t]."""
+    T = src.shape[1]
+    cols = jnp.broadcast_to(jnp.arange(T)[None, :], src.shape)
+    out = jnp.zeros_like(src)
+    return out.at[order.reshape(-1), cols.reshape(-1)].set(src.reshape(-1))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def group_quantile(values, group_ids, num_groups: int, q):
+    """Cross-series quantile per group per step (ref: QuantileRowAggregator uses
+    t-digest; we compute the exact quantile — a strictly better answer the TPU
+    can afford because the whole matrix is resident).
+
+    Sort rows by (group, value) per column, then linearly interpolate at rank
+    q*(k-1) inside each group's contiguous run.
+    """
+    P, T = values.shape
+    big = jnp.where(jnp.isnan(values), jnp.inf, values)
+    gcol = group_ids[:, None] * jnp.ones((1, T), jnp.int32)
+    order = jnp.lexsort((big, gcol), axis=0)
+    v_sorted = jnp.take_along_axis(big, order, axis=0)
+    present = ~jnp.isnan(values)
+    cnt = jax.ops.segment_sum(present.astype(jnp.int32), group_ids, num_groups)  # [G, T]
+    # start position of each group's run per column = cumulative counts of all rows
+    # (incl. missing, which sort to +inf *within the group run*) — compute from
+    # total group sizes instead
+    gsize = jax.ops.segment_sum(jnp.ones_like(group_ids, jnp.int32), group_ids, num_groups)
+    gstart = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(gsize)[:-1]])  # [G]
+    rank = q * jnp.maximum(cnt.astype(jnp.float64) - 1.0, 0.0)                   # [G, T]
+    lo = jnp.floor(rank).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, jnp.maximum(cnt - 1, 0))
+    frac = rank - lo
+
+    def take_rank(r):  # r: [G, T] rank within group -> gather from v_sorted
+        pos = jnp.clip(gstart[:, None] + r, 0, P - 1)               # [G, T]
+        return jnp.take_along_axis(v_sorted, pos, axis=0)
+
+    v_lo = take_rank(lo)
+    v_hi = take_rank(hi)
+    res = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(cnt == 0, jnp.nan, res)

@@ -1,0 +1,93 @@
+"""Binary operators (scalar-vector and vector-vector element math).
+
+Reference: query/.../exec/binaryOp/BinaryOperatorFunction.scala (math + comparison
+incl. _bool variants), exec/ScalarOperationMapper.scala.
+
+Prometheus semantics: comparison ops without ``bool`` act as filters — failing
+elements disappear (represented here as NaN in the [P, T] matrix, dropped by the
+presenter); with ``bool`` they yield 1.0/0.0. ``%`` is fmod, ``^`` is pow.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+MATH_OPS = {"+", "-", "*", "/", "%", "^"}
+COMPARISON_OPS = {"==", "!=", "<", "<=", ">", ">="}
+
+
+def _math(op, a, b):
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        return jnp.fmod(a, b) if not isinstance(a, float) or not isinstance(b, float) else math.fmod(a, b)
+    if op == "^":
+        return a ** b
+    raise ValueError(op)
+
+
+def _compare(op, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ValueError(op)
+
+
+def scalar_binop(op: str, a: float, b: float, bool_modifier: bool = False) -> float:
+    """Pure-scalar fold (both operands literal)."""
+    op = op.removesuffix("_bool")
+    if op in MATH_OPS:
+        if op == "%":
+            return math.fmod(a, b) if b != 0 else math.nan
+        if op == "/" and b == 0:
+            return math.inf if a > 0 else (-math.inf if a < 0 else math.nan)
+        return float(_math(op, a, b))
+    ok = _compare(op, a, b)
+    if bool_modifier:
+        return 1.0 if ok else 0.0
+    # scalar comparisons without bool are only legal via filter semantics
+    return a if ok else math.nan
+
+
+def apply_scalar_op(op: str, scalar: float, values, scalar_is_lhs: bool):
+    """values: [P, T] matrix; returns same shape. NaN propagates (missing stays missing)."""
+    bool_mod = op.endswith("_bool")
+    op = op.removesuffix("_bool")
+    a, b = (scalar, values) if scalar_is_lhs else (values, scalar)
+    if op in MATH_OPS:
+        return _math(op, a, b).astype(values.dtype)
+    ok = _compare(op, a, b)
+    if bool_mod:
+        return jnp.where(jnp.isnan(values), jnp.nan, jnp.where(ok, 1.0, 0.0))
+    return jnp.where(ok, values, jnp.nan)
+
+
+def apply_vector_op(op: str, lhs, rhs):
+    """Aligned [P, T] matrices (join alignment done by the exec layer).
+    Comparison keeps the LHS value where true (Prometheus filter semantics)."""
+    bool_mod = op.endswith("_bool")
+    op = op.removesuffix("_bool")
+    if op in MATH_OPS:
+        return _math(op, lhs, rhs)
+    ok = _compare(op, lhs, rhs)
+    if bool_mod:
+        missing = jnp.isnan(lhs) | jnp.isnan(rhs)
+        return jnp.where(missing, jnp.nan, jnp.where(ok, 1.0, 0.0))
+    return jnp.where(ok, lhs, jnp.nan)

@@ -1,0 +1,90 @@
+"""Instant functions applied element-wise to [P, T] matrices.
+
+Reference: query/.../exec/rangefn/InstantFunction.scala (abs..year; date functions
+interpret the sample value as epoch *seconds*, matching Prometheus).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _civil_from_days(z):
+    """days since epoch -> (year, month [1-12], day [1-31]); Howard Hinnant's
+    civil_from_days algorithm in integer arithmetic (jit-friendly)."""
+    z = z + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _ymd(values):
+    secs = values.astype(jnp.int64)
+    days = jnp.floor_divide(secs, 86400)
+    return _civil_from_days(days), secs
+
+
+def days_in_month(y, m):
+    feb = jnp.where((y % 4 == 0) & ((y % 100 != 0) | (y % 400 == 0)), 29, 28)
+    lengths = jnp.array([31, 0, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+    return jnp.where(m == 2, feb, lengths[m - 1])
+
+
+def apply(fn: str, values, args: tuple[float, ...] = ()):
+    """values: [P, T] float64 (NaN = missing, propagates through every fn)."""
+    nanmask = jnp.isnan(values)
+
+    def keep_nan(r):
+        return jnp.where(nanmask, jnp.nan, r.astype(jnp.float64))
+
+    if fn == "abs":
+        return jnp.abs(values)
+    if fn == "ceil":
+        return jnp.ceil(values)
+    if fn == "floor":
+        return jnp.floor(values)
+    if fn == "exp":
+        return jnp.exp(values)
+    if fn == "ln":
+        return jnp.log(values)
+    if fn == "log10":
+        return jnp.log10(values)
+    if fn == "log2":
+        return jnp.log2(values)
+    if fn == "sqrt":
+        return jnp.sqrt(values)
+    if fn == "round":
+        nearest = args[0] if args else 1.0
+        # Prometheus: floor(v/nearest + 0.5) * nearest (round half up)
+        return jnp.floor(values / nearest + 0.5) * nearest
+    if fn == "clamp_max":
+        return jnp.minimum(values, args[0])
+    if fn == "clamp_min":
+        return jnp.maximum(values, args[0])
+    if fn in ("days_in_month", "day_of_month", "day_of_week", "hour", "minute",
+              "month", "year"):
+        vals = jnp.where(nanmask, 0.0, values)
+        (y, m, d), secs = _ymd(vals)
+        if fn == "year":
+            return keep_nan(y)
+        if fn == "month":
+            return keep_nan(m)
+        if fn == "day_of_month":
+            return keep_nan(d)
+        if fn == "day_of_week":
+            days = jnp.floor_divide(secs, 86400)
+            return keep_nan((days + 4) % 7)  # 1970-01-01 was a Thursday
+        if fn == "hour":
+            return keep_nan((secs % 86400) // 3600)
+        if fn == "minute":
+            return keep_nan((secs % 3600) // 60)
+        if fn == "days_in_month":
+            return keep_nan(days_in_month(y, m))
+    raise ValueError(f"unknown instant function {fn}")

@@ -1,0 +1,145 @@
+"""Window-edge machinery for range functions — the TPU replacement for the
+reference's per-row window iterators.
+
+Reference: query/.../exec/PeriodicSamplesMapper.scala (ChunkedWindowIterator walks
+chunks sample-by-sample per window; SlidingWindowIterator keeps an add/remove
+queue). On TPU the same computation is data-parallel: for S series and T output
+steps we locate all S*T window edges with a vmapped binary search (O(log C) each),
+then answer window reductions from precomputed prefix sums (sum/count/stddev/
+regression) or two-level block aggregates (min/max) — no per-sample iteration.
+
+Conventions:
+  - ``ts``  int64 [P, C] sorted per row, padded with TS_PAD (greater than any real ts)
+  - ``val`` float  [P, C] value column; entries beyond the row's count are garbage
+    and must be masked via ``valid``
+  - a window for output step t covers sample timestamps in [t - window_ms, t]
+    (closed range — Prometheus 2.x era semantics, matching the reference)
+  - ``left``/``right`` [P, T] index the half-open sample range [left, right)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.chunkstore import TS_PAD  # noqa: F401  (re-exported for kernels)
+
+
+def valid_mask(ts, n):
+    """[P, C] bool: which sample slots hold real data."""
+    C = ts.shape[1]
+    return jnp.arange(C)[None, :] < n[:, None]
+
+
+def window_edges(ts, out_ts, window_ms):
+    """Return (left, right) [P, T] half-open sample index ranges per output step."""
+    def row_edges(row):
+        right = jnp.searchsorted(row, out_ts, side="right")
+        left = jnp.searchsorted(row, out_ts - window_ms, side="left")
+        return left, right
+    left, right = jax.vmap(row_edges)(ts)
+    return left, right
+
+
+def take(arr, idx):
+    """Gather arr[p, idx[p, t]] -> [P, T] (idx clipped to valid range)."""
+    return jnp.take_along_axis(arr, jnp.clip(idx, 0, arr.shape[1] - 1), axis=1)
+
+
+def prefix_sum(x, valid, dtype=jnp.float64):
+    """Exclusive prefix sums: out[:, j] = sum(x[:, :j]); shape [P, C+1]."""
+    xz = jnp.where(valid, x, 0).astype(dtype)
+    cs = jnp.cumsum(xz, axis=1)
+    zero = jnp.zeros((x.shape[0], 1), dtype)
+    return jnp.concatenate([zero, cs], axis=1)
+
+
+def window_sum(pfx, left, right):
+    """Sum over [left, right) from an exclusive prefix-sum table."""
+    return take(pfx, right) - take(pfx, left)
+
+
+def counter_correct(val, valid, dtype=jnp.float64):
+    """Apply cumulative counter-reset correction along the time axis.
+
+    Reference: chunk drop metadata on ChunkSetInfo + CounterVectorReader
+    (DoubleVector.scala) feed corrections into rate; here the correction prefix
+    is recomputed on device: corr[j] = sum of drops (prev - cur when cur < prev)
+    up to j, so corrected values are monotonic and window deltas are exact.
+    """
+    v = jnp.where(valid, val, 0).astype(dtype)
+    prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+    pair_valid = valid & jnp.concatenate([jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    drop = jnp.where(pair_valid, jnp.maximum(prev - v, 0), 0)
+    return v + jnp.cumsum(drop, axis=1)
+
+
+# ---- two-level block aggregates for min/max ---------------------------------
+
+def block_agg(val, valid, block: int, op: str):
+    """Per-block aggregates [P, C // block] (C must be a multiple of block)."""
+    P, C = val.shape
+    nb = C // block
+    neutral = jnp.inf if op == "min" else -jnp.inf
+    v = jnp.where(valid, val, neutral).reshape(P, nb, block)
+    return (jnp.min if op == "min" else jnp.max)(v, axis=2)
+
+
+def window_minmax(val, valid, left, right, op: str, block: int = 32):
+    """Min/max over [left, right) via edge gathers + full-block reduce.
+
+    Work per output step is 2*block + C/block elements — O(sqrt C)-ish instead of
+    O(window) — and every access is a static-shape gather XLA can fuse.
+    """
+    P, C = val.shape
+    nb = C // block
+    neutral = jnp.inf if op == "min" else -jnp.inf
+    red = jnp.minimum if op == "min" else jnp.maximum
+    blocks = block_agg(val, valid, block, op)            # [P, NB]
+
+    lb = -(-left // block)      # first full block  = ceil(l / B)
+    rb = right // block         # end of full blocks = floor(r / B)
+
+    # full blocks in [lb, rb)
+    bidx = jnp.arange(nb)[None, None, :]                          # [1, 1, NB]
+    bmask = (bidx >= lb[:, :, None]) & (bidx < rb[:, :, None])
+    full = jnp.where(bmask, blocks[:, None, :], neutral)
+    acc = (jnp.min if op == "min" else jnp.max)(full, axis=2)      # [P, T]
+
+    vv = jnp.where(valid, val, neutral)
+    off = jnp.arange(block)[None, None, :]                         # [1, 1, B]
+
+    # left partial edge: [l, min(lb*B, r))
+    le_end = jnp.minimum(lb * block, right)
+    li = left[:, :, None] + off
+    lmask = li < le_end[:, :, None]
+    lgather = _gather3(vv, li, C)
+    lpart = (jnp.min if op == "min" else jnp.max)(jnp.where(lmask, lgather, neutral), axis=2)
+
+    # right partial edge: [max(rb*B, l), r)
+    re_start = jnp.maximum(rb * block, left)
+    ri = re_start[:, :, None] + off
+    rmask = ri < right[:, :, None]
+    rgather = _gather3(vv, ri, C)
+    rpart = (jnp.min if op == "min" else jnp.max)(jnp.where(rmask, rgather, neutral), axis=2)
+
+    return red(red(acc, lpart), rpart)
+
+
+def _gather3(vv, idx, C):
+    """vv [P, C], idx [P, T, B] -> [P, T, B]."""
+    P, T, B = idx.shape
+    flat = jnp.clip(idx, 0, C - 1).reshape(P, T * B)
+    return jnp.take_along_axis(vv, flat, axis=1).reshape(P, T, B)
+
+
+def gather_windows(ts, val, valid, left, right, w_cap: int, fill=jnp.nan):
+    """Materialize up to ``w_cap`` window samples per step: values [P, T, W] with
+    ``fill`` beyond the window. Used by order-statistics / sequential functions
+    (quantile_over_time, holt_winters) where no prefix structure applies."""
+    P, C = val.shape
+    off = jnp.arange(w_cap)[None, None, :]
+    idx = left[:, :, None] + off
+    mask = idx < right[:, :, None]
+    vals = _gather3(jnp.where(valid, val, fill), idx, C)
+    return jnp.where(mask, vals, fill), mask

@@ -1,0 +1,142 @@
+"""LogicalPlan ADT — the language-independent query tree.
+
+Reference: query/src/main/scala/filodb/query/LogicalPlan.scala:5-169 (RawSeries,
+PeriodicSeries(WithWindowing), Aggregate, BinaryJoin, ScalarVectorBinaryOperation,
+ApplyInstantFunction, ApplyMiscellaneousFunction, ApplySortFunction, metadata plans).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..core.filters import Filter
+
+
+@dataclass(frozen=True)
+class LogicalPlan:
+    pass
+
+
+@dataclass(frozen=True)
+class RawSeriesLikePlan(LogicalPlan):
+    pass
+
+
+@dataclass(frozen=True)
+class PeriodicSeriesPlan(LogicalPlan):
+    """Plans that result in a time series with regular steps."""
+    pass
+
+
+@dataclass(frozen=True)
+class IntervalSelector:
+    """[from, to] in epoch ms (ref: LogicalPlan.scala RangeSelector)."""
+    from_ms: int
+    to_ms: int
+
+
+@dataclass(frozen=True)
+class RawSeries(RawSeriesLikePlan):
+    range_selector: IntervalSelector
+    filters: tuple[Filter, ...]
+    columns: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RawChunkMeta(PeriodicSeriesPlan):
+    """Chunk metadata debug plan (ref: LogicalPlan.scala RawChunkMeta)."""
+    range_selector: IntervalSelector
+    filters: tuple[Filter, ...]
+    column: str = ""
+
+
+@dataclass(frozen=True)
+class PeriodicSeries(PeriodicSeriesPlan):
+    """Instant selector evaluated at regular steps (last sample per step)."""
+    raw_series: RawSeries
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclass(frozen=True)
+class PeriodicSeriesWithWindowing(PeriodicSeriesPlan):
+    """Range function over a window at regular steps."""
+    series: RawSeries
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: int
+    function: str                      # range function name
+    function_args: tuple[float, ...] = ()
+
+
+@dataclass(frozen=True)
+class Aggregate(PeriodicSeriesPlan):
+    operator: str                      # sum/min/max/avg/count/stddev/stdvar/topk/bottomk/count_values/quantile
+    vectors: PeriodicSeriesPlan
+    params: tuple = ()
+    by: tuple[str, ...] = ()
+    without: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class BinaryJoin(PeriodicSeriesPlan):
+    lhs: PeriodicSeriesPlan
+    operator: str
+    cardinality: str                   # OneToOne/OneToMany/ManyToOne/ManyToMany
+    rhs: PeriodicSeriesPlan
+    on: tuple[str, ...] = ()
+    ignoring: tuple[str, ...] = ()
+    include: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ScalarVectorBinaryOperation(PeriodicSeriesPlan):
+    operator: str
+    scalar: float
+    vector: PeriodicSeriesPlan
+    scalar_is_lhs: bool = False
+
+
+@dataclass(frozen=True)
+class ApplyInstantFunction(PeriodicSeriesPlan):
+    vectors: PeriodicSeriesPlan
+    function: str
+    function_args: tuple[float, ...] = ()
+
+
+@dataclass(frozen=True)
+class ApplyMiscellaneousFunction(PeriodicSeriesPlan):
+    vectors: PeriodicSeriesPlan
+    function: str                      # label_replace/label_join/timestamp
+    string_args: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ApplySortFunction(PeriodicSeriesPlan):
+    vectors: PeriodicSeriesPlan
+    function: str                      # sort/sort_desc
+
+
+@dataclass(frozen=True)
+class ScalarPlan(PeriodicSeriesPlan):
+    """A literal scalar expression evaluated at each step."""
+    value: float
+
+
+# ---- metadata plans ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class LabelValues(LogicalPlan):
+    label_names: tuple[str, ...]
+    label_constraints: tuple[tuple[str, str], ...] = ()
+    lookback_ms: int = 0
+
+
+@dataclass(frozen=True)
+class SeriesKeysByFilters(LogicalPlan):
+    filters: tuple[Filter, ...]
+    start_ms: int = 0
+    end_ms: int = 1 << 62

@@ -1,18 +1,28 @@
 """Test harness: force an 8-device virtual CPU mesh so sharding/collective paths are
 exercised without TPU hardware (ref test strategy: akka-multi-node-testkit runs multi-node
-behavior in one process — coordinator/src/multi-jvm/)."""
+behavior in one process — coordinator/src/multi-jvm/).
+
+NOTE: this environment pre-imports jax via a sitecustomize (PYTHONPATH=.axon_site)
+and pre-sets JAX_PLATFORMS=axon (a remote TPU tunnel). Env vars are therefore too
+late here — we must flip the jax *config* before the first backend initialization.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU mesh"
+assert len(jax.devices()) == 8, "expected an 8-device virtual CPU mesh"
 
 
 @pytest.fixture(scope="session")

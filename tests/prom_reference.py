@@ -1,0 +1,128 @@
+"""Naive pure-Python golden model of Prometheus/FiloDB range-function semantics.
+
+Used to verify the vectorized device kernels. Implements the same math as the
+reference's rangefn suite (RateFunctions.scala extrapolatedRate etc.) one window
+at a time, the slow obvious way.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def window_samples(ts, vals, t, window_ms):
+    """[t - window, t] samples (closed range, Prometheus 2.x era)."""
+    sel = (ts >= t - window_ms) & (ts <= t)
+    return ts[sel], vals[sel]
+
+
+def counter_corrected(vals):
+    out = np.array(vals, dtype=np.float64)
+    corr = 0.0
+    for i in range(1, len(out)):
+        if vals[i] < vals[i - 1]:
+            corr += vals[i - 1] - vals[i]
+        out[i] = vals[i] + corr
+    return out
+
+
+def extrapolated_rate(wstart, wend, wts, wvals, is_counter, is_rate):
+    if len(wts) < 2:
+        return math.nan
+    v = counter_corrected(wvals) if is_counter else np.asarray(wvals, np.float64)
+    dur_start = (wts[0] - wstart) / 1000.0
+    dur_end = (wend - wts[-1]) / 1000.0
+    sampled = (wts[-1] - wts[0]) / 1000.0
+    avg = sampled / (len(wts) - 1)
+    delta = v[-1] - v[0]
+    if is_counter and delta > 0 and v[0] >= 0:
+        dur_zero = sampled * (v[0] / delta)
+        if dur_zero < dur_start:
+            dur_start = dur_zero
+    thresh = avg * 1.1
+    extrap = sampled
+    extrap += dur_start if dur_start < thresh else avg / 2
+    extrap += dur_end if dur_end < thresh else avg / 2
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        scaled /= (wend - wstart) / 1000.0
+    return scaled
+
+
+def eval_range_fn(fn, ts, vals, out_ts, window_ms, arg0=0.0, arg1=0.0):
+    """Evaluate fn for one series at every output step; NaN when undefined."""
+    res = np.full(len(out_ts), math.nan)
+    for i, t in enumerate(out_ts):
+        wts, wv = window_samples(ts, vals, t, window_ms)
+        n = len(wts)
+        if fn in ("rate", "increase", "delta"):
+            res[i] = extrapolated_rate(t - window_ms, t, wts, wv,
+                                       fn != "delta", fn == "rate")
+        elif fn in ("irate", "idelta"):
+            if n >= 2:
+                dv = wv[-1] - wv[-2]
+                if fn == "irate":
+                    if wv[-1] < wv[-2]:
+                        dv = wv[-1]
+                    res[i] = dv / ((wts[-1] - wts[-2]) / 1000.0)
+                else:
+                    res[i] = dv
+        elif n == 0:
+            continue
+        elif fn == "sum_over_time":
+            res[i] = wv.sum()
+        elif fn == "count_over_time":
+            res[i] = n
+        elif fn == "avg_over_time":
+            res[i] = wv.mean()
+        elif fn == "min_over_time":
+            res[i] = wv.min()
+        elif fn == "max_over_time":
+            res[i] = wv.max()
+        elif fn == "stddev_over_time":
+            res[i] = wv.std()
+        elif fn == "stdvar_over_time":
+            res[i] = wv.var()
+        elif fn == "last_over_time":
+            res[i] = wv[-1]
+        elif fn == "changes":
+            c = 0
+            for j in range(1, n):
+                if wv[j] != wv[j - 1]:
+                    c += 1
+            res[i] = c
+        elif fn == "resets":
+            c = 0
+            for j in range(1, n):
+                if wv[j] < wv[j - 1]:
+                    c += 1
+            res[i] = c
+        elif fn in ("deriv", "predict_linear"):
+            if n >= 2:
+                t_rel = (wts - wts[0]) / 1000.0
+                slope, intercept = np.polyfit(t_rel, wv, 1)
+                if fn == "deriv":
+                    res[i] = slope
+                else:
+                    res[i] = intercept + slope * ((t - wts[0]) / 1000.0 + arg0)
+        elif fn == "quantile_over_time":
+            q = arg0
+            sv = np.sort(wv)
+            rank = q * (n - 1)
+            lo = int(math.floor(rank))
+            hi = min(lo + 1, n - 1)
+            res[i] = sv[lo] + (sv[hi] - sv[lo]) * (rank - lo)
+        elif fn == "holt_winters":
+            if n >= 2:
+                sf, tf = arg0, arg1
+                s, b = wv[0], wv[1] - wv[0]
+                for j in range(1, n):
+                    s_new = sf * wv[j] + (1 - sf) * (s + b)
+                    b = tf * (s_new - s) + (1 - tf) * b
+                    s = s_new
+                res[i] = s
+        else:
+            raise ValueError(fn)
+    return res
