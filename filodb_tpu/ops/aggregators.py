@@ -81,6 +81,7 @@ def present_partials(op: str, parts: dict):
     """Present phase: partial state -> final [G, T] values (NaN where empty)."""
     cnt = parts["count"]
     empty = cnt == 0
+    cnt = jnp.where(empty, 1.0, cnt)  # avoid 0/0 noise; result masked below
     if op == "count":
         return jnp.where(empty, jnp.nan, cnt)
     if op == "group":
@@ -101,7 +102,7 @@ def present_partials(op: str, parts: dict):
     raise ValueError(op)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def topk_mask(values, group_ids, num_groups: int, k: int, bottom: bool = False):
     """Per-step top-k filter: True where values[p, t] is among the k largest
     (smallest for bottomk) present values of its group at step t.

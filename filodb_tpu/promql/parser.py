@@ -184,7 +184,8 @@ class Parser:
 
     def next(self) -> Token:
         t = self.tokens[self.i]
-        self.i += 1
+        if self.i < len(self.tokens) - 1:  # stay on EOF once reached
+            self.i += 1
         return t
 
     def expect(self, text: str) -> Token:
@@ -274,6 +275,8 @@ class Parser:
 
     def parse_primary(self) -> Expr:
         t = self.next()
+        if t.kind == "EOF":
+            raise ParseError("unexpected end of query")
         if t.text == "(":
             e = self.parse_expr(0)
             self.expect(")")
@@ -441,7 +444,7 @@ def _scalar_value(e: Expr) -> float:
 
 def _lower(e: Expr, p: QueryParams) -> L.LogicalPlan:
     if isinstance(e, NumberLit):
-        return L.ScalarPlan(e.value)
+        return L.ScalarPlan(e.value, p.start_ms, p.step_ms, p.end_ms)
     if isinstance(e, VectorSelector):
         return _lower_vector(e, p)
     if isinstance(e, UnaryExpr):
@@ -500,21 +503,23 @@ def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
 
 
 def _lower_binary(e: BinaryExpr, p: QueryParams) -> L.LogicalPlan:
-    lhs_scalar = isinstance(e.lhs, NumberLit)
-    rhs_scalar = isinstance(e.rhs, NumberLit)
+    lhs = _lower(e.lhs, p)
+    rhs = _lower(e.rhs, p)
+    lhs_scalar = isinstance(lhs, L.ScalarPlan)
+    rhs_scalar = isinstance(rhs, L.ScalarPlan)
     op = e.op + ("_bool" if e.bool_modifier else "")
     if lhs_scalar and rhs_scalar:
         from ..ops.binop import scalar_binop
-        return L.ScalarPlan(scalar_binop(e.op, e.lhs.value, e.rhs.value, e.bool_modifier))
+        return L.ScalarPlan(scalar_binop(e.op, lhs.value, rhs.value, e.bool_modifier),
+                            p.start_ms, p.step_ms, p.end_ms)
     if lhs_scalar or rhs_scalar:
         if e.op in _SET_OPS:
             raise ParseError(f"set operator {e.op} not allowed with scalar")
-        scalar = e.lhs.value if lhs_scalar else e.rhs.value
-        vector = _lower(e.rhs if lhs_scalar else e.lhs, p)
+        scalar = lhs.value if lhs_scalar else rhs.value
+        vector = rhs if lhs_scalar else lhs
         return L.ScalarVectorBinaryOperation(op, scalar, vector, scalar_is_lhs=lhs_scalar)
     card = "OneToOne" if not (e.group_left or e.group_right) else (
         "ManyToOne" if e.group_left else "OneToMany")
     if e.op in _SET_OPS:
         card = "ManyToMany"
-    return L.BinaryJoin(_lower(e.lhs, p), op, card, _lower(e.rhs, p),
-                        e.on, e.ignoring, e.include)
+    return L.BinaryJoin(lhs, op, card, rhs, e.on, e.ignoring, e.include)

@@ -1,0 +1,84 @@
+"""QueryEngine facade: PromQL text -> LogicalPlan -> ExecPlan -> QueryResult.
+
+Reference: coordinator/.../QueryActor.scala (processLogicalPlan2Query) +
+queryengine2/QueryEngine.materialize — minus the actor layer: dispatch here is a
+direct call; the mesh executor (parallel/) plugs in underneath the same API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..core.memstore import TimeSeriesMemStore
+from ..parallel.shardmapper import ShardMapper
+from ..promql import parser as promql
+from . import logical as L
+from .exec import QueryContext
+from .planner import QueryPlanner
+from .rangevector import QueryResult
+
+
+@dataclass
+class QueryConfig:
+    """Ref: query/.../QueryConfig.scala (stale-sample-after, sample limits)."""
+    stale_sample_after_ms: int = 5 * 60 * 1000
+    sample_limit: int = 1_000_000
+
+
+class QueryEngine:
+    def __init__(self, memstore: TimeSeriesMemStore, dataset: str,
+                 shard_mapper: ShardMapper | None = None,
+                 config: QueryConfig = QueryConfig()):
+        self.memstore = memstore
+        self.dataset = dataset
+        num_shards = max(len(memstore.shards_of(dataset)), 1)
+        pow2 = 1
+        while pow2 < num_shards:
+            pow2 *= 2
+        self.mapper = shard_mapper or ShardMapper(pow2)
+        self.config = config
+        schema = memstore._dataset_schema.get(dataset)
+        opts = schema.options if schema else None
+        self.planner = QueryPlanner(self.mapper, opts) if opts else QueryPlanner(self.mapper)
+
+    def _ctx(self) -> QueryContext:
+        return QueryContext(self.memstore, self.dataset,
+                            sample_limit=self.config.sample_limit,
+                            stale_ms=self.config.stale_sample_after_ms)
+
+    def query_range(self, promql_text: str, start_ms: int, end_ms: int,
+                    step_ms: int) -> QueryResult:
+        plan = promql.query_to_logical_plan(promql_text, start_ms, end_ms, step_ms)
+        return self.exec_logical(plan)
+
+    def query_instant(self, promql_text: str, time_ms: int) -> QueryResult:
+        plan = promql.query_to_logical_plan(promql_text, time_ms, time_ms, 1)
+        res = self.exec_logical(plan)
+        res.result_type = "vector"
+        return res
+
+    def exec_logical(self, plan: L.LogicalPlan) -> QueryResult:
+        exec_plan = self.planner.materialize(plan)
+        return exec_plan.run(self._ctx())
+
+    # -- metadata queries (ref: QueryActor label-values / series paths) -------
+
+    def label_values(self, label: str, filters=None, top_k=None) -> list[str]:
+        vals: dict[str, None] = {}
+        for shard in self.memstore.shards_of(self.dataset):
+            for v in shard.label_values(label, filters, top_k=top_k):
+                vals[v] = None
+        return sorted(vals)
+
+    def label_names(self, filters=None) -> list[str]:
+        names: set[str] = set()
+        for shard in self.memstore.shards_of(self.dataset):
+            names.update(shard.label_names(filters))
+        return sorted(names)
+
+    def series(self, filters, start_ms: int, end_ms: int) -> list[dict[str, str]]:
+        out = []
+        for shard in self.memstore.shards_of(self.dataset):
+            pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
+            out.extend(shard.index.labels_of(int(p)) for p in pids)
+        return out

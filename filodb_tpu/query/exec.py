@@ -1,0 +1,638 @@
+"""ExecPlan tree + RangeVectorTransformers: the physical query execution layer.
+
+Reference: query/.../exec/ExecPlan.scala:36 (execute = doExecute + transformer
+chain + limits), SelectRawPartitionsExec.scala (the only data-reading leaf),
+DistConcatExec / ReduceAggregateExec / BinaryJoinExec / SetOperatorExec,
+RangeVectorTransformer.scala:27 (PeriodicSamplesMapper, ScalarOperationMapper,
+InstantVectorFunctionMapper, AggregateMapReduce/Presenter, sort & misc mappers).
+
+TPU-native execution shape:
+  - The leaf resolves part ids host-side (index), then hands the *device store
+    arrays* to the kernel chain. Narrow selections gather rows; wide selections
+    (the 1M-series aggregation case) skip the gather entirely — the range kernel
+    runs over the full [S, C] store and rows outside the selection are disabled
+    via a zeroed sample count (their outputs are NaN and aggregation ignores
+    them). No per-series dispatch anywhere.
+  - Aggregation = host-computed dense group ids + one segment reduce on device.
+  - Scatter-gather across shards is in-process here; parallel/ runs the same
+    plan shape over a jax Mesh with psum (multi-chip) — same partial format.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.filters import Filter
+from ..ops import aggregators, binop, instantfns, rangefns
+from .rangevector import QueryError, QueryResult, RangeVectorKey, ResultMatrix
+
+DEFAULT_SAMPLE_LIMIT = 1_000_000
+GATHER_THRESHOLD = 8192      # selections narrower than this gather rows up front
+
+
+@dataclass
+class QueryContext:
+    memstore: object
+    dataset: str
+    sample_limit: int = DEFAULT_SAMPLE_LIMIT
+    stale_ms: int = 5 * 60 * 1000
+
+
+@dataclass
+class SeriesSelection:
+    """Leaf output: device store arrays + which rows are selected.
+
+    ``rows is None`` => arrays are already compacted to the selection (P rows).
+    Otherwise arrays cover the full store [S, C] and ``rows``/``n`` encode the
+    selection (n is zeroed outside it).
+    """
+    ts: object                # [R, C] int64
+    val: object               # [R, C] float
+    n: object                 # [R] int32 (0 => row disabled)
+    keys: list[RangeVectorKey]
+    rows: np.ndarray | None   # int32 [P] store-row of each key, or None
+
+
+@dataclass
+class MatrixView:
+    """Post-kernel matrix that may still be un-compacted (R >= P rows)."""
+    out_ts: np.ndarray
+    values: object            # [R, T]
+    keys: list[RangeVectorKey]
+    rows: np.ndarray | None
+
+    def compact(self) -> ResultMatrix:
+        vals = self.values
+        if self.rows is not None:
+            vals = jnp.take(vals, jnp.asarray(self.rows), axis=0)
+        return ResultMatrix(self.out_ts, vals, self.keys)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Transformers (ref: RangeVectorTransformer)
+# ---------------------------------------------------------------------------
+
+class Transformer:
+    def apply(self, data, ctx: QueryContext):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+@dataclass
+class PeriodicSamplesMapper(Transformer):
+    """Range/instant function evaluation (ref: PeriodicSamplesMapper.scala:23)."""
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: int | None     # None => instant selector (staleness lookback)
+    function: str | None      # None => last_sample
+    args: tuple = ()
+
+    def out_ts(self, ctx) -> np.ndarray:
+        step = max(self.step_ms, 1)
+        return np.arange(self.start_ms, self.end_ms + 1, step, dtype=np.int64)
+
+    def apply(self, data, ctx: QueryContext):
+        assert isinstance(data, SeriesSelection), "PSM must sit directly on a leaf"
+        out_ts = self.out_ts(ctx)
+        fn = self.function or "last_sample"
+        if fn == "last_sample":
+            window = ctx.stale_ms
+            args = (float(ctx.stale_ms),)
+        else:
+            window = self.window_ms
+            args = tuple(float(a) for a in self.args)
+        a0 = args[0] if len(args) > 0 else 0.0
+        a1 = args[1] if len(args) > 1 else 0.0
+        vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_ts,
+                                         window, fn, a0, a1)
+        return MatrixView(out_ts, vals, data.keys, data.rows)
+
+
+@dataclass
+class InstantVectorFunctionMapper(Transformer):
+    function: str
+    args: tuple = ()
+
+    def apply(self, data, ctx):
+        m = _as_matrix(data)
+        if self.function == "absent":
+            vals = np.asarray(m.values)
+            empty = np.isnan(vals).all(axis=0) if len(m.keys) else np.ones(len(m.out_ts), bool)
+            out = np.where(empty, 1.0, np.nan)[None, :]
+            return ResultMatrix(m.out_ts, out, [RangeVectorKey(())])
+        return ResultMatrix(m.out_ts, instantfns.apply(self.function, m.values, self.args),
+                            m.keys)
+
+
+@dataclass
+class ScalarOperationMapper(Transformer):
+    operator: str
+    scalar: float
+    scalar_is_lhs: bool = False
+
+    def apply(self, data, ctx):
+        m = _as_matrix(data)
+        vals = binop.apply_scalar_op(self.operator, self.scalar, m.values,
+                                     self.scalar_is_lhs)
+        keys = m.keys
+        op = self.operator.removesuffix("_bool")
+        if op in binop.MATH_OPS or self.operator.endswith("_bool"):
+            keys = [k.without(("_metric_",)) for k in keys]
+        return ResultMatrix(m.out_ts, vals, keys)
+
+
+def group_keys_of(keys, by, without):
+    """Aggregation group key per series (metric label always dropped —
+    Prometheus aggregation semantics; ref AggrOverRangeVectors map phase)."""
+    out = []
+    for k in keys:
+        k = k.without(("_metric_",))
+        if by:
+            out.append(k.only(by))
+        elif without:
+            out.append(k.without(without))
+        else:
+            out.append(RangeVectorKey(()))
+    return out
+
+
+@dataclass
+class AggregateMapReduce(Transformer):
+    """Map phase: matrix -> per-group partial state (ref: AggregateMapReduce)."""
+    operator: str
+    params: tuple = ()
+    by: tuple = ()
+    without: tuple = ()
+
+    def apply(self, data, ctx):
+        if self.operator in ("topk", "bottomk", "quantile", "count_values"):
+            # order-statistics aggregators reduce on full matrices at the
+            # reduce node (exact; candidate pruning is a later optimization)
+            return _as_matrix(data)
+        if isinstance(data, MatrixView):
+            m = data
+        else:
+            m = _as_matrix(data)
+            m = MatrixView(m.out_ts, m.values, m.keys, None)
+        gkeys = group_keys_of(m.keys, self.by, self.without)
+        uniq: dict[RangeVectorKey, int] = {}
+        gid_of_key = np.empty(len(gkeys), np.int32)
+        for i, gk in enumerate(gkeys):
+            gid_of_key[i] = uniq.setdefault(gk, len(uniq))
+        G = max(len(uniq), 1)
+        R = m.values.shape[0]
+        if m.rows is None:
+            gids = gid_of_key
+        else:
+            # un-compacted matrix: scatter group ids to store rows; rows outside
+            # the selection keep group 0 — harmless, their values are all-NaN
+            gids = np.zeros(R, np.int32)
+            gids[m.rows] = gid_of_key
+        parts = _segment_partial(self.operator, m.values, jnp.asarray(gids), _pow2(G))
+        return AggPartial(self.operator, m.out_ts, parts, list(uniq), G)
+
+
+@dataclass
+class AggPartial:
+    op: str
+    out_ts: np.ndarray
+    parts: dict                     # name -> [Gpad, T] device arrays
+    group_keys: list[RangeVectorKey]
+    num_groups: int
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _segment_partial(op, values, gids, num_groups):
+    return aggregators.partial_aggregate(op, values, gids, num_groups)
+
+
+@dataclass
+class AggregatePresenter(Transformer):
+    """Present phase (ref: AggregatePresenter in AggrOverRangeVectors.scala)."""
+    operator: str
+    params: tuple = ()
+    by: tuple = ()
+    without: tuple = ()
+
+    def apply(self, data, ctx):
+        if isinstance(data, AggPartial):
+            vals = aggregators.present_partials(data.op, data.parts)
+            return ResultMatrix(data.out_ts, vals[: data.num_groups], data.group_keys)
+        # full-matrix aggregators
+        m = _as_matrix(data)
+        gkeys = group_keys_of(m.keys, self.by, self.without)
+        uniq: dict[RangeVectorKey, int] = {}
+        gids = np.empty(len(gkeys), np.int32)
+        for i, gk in enumerate(gkeys):
+            gids[i] = uniq.setdefault(gk, len(uniq))
+        G = max(len(uniq), 1)
+        if self.operator in ("topk", "bottomk"):
+            k = int(self.params[0])
+            mask = aggregators.topk_mask(jnp.asarray(m.values), jnp.asarray(gids), _pow2(G),
+                                         k, bottom=self.operator == "bottomk")
+            vals = jnp.where(mask, m.values, jnp.nan)
+            return ResultMatrix(m.out_ts, vals, m.keys)
+        if self.operator == "quantile":
+            q = float(self.params[0])
+            vals = aggregators.group_quantile(jnp.asarray(m.values), jnp.asarray(gids),
+                                              _pow2(G), q)
+            return ResultMatrix(m.out_ts, vals[:G], list(uniq))
+        if self.operator == "count_values":
+            return _count_values(m, gkeys, str(self.params[0]))
+        raise QueryError(f"unknown aggregator {self.operator}")
+
+
+def _count_values(m: ResultMatrix, gkeys, label: str) -> ResultMatrix:
+    """count_values aggregation (host path — output cardinality is data-dependent)."""
+    vals = np.asarray(m.values)
+    T = len(m.out_ts)
+    out: dict[RangeVectorKey, np.ndarray] = {}
+    for p, gk in enumerate(gkeys):
+        for t in range(T):
+            v = vals[p, t]
+            if np.isnan(v):
+                continue
+            vstr = ("%g" % v)
+            key = RangeVectorKey(tuple(sorted(dict(gk.labels, **{label: vstr}).items())))
+            row = out.setdefault(key, np.full(T, np.nan))
+            row[t] = (0 if np.isnan(row[t]) else row[t]) + 1
+    if not out:
+        return ResultMatrix(m.out_ts, np.zeros((0, T)), [])
+    return ResultMatrix(m.out_ts, np.stack(list(out.values())), list(out))
+
+
+@dataclass
+class SortFunctionMapper(Transformer):
+    function: str                  # sort / sort_desc
+
+    def apply(self, data, ctx):
+        m = _as_matrix(data).to_host()
+        if not m.keys:
+            return m
+        with np.errstate(all="ignore"):
+            sortkey = np.nanmean(m.values, axis=1)
+        sortkey = np.where(np.isnan(sortkey), -np.inf, sortkey)
+        order = np.argsort(sortkey, kind="stable")
+        if self.function == "sort_desc":
+            order = order[::-1]
+        return ResultMatrix(m.out_ts, m.values[order], [m.keys[i] for i in order])
+
+
+@dataclass
+class MiscellaneousFunctionMapper(Transformer):
+    function: str
+    str_args: tuple = ()
+
+    def apply(self, data, ctx):
+        import re
+        m = _as_matrix(data)
+        if self.function == "timestamp":
+            vals = np.asarray(m.values)
+            out = np.where(np.isnan(vals), np.nan,
+                           (m.out_ts[None, :] / 1000.0))
+            return ResultMatrix(m.out_ts, out,
+                                [k.without(("_metric_",)) for k in m.keys])
+        if self.function == "label_replace":
+            dst, repl, src, regex = self.str_args
+            pat = re.compile(regex)
+            keys = []
+            for k in m.keys:
+                d = k.as_dict()
+                mo = pat.fullmatch(d.get(src, ""))
+                if mo:
+                    newval = mo.expand(_go_to_py_template(repl))
+                    if newval:
+                        d[dst] = newval
+                    else:
+                        d.pop(dst, None)
+                keys.append(RangeVectorKey.of(d))
+            return ResultMatrix(m.out_ts, m.values, keys)
+        if self.function == "label_join":
+            dst, sep, *srcs = self.str_args
+            keys = []
+            for k in m.keys:
+                d = k.as_dict()
+                d[dst] = sep.join(d.get(s, "") for s in srcs)
+                keys.append(RangeVectorKey.of(d))
+            return ResultMatrix(m.out_ts, m.values, keys)
+        raise QueryError(f"unknown misc function {self.function}")
+
+
+def _go_to_py_template(s: str) -> str:
+    """Convert Go regexp replacement ($1, ${name}) to Python (\\1, \\g<name>)."""
+    import re
+    return re.sub(r"\$(\d+)", r"\\\1", re.sub(r"\$\{(\w+)\}", r"\\g<\1>", s))
+
+
+def _as_matrix(data) -> ResultMatrix:
+    if isinstance(data, ResultMatrix):
+        return data
+    if isinstance(data, MatrixView):
+        return data.compact()
+    if isinstance(data, AggPartial):
+        raise QueryError("aggregate partial where matrix expected (missing presenter)")
+    if isinstance(data, SeriesSelection):
+        raise QueryError("raw series where matrix expected (missing periodic mapper)")
+    raise TypeError(type(data))
+
+
+# ---------------------------------------------------------------------------
+# ExecPlans
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecPlan:
+    transformers: list = field(default_factory=list)
+
+    def execute(self, ctx: QueryContext):
+        data = self.do_execute(ctx)
+        for t in self.transformers:
+            data = t.apply(data, ctx)
+        return data
+
+    def run(self, ctx: QueryContext) -> QueryResult:
+        data = self.execute(ctx)
+        m = _as_matrix(data).to_host()
+        if m.num_series * len(m.out_ts) > ctx.sample_limit:
+            raise QueryError(
+                f"result too large: {m.num_series} series x {len(m.out_ts)} steps "
+                f"> sample limit {ctx.sample_limit}")
+        return QueryResult(m)
+
+    def do_execute(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+@dataclass
+class SelectRawPartitionsExec(ExecPlan):
+    """The only data-reading leaf (ref: SelectRawPartitionsExec.scala)."""
+    shard: int = 0
+    filters: tuple = ()
+    start_ms: int = 0
+    end_ms: int = 0
+
+    def do_execute(self, ctx) -> SeriesSelection:
+        shard = ctx.memstore.shard(ctx.dataset, self.shard)
+        pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
+        keys = [RangeVectorKey.of(shard.index.labels_of(int(p))) for p in pids]
+        store = shard.store
+        ts, val, n = store.arrays()
+        total = len(shard.index)
+        if len(pids) == 0:
+            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None)
+        if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
+            # narrow selection: gather rows once, padded to a power of two
+            P = _pow2(len(pids))
+            rows = np.zeros(P, np.int32)
+            rows[: len(pids)] = pids
+            rid = jnp.asarray(rows)
+            sel_n = jnp.where(jnp.arange(P) < len(pids), jnp.take(n, rid), 0)
+            return SeriesSelection(jnp.take(ts, rid, axis=0),
+                                   jnp.take(val, rid, axis=0),
+                                   sel_n.astype(jnp.int32), keys, None)
+        # wide selection: no gather — disable non-selected rows via n = 0
+        if len(pids) == store.S or len(pids) == total:
+            sel_mask = None
+            n_eff = n
+        else:
+            mask = np.zeros(store.S, bool)
+            mask[pids] = True
+            n_eff = jnp.where(jnp.asarray(mask), n, 0)
+        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32))
+
+
+@dataclass
+class DistConcatExec(ExecPlan):
+    """Concatenate child results (ref: DistConcatExec.scala — shard fan-in)."""
+    children: list = field(default_factory=list)
+
+    def do_execute(self, ctx):
+        mats = [_as_matrix(c.execute(ctx)).to_host() for c in self.children]
+        mats = [m for m in mats if m.num_series]
+        if not mats:
+            first = self.children[0].execute(ctx)
+            return _as_matrix(first)
+        out_ts = mats[0].out_ts
+        vals = np.concatenate([np.asarray(m.values) for m in mats], axis=0)
+        keys = [k for m in mats for k in m.keys]
+        return ResultMatrix(out_ts, vals, keys)
+
+
+@dataclass
+class ReduceAggregateExec(ExecPlan):
+    """Cross-shard reduce (ref: ReduceAggregateExec in AggrOverRangeVectors.scala).
+
+    Children yield AggPartials (basic ops) or full matrices (order statistics);
+    partials merge group-by-group, then the presenter finishes.
+    """
+    operator: str = "sum"
+    params: tuple = ()
+    by: tuple = ()
+    without: tuple = ()
+    children: list = field(default_factory=list)
+
+    def do_execute(self, ctx):
+        results = [c.execute(ctx) for c in self.children]
+        if results and isinstance(results[0], AggPartial):
+            return _merge_partials(self.operator, results)
+        mats = [_as_matrix(r).to_host() for r in results]
+        mats = [m for m in mats if m.num_series]
+        if not mats:
+            return ResultMatrix(np.zeros(0, np.int64), np.zeros((0, 0)), [])
+        vals = np.concatenate([np.asarray(m.values) for m in mats], axis=0)
+        keys = [k for m in mats for k in m.keys]
+        return ResultMatrix(mats[0].out_ts, vals, keys)
+
+
+def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
+    """Align group keys across shards, then combine partial state."""
+    all_keys: dict[RangeVectorKey, int] = {}
+    for p in partials:
+        for k in p.group_keys:
+            all_keys.setdefault(k, len(all_keys))
+    G = max(len(all_keys), 1)
+    Gpad = _pow2(G)
+    out_ts = partials[0].out_ts
+    T = len(out_ts)
+    merged: dict[str, object] = {}
+    for p in partials:
+        # scatter this shard's groups into the global group space
+        idx = np.array([all_keys[k] for k in p.group_keys], np.int32)
+        for name, arr in p.parts.items():
+            arr = np.asarray(arr)[: p.num_groups]
+            if name == "min":
+                base = np.full((Gpad, T), np.inf)
+            elif name == "max":
+                base = np.full((Gpad, T), -np.inf)
+            else:
+                base = np.zeros((Gpad, T))
+            if len(idx):
+                base[idx] = arr
+            if name not in merged:
+                merged[name] = base
+            else:
+                if name == "min":
+                    merged[name] = np.minimum(merged[name], base)
+                elif name == "max":
+                    merged[name] = np.maximum(merged[name], base)
+                else:
+                    merged[name] = merged[name] + base
+    return AggPartial(op, out_ts, merged, list(all_keys), G)
+
+
+# ---------------------------------------------------------------------------
+# Binary joins and set operators
+# ---------------------------------------------------------------------------
+
+def _join_key(k: RangeVectorKey, on, ignoring) -> RangeVectorKey:
+    k = k.without(("_metric_",))
+    if on:
+        return k.only(on)
+    if ignoring:
+        return k.without(ignoring)
+    return k
+
+
+@dataclass
+class BinaryJoinExec(ExecPlan):
+    """Vector-vector binary operation (ref: BinaryJoinExec.scala: one-to-one and
+    many-to-one/one-to-many with on/ignoring + group_left/right include)."""
+    lhs: ExecPlan = None
+    rhs: ExecPlan = None
+    operator: str = "+"
+    cardinality: str = "OneToOne"
+    on: tuple = ()
+    ignoring: tuple = ()
+    include: tuple = ()
+
+    def do_execute(self, ctx):
+        lm = _as_matrix(self.lhs.execute(ctx)).to_host()
+        rm = _as_matrix(self.rhs.execute(ctx)).to_host()
+        swap = self.cardinality == "OneToMany"   # treat as ManyToOne with sides swapped
+        many, one = (rm, lm) if swap else (lm, rm)
+        one_by_key: dict[RangeVectorKey, int] = {}
+        for i, k in enumerate(one.keys):
+            jk = _join_key(k, self.on, self.ignoring)
+            if jk in one_by_key:
+                raise QueryError(f"duplicate series on 'one' side of join for {jk}")
+            one_by_key[jk] = i
+        rows_many, rows_one, keys = [], [], []
+        is_filter = (self.operator.removesuffix("_bool") in binop.COMPARISON_OPS
+                     and not self.operator.endswith("_bool"))
+        seen: set[RangeVectorKey] = set()
+        for i, k in enumerate(many.keys):
+            jk = _join_key(k, self.on, self.ignoring)
+            j = one_by_key.get(jk)
+            if j is None:
+                continue
+            if self.cardinality == "OneToOne":
+                if jk in seen:
+                    raise QueryError(f"duplicate series on 'many' side of join for {jk}")
+                seen.add(jk)
+            rows_many.append(i)
+            rows_one.append(j)
+            if is_filter:
+                keys.append(k)               # comparison filter keeps original labels
+            else:
+                out = k.without(("_metric_",))
+                if self.include:
+                    d = out.as_dict()
+                    od = one.keys[j].as_dict()
+                    for lbl in self.include:
+                        if od.get(lbl):
+                            d[lbl] = od[lbl]
+                        else:
+                            d.pop(lbl, None)
+                    out = RangeVectorKey.of(d)
+                elif self.on and self.cardinality == "OneToOne":
+                    out = _join_key(k, self.on, self.ignoring)
+                keys.append(out)
+        if not rows_many:
+            return ResultMatrix(lm.out_ts, np.zeros((0, len(lm.out_ts))), [])
+        mv = np.asarray(many.values)[rows_many]
+        ov = np.asarray(one.values)[rows_one]
+        l_vals, r_vals = (ov, mv) if swap else (mv, ov)
+        vals = binop.apply_vector_op(self.operator, jnp.asarray(l_vals), jnp.asarray(r_vals))
+        return ResultMatrix(lm.out_ts, vals, keys)
+
+
+@dataclass
+class SetOperatorExec(ExecPlan):
+    """and/or/unless with per-step presence semantics (ref: SetOperatorExec.scala)."""
+    lhs: ExecPlan = None
+    rhs: ExecPlan = None
+    operator: str = "and"
+    on: tuple = ()
+    ignoring: tuple = ()
+
+    def do_execute(self, ctx):
+        lm = _as_matrix(self.lhs.execute(ctx)).to_host()
+        rm = _as_matrix(self.rhs.execute(ctx)).to_host()
+        lvals, rvals = np.asarray(lm.values), np.asarray(rm.values)
+        T = len(lm.out_ts)
+        # presence of each join key at each step on the rhs / lhs
+        def presence(mat, keys):
+            pres: dict[RangeVectorKey, np.ndarray] = {}
+            for i, k in enumerate(keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                cur = pres.get(jk)
+                here = ~np.isnan(np.asarray(mat)[i])
+                pres[jk] = here if cur is None else (cur | here)
+            return pres
+        if self.operator == "and":
+            rp = presence(rvals, rm.keys)
+            out = []
+            for i, k in enumerate(lm.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                mask = rp.get(jk, np.zeros(T, bool))
+                out.append(np.where(mask, lvals[i], np.nan))
+            vals = np.stack(out) if out else np.zeros((0, T))
+            return ResultMatrix(lm.out_ts, vals, list(lm.keys))
+        if self.operator == "unless":
+            rp = presence(rvals, rm.keys)
+            out = []
+            for i, k in enumerate(lm.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                mask = rp.get(jk, np.zeros(T, bool))
+                out.append(np.where(mask, np.nan, lvals[i]))
+            vals = np.stack(out) if out else np.zeros((0, T))
+            return ResultMatrix(lm.out_ts, vals, list(lm.keys))
+        if self.operator == "or":
+            lp = presence(lvals, lm.keys)
+            rows = [lvals[i] for i in range(len(lm.keys))]
+            keys = list(lm.keys)
+            for i, k in enumerate(rm.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                lmask = lp.get(jk, np.zeros(T, bool))
+                rows.append(np.where(lmask, np.nan, rvals[i]))
+                keys.append(k)
+            vals = np.stack(rows) if rows else np.zeros((0, T))
+            return ResultMatrix(lm.out_ts, vals, keys)
+        raise QueryError(f"unknown set operator {self.operator}")
+
+
+@dataclass
+class ScalarExec(ExecPlan):
+    """Literal scalar evaluated at each step."""
+    value: float = 0.0
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+
+    def do_execute(self, ctx):
+        out_ts = np.arange(self.start_ms, self.end_ms + 1, max(self.step_ms, 1),
+                           dtype=np.int64)
+        vals = np.full((1, len(out_ts)), self.value)
+        return ResultMatrix(out_ts, vals, [RangeVectorKey(())])

@@ -124,6 +124,9 @@ class ApplySortFunction(PeriodicSeriesPlan):
 class ScalarPlan(PeriodicSeriesPlan):
     """A literal scalar expression evaluated at each step."""
     value: float
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
 
 
 # ---- metadata plans ---------------------------------------------------------

@@ -1,0 +1,136 @@
+"""LogicalPlan -> ExecPlan materializer.
+
+Reference: coordinator/.../queryengine2/QueryEngine.scala:106-375 — walks the
+logical tree, picks target shards from shard-key filters + spread, pushes
+transformers down to the data (map phase at the leaves), and wires scatter-gather
+nodes on top. Here the same shapes materialize to in-process ExecPlans; the mesh
+executor (parallel/) reuses this planner with device-spanning leaves.
+"""
+
+from __future__ import annotations
+
+from ..core.filters import Equals
+from ..core.record import fnv1a64
+from ..core.schemas import DatasetOptions
+from ..parallel.shardmapper import ShardMapper
+from . import logical as L
+from .exec import (AggregateMapReduce, AggregatePresenter, BinaryJoinExec,
+                   DistConcatExec, ExecPlan, InstantVectorFunctionMapper,
+                   MiscellaneousFunctionMapper, PeriodicSamplesMapper, ScalarExec,
+                   ScalarOperationMapper, SelectRawPartitionsExec,
+                   SetOperatorExec, SortFunctionMapper)
+from .rangevector import QueryError
+
+_SET_OPS = {"and", "or", "unless"}
+
+
+class QueryPlanner:
+    def __init__(self, shard_mapper: ShardMapper | None = None,
+                 options: DatasetOptions = DatasetOptions()):
+        self.mapper = shard_mapper or ShardMapper(1)
+        self.options = options
+
+    # -- shard selection (ref: QueryEngine.shardsFromFilters :181-222) -------
+
+    def shards_for_filters(self, filters) -> list[int]:
+        eq = {f.label: f.value for f in filters if isinstance(f, Equals)}
+        if all(c in eq for c in self.options.shard_key_columns):
+            from ..core.schemas import shard_key_of
+            sk = shard_key_of(eq, self.options)
+            return self.mapper.shards_for_shard_key(fnv1a64(sk) & 0xFFFFFFFF)
+        return self.mapper.all_shards()
+
+    # -- materialization ------------------------------------------------------
+
+    def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
+        return self._walk(plan)
+
+    def _leaves(self, raw: L.RawSeries, psm: PeriodicSamplesMapper) -> list[ExecPlan]:
+        shards = self.shards_for_filters(raw.filters)
+        return [
+            SelectRawPartitionsExec(
+                transformers=[psm], shard=s, filters=tuple(raw.filters),
+                start_ms=raw.range_selector.from_ms, end_ms=raw.range_selector.to_ms)
+            for s in shards
+        ]
+
+    def _fan_in(self, children: list[ExecPlan]) -> ExecPlan:
+        if len(children) == 1:
+            return children[0]
+        return DistConcatExec(children=children)
+
+    def _walk(self, p: L.LogicalPlan) -> ExecPlan:
+        if isinstance(p, L.PeriodicSeries):
+            psm = PeriodicSamplesMapper(p.start_ms, p.step_ms, p.end_ms, None, None)
+            return self._fan_in(self._leaves(p.raw_series, psm))
+        if isinstance(p, L.PeriodicSeriesWithWindowing):
+            psm = PeriodicSamplesMapper(p.start_ms, p.step_ms, p.end_ms,
+                                        p.window_ms, p.function, p.function_args)
+            return self._fan_in(self._leaves(p.series, psm))
+        if isinstance(p, L.Aggregate):
+            return self._materialize_aggregate(p)
+        if isinstance(p, L.BinaryJoin):
+            op = p.operator.removesuffix("_bool")
+            lhs = self._walk(p.lhs)
+            rhs = self._walk(p.rhs)
+            if op in _SET_OPS:
+                return SetOperatorExec(lhs=lhs, rhs=rhs, operator=op,
+                                       on=p.on, ignoring=p.ignoring)
+            return BinaryJoinExec(lhs=lhs, rhs=rhs, operator=p.operator,
+                                  cardinality=p.cardinality, on=p.on,
+                                  ignoring=p.ignoring, include=p.include)
+        if isinstance(p, L.ScalarVectorBinaryOperation):
+            child = self._walk(p.vector)
+            child.transformers = child.transformers + [
+                ScalarOperationMapper(p.operator, p.scalar, p.scalar_is_lhs)]
+            return child
+        if isinstance(p, L.ApplyInstantFunction):
+            child = self._walk(p.vectors)
+            child.transformers = child.transformers + [
+                InstantVectorFunctionMapper(p.function, p.function_args)]
+            return child
+        if isinstance(p, L.ApplyMiscellaneousFunction):
+            child = self._walk(p.vectors)
+            child.transformers = child.transformers + [
+                MiscellaneousFunctionMapper(p.function, p.string_args)]
+            return child
+        if isinstance(p, L.ApplySortFunction):
+            child = self._walk(p.vectors)
+            child.transformers = child.transformers + [SortFunctionMapper(p.function)]
+            return child
+        if isinstance(p, L.ScalarPlan):
+            return ScalarExec(value=p.value)
+        raise QueryError(f"cannot materialize {type(p).__name__}")
+
+    def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:
+        from .exec import ReduceAggregateExec
+        inner = p.vectors
+        mr = AggregateMapReduce(p.operator, p.params, p.by, p.without)
+        presenter = AggregatePresenter(p.operator, p.params, p.by, p.without)
+        if isinstance(inner, (L.PeriodicSeries, L.PeriodicSeriesWithWindowing)):
+            # push map phase down to each shard leaf (ref: QueryEngine pushes
+            # AggregateMapReduce onto child plans before ReduceAggregateExec)
+            children = self._walk_shard_children(inner)
+            for c in children:
+                c.transformers = c.transformers + [mr]
+            return ReduceAggregateExec(
+                transformers=[presenter], operator=p.operator, params=p.params,
+                by=p.by, without=p.without, children=children)
+        # complex inner plan: aggregate on top of the materialized child
+        child = self._walk(inner)
+        return ReduceAggregateExec(
+            transformers=[presenter], operator=p.operator, params=p.params,
+            by=p.by, without=p.without, children=[_wrap(child, mr)])
+
+    def _walk_shard_children(self, p) -> list[ExecPlan]:
+        if isinstance(p, L.PeriodicSeries):
+            psm = PeriodicSamplesMapper(p.start_ms, p.step_ms, p.end_ms, None, None)
+            return self._leaves(p.raw_series, psm)
+        psm = PeriodicSamplesMapper(p.start_ms, p.step_ms, p.end_ms,
+                                    p.window_ms, p.function, p.function_args)
+        return self._leaves(p.series, psm)
+
+
+def _wrap(child: ExecPlan, transformer) -> ExecPlan:
+    child.transformers = child.transformers + [transformer]
+    return child

@@ -1,0 +1,104 @@
+"""Result model: the batched [P, T] matrix that flows between ExecPlan nodes.
+
+Reference: core/.../query/RangeVector.scala (RangeVector, RangeVectorKey,
+SerializableRangeVector:137 — results materialized into RecordContainers for the
+wire). TPU-native difference: instead of per-series iterators, one ResultMatrix
+carries *all* series of a plan node: ``values[P, T]`` on device, label keys on
+host. NaN marks absent points; presenters drop them at the edge.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RangeVectorKey:
+    """Immutable label set identifying one output series."""
+    labels: tuple[tuple[str, str], ...]
+
+    @classmethod
+    def of(cls, d: dict[str, str]) -> "RangeVectorKey":
+        return cls(tuple(sorted(d.items())))
+
+    def as_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+    def without(self, names) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple(kv for kv in self.labels if kv[0] not in ns))
+
+    def only(self, names) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple(kv for kv in self.labels if kv[0] in ns))
+
+
+@dataclass
+class ResultMatrix:
+    """out_ts int64 [T]; values float [P, T] (device or host); keys len P."""
+    out_ts: np.ndarray
+    values: object                      # jnp/np [P, T]
+    keys: list[RangeVectorKey]
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+    def to_host(self) -> "ResultMatrix":
+        return ResultMatrix(self.out_ts, np.asarray(self.values), self.keys)
+
+    def iter_series(self) -> Iterator[tuple[RangeVectorKey, np.ndarray, np.ndarray]]:
+        """Yield (key, ts, values) per series with NaN points dropped; series with
+        no points are skipped entirely (Prometheus empty-series semantics)."""
+        vals = np.asarray(self.values)
+        for p, key in enumerate(self.keys):
+            present = ~np.isnan(vals[p])
+            if present.any():
+                yield key, self.out_ts[present], vals[p][present]
+
+
+@dataclass
+class QueryResult:
+    """Ref: query/QueryResults (QueryResult with result schema + RVs)."""
+    matrix: ResultMatrix
+    result_type: str = "matrix"        # matrix | vector | scalar
+    warnings: list[str] = field(default_factory=list)
+
+
+class QueryError(Exception):
+    pass
+
+
+# ---- wire serialization (SerializableRangeVector equivalent) ----------------
+
+_MAGIC = 0x46545256  # 'FTRV'
+
+
+def serialize_matrix(m: ResultMatrix) -> bytes:
+    """Compact wire form for cross-node result transfer (ref: RangeVector.scala
+    SerializableRangeVector materializes into RecordContainers; here: one header
+    + columnar f64 block + label blob)."""
+    import json
+    host = m.to_host()
+    P, T = len(host.keys), len(host.out_ts)
+    blob = json.dumps([k.labels for k in host.keys], separators=(",", ":")).encode()
+    head = struct.pack("<IIII", _MAGIC, P, T, len(blob))
+    return (head + host.out_ts.astype("<i8").tobytes()
+            + np.asarray(host.values, "<f8").tobytes() + blob)
+
+
+def deserialize_matrix(buf: bytes) -> ResultMatrix:
+    import json
+    magic, P, T, blob_len = struct.unpack_from("<IIII", buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad result matrix magic")
+    off = 16
+    out_ts = np.frombuffer(buf, "<i8", T, off).copy(); off += 8 * T
+    values = np.frombuffer(buf, "<f8", P * T, off).reshape(P, T).copy(); off += 8 * P * T
+    keys = [RangeVectorKey(tuple(tuple(kv) for kv in k))
+            for k in json.loads(buf[off:off + blob_len])]
+    return ResultMatrix(out_ts, values, keys)

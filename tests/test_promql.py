@@ -1,0 +1,112 @@
+"""PromQL parser tests (ref analog: prometheus/src/test/.../ParserSpec.scala)."""
+
+import pytest
+
+from filodb_tpu.core.filters import Equals, EqualsRegex, NotEquals
+from filodb_tpu.promql import parser as P
+from filodb_tpu.query import logical as L
+
+
+def lower(q, start=1_000_000, end=2_000_000, step=10_000):
+    return P.query_to_logical_plan(q, start, end, step)
+
+
+def test_simple_selector():
+    p = lower('http_requests_total{job="api", env!="dev"}')
+    assert isinstance(p, L.PeriodicSeries)
+    f = p.raw_series.filters
+    assert Equals("_metric_", "http_requests_total") in f
+    assert Equals("job", "api") in f
+    assert NotEquals("env", "dev") in f
+    # staleness lookback extends raw range
+    assert p.raw_series.range_selector.from_ms == 1_000_000 - P.DEFAULT_STALENESS_MS
+
+
+def test_name_matcher_aliases_metric():
+    p = lower('{__name__="up", dc=~"us-.*"}')
+    f = p.raw_series.filters
+    assert Equals("_metric_", "up") in f
+    assert EqualsRegex("dc", "us-.*") in f
+
+
+def test_rate_range_selector():
+    p = lower("rate(http_requests_total[5m])")
+    assert isinstance(p, L.PeriodicSeriesWithWindowing)
+    assert p.function == "rate"
+    assert p.window_ms == 300_000
+    assert p.series.range_selector.from_ms == 1_000_000 - 300_000
+
+
+def test_aggregate_by_and_param():
+    p = lower('sum by (job) (rate(m[1m]))')
+    assert isinstance(p, L.Aggregate) and p.operator == "sum" and p.by == ("job",)
+    p = lower('topk(5, m)')
+    assert p.operator == "topk" and p.params == (5.0,)
+    p = lower('quantile(0.9, m) without (host)')
+    assert p.operator == "quantile" and p.without == ("host",)
+
+
+def test_function_args_positions():
+    p = lower("quantile_over_time(0.95, m[10m])")
+    assert p.function == "quantile_over_time" and p.function_args == (0.95,)
+    p = lower("holt_winters(m[10m], 0.5, 0.1)")
+    assert p.function_args == (0.5, 0.1)
+    p = lower("predict_linear(m[1h], 3600)")
+    assert p.function_args == (3600.0,)
+
+
+def test_binary_precedence_and_scalar_fold():
+    p = lower("1 + 2 * 3")
+    assert isinstance(p, L.ScalarPlan) and p.value == 7.0
+    p = lower("2 ^ 3 ^ 2")  # right assoc
+    assert p.value == 512.0
+
+
+def test_scalar_vector_op():
+    p = lower("m * 2")
+    assert isinstance(p, L.ScalarVectorBinaryOperation)
+    assert p.operator == "*" and p.scalar == 2.0 and not p.scalar_is_lhs
+    p = lower("2 < bool m")
+    assert p.operator == "<_bool" and p.scalar_is_lhs
+
+
+def test_vector_join_modifiers():
+    p = lower("a / on (job) group_left (env) b")
+    assert isinstance(p, L.BinaryJoin)
+    assert p.on == ("job",) and p.cardinality == "ManyToOne" and p.include == ("env",)
+    p = lower("a and ignoring (x) b")
+    assert p.operator == "and" and p.cardinality == "ManyToMany"
+
+
+def test_offset_and_durations():
+    p = lower("sum(rate(m[90s] offset 10m))")
+    inner = p.vectors
+    assert inner.window_ms == 90_000
+    assert inner.start_ms == 1_000_000 - 600_000
+
+
+def test_instant_and_misc_functions():
+    p = lower("clamp_max(abs(m), 100)")
+    assert isinstance(p, L.ApplyInstantFunction) and p.function == "clamp_max"
+    assert p.function_args == (100.0,)
+    assert p.vectors.function == "abs"
+    p = lower('label_replace(m, "dst", "$1", "src", "(.*)")')
+    assert isinstance(p, L.ApplyMiscellaneousFunction)
+    assert p.string_args == ("dst", "$1", "src", "(.*)")
+    p = lower("sort_desc(m)")
+    assert isinstance(p, L.ApplySortFunction)
+
+
+def test_parse_errors():
+    for bad in ["rate(m)", "sum(", "m[5x]", "m{x=}", "foo bar", "and(m)"]:
+        with pytest.raises(P.ParseError):
+            lower(bad)
+
+
+def test_nested_expression():
+    q = 'sum by (job) (rate(http_req[5m])) / sum by (job) (rate(http_lat[5m])) > 0.5'
+    p = lower(q)
+    # `> 0.5` is a scalar-vector filter over the ratio join
+    assert isinstance(p, L.ScalarVectorBinaryOperation) and p.operator == ">"
+    assert isinstance(p.vector, L.BinaryJoin) and p.vector.operator == "/"
+    assert isinstance(p.vector.lhs, L.Aggregate) and p.vector.lhs.by == ("job",)
