@@ -38,13 +38,18 @@ RANGE_FNS = [
 
 
 def _extrapolated(out_ts, window_ms, first_t, first_v, last_t, last_v, cnt,
-                  is_counter: bool, is_rate: bool):
-    """Prometheus extrapolatedRate (ref RateFunctions.scala:37-80), vectorized."""
-    win_start = (out_ts[None, :] - window_ms).astype(jnp.float64)
-    win_end = out_ts[None, :].astype(jnp.float64)
-    dur_start = (first_t - win_start) / 1000.0
-    dur_end = (win_end - last_t) / 1000.0
-    sampled = (last_t - first_t) / 1000.0
+                  is_counter: bool, is_rate: bool, acc=jnp.float64):
+    """Prometheus extrapolatedRate (ref RateFunctions.scala:37-80), vectorized.
+
+    ``first_t``/``last_t`` are int64 epoch ms: all time arithmetic stays integer
+    and only the (small) differences are cast to ``acc`` — mandatory for f32
+    accumulation, where epoch-ms magnitudes lose whole-second precision.
+    """
+    win_start = out_ts[None, :] - window_ms
+    win_end = out_ts[None, :]
+    dur_start = (first_t - win_start).astype(acc) / 1000.0
+    dur_end = (win_end - last_t).astype(acc) / 1000.0
+    sampled = (last_t - first_t).astype(acc) / 1000.0
     avg_dur = sampled / (cnt - 1.0)
     delta = last_v - first_v
     if is_counter:
@@ -57,7 +62,7 @@ def _extrapolated(out_ts, window_ms, first_t, first_v, last_t, last_v, cnt,
     extrap = extrap + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
     scaled = delta * (extrap / sampled)
     if is_rate:
-        scaled = scaled / ((win_end - win_start) / 1000.0)
+        scaled = scaled / ((win_end - win_start).astype(acc) / 1000.0)
     return jnp.where(cnt >= 2, scaled, NAN)
 
 
@@ -82,21 +87,21 @@ def _linreg_sums(ctx):
     return cnt, slope, intercept
 
 
-def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap):
+def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap, acc):
     """Core dispatch; ``fn`` and ``w_cap`` are static."""
     valid = W.valid_mask(ts, n)
     left, right = W.window_edges(ts, out_ts, window_ms)
     cnt_i = right - left
-    cnt = cnt_i.astype(jnp.float64)
-    fval = jnp.where(valid, val, 0).astype(jnp.float64)
+    cnt = cnt_i.astype(acc)
+    fval = jnp.where(valid, val, 0).astype(acc)
     ctx = dict(ts=ts, val=val, fval=fval, valid=valid, left=left, right=right,
                t0=out_ts[0] - window_ms)
 
     def first_last(values):
         f_v = W.take(values, left)
         l_v = W.take(values, right - 1)
-        f_t = W.take(ts, left).astype(jnp.float64)
-        l_t = W.take(ts, right - 1).astype(jnp.float64)
+        f_t = W.take(ts, left)          # int64: cast only differences downstream
+        l_t = W.take(ts, right - 1)
         return f_t, f_v, l_t, l_v
 
     if fn in ("rate", "increase", "delta"):
@@ -104,40 +109,39 @@ def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap):
         if is_counter:
             # window-relative correction: first sample stays raw; the last sample
             # carries only the resets *inside* the window (corr[last] - corr[first])
-            corrected = W.counter_correct(val, valid)
+            corrected = W.counter_correct(val, valid, dtype=acc)
             corr = corrected - fval
             f_v = W.take(fval, left)
             l_v = W.take(fval, right - 1) + (W.take(corr, right - 1) - W.take(corr, left))
-            f_t = W.take(ts, left).astype(jnp.float64)
-            l_t = W.take(ts, right - 1).astype(jnp.float64)
+            f_t = W.take(ts, left)
+            l_t = W.take(ts, right - 1)
         else:
             f_t, f_v, l_t, l_v = first_last(fval)
         return _extrapolated(out_ts, window_ms, f_t, f_v, l_t, l_v, cnt,
-                             is_counter, fn == "rate")
+                             is_counter, fn == "rate", acc)
 
     if fn in ("irate", "idelta"):
         i2 = right - 1
         i1 = right - 2
         v2 = W.take(fval, i2)
         v1 = W.take(fval, i1)
-        t2 = W.take(ts, i2).astype(jnp.float64)
-        t1 = W.take(ts, i1).astype(jnp.float64)
+        dt = (W.take(ts, i2) - W.take(ts, i1)).astype(acc)
         if fn == "irate":
             dv = jnp.where(v2 >= v1, v2 - v1, v2)  # reset => counter restarted
-            res = dv / ((t2 - t1) / 1000.0)
+            res = dv / (dt / 1000.0)
         else:
             res = v2 - v1
         return jnp.where(cnt_i >= 2, res, NAN)
 
     if fn == "sum_over_time":
-        s = W.window_sum(W.prefix_sum(fval, valid), left, right)
+        s = W.window_sum(W.prefix_sum(fval, valid, dtype=acc), left, right)
         return jnp.where(cnt_i >= 1, s, NAN)
 
     if fn == "count_over_time":
         return jnp.where(cnt_i >= 1, cnt, NAN)
 
     if fn == "avg_over_time":
-        s = W.window_sum(W.prefix_sum(fval, valid), left, right)
+        s = W.window_sum(W.prefix_sum(fval, valid, dtype=acc), left, right)
         return jnp.where(cnt_i >= 1, s / cnt, NAN)
 
     if fn in ("min_over_time", "max_over_time"):
@@ -151,8 +155,8 @@ def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap):
         nvalid = jnp.maximum(valid.sum(axis=1), 1)
         row_mean = (jnp.where(valid, fval, 0).sum(axis=1) / nvalid)[:, None]
         cv = jnp.where(valid, fval - row_mean, 0.0)
-        s = W.window_sum(W.prefix_sum(cv, valid), left, right)
-        s2 = W.window_sum(W.prefix_sum(cv * cv, valid), left, right)
+        s = W.window_sum(W.prefix_sum(cv, valid, dtype=acc), left, right)
+        s2 = W.window_sum(W.prefix_sum(cv * cv, valid, dtype=acc), left, right)
         mean = s / cnt
         var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
         var = jnp.where(cnt_i <= 1, 0.0, var)  # one sample: exactly zero spread
@@ -176,7 +180,7 @@ def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap):
             ind = pair_ok & (fval != prev)
         else:
             ind = pair_ok & (fval < prev)
-        pfx = W.prefix_sum(ind.astype(jnp.float64), jnp.ones_like(valid))
+        pfx = W.prefix_sum(ind.astype(acc), jnp.ones_like(valid), dtype=acc)
         c = W.take(pfx, right) - W.take(pfx, jnp.minimum(left + 1, right))
         return jnp.where(cnt_i >= 1, c, NAN)
 
@@ -232,12 +236,14 @@ def _periodic(fn, ts, val, n, out_ts, window_ms, arg0, arg1, w_cap):
 
 
 @functools.cache
-def _kernel(fn: str, w_cap: int):
-    return jax.jit(functools.partial(_periodic, fn, w_cap=w_cap))
+def _kernel(fn: str, w_cap: int, acc_name: str):
+    acc = jnp.dtype(acc_name)
+    return jax.jit(functools.partial(_periodic, fn, w_cap=w_cap, acc=acc))
 
 
 def periodic_samples(ts, val, n, out_ts, window_ms, fn: str,
-                     arg0: float = 0.0, arg1: float = 0.0, w_cap: int = 256):
+                     arg0: float = 0.0, arg1: float = 0.0, w_cap: int = 256,
+                     accum: str = "float64"):
     """Evaluate range function ``fn`` for every series row at every output step.
 
     ts/val/n: store arrays (already gathered to the selected rows) — see windows.py.
@@ -245,6 +251,6 @@ def periodic_samples(ts, val, n, out_ts, window_ms, fn: str,
     ``last_sample`` pass the staleness lookback as both window and arg0).
     Returns float64 [P, T] with NaN for undefined points.
     """
-    return _kernel(fn, w_cap)(ts, val, n, jnp.asarray(out_ts),
-                              jnp.int64(window_ms), jnp.float64(arg0),
-                              jnp.float64(arg1))
+    return _kernel(fn, w_cap, accum)(ts, val, n, jnp.asarray(out_ts),
+                                     jnp.int64(window_ms), jnp.float64(arg0),
+                                     jnp.float64(arg1))

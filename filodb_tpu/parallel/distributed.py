@@ -1,0 +1,117 @@
+"""Distributed query execution over a jax device mesh.
+
+Reference: the Akka scatter-gather plane — ExecPlans Kryo-dispatched to per-shard
+QueryActors, partial aggregates reduced on the calling node
+(coordinator/.../queryengine2/QueryEngine.scala:59-67, query/.../exec/ExecPlan.scala
+NonLeafExecPlan.dispatchRemotePlan, client/Serializer.scala Kryo wire).
+
+TPU-native replacement: shards live on mesh devices ("shard" axis); one
+``shard_map``-compiled program evaluates the range function on every shard's
+resident block and reduces partial aggregates with ``psum`` over ICI — the
+collective *is* the scatter-gather. No serialization, no per-shard dispatch.
+
+The same partial-aggregate format as the in-process path (ops/aggregators.py)
+crosses the collective, so single-chip and multi-chip execution share semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import aggregators, rangefns
+
+
+def make_mesh(devices=None, axis: str = "shard") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+class DistributedStore:
+    """Global sharded view over per-shard device stores.
+
+    Each TimeSeriesShard's SeriesStore already lives on one mesh device; this
+    assembles the per-device blocks into global arrays [NSHARD, S, C] sharded on
+    the "shard" mesh axis with ``make_array_from_single_device_arrays`` — zero
+    copy, the shards' HBM blocks become one logical array.
+    """
+
+    def __init__(self, mesh: Mesh, shards):
+        self.mesh = mesh
+        self.shards = shards
+        ns = len(shards)
+        assert ns == mesh.devices.size, "one shard per mesh device"
+        s0 = shards[0].store
+        self.S, self.C = s0.S, s0.C
+        self.sharding = NamedSharding(mesh, P("shard"))
+
+    def _global(self, per_shard_arrays, extra_shape, dtype):
+        ns = len(self.shards)
+        shape = (ns,) + extra_shape
+        arrs = [a.reshape((1,) + extra_shape) for a in per_shard_arrays]
+        return jax.make_array_from_single_device_arrays(
+            shape, self.sharding, arrs)
+
+    def arrays(self):
+        ts = self._global([s.store.ts for s in self.shards], (self.S, self.C), jnp.int64)
+        val = self._global([s.store.val for s in self.shards], (self.S, self.C), None)
+        n = self._global([s.store.n for s in self.shards], (self.S,), jnp.int32)
+        return ts, val, n
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh"))
+def dist_aggregate(ts_g, val_g, n_g, gids_g, out_ts, window_ms, a0, a1,
+                   fn: str, op: str, num_groups: int, mesh: Mesh):
+    """One compiled distributed query step: range function per shard block +
+    segment partials + psum over the shard axis; every shard ends with the same
+    [G, T] final matrix (taken from shard 0 by the caller)."""
+
+    def per_shard(ts, val, n, gids):
+        acc = jnp.float64 if val.dtype == jnp.float64 else jnp.float32
+        mat = rangefns._periodic(fn, ts[0], val[0], n[0], out_ts, window_ms,
+                                 a0, a1, w_cap=256, acc=acc)
+        parts = aggregators.partial_aggregate(op, mat, gids[0], num_groups)
+        parts = {k: jax.lax.psum(v, "shard") if k not in ("min", "max")
+                 else (jax.lax.pmin(v, "shard") if k == "min" else jax.lax.pmax(v, "shard"))
+                 for k, v in parts.items()}
+        return aggregators.present_partials(op, parts)[None]
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard")),
+        out_specs=P("shard"),
+    )(ts_g, val_g, n_g, gids_g)
+
+
+class MeshQueryExecutor:
+    """Runs aggregation queries over a DistributedStore (used by the engine when
+    a mesh is configured; falls back to in-process scatter-gather otherwise)."""
+
+    def __init__(self, dstore: DistributedStore):
+        self.dstore = dstore
+
+    def aggregate(self, fn: str, op: str, out_ts: np.ndarray, window_ms: int,
+                  group_ids_per_shard: list[np.ndarray], num_groups: int,
+                  args=(0.0, 0.0)):
+        ts_g, val_g, n_g = self.dstore.arrays()
+        devs = list(self.dstore.mesh.devices.ravel())
+        gids = self.dstore._global(
+            [jax.device_put(jnp.asarray(g, jnp.int32), d)
+             for g, d in zip(group_ids_per_shard, devs)], (self.dstore.S,), jnp.int32)
+        G = _pow2(num_groups)
+        out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_ts),
+                             jnp.int64(window_ms), jnp.float64(args[0]),
+                             jnp.float64(args[1]), fn, op, G, self.dstore.mesh)
+        # all shards hold identical presented results; take shard 0's block
+        return np.asarray(out.addressable_shards[0].data[0])[:num_groups]
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
