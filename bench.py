@@ -1,0 +1,122 @@
+"""North-star benchmark: PromQL ``sum(rate(metric[5m]))`` over 1M series.
+
+Mirrors the reference's jmh QueryInMemoryBenchmark workload
+(jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala: 720 samples/series
+@ 10s spacing = 2h of data, query_range step 150s over the window) scaled to the
+BASELINE.json north star: 1M in-memory series on one chip.
+
+Data is synthesized directly into the device store layout (the benchmark targets
+the query path — the reference benchmark also pre-ingests before measuring).
+Execution runs the same kernels the query engine uses (rate + segment-sum
+partials), row-batched to bound intermediate HBM, f32 accumulation with int64
+timestamp math.
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md). We use a
+conservative JVM estimate derived from the workload definition: the chunked
+ChunkedRateFunction path touches the first/last samples + chunk metadata of every
+(series, window); at an optimistic 100M window-evaluations/sec on the JVM, 1M
+series x 48 steps ~= 0.5s per query. vs_baseline = estimated_jvm_ms / measured_ms.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+JVM_BASELINE_MS = 480.0  # see docstring: 1M series x 48 steps @ 100M evals/s
+
+NUM_SERIES = 1_000_000
+NUM_SAMPLES = 720          # 2h @ 10s
+CAPACITY = 768             # padded row capacity
+INTERVAL_MS = 10_000
+WINDOW_MS = 300_000        # [5m]
+STEP_MS = 150_000          # 150s, ref benchmark step
+ROW_BATCH = 131_072
+BASE_TS = 1_700_000_000_000
+
+
+def build_store(batch, rng_key):
+    """Synthesize one row-batch of counter series directly on device."""
+    import jax
+    import jax.numpy as jnp
+    from filodb_tpu.core.chunkstore import TS_PAD
+
+    @jax.jit
+    def make(key):
+        increments = jax.random.exponential(key, (batch, NUM_SAMPLES), jnp.float32) * 5.0
+        vals = jnp.cumsum(increments, axis=1)
+        ts_row = BASE_TS + jnp.arange(NUM_SAMPLES, dtype=jnp.int64) * INTERVAL_MS
+        ts = jnp.full((batch, CAPACITY), TS_PAD, jnp.int64)
+        ts = ts.at[:, :NUM_SAMPLES].set(ts_row[None, :])
+        val = jnp.zeros((batch, CAPACITY), jnp.float32).at[:, :NUM_SAMPLES].set(vals)
+        n = jnp.full(batch, NUM_SAMPLES, jnp.int32)
+        return ts, val, n
+
+    return make(rng_key)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from filodb_tpu.ops import aggregators, rangefns
+
+    dev = jax.devices()[0]
+    out_ts = np.arange(BASE_TS + WINDOW_MS,
+                       BASE_TS + NUM_SAMPLES * INTERVAL_MS + 1, STEP_MS,
+                       dtype=np.int64)
+    T = len(out_ts)
+    out_ts_d = jnp.asarray(out_ts)
+
+    n_batches = NUM_SERIES // ROW_BATCH
+    keys = jax.random.split(jax.random.PRNGKey(7), n_batches)
+    batches = [build_store(ROW_BATCH, k) for k in keys]
+    for ts, val, n in batches:
+        ts.block_until_ready()
+
+    gids = jnp.zeros(ROW_BATCH, jnp.int32)
+
+    @jax.jit
+    def query_batch(ts, val, n):
+        mat = rangefns._periodic("rate", ts, val, n, out_ts_d, jnp.int64(WINDOW_MS),
+                                 jnp.float64(0.0), jnp.float64(0.0),
+                                 w_cap=256, acc=jnp.float32)
+        return aggregators.partial_aggregate("sum", mat, gids, 8)
+
+    def run_query():
+        parts = None
+        for ts, val, n in batches:
+            p = query_batch(ts, val, n)
+            parts = p if parts is None else aggregators.combine_partials("sum", parts, p)
+        res = aggregators.present_partials("sum", parts)
+        return res[0].block_until_ready()
+
+    run_query()  # warmup/compile
+    lat = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        run_query()
+        lat.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(lat, 50))
+    series_per_sec = NUM_SERIES / (p50 / 1000.0)
+    result = {
+        "metric": "promql_sum_rate_5m_p50_latency_1M_series",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "vs_baseline": round(JVM_BASELINE_MS / p50, 2),
+        "detail": {
+            "series": NUM_SERIES,
+            "samples_per_series": NUM_SAMPLES,
+            "steps": T,
+            "series_per_sec": round(series_per_sec),
+            "device": str(dev),
+            "latencies_ms": [round(x, 1) for x in lat],
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ from .filters import Filter
 from .partkey_index import PartKeyIndex
 from .record import RecordContainer
 from .schemas import Schema, Schemas, part_key_of
+from .store import ChunkSetRecord, ChunkSink
 
 
 @dataclass
@@ -53,7 +54,7 @@ class TimeSeriesShard:
     """All state for one shard of one dataset."""
 
     def __init__(self, dataset: str, schema: Schema, shard_num: int, config: StoreConfig,
-                 device=None):
+                 device=None, sink: ChunkSink | None = None):
         import jax.numpy as jnp
         self.dataset = dataset
         self.schema = schema
@@ -72,6 +73,12 @@ class TimeSeriesShard:
         # per-group ingest offset watermarks (ref: checkpoint per flush group)
         self.group_watermarks = np.full(config.groups_per_shard, -1, np.int64)
         self._pending_offset = -1
+        # persistence (ref: doFlushSteps — encode + sink write + checkpoint commit)
+        self.sink = sink
+        G = config.groups_per_shard
+        self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
+        self._pending_group_offset = np.full(G, -1, np.int64)
+        self._persisted_parts = 0
         self.stats = ShardStats()
 
     # -- partition resolution ----------------------------------------------
@@ -94,17 +101,34 @@ class TimeSeriesShard:
 
     # -- ingest -------------------------------------------------------------
 
-    def ingest(self, container: RecordContainer, offset: int = -1) -> None:
+    def ingest(self, container: RecordContainer, offset: int = -1,
+               recovery_watermarks: np.ndarray | None = None) -> None:
+        """Ingest one container. During recovery replay, rows whose flush group
+        already persisted past ``offset`` are skipped (ref: TimeSeriesShard
+        recovery skips rows below the group watermark, :180-184)."""
         if container.schema.schema_id != self.schema.schema_id:
             self.stats.unknown_schema_dropped += len(container)
             return
         pids = self._resolve_part_ids(container)
+        ts, vals = container.ts, container.values
+        if recovery_watermarks is not None:
+            keep = recovery_watermarks[pids % self.config.groups_per_shard] < offset
+            if not keep.all():
+                pids, ts, vals = pids[keep], ts[keep], vals[keep]
+        if len(pids) == 0:
+            return
         self._stage_pid.append(pids)
-        self._stage_ts.append(container.ts)
-        self._stage_val.append(container.values)
-        self._staged += len(container)
+        self._stage_ts.append(ts)
+        self._stage_val.append(vals)
+        self._staged += len(ts)
         self._pending_offset = max(self._pending_offset, offset)
-        self.stats.rows_ingested += len(container)
+        self.stats.rows_ingested += len(ts)
+        if self.sink is not None:
+            groups = pids % self.config.groups_per_shard
+            for g in np.unique(groups):
+                sel = groups == g
+                self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
+                self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
         if self._staged >= self.config.flush_batch_size:
             self.flush()
 
@@ -118,13 +142,88 @@ class TimeSeriesShard:
         self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
         self._staged = 0
         written = self.store.append(pids, ts, vals)
-        if self._pending_offset >= 0:
+        if self.sink is None and self._pending_offset >= 0:
+            # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset
         # capacity pressure -> compact out data older than retention
         if self.store.n_host.max(initial=0) >= self.config.samples_per_series:
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
             self.store.compact(cutoff)
         return written
+
+    # -- persistence flush pipeline (ref: TimeSeriesShard.doFlushSteps :814) --
+
+    def flush_group(self, group: int) -> int:
+        """Encode and persist one flush group's pending samples, then commit its
+        checkpoint atomically after the write (ref: :989 writeChunks ->
+        :1048 commitCheckpoint). Returns chunkset record count."""
+        if self.sink is None:
+            return 0
+        self.flush()                      # device state first
+        pending = self._pending_chunks[group]
+        if not pending:
+            return 0
+        self._pending_chunks[group] = []
+        pids = np.concatenate([p for p, _, _ in pending])
+        ts = np.concatenate([t for _, t, _ in pending])
+        vals = np.concatenate([v for _, _, v in pending])
+        order = np.argsort(pids, kind="stable")
+        pids, ts, vals = pids[order], ts[order], vals[order]
+        bounds = np.concatenate([[0], np.nonzero(np.diff(pids))[0] + 1, [len(pids)]])
+        records = [
+            ChunkSetRecord(int(pids[bounds[i]]), ts[bounds[i]:bounds[i + 1]],
+                           vals[bounds[i]:bounds[i + 1]])
+            for i in range(len(bounds) - 1)
+        ]
+        # new part keys ride along with any group flush (ref: writeTimeBuckets)
+        if self._persisted_parts < len(self.index):
+            entries = [(pid, self.index.labels_of(pid), self.index.start_time(pid))
+                       for pid in range(self._persisted_parts, len(self.index))]
+            self.sink.write_part_keys(self.dataset, self.shard_num, entries)
+            self._persisted_parts = len(self.index)
+        self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
+        off = int(self._pending_group_offset[group])
+        if off >= 0:
+            self.sink.write_checkpoint(self.dataset, self.shard_num, group, off)
+            self.group_watermarks[group] = off
+        return len(records)
+
+    def flush_all_groups(self) -> None:
+        for g in range(self.config.groups_per_shard):
+            self.flush_group(g)
+
+    def recover(self, bus=None, schemas: Schemas | None = None) -> int:
+        """Restore shard state from the sink + replay the bus from the minimum
+        checkpointed offset (ref: TimeSeriesShard.recoverIndex :483 +
+        TimeSeriesMemStore.recoverStream :148). Returns rows replayed."""
+        assert self.sink is not None and len(self.index) == 0
+        # 1. part keys -> index (ids were assigned densely in order)
+        for pid, labels, start in self.sink.read_part_keys(self.dataset, self.shard_num) or ():
+            pk = part_key_of(labels, self.schema.options)
+            self._part_key_to_id[pk] = pid
+            self.index.add_part_key(pid, labels, start)
+        self._persisted_parts = len(self.index)
+        # 2. chunks -> device store (batched appends, flush order == time order)
+        for group, records in self.sink.read_chunksets(self.dataset, self.shard_num) or ():
+            pids = np.concatenate([np.full(len(r.ts), r.part_id, np.int32) for r in records])
+            ts = np.concatenate([r.ts for r in records])
+            vals = np.concatenate([r.values for r in records])
+            self.store.append(pids, ts, vals)
+        # 3. checkpoints -> watermarks; replay the bus past them
+        cps = self.sink.read_checkpoints(self.dataset, self.shard_num)
+        for g, off in cps.items():
+            self.group_watermarks[g] = off
+            self._pending_group_offset[g] = off
+        replayed = 0
+        if bus is not None:
+            wm = self.group_watermarks.copy()
+            start_off = int(wm[wm >= 0].min()) if (wm >= 0).any() else 0
+            for off, container in bus.consume(schemas or Schemas(), start_off):
+                before = self.stats.rows_ingested
+                self.ingest(container, off, recovery_watermarks=wm)
+                replayed += self.stats.rows_ingested - before
+            self.flush()
+        return replayed
 
     # -- queries ------------------------------------------------------------
 
@@ -154,7 +253,8 @@ class TimeSeriesMemStore:
         self._dataset_schema: dict[str, Schema] = {}
 
     def setup(self, dataset: str, schema: Schema | str, shard: int,
-              config: StoreConfig | None = None, device=None) -> TimeSeriesShard:
+              config: StoreConfig | None = None, device=None,
+              sink: ChunkSink | None = None) -> TimeSeriesShard:
         if isinstance(schema, str):
             schema = self.schemas[schema]
         cfg = config or self._configs.get(dataset) or StoreConfig()
@@ -163,7 +263,7 @@ class TimeSeriesMemStore:
         key = (dataset, shard)
         if key in self._shards:
             raise ValueError(f"shard {shard} of {dataset} already set up")
-        s = TimeSeriesShard(dataset, schema, shard, cfg, device=device)
+        s = TimeSeriesShard(dataset, schema, shard, cfg, device=device, sink=sink)
         self._shards[key] = s
         return s
 

@@ -1,0 +1,167 @@
+"""Chunk persistence: ChunkSink/ChunkSource traits + implementations.
+
+Reference: core/.../store/ChunkSink.scala:18 (sink trait + NullColumnStore:98),
+ChunkSource.scala (read side), cassandra/.../columnstore/CassandraColumnStore.scala
+(chunk table, ingestion-time index, partkey table).
+
+TPU-native shape: a flushed chunkset is a *columnar batch* — one frame per flush
+group holding per-series compressed vectors (delta-delta timestamps + XOR/
+NibblePack values; the same codecs the reference stores in Cassandra cells).
+The FileColumnStore keeps, per (dataset, shard):
+    chunks.log     append-only chunkset frames (the chunk table)
+    partkeys.log   part-key id -> labels json (the partkey/index table)
+    checkpoint.json  per-flush-group offset watermarks (the checkpoint table)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..memory import deltadelta, nibblepack
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChunkSetRecord:
+    """One series' slice of a flushed chunkset."""
+    part_id: int
+    ts: np.ndarray
+    values: np.ndarray
+
+
+class ChunkSink:
+    """Write side (ref: ChunkSink.scala trait)."""
+
+    def write_chunkset(self, dataset: str, shard: int, group: int,
+                       records: list[ChunkSetRecord]) -> None:
+        raise NotImplementedError
+
+    def write_part_keys(self, dataset: str, shard: int, entries) -> None:
+        raise NotImplementedError
+
+    def write_checkpoint(self, dataset: str, shard: int, group: int,
+                         offset: int) -> None:
+        raise NotImplementedError
+
+    def read_checkpoints(self, dataset: str, shard: int) -> dict[int, int]:
+        raise NotImplementedError
+
+
+class NullColumnStore(ChunkSink):
+    """No-op sink for tests/ephemeral nodes (ref: ChunkSink.scala:98)."""
+
+    def __init__(self):
+        self.chunksets_written = 0
+        self._checkpoints: dict[tuple, dict[int, int]] = {}
+
+    def write_chunkset(self, dataset, shard, group, records):
+        self.chunksets_written += 1
+
+    def write_part_keys(self, dataset, shard, entries):
+        pass
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        self._checkpoints.setdefault((dataset, shard), {})[group] = offset
+
+    def read_checkpoints(self, dataset, shard):
+        return dict(self._checkpoints.get((dataset, shard), {}))
+
+
+_CHUNK_HDR = struct.Struct("<IIQ")     # group, n_records, flush_seq
+
+
+class FileColumnStore(ChunkSink):
+    """Durable columnar chunk store on local disk (the Cassandra-equivalent)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _dir(self, dataset: str, shard: int) -> str:
+        d = os.path.join(self.root, dataset, f"shard{shard}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- chunks --------------------------------------------------------------
+
+    def write_chunkset(self, dataset, shard, group, records):
+        frames = []
+        for r in records:
+            ts_enc = deltadelta.encode(r.ts)
+            val_enc = nibblepack.pack_doubles(np.asarray(r.values, np.float64))
+            frames.append(struct.pack("<IIII", r.part_id, len(r.ts),
+                                      len(ts_enc), len(val_enc)) + ts_enc + val_enc)
+        payload = b"".join(frames)
+        with open(os.path.join(self._dir(dataset, shard), "chunks.log"), "ab") as f:
+            f.write(_CHUNK_HDR.pack(group, len(records), 0))
+            f.write(struct.pack("<I", len(payload)))
+            f.write(payload)
+
+    def read_chunksets(self, dataset, shard, start_ms: int = 0,
+                       end_ms: int = 1 << 62):
+        """Yield (group, [ChunkSetRecord...]) overlapping [start_ms, end_ms]
+        (ref: RawChunkSource.readRawPartitions time-filtered reads)."""
+        path = os.path.join(self._dir(dataset, shard), "chunks.log")
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(_CHUNK_HDR.size)
+                if len(hdr) < _CHUNK_HDR.size:
+                    return
+                group, n_rec, _ = _CHUNK_HDR.unpack(hdr)
+                (plen,) = struct.unpack("<I", f.read(4))
+                payload = f.read(plen)
+                records = []
+                off = 0
+                for _ in range(n_rec):
+                    pid, n, tlen, vlen = struct.unpack_from("<IIII", payload, off)
+                    off += 16
+                    ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
+                    vals = nibblepack.unpack_doubles(payload[off:off + vlen], n); off += vlen
+                    if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
+                        records.append(ChunkSetRecord(pid, ts, vals))
+                if records:
+                    yield group, records
+
+    # -- part keys ------------------------------------------------------------
+
+    def write_part_keys(self, dataset, shard, entries):
+        """entries: iterable of (part_id, labels_dict, start_time)."""
+        with open(os.path.join(self._dir(dataset, shard), "partkeys.log"), "a") as f:
+            for pid, labels, start in entries:
+                f.write(json.dumps({"id": pid, "labels": labels, "start": start},
+                                   separators=(",", ":")) + "\n")
+
+    def read_part_keys(self, dataset, shard):
+        path = os.path.join(self._dir(dataset, shard), "partkeys.log")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    e = json.loads(line)
+                    yield e["id"], e["labels"], e["start"]
+
+    # -- checkpoints (ref: cassandra/.../metastore/CheckpointTable.scala) ------
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        path = os.path.join(self._dir(dataset, shard), "checkpoint.json")
+        cp = self.read_checkpoints(dataset, shard)
+        cp[group] = offset
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in cp.items()}, f)
+        os.replace(tmp, path)   # atomic commit
+
+    def read_checkpoints(self, dataset, shard):
+        path = os.path.join(self._dir(dataset, shard), "checkpoint.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return {int(k): v for k, v in json.load(f).items()}

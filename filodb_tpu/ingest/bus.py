@@ -1,0 +1,61 @@
+"""Durable ingest bus — the Kafka-equivalent data plane.
+
+Reference: kafka/src/main/scala/filodb/kafka/KafkaIngestionStream.scala
+(1 shard == 1 partition, seek to checkpointed offset, replay). Here: one
+append-only log file per (dataset, shard) of length-prefixed RecordContainer
+frames; offsets are frame ordinals. The same interface can front a real broker.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from ..core.record import RecordContainer
+
+_FRAME = struct.Struct("<Q I")   # offset, payload length
+
+
+class FileBus:
+    """Append-only per-shard container log with offset-addressed replay."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._next_offset = 0
+        if os.path.exists(path):
+            for off, _ in self._frames():
+                self._next_offset = off + 1
+
+    def publish(self, container: RecordContainer) -> int:
+        """Append a container; returns its offset."""
+        payload = container.to_bytes()
+        off = self._next_offset
+        with open(self.path, "ab") as f:
+            f.write(_FRAME.pack(off, len(payload)))
+            f.write(payload)
+        self._next_offset = off + 1
+        return off
+
+    def _frames(self) -> Iterator[tuple[int, bytes]]:
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    return
+                off, ln = _FRAME.unpack(hdr)
+                payload = f.read(ln)
+                if len(payload) < ln:
+                    return  # truncated tail (torn write) — stop cleanly
+                yield off, payload
+
+    def consume(self, schemas, from_offset: int = 0) -> Iterator[tuple[int, RecordContainer]]:
+        """Replay containers from ``from_offset`` (ref: Kafka seek-to-checkpoint)."""
+        for off, payload in self._frames():
+            if off >= from_offset:
+                yield off, RecordContainer.from_bytes(payload, schemas)
+
+    @property
+    def end_offset(self) -> int:
+        return self._next_offset
