@@ -1,0 +1,110 @@
+"""filo-cli equivalent: dataset ops, ingestion, PromQL queries, shard status.
+
+Reference: cli/src/main/scala/filodb.cli/CliMain.scala:26-90 (importcsv, promql
+queries against a cluster, labelValues, shard status, schema validation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="filo-cli", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="start a standalone server")
+    s.add_argument("--config", default=None, help="server config json")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--dataset", default="prometheus")
+    s.add_argument("--schema", default="gauge")
+    s.add_argument("--shards", type=int, default=1)
+    s.add_argument("--data-dir", default=None, help="enable durable chunk store")
+    s.add_argument("--seed-data", action="store_true",
+                   help="ingest synthetic demo data on startup")
+
+    q = sub.add_parser("query", help="run a PromQL range query")
+    q.add_argument("promql")
+    q.add_argument("--host", default="http://127.0.0.1:8080")
+    q.add_argument("--dataset", default="prometheus")
+    q.add_argument("--start", type=float, required=True, help="unix seconds")
+    q.add_argument("--end", type=float, required=True)
+    q.add_argument("--step", default="15s")
+
+    lv = sub.add_parser("labelvalues", help="list label values")
+    lv.add_argument("label")
+    lv.add_argument("--host", default="http://127.0.0.1:8080")
+    lv.add_argument("--dataset", default="prometheus")
+
+    st = sub.add_parser("status", help="cluster/shard status")
+    st.add_argument("--host", default="http://127.0.0.1:8080")
+
+    ic = sub.add_parser("importcsv", help="ingest a CSV into a running server's bus "
+                                          "or print container stats")
+    ic.add_argument("csv")
+    ic.add_argument("--bus", required=True, help="file-bus path to publish to")
+
+    args = p.parse_args(argv)
+    if args.cmd == "serve":
+        return _serve(args)
+    if args.cmd == "query":
+        return _http_get(args.host, f"/promql/{args.dataset}/api/v1/query_range",
+                         {"query": args.promql, "start": args.start,
+                          "end": args.end, "step": args.step})
+    if args.cmd == "labelvalues":
+        return _http_get(args.host, f"/promql/{args.dataset}/api/v1/label/{args.label}/values", {})
+    if args.cmd == "status":
+        return _http_get(args.host, "/api/v1/cluster/status", {})
+    if args.cmd == "importcsv":
+        from .ingest.bus import FileBus
+        from .ingest.stream import CsvStream
+        bus = FileBus(args.bus)
+        total = 0
+        for _, container in CsvStream(args.csv):
+            bus.publish(container)
+            total += len(container)
+        print(f"published {total} samples to {args.bus}")
+        return 0
+    return 2
+
+
+def _serve(args) -> int:
+    from .core.memstore import StoreConfig, TimeSeriesMemStore
+    from .core.store import FileColumnStore
+    from .http.api import FiloHttpServer
+    from .query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    sink = FileColumnStore(args.data_dir) if args.data_dir else None
+    for shard in range(args.shards):
+        ms.setup(args.dataset, args.schema, shard, StoreConfig(), sink=sink)
+    if args.seed_data:
+        from .ingest.stream import SyntheticStream
+        for off, c in SyntheticStream():
+            ms.ingest(args.dataset, off % args.shards, c, off)
+        ms.flush_all()
+    engine = QueryEngine(ms, args.dataset)
+    server = FiloHttpServer({args.dataset: engine}, port=args.port).start()
+    print(f"filodb_tpu serving dataset {args.dataset!r} on :{server.port}")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _http_get(host: str, path: str, params: dict) -> int:
+    import urllib.parse
+    import urllib.request
+    url = host + path + ("?" + urllib.parse.urlencode(params) if params else "")
+    with urllib.request.urlopen(url) as r:
+        print(json.dumps(json.load(r), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

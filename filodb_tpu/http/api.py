@@ -1,0 +1,182 @@
+"""Prometheus-compatible HTTP API.
+
+Reference: http/src/main/scala/filodb/http/PrometheusApiRoute.scala:36-90
+(/promql/{dataset}/api/v1/query_range, query), ClusterApiRoute.scala (shard
+status), HealthRoute.scala (/__health); response JSON matches the Prometheus
+model (prometheus/.../query/PrometheusModel.scala).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..core import filters as F
+from ..promql.parser import ParseError
+from ..query.engine import QueryEngine
+from ..query.rangevector import QueryError
+
+
+def matrix_to_prom_json(result) -> dict:
+    """QueryResult -> Prometheus /api/v1 response data (ref: PrometheusModel
+    convertSampl... matrix/vector conversion; values are [sec, "str"] pairs)."""
+    out = []
+    vector = result.result_type == "vector"
+    for key, ts, vals in result.matrix.iter_series():
+        metric = dict(key.labels)
+        if "_metric_" in metric:
+            metric["__name__"] = metric.pop("_metric_")
+        if vector:
+            out.append({"metric": metric,
+                        "value": [ts[-1] / 1000.0, "%g" % vals[-1]]})
+        else:
+            out.append({"metric": metric,
+                        "values": [[t / 1000.0, "%g" % v] for t, v in zip(ts, vals)]})
+    return {"resultType": "vector" if vector else "matrix", "result": out}
+
+
+def _parse_time(v: str) -> int:
+    """Prometheus time param (unix seconds, possibly float) -> epoch ms."""
+    return int(float(v) * 1000)
+
+
+def _parse_step(v: str) -> int:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|[smhdwy])?", v)
+    if not m:
+        raise ValueError(f"bad step {v!r}")
+    mult = {"ms": 1, None: 1000, "s": 1000, "m": 60_000, "h": 3_600_000,
+            "d": 86_400_000, "w": 604_800_000, "y": 31_536_000_000}[m.group(2)]
+    return int(float(m.group(1)) * mult)
+
+
+def _selector_to_filters(sel: str):
+    from ..promql.parser import Parser
+    expr = Parser(sel).parse()
+    filters = list(expr.matchers)
+    if expr.metric:
+        filters.append(F.Equals("_metric_", expr.metric))
+    return [F.Equals("_metric_", f.value) if isinstance(f, F.Equals) and f.label == "__name__"
+            else f for f in filters]
+
+
+class FiloHttpServer:
+    """Stdlib threaded HTTP server hosting the Prometheus API for one or more
+    datasets (ref: FiloHttpServer / akka-http binding)."""
+
+    def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
+                 cluster=None):
+        self.engines = engines
+        self.cluster = cluster
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    outer._route(self)
+                except (QueryError, ParseError) as e:
+                    self._send(422, {"status": "error", "errorType": "bad_data",
+                                     "error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    self._send(500, {"status": "error", "errorType": "internal",
+                                     "error": str(e)})
+
+            do_POST = do_GET
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, h) -> None:
+        url = urlparse(h.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        if h.command == "POST":
+            ln = int(h.headers.get("Content-Length") or 0)
+            if ln:
+                body = h.rfile.read(ln).decode()
+                q.update({k: v[0] for k, v in parse_qs(body).items()})
+        path = url.path
+
+        if path == "/__health":
+            h._send(200, {"status": "healthy"})
+            return
+        if path == "/api/v1/cluster/status" or path.startswith("/api/v1/cluster/"):
+            h._send(200, {"status": "success", "data": self._cluster_status(path)})
+            return
+
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/(query_range|query)", path)
+        if m:
+            engine = self.engines.get(m.group(1))
+            if engine is None:
+                h._send(404, {"status": "error", "error": f"no dataset {m.group(1)}"})
+                return
+            if m.group(2) == "query_range":
+                res = engine.query_range(q["query"], _parse_time(q["start"]),
+                                         _parse_time(q["end"]), _parse_step(q["step"]))
+            else:
+                res = engine.query_instant(q["query"], _parse_time(q["time"]))
+            h._send(200, {"status": "success", "data": matrix_to_prom_json(res)})
+            return
+
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/labels", path)
+        if m:
+            engine = self.engines[m.group(1)]
+            h._send(200, {"status": "success", "data": engine.label_names()})
+            return
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/label/([^/]+)/values", path)
+        if m:
+            engine = self.engines[m.group(1)]
+            h._send(200, {"status": "success", "data": engine.label_values(m.group(2))})
+            return
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/series", path)
+        if m:
+            engine = self.engines[m.group(1)]
+            filters = _selector_to_filters(q["match[]"])
+            start = _parse_time(q.get("start", "0"))
+            end = _parse_time(q.get("end", "9999999999"))
+            data = []
+            for labels in engine.series(filters, start, end):
+                d = dict(labels)
+                if "_metric_" in d:
+                    d["__name__"] = d.pop("_metric_")
+                data.append(d)
+            h._send(200, {"status": "success", "data": data})
+            return
+        h._send(404, {"status": "error", "error": f"unknown path {path}"})
+
+    def _cluster_status(self, path: str):
+        if self.cluster is None:
+            return {"shards": [
+                {"dataset": ds, "shard": s.shard_num, "status": "Active",
+                 "numSeries": s.num_series}
+                for ds, e in self.engines.items()
+                for s in e.memstore.shards_of(ds)]}
+        return self.cluster.status()

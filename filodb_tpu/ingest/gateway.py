@@ -1,0 +1,157 @@
+"""Ingestion gateway: Influx line protocol -> shard-routed record containers.
+
+Reference: gateway/src/main/scala/filodb/gateway/GatewayServer.scala:37-60 (Netty
+TCP server), conversion/InfluxProtocolParser.scala (line protocol), InputRecord
+(field mapping), KafkaContainerSink (shard-hashed container publishing).
+
+TPU-native shape: the gateway is pure host-side; it parses lines, batches per
+shard with RecordBuilders (shard = ShardMapper(shard-key-hash, part-key-hash)),
+and publishes containers to the per-shard bus.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+from ..core.record import RecordBuilder, fnv1a64
+from ..core.schemas import GAUGE, Schema, part_key_of, shard_key_of
+from ..parallel.shardmapper import ShardMapper
+
+
+class InfluxParseError(ValueError):
+    pass
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    out, cur, i = [], [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def parse_influx_line(line: str) -> tuple[str, dict[str, str], dict[str, float], int]:
+    """``measurement,tag=v,... field=1.5,... timestamp_ns`` -> parts
+    (ref: InfluxProtocolParser.parse)."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        raise InfluxParseError("empty/comment line")
+    # split into (measurement+tags, fields, optional ts) on unescaped spaces
+    segs = []
+    cur, i = [], 0
+    while i < len(line):
+        c = line[i]
+        if c == "\\" and i + 1 < len(line):
+            cur.append(line[i]); cur.append(line[i + 1]); i += 2; continue
+        if c == " ":
+            segs.append("".join(cur)); cur = []
+        else:
+            cur.append(c)
+        i += 1
+    segs.append("".join(cur))
+    if len(segs) < 2:
+        raise InfluxParseError(f"bad line: {line!r}")
+    head = _split_unescaped(segs[0], ",")
+    measurement = head[0]
+    tags = {}
+    for t in head[1:]:
+        if "=" not in t:
+            raise InfluxParseError(f"bad tag {t!r}")
+        k, v = t.split("=", 1)
+        tags[k] = v
+    fields = {}
+    for fkv in _split_unescaped(segs[1], ","):
+        if "=" not in fkv:
+            raise InfluxParseError(f"bad field {fkv!r}")
+        k, v = fkv.split("=", 1)
+        v = v.rstrip("iu")
+        if v.startswith('"'):
+            continue  # string fields are not time series samples
+        fields[k] = float(v)
+    ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+    return measurement, tags, fields, ts_ns
+
+
+class GatewayServer:
+    """TCP line-protocol listener publishing shard-batched containers."""
+
+    def __init__(self, publish, num_shards: int = 4, spread: int = 0,
+                 schema: Schema = GAUGE, host="127.0.0.1", port=0,
+                 flush_lines: int = 1000):
+        """``publish(shard, container)`` delivers a built container (e.g. to a
+        FileBus per shard or straight into a memstore)."""
+        self.publish = publish
+        self.mapper = ShardMapper(num_shards, spread)
+        self.schema = schema
+        self.flush_lines = flush_lines
+        self._builders = {}
+        self._counts = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    line = raw.decode(errors="replace")
+                    if line.strip():
+                        try:
+                            outer.ingest_line(line)
+                        except InfluxParseError:
+                            pass
+                outer.flush()
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._server.daemon_threads = True
+
+    @property
+    def port(self):
+        return self._server.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+
+    def ingest_line(self, line: str) -> None:
+        measurement, tags, fields, ts_ns = parse_influx_line(line)
+        ts_ms = ts_ns // 1_000_000 if ts_ns else 0
+        with self._lock:
+            for fname, fval in fields.items():
+                metric = measurement if fname == "value" else f"{measurement}_{fname}"
+                labels = dict(tags)
+                labels["_metric_"] = metric
+                labels.setdefault("_ws_", "default")
+                labels.setdefault("_ns_", "default")
+                opts = self.schema.options
+                shard = self.mapper.shard_of(
+                    fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
+                    fnv1a64(part_key_of(labels, opts)))
+                b = self._builders.get(shard)
+                if b is None:
+                    b = self._builders[shard] = RecordBuilder(self.schema)
+                    self._counts[shard] = 0
+                b.add(labels, ts_ms, fval)
+                self._counts[shard] += 1
+                if self._counts[shard] >= self.flush_lines:
+                    self.publish(shard, b.build())
+                    self._counts[shard] = 0
+
+    def flush(self) -> None:
+        with self._lock:
+            for shard, b in self._builders.items():
+                if self._counts.get(shard):
+                    self.publish(shard, b.build())
+                    self._counts[shard] = 0

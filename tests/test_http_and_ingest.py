@@ -1,0 +1,139 @@
+"""HTTP API, gateway (Influx line protocol), and ingestion source tests
+(ref analogs: http route tests, gateway InfluxProtocolParser tests,
+CsvStream usage in IngestionStreamSpec)."""
+
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.http.api import FiloHttpServer
+from filodb_tpu.ingest.gateway import GatewayServer, parse_influx_line
+from filodb_tpu.ingest.stream import CsvStream, SyntheticStream
+from filodb_tpu.query.engine import QueryEngine
+
+
+@pytest.fixture(scope="module")
+def server():
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=128, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float64")
+    ms.setup("prometheus", GAUGE, 0, cfg)
+    for off, c in SyntheticStream(n_series=5, n_batches=4, samples_per_batch=25):
+        ms.ingest("prometheus", 0, c, off)
+    ms.flush_all()
+    srv = FiloHttpServer({"prometheus": QueryEngine(ms, "prometheus")}, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def get(srv, path, **params):
+    import urllib.parse
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    with urllib.request.urlopen(url) as r:
+        return json.load(r)
+
+
+def test_health(server):
+    assert get(server, "/__health")["status"] == "healthy"
+
+
+def test_query_range_endpoint(server):
+    r = get(server, "/promql/prometheus/api/v1/query_range",
+            query='sum(heap_usage0{_ws_="demo"})', start=1300, end=1990, step="15s")
+    assert r["status"] == "success"
+    data = r["data"]
+    assert data["resultType"] == "matrix"
+    assert len(data["result"]) == 1
+    values = data["result"][0]["values"]
+    assert len(values) > 10
+    # sum of 5 sinusoidal gauges: 15*(1+..+5)=225 mean
+    mean = np.mean([float(v) for _, v in values])
+    assert 150 < mean < 300
+
+
+def test_instant_query_and_metric_rename(server):
+    r = get(server, "/promql/prometheus/api/v1/query",
+            query='heap_usage0{instance="Instance-1"}', time=1990)
+    res = r["data"]["result"]
+    assert r["data"]["resultType"] == "vector"
+    assert len(res) == 1
+    assert res[0]["metric"]["__name__"] == "heap_usage0"
+    assert "value" in res[0]
+
+
+def test_labels_series_status(server):
+    r = get(server, "/promql/prometheus/api/v1/labels")
+    assert "instance" in r["data"]
+    r = get(server, "/promql/prometheus/api/v1/label/instance/values")
+    assert "Instance-0" in r["data"]
+    r = get(server, "/promql/prometheus/api/v1/series", **{"match[]": "heap_usage0"})
+    assert len(r["data"]) == 5
+    r = get(server, "/api/v1/cluster/status")
+    assert r["data"]["shards"][0]["numSeries"] == 5
+
+
+def test_query_error_is_422(server):
+    url = f"http://127.0.0.1:{server.port}/promql/prometheus/api/v1/query_range?query=rate(m)&start=1&end=2&step=15s"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url)
+    assert e.value.code == 422
+
+
+# ---- influx gateway ---------------------------------------------------------
+
+def test_parse_influx_line():
+    m, tags, fields, ts = parse_influx_line(
+        'cpu,host=h1,dc=us\\ east usage=0.5,idle=99i 1700000000000000000')
+    assert m == "cpu" and tags == {"host": "h1", "dc": "us east"}
+    assert fields == {"usage": 0.5, "idle": 99.0}
+    assert ts == 1_700_000_000_000_000_000
+
+
+def test_gateway_tcp_roundtrip():
+    received = []
+    gw = GatewayServer(lambda shard, c: received.append((shard, c)),
+                       num_shards=4, flush_lines=10**9, port=0).start()
+    try:
+        with socket.create_connection(("127.0.0.1", gw.port)) as s:
+            for t in range(5):
+                s.sendall(f"mem,host=h1 value={t}.5 {1700000000 + t}000000000\n".encode())
+        import time
+        for _ in range(100):
+            if received:
+                break
+            time.sleep(0.02)
+    finally:
+        gw.stop()
+    assert received
+    shard, c = received[0]
+    assert len(c) == 5
+    assert c.label_sets[0]["_metric_"] == "mem"
+    np.testing.assert_array_equal(np.diff(c.ts), 1000)
+
+
+def test_csv_stream(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("metric,timestamp,value,labels\n"
+                 "cpu,1000,1.5,host=a\n"
+                 "cpu,2000,2.5,host=a\n"
+                 "mem,1000,3.5,host=b\n")
+    batches = list(CsvStream(str(p), batch_size=2))
+    assert len(batches) == 2
+    assert len(batches[0][1]) == 2
+    assert batches[0][1].label_sets[0]["host"] == "a"
+
+
+def test_cli_importcsv_and_status(tmp_path, capsys):
+    from filodb_tpu.cli import main
+    p = tmp_path / "d.csv"
+    p.write_text("cpu,1000,1.5,host=a\ncpu,2000,2.5,host=a\n")
+    rc = main(["importcsv", str(p), "--bus", str(tmp_path / "bus.log")])
+    assert rc == 0
+    assert "published 2 samples" in capsys.readouterr().out
