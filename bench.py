@@ -7,9 +7,9 @@ BASELINE.json north star: 1M in-memory series on one chip.
 
 Data is synthesized directly into the device store layout (the benchmark targets
 the query path — the reference benchmark also pre-ingests before measuring).
-Execution runs the same kernels the query engine uses (rate + segment-sum
-partials), row-batched to bound intermediate HBM, f32 accumulation with int64
-timestamp math.
+Execution runs the same kernels the query engine uses for grid-aligned shards
+(ops/gridfns.py: MXU band-matmul rate + segment-sum partials), row-batched to
+bound intermediate HBM, f32 accumulation with int64 timestamp math.
 
 Baseline: the reference publishes no absolute numbers (BASELINE.md). We use a
 conservative JVM estimate derived from the workload definition: the chunked
@@ -78,11 +78,20 @@ def main():
 
     gids = jnp.zeros(ROW_BATCH, jnp.int32)
 
+    from filodb_tpu.ops import gridfns
+    lo, hi = gridfns.grid_edges(out_ts, WINDOW_MS, BASE_TS, INTERVAL_MS)
+    band_open = jnp.asarray(gridfns.band_matrix(CAPACITY, lo, hi, True))
+    onehot_lo = jnp.asarray(gridfns.onehot_matrix(CAPACITY, np.maximum(lo, 0)))
+    onehot_hi = jnp.asarray(gridfns.onehot_matrix(CAPACITY, hi))
+    band = jnp.asarray(gridfns.band_matrix(CAPACITY, lo, hi, False))
+    lo_d, hi_d = jnp.asarray(lo), jnp.asarray(hi)
+
     @jax.jit
     def query_batch(ts, val, n):
-        mat = rangefns._periodic("rate", ts, val, n, out_ts_d, jnp.int64(WINDOW_MS),
-                                 jnp.float64(0.0), jnp.float64(0.0),
-                                 w_cap=256, acc=jnp.float32)
+        mat = gridfns._grid_kernel("rate", val, n, band, band_open, onehot_lo,
+                                   onehot_hi, lo_d, hi_d, out_ts_d,
+                                   jnp.int64(WINDOW_MS), jnp.int64(INTERVAL_MS),
+                                   jnp.int64(BASE_TS), jnp.int64(300_000))
         return aggregators.partial_aggregate("sum", mat, gids, 8)
 
     def run_query():

@@ -88,6 +88,13 @@ class SeriesStore:
         # host mirrors: ingest-path bookkeeping without device->host syncs
         self.n_host = np.zeros(max_series, np.int32)
         self.last_ts = np.full(max_series, -(1 << 62), np.int64)
+        self.first_ts = np.full(max_series, -1, np.int64)
+        # scrape-grid tracking: when every series stays aligned to a common
+        # (base, interval) grid with contiguous samples, queries take the MXU
+        # band-matmul fast path (ops/gridfns.py) instead of per-row searches
+        self.grid_base: int | None = None
+        self.grid_interval: int | None = None
+        self.grid_ok = True
         self.stats = SeriesStoreStats()
 
     # -- ingest -------------------------------------------------------------
@@ -140,6 +147,10 @@ class SeriesStore:
         if m == 0:
             return 0
         # host bookkeeping
+        uniq, first_pos = np.unique(r, return_index=True)
+        newly = uniq[self.n_host[uniq] == 0]
+        self.first_ts[newly] = t[first_pos[self.n_host[uniq] == 0]]
+        self._track_grid(r, t, uniq, first_pos)
         np.maximum.at(self.last_ts, r, t)
         counts = np.bincount(r, minlength=self.S).astype(np.int32)
         self.n_host += counts
@@ -156,12 +167,73 @@ class SeriesStore:
         self.stats.samples_appended += m
         return m
 
+    def _track_grid(self, r, t, uniq, first_pos) -> None:
+        """Maintain the shard scrape-grid invariant on each append batch:
+        common (base, interval), per-series contiguity, uniform start."""
+        if not self.grid_ok:
+            return
+        if self.grid_base is None:
+            self.grid_base = int(t[0])
+        if self.grid_interval is None:
+            same = np.concatenate([[False], np.diff(r) == 0])
+            if same.any():
+                i = int(np.argmax(same))
+                self.grid_interval = int(t[i] - t[i - 1])
+            else:
+                existing = self.n_host[r] > 0
+                if existing.any():
+                    i = int(np.argmax(existing))
+                    self.grid_interval = int(t[i] - self.last_ts[r[i]])
+            if self.grid_interval is not None and self.grid_interval <= 0:
+                self.grid_ok = False
+            if self.grid_interval is None:
+                return
+        iv = self.grid_interval
+        ok = ((t - self.grid_base) % iv == 0).all()
+        # contiguity within the batch
+        same = np.concatenate([[False], np.diff(r) == 0])
+        if ok and same.any():
+            ok = (np.diff(t)[same[1:]] == iv).all()
+        # contiguity vs stored tail for series with history
+        if ok:
+            existing = self.n_host[uniq] > 0
+            if existing.any():
+                heads = t[first_pos[existing]]
+                ok = (heads == self.last_ts[uniq[existing]] + iv).all()
+        # uniform start: every new series must begin at the shard's start cell
+        if ok:
+            fresh = self.n_host[uniq] == 0
+            if fresh.any():
+                start = self.first_ts[uniq[~fresh]].min() if (~fresh).any() else None
+                live = self.n_host > 0
+                if start is None and live.any():
+                    start = self.first_ts[live].min()
+                if start is not None:
+                    ok = (t[first_pos[fresh]] == start).all()
+        if not ok:
+            self.grid_ok = False
+
+    def grid_info(self):
+        """(base_ts, interval_ms) when the MXU grid fast path applies, else None.
+        base_ts is the uniform start timestamp (sample k at base + k*interval)."""
+        if not self.grid_ok or not self.grid_interval:
+            return None
+        live = self.n_host > 0
+        if not live.any():
+            return None
+        starts = self.first_ts[live]
+        if (starts != starts[0]).any():
+            return None
+        return int(starts[0]), int(self.grid_interval)
+
     def compact(self, cutoff_ts: int) -> None:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
         by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
         self.ts, self.val, self.n = _compact(self.ts, self.val, self.n,
                                              jnp.int64(cutoff_ts))
         self.n_host = np.array(self.n)  # fresh writable host copy
+        new_first = np.array(self.ts[:, 0])
+        self.first_ts = np.where(self.n_host > 0, new_first, -1)
         self.stats.compactions += 1
 
     # -- query access -------------------------------------------------------

@@ -1,0 +1,144 @@
+"""Grid fast path: range functions as static band matmuls on the MXU.
+
+Why: TPU microbenchmarks (scripts/profile_kernels.py) show per-row binary search
+and data-dependent [S, T] gathers are 20-2000x slower than streaming compares and
+matmuls. Prometheus-style series are scrape-interval regular, so the store tracks
+a per-shard *grid* (base_ts, interval, uniform start): when every live series has
+sample k at timestamp base + k*interval, window edges are closed-form grid
+indices and window reductions become [S, C] x [C, T] matmuls with STATIC 0/1
+band matrices — the MXU-shaped formulation:
+
+  - count:            closed form from per-series sample count n
+  - sum/avg:          val @ band
+  - rate/increase/delta: per-cell increments inc[s,c] (elementwise; counter
+    correction folds in as relu — a reset cell's corrected increment is 0), then
+    window delta over (lo_t, hi_t] is ONE matmul inc @ band_open; first-sample
+    values ride a static one-hot matmul
+  - last_over_time/last_sample: static one-hot matmul + per-row tail value
+
+Shards that drift off the grid (irregular intervals, mid-series gaps,
+heterogeneous starts) fall back to the general path (ops/rangefns.py).
+Mixed start cohorts are a known TODO: bucket rows by start cell and shift bands
+per cohort. Semantics match the general kernels exactly on aligned data
+(reference behavior: query/.../exec/rangefn/ + RateFunctions.scala).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GRID_FNS = {"rate", "increase", "delta", "sum_over_time", "count_over_time",
+            "avg_over_time", "last_sample", "last_over_time"}
+
+
+def grid_edges(out_ts: np.ndarray, window_ms: int, base_ts: int, interval_ms: int):
+    """Host-side closed-form window edges in grid cells: cells with timestamps
+    in [t - window, t] are [lo_t, hi_t] inclusive (empty when hi < lo)."""
+    lo = np.ceil((out_ts - window_ms - base_ts) / interval_ms).astype(np.int64)
+    hi = np.floor((out_ts - base_ts) / interval_ms).astype(np.int64)
+    return lo, hi
+
+
+def band_matrix(C: int, lo: np.ndarray, hi: np.ndarray, open_left: bool,
+                dtype=np.float32) -> np.ndarray:
+    """Static [C, T] 0/1 band: cell c contributes to step t iff
+    lo_t < c <= hi_t (open_left) or lo_t <= c <= hi_t."""
+    c = np.arange(C)[:, None]
+    lo_ = lo[None, :] + (1 if open_left else 0)
+    return ((c >= lo_) & (c <= hi[None, :])).astype(dtype)
+
+
+def onehot_matrix(C: int, pos: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """[C, T] one-hot of clipped positions per step."""
+    m = np.zeros((C, len(pos)), dtype)
+    m[np.clip(pos, 0, C - 1), np.arange(len(pos))] = 1
+    return m
+
+
+@functools.partial(jax.jit, static_argnames=("fn",))
+def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
+                 out_ts, window_ms, interval_ms, base_ts, stale_ms):
+    """val [S, C]: sample k of each series at column k == grid cell k."""
+    S, C = val.shape
+    acc = val.dtype
+    valid = jnp.arange(C, dtype=jnp.int32)[None, :] < n[:, None]
+    v = jnp.where(valid, val, 0).astype(acc)
+
+    last_cell = n.astype(jnp.int64)[:, None] - 1                  # [S, 1]
+    lo_c = jnp.maximum(lo, 0)[None, :]                            # [1, T]
+    f_idx = lo_c                                                  # uniform start 0
+    l_idx = jnp.minimum(hi[None, :], last_cell)
+    cnt = jnp.maximum(l_idx - f_idx + 1, 0)
+    cnt_f = cnt.astype(acc)
+
+    if fn == "count_over_time":
+        return jnp.where(cnt >= 1, cnt_f, jnp.nan)
+
+    if fn in ("sum_over_time", "avg_over_time"):
+        s = v @ band                                              # MXU
+        if fn == "avg_over_time":
+            s = s / cnt_f
+        return jnp.where(cnt >= 1, s, jnp.nan)
+
+    if fn in ("last_sample", "last_over_time"):
+        static_v = v @ onehot_hi                                  # value at cell hi_t
+        row_last = jnp.take_along_axis(
+            v, jnp.clip(last_cell, 0, C - 1).astype(jnp.int32), axis=1)  # [S, 1]
+        l_v = jnp.where(hi[None, :] <= last_cell, static_v, row_last)
+        l_t = base_ts + l_idx * interval_ms
+        ok = cnt >= 1
+        if fn == "last_sample":
+            ok = ok & ((out_ts[None, :] - l_t) <= stale_ms)
+        return jnp.where(ok, l_v, jnp.nan)
+
+    if fn in ("rate", "increase", "delta"):
+        is_counter = fn != "delta"
+        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+        pair = valid & jnp.concatenate([jnp.zeros_like(valid[:, :1]), valid[:, :-1]], 1)
+        raw_inc = jnp.where(pair, v - prev, 0.0)
+        # counter: corrected increment = relu(diff); a reset cell contributes 0
+        inc = jnp.maximum(raw_inc, 0.0) if is_counter else raw_inc
+        delta = inc @ band_open                                   # MXU, (lo_t, hi_t]
+        f_v = v @ onehot_lo                                       # raw first value
+        f_t = base_ts + f_idx * interval_ms                       # [1, T] int64
+        l_t = base_ts + l_idx * interval_ms                       # [S, T]
+        win_start = out_ts[None, :] - window_ms
+        win_end = out_ts[None, :]
+        dur_start = (f_t - win_start).astype(acc) / 1000.0
+        dur_end = (win_end - l_t).astype(acc) / 1000.0
+        sampled = (l_t - f_t).astype(acc) / 1000.0
+        avg_dur = sampled / (cnt_f - 1.0)
+        if is_counter:
+            dur_zero = jnp.where(delta > 0, sampled * (f_v / delta), jnp.inf)
+            dur_start = jnp.where((delta > 0) & (f_v >= 0) & (dur_zero < dur_start),
+                                  dur_zero, dur_start)
+        thresh = avg_dur * 1.1
+        extrap = sampled
+        extrap = extrap + jnp.where(dur_start < thresh, dur_start, avg_dur / 2)
+        extrap = extrap + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
+        scaled = delta * (extrap / sampled)
+        if fn == "rate":
+            scaled = scaled / ((win_end - win_start).astype(acc) / 1000.0)
+        return jnp.where(cnt >= 2, scaled, jnp.nan)
+
+    raise ValueError(fn)  # pragma: no cover
+
+
+def periodic_samples_grid(val, n, out_ts: np.ndarray, window_ms: int, fn: str,
+                          base_ts: int, interval_ms: int, stale_ms: int = 300_000):
+    """Grid-path periodic samples over a uniform-start shard: [S, T] output."""
+    C = val.shape[1]
+    lo, hi = grid_edges(np.asarray(out_ts), window_ms, base_ts, interval_ms)
+    dtype = np.float64 if val.dtype == jnp.float64 else np.float32
+    return _grid_kernel(fn, val, jnp.asarray(n),
+                        jnp.asarray(band_matrix(C, lo, hi, False, dtype)),
+                        jnp.asarray(band_matrix(C, lo, hi, True, dtype)),
+                        jnp.asarray(onehot_matrix(C, np.maximum(lo, 0), dtype)),
+                        jnp.asarray(onehot_matrix(C, hi, dtype)),
+                        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(out_ts),
+                        jnp.int64(window_ms), jnp.int64(interval_ms),
+                        jnp.int64(base_ts), jnp.int64(stale_ms))

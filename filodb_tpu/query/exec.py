@@ -56,6 +56,7 @@ class SeriesSelection:
     n: object                 # [R] int32 (0 => row disabled)
     keys: list[RangeVectorKey]
     rows: np.ndarray | None   # int32 [P] store-row of each key, or None
+    grid: tuple | None = None  # (base_ts, interval_ms) => MXU band-matmul path
 
 
 @dataclass
@@ -115,8 +116,15 @@ class PeriodicSamplesMapper(Transformer):
             args = tuple(float(a) for a in self.args)
         a0 = args[0] if len(args) > 0 else 0.0
         a1 = args[1] if len(args) > 1 else 0.0
-        vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_ts,
-                                         window, fn, a0, a1)
+        from ..ops import gridfns
+        if data.grid is not None and fn in gridfns.GRID_FNS:
+            base_ts, interval_ms = data.grid
+            vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
+                                                 fn, base_ts, interval_ms,
+                                                 stale_ms=ctx.stale_ms)
+        else:
+            vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_ts,
+                                             window, fn, a0, a1)
         return MatrixView(out_ts, vals, data.keys, data.rows)
 
 
@@ -390,8 +398,9 @@ class SelectRawPartitionsExec(ExecPlan):
         store = shard.store
         ts, val, n = store.arrays()
         total = len(shard.index)
+        grid = store.grid_info()
         if len(pids) == 0:
-            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None)
+            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None, grid)
         if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
             # narrow selection: gather rows once, padded to a power of two
             P = _pow2(len(pids))
@@ -401,16 +410,15 @@ class SelectRawPartitionsExec(ExecPlan):
             sel_n = jnp.where(jnp.arange(P) < len(pids), jnp.take(n, rid), 0)
             return SeriesSelection(jnp.take(ts, rid, axis=0),
                                    jnp.take(val, rid, axis=0),
-                                   sel_n.astype(jnp.int32), keys, None)
+                                   sel_n.astype(jnp.int32), keys, None, grid)
         # wide selection: no gather — disable non-selected rows via n = 0
         if len(pids) == store.S or len(pids) == total:
-            sel_mask = None
             n_eff = n
         else:
             mask = np.zeros(store.S, bool)
             mask[pids] = True
             n_eff = jnp.where(jnp.asarray(mask), n, 0)
-        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32))
+        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid)
 
 
 @dataclass

@@ -1,0 +1,54 @@
+"""Microbenchmark kernel pieces on the current backend to find the bottleneck."""
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+B, C, T = 131_072, 768, 47
+BASE = 1_700_000_000_000
+
+
+def bench(name, fn, *args, reps=3):
+    r = jax.jit(fn)(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), r)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = jax.jit(fn)(*args)
+        jax.tree.map(lambda x: x.block_until_ready(), r)
+    dt = (time.perf_counter() - t0) / reps * 1000
+    print(f"{name:32s} {dt:9.1f} ms")
+    return dt
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    ts64 = BASE + jnp.broadcast_to(jnp.arange(C, dtype=jnp.int64) * 10_000, (B, C))
+    ts32 = (ts64 - BASE).astype(jnp.int32)
+    val = jax.random.normal(key, (B, C), jnp.float32)
+    out64 = BASE + jnp.arange(T, dtype=jnp.int64) * 150_000
+    out32 = (out64 - BASE).astype(jnp.int32)
+    n = jnp.full(B, C, jnp.int32)
+
+    bench("searchsorted i64 (vmap scan)", lambda a, v: jax.vmap(
+        lambda row: jnp.searchsorted(row, v, side="right"))(a), ts64, out64)
+    bench("searchsorted i32 (vmap scan)", lambda a, v: jax.vmap(
+        lambda row: jnp.searchsorted(row, v, side="right"))(a), ts32, out32)
+    bench("searchsorted i32 compare_all", lambda a, v: jax.vmap(
+        lambda row: jnp.searchsorted(row, v, side="right", method="compare_all"))(a),
+        ts32, out32)
+    bench("compare_all broadcast i32", lambda a, v: (a[:, None, :] <= v[None, :, None])
+          .sum(axis=2, dtype=jnp.int32), ts32, out32)
+    bench("cumsum f32 [B,C]", lambda v: jnp.cumsum(v, axis=1), val)
+    bench("counter_correct f32", lambda v: v + jnp.cumsum(
+        jnp.maximum(jnp.concatenate([v[:, :1], v[:, :-1]], 1) - v, 0), axis=1), val)
+    idx = jnp.clip(jax.random.randint(key, (B, T), 0, C), 0, C - 1)
+    bench("take_along_axis [B,T]", lambda v, i: jnp.take_along_axis(v, i, axis=1), val, idx)
+    bench("segment partial sum", lambda v: jax.ops.segment_sum(
+        v, jnp.zeros(B, jnp.int32), 8), val)
+
+
+if __name__ == "__main__":
+    main()

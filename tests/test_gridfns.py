@@ -1,0 +1,118 @@
+"""Grid (MXU band-matmul) fast path vs the general kernels and the golden model."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.chunkstore import SeriesStore, TS_PAD
+from filodb_tpu.ops import gridfns, rangefns
+
+from .prom_reference import eval_range_fn
+
+BASE = 1_700_000_000_000
+IV = 10_000
+C = 128
+
+
+def build(n_samples_per_row, kind="counter", rng=None):
+    rng = rng or np.random.default_rng(3)
+    S = len(n_samples_per_row)
+    ts = np.full((S, C), TS_PAD, np.int64)
+    val = np.zeros((S, C), np.float64)
+    n = np.asarray(n_samples_per_row, np.int32)
+    series = []
+    for s, ns in enumerate(n_samples_per_row):
+        t = BASE + np.arange(ns) * IV
+        if kind == "counter":
+            v = np.cumsum(rng.exponential(5, ns))
+            if ns > 10:
+                v[ns // 2:] -= v[ns // 2 - 1]  # a reset
+            v = np.maximum(v, 0)
+        else:
+            v = rng.normal(50, 10, ns)
+        ts[s, :ns] = t
+        val[s, :ns] = v
+        series.append((t, v))
+    return ts, val, n, series
+
+
+@pytest.mark.parametrize("fn,kind", [
+    ("rate", "counter"), ("increase", "counter"), ("delta", "gauge"),
+    ("sum_over_time", "gauge"), ("count_over_time", "gauge"),
+    ("avg_over_time", "gauge"), ("last_over_time", "gauge"),
+])
+def test_grid_matches_golden_and_general(fn, kind):
+    # rows with different lengths (incl. one empty) — uniform start, ragged ends
+    ts, val, n, series = build([100, 60, 5, 0, 128], kind)
+    out_ts = np.arange(BASE + 300_000, BASE + 900_001, 45_000, dtype=np.int64)
+    window = 120_000
+    got = np.asarray(gridfns.periodic_samples_grid(val, n, out_ts, window, fn, BASE, IV))
+    general = np.asarray(rangefns.periodic_samples(ts, val, n, out_ts, window, fn))
+    for s, (t, v) in enumerate(series):
+        want = eval_range_fn(fn, t, v, out_ts, window)
+        np.testing.assert_allclose(got[s], want, rtol=1e-9, atol=1e-9, equal_nan=True,
+                                   err_msg=f"{fn} grid vs golden, series {s}")
+    np.testing.assert_allclose(got, general, rtol=1e-9, atol=1e-9, equal_nan=True,
+                               err_msg=f"{fn} grid vs general")
+
+
+def test_grid_last_sample_staleness():
+    ts, val, n, series = build([20, 128], "gauge")
+    out_ts = np.array([BASE + 190_000, BASE + 1_000_000], dtype=np.int64)
+    stale = 300_000
+    got = np.asarray(gridfns.periodic_samples_grid(val, n, out_ts, stale,
+                                                   "last_sample", BASE, IV,
+                                                   stale_ms=stale))
+    assert got[0, 0] == series[0][1][-1]      # fresh at t=190s
+    assert np.isnan(got[0, 1])                # stale at t=1000s
+    assert got[1, 1] == series[1][1][100]     # last sample at/before t=1000s is cell 100
+
+
+def test_store_grid_tracking_aligned():
+    st = SeriesStore(max_series=4, capacity=32)
+    for k in range(3):
+        st.append(np.array([0, 1], np.int32),
+                  np.array([BASE + k * IV] * 2, np.int64),
+                  np.array([1.0, 2.0]))
+    assert st.grid_info() == (BASE, IV)
+    # a new series joining later breaks uniform start -> fast path off
+    st.append(np.array([2], np.int32), np.array([BASE + 3 * IV], np.int64),
+              np.array([9.0]))
+    assert st.grid_info() is None
+
+
+def test_store_grid_tracking_irregular():
+    st = SeriesStore(max_series=4, capacity=32)
+    st.append(np.array([0], np.int32), np.array([BASE], np.int64), np.array([1.0]))
+    st.append(np.array([0], np.int32), np.array([BASE + IV], np.int64), np.array([1.0]))
+    assert st.grid_info() == (BASE, IV)
+    st.append(np.array([0], np.int32), np.array([BASE + IV + 7777], np.int64),
+              np.array([1.0]))
+    assert st.grid_info() is None            # off-grid sample drops the invariant
+
+
+def test_engine_uses_grid_path_same_results():
+    """Engine-level check: aligned ingest gives identical results whether or not
+    the grid path is enabled (flip grid_ok to force the general path)."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("prometheus", GAUGE, 0, cfg)
+    b = RecordBuilder(GAUGE)
+    for t in range(50):
+        for s in range(3):
+            b.add({"_metric_": "m", "host": f"h{s}"}, BASE + t * IV, float(s * 10 + t))
+    shard.ingest(b.build())
+    shard.flush()
+    assert shard.store.grid_info() is not None
+    eng = QueryEngine(ms, "prometheus")
+    r1 = eng.query_range("sum(rate(m[2m]))", BASE + 200_000, BASE + 400_000, 30_000)
+    shard.store.grid_ok = False               # force general path
+    r2 = eng.query_range("sum(rate(m[2m]))", BASE + 200_000, BASE + 400_000, 30_000)
+    (k1, t1, v1), = list(r1.matrix.iter_series())
+    (k2, t2, v2), = list(r2.matrix.iter_series())
+    np.testing.assert_allclose(v1, v2, rtol=1e-12)
