@@ -79,19 +79,16 @@ def main():
     gids = jnp.zeros(ROW_BATCH, jnp.int32)
 
     from filodb_tpu.ops import gridfns
-    lo, hi = gridfns.grid_edges(out_ts, WINDOW_MS, BASE_TS, INTERVAL_MS)
-    band_open = jnp.asarray(gridfns.band_matrix(CAPACITY, lo, hi, True))
-    onehot_lo = jnp.asarray(gridfns.onehot_matrix(CAPACITY, np.maximum(lo, 0)))
-    onehot_hi = jnp.asarray(gridfns.onehot_matrix(CAPACITY, hi))
-    band = jnp.asarray(gridfns.band_matrix(CAPACITY, lo, hi, False))
-    lo_d, hi_d = jnp.asarray(lo), jnp.asarray(hi)
+    ops = gridfns.grid_operands(CAPACITY, out_ts, WINDOW_MS, "rate",
+                                BASE_TS, INTERVAL_MS)
 
     @jax.jit
     def query_batch(ts, val, n):
-        mat = gridfns._grid_kernel("rate", val, n, band, band_open, onehot_lo,
-                                   onehot_hi, lo_d, hi_d, out_ts_d,
-                                   jnp.int64(WINDOW_MS), jnp.int64(INTERVAL_MS),
-                                   jnp.int64(BASE_TS), jnp.int64(300_000))
+        mat = gridfns._grid_kernel("rate", val, n, ops["band"], ops["band_open"],
+                                   ops["onehot_lo"], ops["onehot_hi"],
+                                   ops["lo"], ops["hi"], ops["rel_out"],
+                                   ops["window_ms"], ops["interval_ms"],
+                                   jnp.int32(300_000))
         return aggregators.partial_aggregate("sum", mat, gids, 8)
 
     def run_query():

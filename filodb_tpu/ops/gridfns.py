@@ -61,16 +61,21 @@ def onehot_matrix(C: int, pos: np.ndarray, dtype=np.float32) -> np.ndarray:
 
 @functools.partial(jax.jit, static_argnames=("fn",))
 def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
-                 out_ts, window_ms, interval_ms, base_ts, stale_ms):
-    """val [S, C]: sample k of each series at column k == grid cell k."""
+                 rel_out, window_ms, interval_ms, stale_ms):
+    """val [S, C]: sample k of each series at column k == grid cell k.
+
+    All device-side time arithmetic is int32 *grid-relative* milliseconds
+    (rel_out = out_ts - base_ts): no int64 emulation on TPU. The wrapper
+    guarantees the relative range fits i32 (falls back to the general path
+    otherwise).
+    """
     S, C = val.shape
     acc = val.dtype
     valid = jnp.arange(C, dtype=jnp.int32)[None, :] < n[:, None]
     v = jnp.where(valid, val, 0).astype(acc)
 
-    last_cell = n.astype(jnp.int64)[:, None] - 1                  # [S, 1]
-    lo_c = jnp.maximum(lo, 0)[None, :]                            # [1, T]
-    f_idx = lo_c                                                  # uniform start 0
+    last_cell = n[:, None] - 1                                    # [S, 1] i32
+    f_idx = jnp.maximum(lo, 0)[None, :]                           # [1, T] i32
     l_idx = jnp.minimum(hi[None, :], last_cell)
     cnt = jnp.maximum(l_idx - f_idx + 1, 0)
     cnt_f = cnt.astype(acc)
@@ -87,12 +92,12 @@ def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
     if fn in ("last_sample", "last_over_time"):
         static_v = v @ onehot_hi                                  # value at cell hi_t
         row_last = jnp.take_along_axis(
-            v, jnp.clip(last_cell, 0, C - 1).astype(jnp.int32), axis=1)  # [S, 1]
+            v, jnp.clip(last_cell, 0, C - 1), axis=1)             # [S, 1]
         l_v = jnp.where(hi[None, :] <= last_cell, static_v, row_last)
-        l_t = base_ts + l_idx * interval_ms
         ok = cnt >= 1
         if fn == "last_sample":
-            ok = ok & ((out_ts[None, :] - l_t) <= stale_ms)
+            l_rel = l_idx * interval_ms                           # i32 [S, T]
+            ok = ok & ((rel_out[None, :] - l_rel) <= stale_ms)
         return jnp.where(ok, l_v, jnp.nan)
 
     if fn in ("rate", "increase", "delta"):
@@ -104,13 +109,13 @@ def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
         inc = jnp.maximum(raw_inc, 0.0) if is_counter else raw_inc
         delta = inc @ band_open                                   # MXU, (lo_t, hi_t]
         f_v = v @ onehot_lo                                       # raw first value
-        f_t = base_ts + f_idx * interval_ms                       # [1, T] int64
-        l_t = base_ts + l_idx * interval_ms                       # [S, T]
-        win_start = out_ts[None, :] - window_ms
-        win_end = out_ts[None, :]
-        dur_start = (f_t - win_start).astype(acc) / 1000.0
-        dur_end = (win_end - l_t).astype(acc) / 1000.0
-        sampled = (l_t - f_t).astype(acc) / 1000.0
+        f_rel = f_idx * interval_ms                               # [1, T] i32
+        l_rel = l_idx * interval_ms                               # [S, T] i32
+        win_start = rel_out[None, :] - window_ms
+        win_end = rel_out[None, :]
+        dur_start = (f_rel - win_start).astype(acc) / 1000.0
+        dur_end = (win_end - l_rel).astype(acc) / 1000.0
+        sampled = (l_rel - f_rel).astype(acc) / 1000.0
         avg_dur = sampled / (cnt_f - 1.0)
         if is_counter:
             dur_zero = jnp.where(delta > 0, sampled * (f_v / delta), jnp.inf)
@@ -122,23 +127,37 @@ def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
         extrap = extrap + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
         scaled = delta * (extrap / sampled)
         if fn == "rate":
-            scaled = scaled / ((win_end - win_start).astype(acc) / 1000.0)
+            scaled = scaled * (1000.0 / window_ms.astype(acc))
         return jnp.where(cnt >= 2, scaled, jnp.nan)
 
     raise ValueError(fn)  # pragma: no cover
+
+
+def grid_operands(C: int, out_ts: np.ndarray, window_ms: int, fn: str,
+                  base_ts: int, interval_ms: int, dtype=np.float32):
+    """Host-side static operands for _grid_kernel (bands, one-hots, edges)."""
+    out_ts = np.asarray(out_ts)
+    lo, hi = grid_edges(out_ts, window_ms, base_ts, interval_ms)
+    rel = out_ts - base_ts
+    assert abs(rel).max() < 2**31 and window_ms < 2**31, "grid range exceeds i32"
+    return dict(
+        band=jnp.asarray(band_matrix(C, lo, hi, False, dtype)),
+        band_open=jnp.asarray(band_matrix(C, lo, hi, True, dtype)),
+        onehot_lo=jnp.asarray(onehot_matrix(C, np.maximum(lo, 0), dtype)),
+        onehot_hi=jnp.asarray(onehot_matrix(C, hi, dtype)),
+        lo=jnp.asarray(lo.astype(np.int32)), hi=jnp.asarray(hi.astype(np.int32)),
+        rel_out=jnp.asarray(rel.astype(np.int32)),
+        window_ms=jnp.int32(window_ms), interval_ms=jnp.int32(interval_ms),
+    )
 
 
 def periodic_samples_grid(val, n, out_ts: np.ndarray, window_ms: int, fn: str,
                           base_ts: int, interval_ms: int, stale_ms: int = 300_000):
     """Grid-path periodic samples over a uniform-start shard: [S, T] output."""
     C = val.shape[1]
-    lo, hi = grid_edges(np.asarray(out_ts), window_ms, base_ts, interval_ms)
     dtype = np.float64 if val.dtype == jnp.float64 else np.float32
-    return _grid_kernel(fn, val, jnp.asarray(n),
-                        jnp.asarray(band_matrix(C, lo, hi, False, dtype)),
-                        jnp.asarray(band_matrix(C, lo, hi, True, dtype)),
-                        jnp.asarray(onehot_matrix(C, np.maximum(lo, 0), dtype)),
-                        jnp.asarray(onehot_matrix(C, hi, dtype)),
-                        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(out_ts),
-                        jnp.int64(window_ms), jnp.int64(interval_ms),
-                        jnp.int64(base_ts), jnp.int64(stale_ms))
+    ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
+    return _grid_kernel(fn, val, jnp.asarray(n, jnp.int32), ops["band"],
+                        ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
+                        ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
+                        ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))

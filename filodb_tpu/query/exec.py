@@ -117,7 +117,11 @@ class PeriodicSamplesMapper(Transformer):
         a0 = args[0] if len(args) > 0 else 0.0
         a1 = args[1] if len(args) > 1 else 0.0
         from ..ops import gridfns
-        if data.grid is not None and fn in gridfns.GRID_FNS:
+        grid_usable = (
+            data.grid is not None and fn in gridfns.GRID_FNS
+            and max(abs(int(out_ts[0]) - data.grid[0]),
+                    abs(int(out_ts[-1]) - data.grid[0])) + window < 2**31)
+        if grid_usable:
             base_ts, interval_ms = data.grid
             vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
                                                  fn, base_ts, interval_ms,
