@@ -49,7 +49,11 @@ def _compact(ts, val, n, cutoff):
     valid = idx < C
     idx = jnp.where(valid, idx, C - 1)
     new_ts = jnp.where(valid, jnp.take_along_axis(ts, idx, axis=1), TS_PAD)
-    new_val = jnp.where(valid, jnp.take_along_axis(val, idx, axis=1), 0)
+    if val.ndim == 3:   # histogram store [S, C, B]
+        new_val = jnp.where(valid[:, :, None],
+                            jnp.take_along_axis(val, idx[:, :, None], axis=1), 0)
+    else:
+        new_val = jnp.where(valid, jnp.take_along_axis(val, idx, axis=1), 0)
     new_n = jnp.maximum(n - k.astype(n.dtype), 0)
     # re-pad anything beyond the new count (handles rows where k > old n)
     pos = jnp.arange(C)[None, :]
@@ -77,13 +81,15 @@ class SeriesStore:
     """One shard's device store for a non-histogram schema value column."""
 
     def __init__(self, max_series: int, capacity: int, dtype=jnp.float32,
-                 device=None):
+                 device=None, nbuckets: int = 0):
         self.S = max_series
         self.C = capacity
         self.dtype = dtype
+        self.nbuckets = nbuckets   # 0 = scalar values; >0 = histogram [S, C, B]
         dev = device or jax.devices()[0]
+        vshape = (max_series, capacity) if not nbuckets else (max_series, capacity, nbuckets)
         self.ts = jax.device_put(jnp.full((max_series, capacity), TS_PAD, jnp.int64), dev)
-        self.val = jax.device_put(jnp.zeros((max_series, capacity), dtype), dev)
+        self.val = jax.device_put(jnp.zeros(vshape, dtype), dev)
         self.n = jax.device_put(jnp.zeros(max_series, jnp.int32), dev)
         # host mirrors: ingest-path bookkeeping without device->host syncs
         self.n_host = np.zeros(max_series, np.int32)
@@ -156,10 +162,11 @@ class SeriesStore:
         self.n_host += counts
         # pad to bucketed size; padded rows use row index S => dropped by scatter
         P = _pad_size(m)
+        v = np.asarray(v)
         rp = np.full(P, self.S, np.int32); rp[:m] = r
         cp = np.zeros(P, np.int32); cp[:m] = cols
         tp = np.zeros(P, np.int64); tp[:m] = t
-        vp = np.zeros(P, np.asarray(v).dtype); vp[:m] = v
+        vp = np.zeros((P,) + v.shape[1:], v.dtype); vp[:m] = v
         self.ts, self.val, self.n = _scatter_append(
             self.ts, self.val, self.n,
             jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),

@@ -62,9 +62,17 @@ class TimeSeriesShard:
         self.config = config
         self.index = PartKeyIndex()
         self._part_key_to_id: dict[bytes, int] = {}
-        dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
-        self.store = SeriesStore(config.max_series_per_shard, config.samples_per_series,
-                                 dtype=dtype, device=device)
+        self._device = device
+        self._dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+        self.bucket_les: np.ndarray | None = None
+        if schema.is_histogram:
+            # histogram stores are created lazily: the bucket scheme arrives with
+            # the first container (ref: BinaryHistogram carries its bucket scheme)
+            self.store = None
+        else:
+            self.store = SeriesStore(config.max_series_per_shard,
+                                     config.samples_per_series,
+                                     dtype=self._dtype, device=device)
         # staging buffers (host)
         self._stage_pid: list[np.ndarray] = []
         self._stage_ts: list[np.ndarray] = []
@@ -109,6 +117,14 @@ class TimeSeriesShard:
         if container.schema.schema_id != self.schema.schema_id:
             self.stats.unknown_schema_dropped += len(container)
             return
+        if self.store is None:
+            nb = container.values.shape[1] if container.values.ndim == 2 else 0
+            self.bucket_les = (np.asarray(container.bucket_les)
+                               if container.bucket_les is not None else None)
+            self.store = SeriesStore(self.config.max_series_per_shard,
+                                     self.config.samples_per_series,
+                                     dtype=self._dtype, device=self._device,
+                                     nbuckets=nb)
         pids = self._resolve_part_ids(container)
         ts, vals = container.ts, container.values
         if recovery_watermarks is not None:
@@ -138,7 +154,7 @@ class TimeSeriesShard:
             return 0
         pids = np.concatenate(self._stage_pid)
         ts = np.concatenate(self._stage_ts)
-        vals = np.concatenate(self._stage_val)
+        vals = np.concatenate(self._stage_val, axis=0)
         self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
         self._staged = 0
         written = self.store.append(pids, ts, vals)
@@ -175,6 +191,10 @@ class TimeSeriesShard:
                            vals[bounds[i]:bounds[i + 1]])
             for i in range(len(bounds) - 1)
         ]
+        if self.bucket_les is not None and self._persisted_parts == 0:
+            if hasattr(self.sink, "write_meta"):
+                self.sink.write_meta(self.dataset, self.shard_num,
+                                     {"bucket_les": list(map(float, self.bucket_les))})
         # new part keys ride along with any group flush (ref: writeTimeBuckets)
         if self._persisted_parts < len(self.index):
             entries = [(pid, self.index.labels_of(pid), self.index.start_time(pid))
@@ -197,6 +217,15 @@ class TimeSeriesShard:
         checkpointed offset (ref: TimeSeriesShard.recoverIndex :483 +
         TimeSeriesMemStore.recoverStream :148). Returns rows replayed."""
         assert self.sink is not None and len(self.index) == 0
+        if self.schema.is_histogram and self.store is None:
+            meta = self.sink.read_meta(self.dataset, self.shard_num) \
+                if hasattr(self.sink, "read_meta") else {}
+            if meta.get("bucket_les"):
+                self.bucket_les = np.asarray(meta["bucket_les"])
+                self.store = SeriesStore(self.config.max_series_per_shard,
+                                         self.config.samples_per_series,
+                                         dtype=self._dtype, device=self._device,
+                                         nbuckets=len(self.bucket_les))
         # 1. part keys -> index (ids were assigned densely in order)
         for pid, labels, start in self.sink.read_part_keys(self.dataset, self.shard_num) or ():
             pk = part_key_of(labels, self.schema.options)

@@ -22,7 +22,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..memory import deltadelta, nibblepack
+from ..memory import deltadelta, hist as histcodec, nibblepack
 
 # ---------------------------------------------------------------------------
 
@@ -93,8 +93,14 @@ class FileColumnStore(ChunkSink):
         frames = []
         for r in records:
             ts_enc = deltadelta.encode(r.ts)
-            val_enc = nibblepack.pack_doubles(np.asarray(r.values, np.float64))
-            frames.append(struct.pack("<IIII", r.part_id, len(r.ts),
+            vals = np.asarray(r.values)
+            if vals.ndim == 2:     # histogram: 2D-delta + NibblePack codec
+                nb = vals.shape[1]
+                val_enc = histcodec.encode_hist_series(vals)
+            else:
+                nb = 0
+                val_enc = nibblepack.pack_doubles(vals.astype(np.float64))
+            frames.append(struct.pack("<IIIII", r.part_id, len(r.ts), nb,
                                       len(ts_enc), len(val_enc)) + ts_enc + val_enc)
         payload = b"".join(frames)
         with open(os.path.join(self._dir(dataset, shard), "chunks.log"), "ab") as f:
@@ -120,10 +126,14 @@ class FileColumnStore(ChunkSink):
                 records = []
                 off = 0
                 for _ in range(n_rec):
-                    pid, n, tlen, vlen = struct.unpack_from("<IIII", payload, off)
-                    off += 16
+                    pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII", payload, off)
+                    off += 20
                     ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
-                    vals = nibblepack.unpack_doubles(payload[off:off + vlen], n); off += vlen
+                    if nb:
+                        vals = histcodec.decode_hist_series(payload[off:off + vlen]).astype(np.float64)
+                    else:
+                        vals = nibblepack.unpack_doubles(payload[off:off + vlen], n)
+                    off += vlen
                     if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
                         records.append(ChunkSetRecord(pid, ts, vals))
                 if records:
@@ -147,6 +157,18 @@ class FileColumnStore(ChunkSink):
                 if line.strip():
                     e = json.loads(line)
                     yield e["id"], e["labels"], e["start"]
+
+    def write_meta(self, dataset, shard, meta: dict):
+        path = os.path.join(self._dir(dataset, shard), "meta.json")
+        with open(path, "w") as f:
+            json.dump(meta, f)
+
+    def read_meta(self, dataset, shard) -> dict:
+        path = os.path.join(self._dir(dataset, shard), "meta.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
 
     # -- checkpoints (ref: cassandra/.../metastore/CheckpointTable.scala) ------
 

@@ -129,10 +129,11 @@ def _pack_groups(g: np.ndarray) -> bytes:
     return out.tobytes()
 
 
-def _unpack_groups(buf: bytes, n: int) -> np.ndarray:
+def _unpack_groups(buf: bytes, n: int, return_consumed: bool = False):
     """Decode ``n`` u64 words from ``buf`` (walks variable-size groups)."""
     if n == 0:
-        return np.zeros(0, dtype=_U64)
+        out0 = np.zeros(0, dtype=_U64)
+        return (out0, 0) if return_consumed else out0
     raw = np.frombuffer(buf, dtype=np.uint8)
     groups = -(-n // 8)
     out = np.zeros(groups * 8, dtype=_U64)
@@ -159,11 +160,19 @@ def _unpack_groups(buf: bytes, n: int) -> np.ndarray:
         lanes = np.nonzero([(bitmask >> i) & 1 for i in range(8)])[0]
         out[gi * 8 + lanes] = vals
         pos += 2 + nbytes
+    if return_consumed:
+        return out[:n], pos
     return out[:n]
 
 
 def unpack_u64(buf: bytes, n: int) -> np.ndarray:
     return _unpack_groups(buf, n)
+
+
+def unpack_u64_consumed(buf: bytes, n: int) -> tuple[np.ndarray, int]:
+    """Like unpack_u64 but also returns bytes consumed (for length-prefix-free
+    streams of packed arrays, e.g. the histogram codec)."""
+    return _unpack_groups(buf, n, return_consumed=True)
 
 
 def unpack_delta(buf: bytes, n: int) -> np.ndarray:

@@ -151,6 +151,124 @@ def grid_operands(C: int, out_ts: np.ndarray, window_ms: int, fn: str,
     )
 
 
+# ---- histograms -------------------------------------------------------------
+
+HIST_GRID_FNS = {"rate", "increase", "delta", "sum_over_time", "last_sample",
+                 "last_over_time"}
+
+
+@functools.partial(jax.jit, static_argnames=("fn",))
+def _grid_hist_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
+                      rel_out, window_ms, interval_ms, stale_ms):
+    """Histogram variant: val [S, C, B] cumulative bucket counts; outputs
+    [S, T, B]. Buckets share the series' sample times, so window edges and the
+    extrapolation factor are computed once and broadcast over B; the per-bucket
+    delta rides one einsum (ref: ChunkedRateFunction on HistogramVector —
+    rate/increase apply per bucket)."""
+    S, C, B = val.shape
+    acc = val.dtype
+    valid = jnp.arange(C, dtype=jnp.int32)[None, :] < n[:, None]
+    v = jnp.where(valid[:, :, None], val, 0).astype(acc)
+
+    last_cell = n[:, None] - 1
+    f_idx = jnp.maximum(lo, 0)[None, :]
+    l_idx = jnp.minimum(hi[None, :], last_cell)
+    cnt = jnp.maximum(l_idx - f_idx + 1, 0)                       # [S, T]
+    cnt_f = cnt.astype(acc)
+
+    if fn == "sum_over_time":
+        s = jnp.einsum("scb,ct->stb", v, band)
+        return jnp.where((cnt >= 1)[:, :, None], s, jnp.nan)
+
+    if fn in ("last_sample", "last_over_time"):
+        static_v = jnp.einsum("scb,ct->stb", v, onehot_hi)
+        row_last = jnp.take_along_axis(
+            v, jnp.clip(last_cell, 0, C - 1)[:, :, None], axis=1)  # [S, 1, B]
+        l_v = jnp.where((hi[None, :] <= last_cell)[:, :, None], static_v, row_last)
+        ok = cnt >= 1
+        if fn == "last_sample":
+            l_rel = l_idx * interval_ms
+            ok = ok & ((rel_out[None, :] - l_rel) <= stale_ms)
+        return jnp.where(ok[:, :, None], l_v, jnp.nan)
+
+    if fn in ("rate", "increase", "delta"):
+        is_counter = fn != "delta"
+        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+        pair = valid & jnp.concatenate([jnp.zeros_like(valid[:, :1]), valid[:, :-1]], 1)
+        raw_inc = jnp.where(pair[:, :, None], v - prev, 0.0)
+        inc = jnp.maximum(raw_inc, 0.0) if is_counter else raw_inc
+        delta = jnp.einsum("scb,ct->stb", inc, band_open)          # [S, T, B]
+        f_v = jnp.einsum("scb,ct->stb", v, onehot_lo)
+        f_rel = f_idx * interval_ms
+        l_rel = l_idx * interval_ms
+        win_end = rel_out[None, :]
+        dur_start = (f_rel - (win_end - window_ms)).astype(acc) / 1000.0   # [.., T]
+        dur_end = (win_end - l_rel).astype(acc) / 1000.0
+        sampled = (l_rel - f_rel).astype(acc) / 1000.0
+        avg_dur = sampled / (cnt_f - 1.0)
+        thresh = avg_dur * 1.1
+        extrap = sampled
+        extrap = extrap + jnp.where(dur_start < thresh, dur_start, avg_dur / 2)
+        extrap = extrap + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
+        factor = (extrap / sampled)[:, :, None]                    # [S, T, 1]
+        if is_counter:
+            dur_zero = jnp.where(delta > 0, sampled[:, :, None] * (f_v / delta), jnp.inf)
+            # per-bucket zero clamp (matches per-bucket extrapolatedRate)
+            ds = jnp.broadcast_to(dur_start[:, :, None], delta.shape)
+            ds = jnp.where((delta > 0) & (f_v >= 0) & (dur_zero < ds), dur_zero, ds)
+            extrap_b = sampled[:, :, None] + \
+                jnp.where(ds < thresh[:, :, None], ds, avg_dur[:, :, None] / 2) + \
+                jnp.where(dur_end[:, :, None] < thresh[:, :, None],
+                          dur_end[:, :, None], avg_dur[:, :, None] / 2)
+            factor = extrap_b / sampled[:, :, None]
+        scaled = delta * factor
+        if fn == "rate":
+            scaled = scaled * (1000.0 / window_ms.astype(acc))
+        return jnp.where((cnt >= 2)[:, :, None], scaled, jnp.nan)
+
+    raise ValueError(fn)  # pragma: no cover
+
+
+def periodic_samples_grid_hist(val, n, out_ts: np.ndarray, window_ms: int, fn: str,
+                               base_ts: int, interval_ms: int,
+                               stale_ms: int = 300_000):
+    """Histogram grid path: [S, T, B] output."""
+    C = val.shape[1]
+    dtype = np.float64 if val.dtype == jnp.float64 else np.float32
+    ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
+    return _grid_hist_kernel(fn, val, jnp.asarray(n, jnp.int32), ops["band"],
+                             ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
+                             ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
+                             ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
+
+
+@jax.jit
+def histogram_quantile(q, les, counts):
+    """Prometheus histogram_quantile, vectorized: les [B], counts [..., B]
+    cumulative -> [...] (ref: Histogram.scala quantile :288; device mirror of
+    memory/hist.py host reference)."""
+    B = les.shape[0]
+    total = counts[..., -1]
+    rank = q * total
+    # first bucket with cumulative >= rank
+    b = (counts < rank[..., None]).sum(axis=-1)
+    b = jnp.clip(b, 0, B - 1)
+    lo_le = jnp.where(b > 0, les[jnp.maximum(b - 1, 0)], 0.0)
+    hi_le = les[b]
+    lo_cnt = jnp.where(b > 0, jnp.take_along_axis(
+        counts, jnp.maximum(b - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
+    hi_cnt = jnp.take_along_axis(counts, b[..., None], axis=-1)[..., 0]
+    frac = jnp.where(hi_cnt > lo_cnt, (rank - lo_cnt) / (hi_cnt - lo_cnt), 1.0)
+    res = lo_le + (hi_le - lo_le) * frac
+    # +Inf top bucket: clamp to the highest finite bound
+    res = jnp.where(jnp.isinf(hi_le),
+                    jnp.where(b > 0, les[jnp.maximum(b - 1, 0)], jnp.nan), res)
+    res = jnp.where((total > 0) & ~jnp.isnan(total), res, jnp.nan)
+    res = jnp.where(q < 0, -jnp.inf, res)
+    res = jnp.where(q > 1, jnp.inf, res)
+    return res
+
+
 def periodic_samples_grid(val, n, out_ts: np.ndarray, window_ms: int, fn: str,
                           base_ts: int, interval_ms: int, stale_ms: int = 300_000):
     """Grid-path periodic samples over a uniform-start shard: [S, T] output."""

@@ -52,26 +52,28 @@ class SeriesSelection:
     selection (n is zeroed outside it).
     """
     ts: object                # [R, C] int64
-    val: object               # [R, C] float
+    val: object               # [R, C] float (or [R, C, B] histogram buckets)
     n: object                 # [R] int32 (0 => row disabled)
     keys: list[RangeVectorKey]
     rows: np.ndarray | None   # int32 [P] store-row of each key, or None
     grid: tuple | None = None  # (base_ts, interval_ms) => MXU band-matmul path
+    bucket_les: np.ndarray | None = None  # histogram bucket tops [B]
 
 
 @dataclass
 class MatrixView:
     """Post-kernel matrix that may still be un-compacted (R >= P rows)."""
     out_ts: np.ndarray
-    values: object            # [R, T]
+    values: object            # [R, T] (or [R, T, B] for histogram results)
     keys: list[RangeVectorKey]
     rows: np.ndarray | None
+    bucket_les: np.ndarray | None = None
 
     def compact(self) -> ResultMatrix:
         vals = self.values
         if self.rows is not None:
             vals = jnp.take(vals, jnp.asarray(self.rows), axis=0)
-        return ResultMatrix(self.out_ts, vals, self.keys)
+        return ResultMatrix(self.out_ts, vals, self.keys, self.bucket_les)
 
 
 def _pow2(n: int, floor: int = 8) -> int:
@@ -118,10 +120,21 @@ class PeriodicSamplesMapper(Transformer):
         a1 = args[1] if len(args) > 1 else 0.0
         from ..ops import gridfns
         grid_usable = (
-            data.grid is not None and fn in gridfns.GRID_FNS
+            data.grid is not None
             and max(abs(int(out_ts[0]) - data.grid[0]),
                     abs(int(out_ts[-1]) - data.grid[0])) + window < 2**31)
-        if grid_usable:
+        if data.bucket_les is not None:
+            # native histograms require the grid path (ref: HistogramVector is
+            # only read through chunked functions; general hist path is TODO)
+            if not (grid_usable and fn in gridfns.HIST_GRID_FNS):
+                raise QueryError(f"function {fn} not supported on histogram "
+                                 "series (or shard not grid-aligned)")
+            base_ts, interval_ms = data.grid
+            vals = gridfns.periodic_samples_grid_hist(
+                data.val, data.n, out_ts, window, fn, base_ts, interval_ms,
+                stale_ms=ctx.stale_ms)
+            return MatrixView(out_ts, vals, data.keys, data.rows, data.bucket_les)
+        if grid_usable and fn in gridfns.GRID_FNS:
             base_ts, interval_ms = data.grid
             vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
                                                  fn, base_ts, interval_ms,
@@ -139,6 +152,21 @@ class InstantVectorFunctionMapper(Transformer):
 
     def apply(self, data, ctx):
         m = _as_matrix(data)
+        if self.function in ("histogram_quantile", "histogram_bucket",
+                             "histogram_max_quantile"):
+            from ..ops import gridfns
+            if m.bucket_les is None:
+                raise QueryError(f"{self.function} requires native histogram series")
+            les = np.asarray(m.bucket_les, np.float64)
+            if self.function == "histogram_bucket":
+                b = int(np.argmin(np.abs(les - self.args[0])))
+                return ResultMatrix(m.out_ts, m.values[:, :, b], m.keys)
+            q = float(self.args[0])
+            vals = gridfns.histogram_quantile(jnp.float64(q), jnp.asarray(les),
+                                              jnp.asarray(m.values))
+            return ResultMatrix(m.out_ts, vals, m.keys)
+        if m.bucket_les is not None:
+            raise QueryError(f"{self.function} not supported on histogram series")
         if self.function == "absent":
             vals = np.asarray(m.values)
             empty = np.isnan(vals).all(axis=0) if len(m.keys) else np.ones(len(m.out_ts), bool)
@@ -196,8 +224,8 @@ class AggregateMapReduce(Transformer):
         if isinstance(data, MatrixView):
             m = data
         else:
-            m = _as_matrix(data)
-            m = MatrixView(m.out_ts, m.values, m.keys, None)
+            mm = _as_matrix(data)
+            m = MatrixView(mm.out_ts, mm.values, mm.keys, None, mm.bucket_les)
         gkeys = group_keys_of(m.keys, self.by, self.without)
         uniq: dict[RangeVectorKey, int] = {}
         gid_of_key = np.empty(len(gkeys), np.int32)
@@ -212,17 +240,25 @@ class AggregateMapReduce(Transformer):
             # the selection keep group 0 — harmless, their values are all-NaN
             gids = np.zeros(R, np.int32)
             gids[m.rows] = gid_of_key
-        parts = _segment_partial(self.operator, m.values, jnp.asarray(gids), _pow2(G))
-        return AggPartial(self.operator, m.out_ts, parts, list(uniq), G)
+        vals = m.values
+        les = m.bucket_les
+        if les is not None:
+            if self.operator not in ("sum", "count", "group"):
+                raise QueryError(f"{self.operator} not supported on histograms")
+            R_, T_, B_ = vals.shape
+            vals = vals.reshape(R_, T_ * B_)   # bucket-wise reduce (hSum)
+        parts = _segment_partial(self.operator, vals, jnp.asarray(gids), _pow2(G))
+        return AggPartial(self.operator, m.out_ts, parts, list(uniq), G, les)
 
 
 @dataclass
 class AggPartial:
     op: str
     out_ts: np.ndarray
-    parts: dict                     # name -> [Gpad, T] device arrays
+    parts: dict                     # name -> [Gpad, T] device arrays ([Gpad, T*B] hist)
     group_keys: list[RangeVectorKey]
     num_groups: int
+    bucket_les: np.ndarray | None = None
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3))
@@ -240,8 +276,11 @@ class AggregatePresenter(Transformer):
 
     def apply(self, data, ctx):
         if isinstance(data, AggPartial):
-            vals = aggregators.present_partials(data.op, data.parts)
-            return ResultMatrix(data.out_ts, vals[: data.num_groups], data.group_keys)
+            vals = aggregators.present_partials(data.op, data.parts)[: data.num_groups]
+            if data.bucket_les is not None:
+                B = len(data.bucket_les)
+                vals = vals.reshape(vals.shape[0], -1, B)
+            return ResultMatrix(data.out_ts, vals, data.group_keys, data.bucket_les)
         # full-matrix aggregators
         m = _as_matrix(data)
         gkeys = group_keys_of(m.keys, self.by, self.without)
@@ -397,14 +436,20 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def do_execute(self, ctx) -> SeriesSelection:
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
+        if shard.store is None:   # histogram shard with no data yet
+            z = jnp.zeros((8, 8), jnp.float32)
+            return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
+                                   jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
         keys = [RangeVectorKey.of(shard.index.labels_of(int(p))) for p in pids]
         store = shard.store
+        les = getattr(shard, "bucket_les", None)
         ts, val, n = store.arrays()
         total = len(shard.index)
         grid = store.grid_info()
         if len(pids) == 0:
-            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None, grid)
+            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None,
+                                   grid, les)
         if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
             # narrow selection: gather rows once, padded to a power of two
             P = _pow2(len(pids))
@@ -414,7 +459,7 @@ class SelectRawPartitionsExec(ExecPlan):
             sel_n = jnp.where(jnp.arange(P) < len(pids), jnp.take(n, rid), 0)
             return SeriesSelection(jnp.take(ts, rid, axis=0),
                                    jnp.take(val, rid, axis=0),
-                                   sel_n.astype(jnp.int32), keys, None, grid)
+                                   sel_n.astype(jnp.int32), keys, None, grid, les)
         # wide selection: no gather — disable non-selected rows via n = 0
         if len(pids) == store.S or len(pids) == total:
             n_eff = n
@@ -422,7 +467,7 @@ class SelectRawPartitionsExec(ExecPlan):
             mask = np.zeros(store.S, bool)
             mask[pids] = True
             n_eff = jnp.where(jnp.asarray(mask), n, 0)
-        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid)
+        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les)
 
 
 @dataclass
@@ -439,7 +484,7 @@ class DistConcatExec(ExecPlan):
         out_ts = mats[0].out_ts
         vals = np.concatenate([np.asarray(m.values) for m in mats], axis=0)
         keys = [k for m in mats for k in m.keys]
-        return ResultMatrix(out_ts, vals, keys)
+        return ResultMatrix(out_ts, vals, keys, mats[0].bucket_les)
 
 
 @dataclass
@@ -477,7 +522,8 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
     G = max(len(all_keys), 1)
     Gpad = _pow2(G)
     out_ts = partials[0].out_ts
-    T = len(out_ts)
+    les = partials[0].bucket_les
+    T = len(out_ts) * (len(les) if les is not None else 1)
     merged: dict[str, object] = {}
     for p in partials:
         # scatter this shard's groups into the global group space
@@ -501,7 +547,7 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
                     merged[name] = np.maximum(merged[name], base)
                 else:
                     merged[name] = merged[name] + base
-    return AggPartial(op, out_ts, merged, list(all_keys), G)
+    return AggPartial(op, out_ts, merged, list(all_keys), G, les)
 
 
 # ---------------------------------------------------------------------------

@@ -39,17 +39,24 @@ class RangeVectorKey:
 
 @dataclass
 class ResultMatrix:
-    """out_ts int64 [T]; values float [P, T] (device or host); keys len P."""
+    """out_ts int64 [T]; values float [P, T] (device or host); keys len P.
+    Histogram-valued matrices carry [P, T, B] values + bucket_les [B]."""
     out_ts: np.ndarray
-    values: object                      # jnp/np [P, T]
+    values: object                      # jnp/np [P, T] or [P, T, B]
     keys: list[RangeVectorKey]
+    bucket_les: np.ndarray | None = None
 
     @property
     def num_series(self) -> int:
         return len(self.keys)
 
+    @property
+    def is_histogram(self) -> bool:
+        return self.bucket_les is not None
+
     def to_host(self) -> "ResultMatrix":
-        return ResultMatrix(self.out_ts, np.asarray(self.values), self.keys)
+        return ResultMatrix(self.out_ts, np.asarray(self.values), self.keys,
+                            self.bucket_les)
 
     def iter_series(self) -> Iterator[tuple[RangeVectorKey, np.ndarray, np.ndarray]]:
         """Yield (key, ts, values) per series with NaN points dropped; series with
