@@ -1,0 +1,123 @@
+"""Downsampling: inline (at flush) and batch, reusing the store's grid structure.
+
+Reference: core/.../downsample/ChunkDownsampler.scala:18-30 (dMin/dMax/dSum/
+dCount/dAvg/dLast/tTime samplers), ShardDownsampler (emits downsample records at
+flush into a publisher), spark-jobs/.../BatchDownsampler.scala (6-hourly batch job
+over Cassandra chunks).
+
+TPU-native shape: downsample buckets on a grid-aligned shard are non-overlapping
+fixed-size cell ranges, so the whole shard downsamples with ``lax.reduce_window``
+(sum/min/max/count) and strided slices (last) — one fused pass per aggregate.
+Irregular shards use the general window kernels with bucket-end step times.
+
+Output model: one downsampled series store per aggregate. The reference packs all
+aggregates as extra columns of a downsample dataset and selects with ``__col__``;
+here each aggregate lands in its own dataset ``{name}:ds_{res}:{agg}`` queryable
+with standard PromQL (multi-column stores are a planned follow-up).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+DOWNSAMPLERS = ("dMin", "dMax", "dSum", "dCount", "dAvg", "dLast")
+
+
+@dataclass
+class DownsampledBlock:
+    """One aggregate's downsampled series block."""
+    agg: str
+    out_ts: np.ndarray        # bucket-end timestamps [Tds]
+    values: np.ndarray        # [S, Tds] (NaN = empty bucket)
+
+
+def grid_downsample(val, n, base_ts: int, interval_ms: int, resolution_ms: int,
+                    aggs=DOWNSAMPLERS) -> list[DownsampledBlock]:
+    """Downsample a grid-aligned store block [S, C] to ``resolution_ms`` buckets.
+
+    Bucket t covers cells ((t-1)*k, t*k] where k = resolution/interval; the
+    emitted timestamp is the bucket's last cell time (ref: ChunkDownsampler
+    tTime = last sample time in bucket, using bucket-end convention).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    S, C = val.shape
+    assert resolution_ms % interval_ms == 0, "resolution must be a multiple of the grid interval"
+    k = resolution_ms // interval_ms
+    Tds = C // k
+    valid = jnp.arange(C, dtype=jnp.int32)[None, :] < jnp.asarray(n)[:, None]
+    v = jnp.where(valid, val, 0.0)
+
+    def rw(x, init, op):
+        return lax.reduce_window(x, init, op, (1, k), (1, k), "VALID")[:, :Tds]
+
+    cnt = rw(valid.astype(val.dtype), 0.0, lax.add)
+    out: dict[str, np.ndarray] = {}
+    if "dSum" in aggs or "dAvg" in aggs:
+        s = rw(v, 0.0, lax.add)
+        out["dSum"] = s
+        if "dAvg" in aggs:
+            out["dAvg"] = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
+    if "dMin" in aggs:
+        out["dMin"] = rw(jnp.where(valid, val, jnp.inf), jnp.inf, lax.min)
+    if "dMax" in aggs:
+        out["dMax"] = rw(jnp.where(valid, val, -jnp.inf), -jnp.inf, lax.max)
+    if "dLast" in aggs:
+        out["dLast"] = v[:, k - 1::k][:, :Tds]
+    if "dCount" in aggs:
+        out["dCount"] = cnt
+    empty = np.asarray(cnt) == 0
+    out_ts = base_ts + (np.arange(Tds) * k + (k - 1)) * interval_ms
+    blocks = []
+    for agg in aggs:
+        if agg not in out:
+            continue
+        vals = np.asarray(out[agg], np.float64)
+        vals[empty] = np.nan
+        blocks.append(DownsampledBlock(agg, out_ts, vals))
+    return blocks
+
+
+def downsample_records(pids, ts, vals, resolution_ms: int,
+                       aggs=DOWNSAMPLERS) -> dict[str, tuple]:
+    """Host-side inline downsampling of one flush group's raw samples (ref:
+    ShardDownsampler emitting records during doFlushSteps). Input arrays are the
+    pending flush buffers (unsorted); returns per-agg (pids, ts, values) arrays
+    keyed on (series, bucket)."""
+    if len(pids) == 0:
+        return {}
+    bucket = ts // resolution_ms
+    # group key (series, bucket)
+    order = np.lexsort((ts, bucket, pids))
+    p, b, t, v = pids[order], bucket[order], ts[order], vals[order]
+    newgrp = np.concatenate([[True], (p[1:] != p[:-1]) | (b[1:] != b[:-1])])
+    gidx = np.cumsum(newgrp) - 1
+    ngroups = gidx[-1] + 1
+    out_pids = p[newgrp]
+    out_ts = (b[newgrp] + 1) * resolution_ms - 1    # bucket-end timestamp
+    res: dict[str, tuple] = {}
+    sums = np.bincount(gidx, weights=v, minlength=ngroups)
+    cnts = np.bincount(gidx, minlength=ngroups).astype(np.float64)
+    for agg in aggs:
+        if agg == "dSum":
+            res[agg] = (out_pids, out_ts, sums)
+        elif agg == "dCount":
+            res[agg] = (out_pids, out_ts, cnts)
+        elif agg == "dAvg":
+            res[agg] = (out_pids, out_ts, sums / cnts)
+        elif agg == "dMin":
+            m = np.full(ngroups, np.inf)
+            np.minimum.at(m, gidx, v)
+            res[agg] = (out_pids, out_ts, m)
+        elif agg == "dMax":
+            m = np.full(ngroups, -np.inf)
+            np.maximum.at(m, gidx, v)
+            res[agg] = (out_pids, out_ts, m)
+        elif agg == "dLast":
+            last = np.zeros(ngroups)
+            last[gidx] = v                        # last write wins (time-sorted)
+            res[agg] = (out_pids, out_ts, last)
+    return res

@@ -87,6 +87,9 @@ class TimeSeriesShard:
         self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
         self._pending_group_offset = np.full(G, -1, np.int64)
         self._persisted_parts = 0
+        # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
+        # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
+        self.downsample: tuple | None = None
         self.stats = ShardStats()
 
     # -- partition resolution ----------------------------------------------
@@ -191,6 +194,10 @@ class TimeSeriesShard:
                            vals[bounds[i]:bounds[i + 1]])
             for i in range(len(bounds) - 1)
         ]
+        if self.downsample is not None and vals.ndim == 1:
+            from .downsample import downsample_records
+            res_ms, publish = self.downsample
+            publish(self, downsample_records(pids, ts, vals, res_ms))
         if self.bucket_les is not None and self._persisted_parts == 0:
             if hasattr(self.sink, "write_meta"):
                 self.sink.write_meta(self.dataset, self.shard_num,

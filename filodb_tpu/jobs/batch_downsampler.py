@@ -1,0 +1,82 @@
+"""Batch downsampling job — the spark-jobs/DownsamplerMain equivalent.
+
+Reference: spark-jobs/.../DownsamplerMain.scala:6-31 (cron every 6h, 2h widen for
+late data), BatchDownsampler.scala (per-partition chunk reassembly + ChunkDownsampler
+kernels off-heap), PerThreadOffHeapMemory.
+
+TPU-native shape: instead of a Spark cluster mapping over Cassandra token ranges,
+the job streams chunksets from the column store, reassembles per-series arrays,
+downsamples (device ``grid_downsample`` when the data is grid-aligned, host
+fallback otherwise), and writes downsample chunksets back under
+``{dataset}:ds_{res}:{agg}`` — directly queryable datasets.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from ..core.downsample import DOWNSAMPLERS, downsample_records
+from ..core.store import ChunkSetRecord, FileColumnStore
+
+
+def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
+                         resolution_ms: int, start_ms: int = 0,
+                         end_ms: int = 1 << 62, aggs=DOWNSAMPLERS) -> dict[str, int]:
+    """Downsample one shard's persisted raw chunks; returns per-agg record counts."""
+    per_series_ts: dict[int, list] = defaultdict(list)
+    per_series_val: dict[int, list] = defaultdict(list)
+    for _group, records in store.read_chunksets(dataset, shard, start_ms, end_ms):
+        for r in records:
+            sel = (r.ts >= start_ms) & (r.ts <= end_ms)
+            if sel.any():
+                per_series_ts[r.part_id].append(r.ts[sel])
+                per_series_val[r.part_id].append(np.asarray(r.values)[sel])
+    if not per_series_ts:
+        return {}
+    pids = np.concatenate([np.full(sum(map(len, per_series_ts[p])), p, np.int32)
+                           for p in per_series_ts])
+    ts = np.concatenate([t for p in per_series_ts for t in per_series_ts[p]])
+    vals = np.concatenate([v for p in per_series_val for v in per_series_val[p]])
+    if vals.ndim > 1:
+        raise NotImplementedError("histogram batch downsampling lands in a later round")
+    dsrec = downsample_records(pids, ts, vals, resolution_ms, aggs)
+    written = {}
+    for agg, (opids, ots, ovals) in dsrec.items():
+        ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
+        # one chunkset per agg; per-series slices
+        order = np.argsort(opids, kind="stable")
+        op, ot, ov = opids[order], ots[order], ovals[order]
+        bounds = np.concatenate([[0], np.nonzero(np.diff(op))[0] + 1, [len(op)]])
+        recs = [ChunkSetRecord(int(op[bounds[i]]), ot[bounds[i]:bounds[i + 1]],
+                               ov[bounds[i]:bounds[i + 1]])
+                for i in range(len(bounds) - 1)]
+        store.write_chunkset(ds_name, shard, 0, recs)
+        # mirror the raw part keys so the downsample dataset is queryable
+        entries = list(store.read_part_keys(dataset, shard) or ())
+        if entries:
+            store.write_part_keys(ds_name, shard, entries)
+        written[agg] = len(recs)
+    return written
+
+
+def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
+                     resolution_ms: int, agg: str, memstore, config=None):
+    """Load a batch-downsampled dataset into a memstore for querying."""
+    from ..core.memstore import StoreConfig
+    from ..core.schemas import GAUGE
+    ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
+    shard_obj = memstore.setup(ds_name, GAUGE, shard, config or StoreConfig())
+    labels_by_pid = {pid: labels for pid, labels, _ in
+                     (store.read_part_keys(ds_name, shard) or ())}
+    for _g, records in store.read_chunksets(ds_name, shard) or ():
+        for r in records:
+            from ..core.record import RecordBuilder
+            b = RecordBuilder(GAUGE)
+            labels = labels_by_pid.get(r.part_id, {"_metric_": "unknown"})
+            for t, v in zip(r.ts, r.values):
+                b.add(labels, int(t), float(v))
+            shard_obj.ingest(b.build())
+    shard_obj.flush()
+    return shard_obj
