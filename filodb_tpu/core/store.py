@@ -23,6 +23,13 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..memory import deltadelta, hist as histcodec, nibblepack
+from ..memory import native as _native
+
+# persistence hot path prefers the C++ codecs (bit-identical; tests/test_native.py)
+if _native.available():
+    _pack_doubles, _unpack_doubles = _native.pack_doubles, _native.unpack_doubles
+else:  # pragma: no cover - toolchain-less fallback
+    _pack_doubles, _unpack_doubles = nibblepack.pack_doubles, nibblepack.unpack_doubles
 
 # ---------------------------------------------------------------------------
 
@@ -99,7 +106,7 @@ class FileColumnStore(ChunkSink):
                 val_enc = histcodec.encode_hist_series(vals)
             else:
                 nb = 0
-                val_enc = nibblepack.pack_doubles(vals.astype(np.float64))
+                val_enc = _pack_doubles(vals.astype(np.float64))
             frames.append(struct.pack("<IIIII", r.part_id, len(r.ts), nb,
                                       len(ts_enc), len(val_enc)) + ts_enc + val_enc)
         payload = b"".join(frames)
@@ -132,7 +139,7 @@ class FileColumnStore(ChunkSink):
                     if nb:
                         vals = histcodec.decode_hist_series(payload[off:off + vlen]).astype(np.float64)
                     else:
-                        vals = nibblepack.unpack_doubles(payload[off:off + vlen], n)
+                        vals = _unpack_doubles(payload[off:off + vlen], n)
                     off += vlen
                     if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
                         records.append(ChunkSetRecord(pid, ts, vals))

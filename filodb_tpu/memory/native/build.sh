@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native codec library. Called automatically on first import of
+# filodb_tpu.memory.native (and from CI); idempotent.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -o libfilodb_codecs.so codecs.cpp
+echo "built $(pwd)/libfilodb_codecs.so"

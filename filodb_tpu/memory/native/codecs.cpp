@@ -1,0 +1,145 @@
+// Native host codecs: NibblePack pack/unpack + delta-delta residuals.
+//
+// Reference role: the JVM reference's hot encode path is hand-rolled Scala over
+// sun.misc.Unsafe (memory/.../format/NibblePack.scala); here the equivalent
+// native layer is C++ compiled to a shared library and loaded via ctypes
+// (filodb_tpu/memory/native/__init__.py). The Python/numpy implementations in
+// nibblepack.py remain the reference/spec implementation; these functions are
+// bit-identical (tested in test_native.py) and used on the ingest/persistence
+// hot path where Python-loop decode would bottleneck.
+//
+// Build: memory/native/build.sh -> libfilodb_codecs.so
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline int leading_zero_nibbles(uint64_t v) {
+    if (v == 0) return 16;
+    return __builtin_clzll(v) / 4;
+}
+
+inline int trailing_zero_nibbles(uint64_t v) {
+    if (v == 0) return 16;
+    return __builtin_ctzll(v) / 4;
+}
+
+// Pack one group of 8 words; returns bytes written.
+inline size_t pack8(const uint64_t* in, uint8_t* out) {
+    uint8_t bitmask = 0;
+    int lead = 16, trail = 16;
+    for (int i = 0; i < 8; i++) {
+        if (in[i] != 0) {
+            bitmask |= (uint8_t)(1u << i);
+            int lz = leading_zero_nibbles(in[i]);
+            int tz = trailing_zero_nibbles(in[i]);
+            if (lz < lead) lead = lz;
+            if (tz < trail) trail = tz;
+        }
+    }
+    out[0] = bitmask;
+    if (bitmask == 0) return 1;
+    int nnib = 16 - lead - trail;
+    out[1] = (uint8_t)(trail | ((nnib - 1) << 4));
+    size_t nibpos = 0;   // nibble index within the stream starting at out+2
+    uint8_t* data = out + 2;
+    // stream is zero-initialized by caller requirement: we clear as we go
+    size_t totnib_max = (size_t)nnib * 8;
+    memset(data, 0, (totnib_max + 1) / 2);
+    for (int i = 0; i < 8; i++) {
+        if (!(bitmask & (1u << i))) continue;
+        uint64_t v = in[i] >> (4 * trail);
+        for (int k = 0; k < nnib; k++) {
+            uint8_t nib = (uint8_t)((v >> (4 * k)) & 0xF);
+            data[nibpos >> 1] |= (uint8_t)(nib << ((nibpos & 1) * 4));
+            nibpos++;
+        }
+    }
+    return 2 + (nibpos + 1) / 2;
+}
+
+inline size_t unpack8(const uint8_t* in, uint64_t* out) {
+    uint8_t bitmask = in[0];
+    for (int i = 0; i < 8; i++) out[i] = 0;
+    if (bitmask == 0) return 1;
+    int trail = in[1] & 0xF;
+    int nnib = (in[1] >> 4) + 1;
+    const uint8_t* data = in + 2;
+    size_t nibpos = 0;
+    for (int i = 0; i < 8; i++) {
+        if (!(bitmask & (1u << i))) continue;
+        uint64_t v = 0;
+        for (int k = 0; k < nnib; k++) {
+            uint64_t nib = (data[nibpos >> 1] >> ((nibpos & 1) * 4)) & 0xF;
+            v |= nib << (4 * k);
+            nibpos++;
+        }
+        out[i] = v << (4 * trail);
+    }
+    return 2 + (nibpos + 1) / 2;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack n u64 words; out must have room for n/8*34+34 bytes. Returns bytes written.
+size_t np_pack_u64(const uint64_t* in, size_t n, uint8_t* out) {
+    size_t pos = 0;
+    uint64_t group[8];
+    size_t full = n / 8;
+    for (size_t g = 0; g < full; g++) {
+        pos += pack8(in + g * 8, out + pos);
+    }
+    size_t rem = n % 8;
+    if (rem) {
+        memset(group, 0, sizeof(group));
+        memcpy(group, in + full * 8, rem * sizeof(uint64_t));
+        pos += pack8(group, out + pos);
+    }
+    return pos;
+}
+
+// Unpack n u64 words; returns bytes consumed.
+size_t np_unpack_u64(const uint8_t* in, size_t n, uint64_t* out) {
+    size_t pos = 0;
+    uint64_t group[8];
+    size_t groups = (n + 7) / 8;
+    for (size_t g = 0; g < groups; g++) {
+        pos += unpack8(in + pos, group);
+        size_t take = (g == groups - 1 && n % 8) ? n % 8 : 8;
+        memcpy(out + g * 8, group, take * sizeof(uint64_t));
+    }
+    return pos;
+}
+
+// XOR-chain doubles (Gorilla predictor): out[0] unused; caller writes head raw.
+void xor_chain(const uint64_t* bits, size_t n, uint64_t* out) {
+    for (size_t i = 1; i < n; i++) out[i - 1] = bits[i] ^ bits[i - 1];
+}
+
+void xor_unchain(uint64_t head, const uint64_t* xored, size_t n, uint64_t* out) {
+    out[0] = head;
+    for (size_t i = 1; i < n; i++) out[i] = out[i - 1] ^ xored[i - 1];
+}
+
+// delta-delta residuals vs the sloped line: resid[i] = v[i] - (first + slope*i),
+// zigzag-encoded into u64 (ref: doc/compression.md Long/Integer Compression).
+void dd_residuals(const int64_t* v, size_t n, int64_t first, int64_t slope,
+                  uint64_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        int64_t r = v[i] - (first + slope * (int64_t)i);
+        out[i] = (uint64_t)((r << 1) ^ (r >> 63));
+    }
+}
+
+void dd_restore(const uint64_t* zz, size_t n, int64_t first, int64_t slope,
+                int64_t* out) {
+    for (size_t i = 0; i < n; i++) {
+        int64_t r = (int64_t)(zz[i] >> 1) ^ -(int64_t)(zz[i] & 1);
+        out[i] = first + slope * (int64_t)i + r;
+    }
+}
+
+}  // extern "C"
