@@ -1,0 +1,118 @@
+"""Layered configuration system.
+
+Reference: Typesafe HOCON layering — core/src/main/resources/filodb-defaults.conf
+(367 lines of defaults incl. schema definitions :17-106, store-factory FQCN :273,
+spread :128-133) <- server conf <- per-dataset source configs
+(conf/timeseries-dev-source.conf, parsed by core/.../store/IngestionConfig.scala).
+
+Here: JSON (a strict HOCON subset) with deep-merge layering:
+defaults <- config file <- programmatic overrides. Duration strings ("5m",
+"2h", "90s") are accepted anywhere a *_ms value is expected.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+from typing import Any
+
+DEFAULTS: dict[str, Any] = {
+    "dataset": "prometheus",
+    "schema": "gauge",
+    "num_shards": 1,
+    "spread": 0,
+    "store": {
+        "max_series_per_shard": 1 << 20,
+        "samples_per_series": 1024,
+        "flush_batch_size": 65536,
+        "groups_per_shard": 16,
+        "retention": "3h",
+        "dtype": "float32",
+    },
+    "query": {
+        "stale_sample_after": "5m",
+        "sample_limit": 1_000_000,
+    },
+    "downsample": {
+        "enabled": False,
+        "resolutions": ["1m"],
+    },
+    "http": {"host": "127.0.0.1", "port": 8080},
+    "data_dir": None,            # enables the durable FileColumnStore when set
+    "bus_dir": None,             # enables FileBus ingestion when set
+    "profiler": {"enabled": False, "interval": "100ms"},
+    "tracing": {"log_spans": False},
+}
+
+_DUR = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def parse_duration_ms(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|[smhd])", str(v))
+    if not m:
+        raise ValueError(f"bad duration {v!r}")
+    return int(float(m.group(1)) * _DUR[m.group(2)])
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+class Config:
+    def __init__(self, *layers: dict):
+        merged = DEFAULTS
+        for layer in layers:
+            if layer:
+                merged = _deep_merge(merged, layer)
+        self.data = merged
+
+    @classmethod
+    def load(cls, path: str | None = None, overrides: dict | None = None) -> "Config":
+        layers = []
+        if path:
+            with open(path) as f:
+                layers.append(json.load(f))
+        if overrides:
+            layers.append(overrides)
+        return cls(*layers)
+
+    def __getitem__(self, dotted: str):
+        cur = self.data
+        for part in dotted.split("."):
+            cur = cur[part]
+        return cur
+
+    def get(self, dotted: str, default=None):
+        try:
+            return self[dotted]
+        except KeyError:
+            return default
+
+    def store_config(self):
+        from .core.memstore import StoreConfig
+        s = self.data["store"]
+        return StoreConfig(
+            max_series_per_shard=s["max_series_per_shard"],
+            samples_per_series=s["samples_per_series"],
+            flush_batch_size=s["flush_batch_size"],
+            groups_per_shard=s["groups_per_shard"],
+            retention_ms=parse_duration_ms(s["retention"]),
+            dtype=s["dtype"],
+        )
+
+    def query_config(self):
+        from .query.engine import QueryConfig
+        q = self.data["query"]
+        return QueryConfig(
+            stale_sample_after_ms=parse_duration_ms(q["stale_sample_after"]),
+            sample_limit=q["sample_limit"],
+        )

@@ -261,6 +261,57 @@ class TimeSeriesShard:
             self.flush()
         return replayed
 
+    # -- on-demand paging (ref: OnDemandPagingShard.scala:26,58 +
+    #    DemandPagedChunkStore.scala:35 — cold chunks paged in for queries) -----
+
+    def needs_paging(self, pids: np.ndarray, start_ms: int) -> bool:
+        """True when the query needs data older than what's resident for any
+        selected series and a durable sink exists to page from."""
+        if self.sink is None or len(pids) == 0 or self.store is None:
+            return False
+        first = self.store.first_ts[pids]
+        return bool((first[first >= 0] > start_ms).any())
+
+    def read_with_paging(self, pids: np.ndarray, start_ms: int, end_ms: int):
+        """Merged (ts [P, C'], val [P, C'], n [P]) host arrays combining paged
+        cold chunks (from the sink) with resident device data, deduped on the
+        per-series resident first-timestamp boundary."""
+        from .chunkstore import TS_PAD
+        cold_ts: dict[int, list] = {int(p): [] for p in pids}
+        cold_val: dict[int, list] = {int(p): [] for p in pids}
+        reader = getattr(self.sink, "read_chunksets", None)
+        if reader is not None:
+            for _g, records in reader(self.dataset, self.shard_num, start_ms, end_ms) or ():
+                for r in records:
+                    if r.part_id in cold_ts:
+                        cold_ts[r.part_id].append(r.ts)
+                        cold_val[r.part_id].append(np.asarray(r.values))
+        rows_ts, rows_val = [], []
+        for p in pids:
+            p = int(p)
+            hot_t, hot_v = self.store.series_snapshot(p)
+            boundary = hot_t[0] if len(hot_t) else (1 << 62)
+            if cold_ts[p]:
+                ct = np.concatenate(cold_ts[p])
+                cv = np.concatenate(cold_val[p])
+                sel = ct < boundary            # dedupe vs resident data
+                order = np.argsort(ct[sel], kind="stable")
+                rows_ts.append(np.concatenate([ct[sel][order], hot_t]))
+                rows_val.append(np.concatenate([cv[sel][order], hot_v]))
+            else:
+                rows_ts.append(hot_t)
+                rows_val.append(hot_v)
+        C = max((len(t) for t in rows_ts), default=1)
+        P = len(pids)
+        ts_arr = np.full((P, C), TS_PAD, np.int64)
+        val_arr = np.zeros((P, C), np.float64)
+        n_arr = np.zeros(P, np.int32)
+        for i, (t, v) in enumerate(zip(rows_ts, rows_val)):
+            ts_arr[i, :len(t)] = t
+            val_arr[i, :len(t)] = v
+            n_arr[i] = len(t)
+        return ts_arr, val_arr, n_arr
+
     # -- queries ------------------------------------------------------------
 
     def part_ids_from_filters(self, filters: list[Filter], start: int, end: int,

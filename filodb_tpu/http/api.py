@@ -128,6 +128,15 @@ class FiloHttpServer:
         if path == "/__health":
             h._send(200, {"status": "healthy"})
             return
+        if path == "/metrics":
+            from ..utils.metrics import registry
+            body = registry.expose_prometheus().encode()
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain; version=0.0.4")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+            return
         if path == "/api/v1/cluster/status" or path.startswith("/api/v1/cluster/"):
             h._send(200, {"status": "success", "data": self._cluster_status(path)})
             return

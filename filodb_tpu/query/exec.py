@@ -444,6 +444,16 @@ class SelectRawPartitionsExec(ExecPlan):
         keys = [RangeVectorKey.of(shard.index.labels_of(int(p))) for p in pids]
         store = shard.store
         les = getattr(shard, "bucket_les", None)
+        # on-demand paging: query reaches behind resident data -> merge cold
+        # chunks from the sink (ref: OnDemandPagingShard.scanPartitions)
+        if les is None and shard.needs_paging(pids, self.start_ms):
+            if len(pids) > GATHER_THRESHOLD:
+                raise QueryError(
+                    f"{len(pids)} series need on-demand paging beyond memory "
+                    "retention; narrow the selection or query a downsampled dataset")
+            ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms, self.end_ms)
+            return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
+                                   jnp.asarray(n_h), keys, None, None)
         ts, val, n = store.arrays()
         total = len(shard.index)
         grid = store.grid_info()

@@ -1,0 +1,133 @@
+"""Standalone server: config -> cluster -> shards -> ingestion -> HTTP.
+
+Reference: standalone/.../FiloServer.scala:15-38 (bootstraps the cluster, creates
+datasets from config, starts HTTP) + coordinator/.../IngestionActor.scala:57
+(per-shard ingestion lifecycle: resync on shard assignment, recovery from
+checkpoints, then live consumption with status events).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .config import Config, parse_duration_ms
+from .core.memstore import TimeSeriesMemStore
+from .core.store import FileColumnStore
+from .http.api import FiloHttpServer
+from .ingest.bus import FileBus
+from .parallel.cluster import ShardManager, ShardStatus
+from .parallel.shardmapper import ShardMapper
+from .query.engine import QueryEngine
+from .utils.metrics import ShardHealthStats, registry
+from .utils.tracing import tracer
+
+log = logging.getLogger("filodb_tpu.server")
+
+
+class IngestionConsumer(threading.Thread):
+    """Per-shard bus consumer (ref: IngestionActor drives memStore.ingestStream /
+    recoverStream with RecoveryInProgress -> IngestionStarted events)."""
+
+    def __init__(self, shard, bus: FileBus, schemas, manager: ShardManager,
+                 dataset: str, poll_s: float = 0.5):
+        super().__init__(daemon=True, name=f"ingest-{dataset}-{shard.shard_num}")
+        self.shard = shard
+        self.bus = bus
+        self.schemas = schemas
+        self.manager = manager
+        self.dataset = dataset
+        self.poll_s = poll_s
+        self._stop_ev = threading.Event()
+        self._offset = 0
+
+    def run(self):
+        sh = self.shard
+        try:
+            if sh.sink is not None:
+                self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.RECOVERY)
+                sh.recover(self.bus, self.schemas)
+                wm = sh.group_watermarks
+                self._offset = int(self.bus.end_offset)
+            self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ACTIVE)
+            rows = registry.counter("filodb_ingested_rows",
+                                    {"dataset": self.dataset, "shard": str(sh.shard_num)})
+            while not self._stop_ev.wait(self.poll_s):
+                for off, container in self.bus.consume(self.schemas, self._offset):
+                    sh.ingest(container, off)
+                    rows.increment(len(container))
+                    self._offset = off + 1
+                sh.flush()
+                if sh.sink is not None:
+                    sh.flush_all_groups()
+        except Exception:  # noqa: BLE001
+            log.exception("ingestion failed for shard %s", sh.shard_num)
+            self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ERROR)
+
+    def stop(self):
+        self._stop_ev.set()
+
+
+class FiloServer:
+    def __init__(self, config: Config | None = None, node_name: str = "local"):
+        self.config = config or Config()
+        self.node = node_name
+        self.memstore = TimeSeriesMemStore()
+        self.manager = ShardManager()
+        self.manager.add_node(node_name)
+        self.consumers: list[IngestionConsumer] = []
+        self.http: FiloHttpServer | None = None
+        self.engines: dict[str, QueryEngine] = {}
+        self.profiler = None
+
+    def start(self) -> "FiloServer":
+        cfg = self.config
+        dataset = cfg["dataset"]
+        num_shards = cfg["num_shards"]
+        self.manager.add_dataset(dataset, num_shards)
+        sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
+        store_cfg = cfg.store_config()
+        health = ShardHealthStats(dataset)
+        self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
+        for shard_num in self.manager.shards_of_node(dataset, self.node):
+            shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
+                                        store_cfg, sink=sink)
+            if cfg.get("bus_dir"):
+                bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
+                c = IngestionConsumer(shard, bus, self.memstore.schemas,
+                                      self.manager, dataset)
+                self.consumers.append(c)
+                c.start()
+            else:
+                self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
+        mapper = ShardMapper(_pow2(num_shards), spread=cfg["spread"])
+        self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
+                                            cfg.query_config())
+        self.http = FiloHttpServer(self.engines, host=cfg["http.host"],
+                                   port=cfg["http.port"], cluster=self.manager).start()
+        if cfg.get("profiler.enabled"):
+            from .utils.profiler import SimpleProfiler
+            self.profiler = SimpleProfiler(
+                parse_duration_ms(cfg["profiler.interval"]) / 1000.0).start()
+        tracer.log_spans = bool(cfg.get("tracing.log_spans"))
+        log.info("FiloServer up: dataset=%s shards=%s port=%s",
+                 dataset, num_shards, self.http.port)
+        return self
+
+    def shutdown(self) -> None:
+        for c in self.consumers:
+            c.stop()
+        for c in self.consumers:
+            c.join(timeout=3)
+        if self.http:
+            self.http.stop()
+        if self.profiler:
+            self.profiler.stop()
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

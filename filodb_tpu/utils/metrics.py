@@ -1,0 +1,123 @@
+"""Metrics: counters/gauges/histograms + Prometheus text exposition.
+
+Reference: Kamon instrumentation throughout the hot paths (TimeSeriesShardStats
+TimeSeriesShard.scala:36-97, MemoryStats BlockManager.scala:63, ChunkSinkStats,
+ShardHealthStats.scala) exported via the Prometheus embedded server / log
+reporters (coordinator/.../KamonLogger.scala).
+
+One process-global registry; the HTTP server exposes it at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_right
+from collections import defaultdict
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def increment(self, by: float = 1.0):
+        with self._lock:
+            self._v += by
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    def __init__(self):
+        self.value = 0.0
+
+    def update(self, v: float):
+        self.value = float(v)
+
+
+class Histogram:
+    """Fixed-boundary histogram (ms-scale latencies by default)."""
+
+    DEFAULT_BOUNDS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
+
+    def __init__(self, bounds=DEFAULT_BOUNDS):
+        self.bounds = list(bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def record(self, v: float):
+        with self._lock:
+            self.buckets[bisect_right(self.bounds, v)] += 1
+            self.sum += v
+            self.count += 1
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, tags: dict | None):
+        key = (name, tuple(sorted((tags or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls()
+            return m
+
+    def counter(self, name: str, tags: dict | None = None) -> Counter:
+        return self._get(Counter, name, tags)
+
+    def gauge(self, name: str, tags: dict | None = None) -> Gauge:
+        return self._get(Gauge, name, tags)
+
+    def histogram(self, name: str, tags: dict | None = None) -> Histogram:
+        return self._get(Histogram, name, tags)
+
+    def expose_prometheus(self) -> str:
+        """Prometheus text format 0.0.4."""
+        lines = []
+        for (name, tags), m in sorted(self._metrics.items()):
+            tag_s = ",".join(f'{k}="{v}"' for k, v in tags)
+            tag_s = "{" + tag_s + "}" if tag_s else ""
+            if isinstance(m, Counter):
+                lines.append(f"{name}_total{tag_s} {m.value:g}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{name}{tag_s} {m.value:g}")
+            elif isinstance(m, Histogram):
+                cum = 0
+                for b, c in zip(m.bounds, m.buckets):
+                    cum += c
+                    lt = (tag_s[:-1] + "," if tag_s else "{") + f'le="{b}"' + "}"
+                    lines.append(f"{name}_bucket{lt} {cum}")
+                lt = (tag_s[:-1] + "," if tag_s else "{") + 'le="+Inf"}'
+                lines.append(f"{name}_bucket{lt} {m.count}")
+                lines.append(f"{name}_sum{tag_s} {m.sum:g}")
+                lines.append(f"{name}_count{tag_s} {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+registry = MetricsRegistry()
+
+
+class ShardHealthStats:
+    """Ref: coordinator/.../ShardHealthStats.scala — gauges per dataset for
+    active/recovering/down shard counts fed from ShardManager snapshots."""
+
+    def __init__(self, dataset: str, reg: MetricsRegistry = registry):
+        self.dataset = dataset
+        self.reg = reg
+
+    def update(self, snapshot: dict) -> None:
+        counts = defaultdict(int)
+        for info in snapshot.values():
+            counts[info["status"]] += 1
+        for status in ("Active", "Assigned", "Recovery", "Down", "Unassigned"):
+            self.reg.gauge("filodb_shard_status",
+                           {"dataset": self.dataset, "status": status}
+                           ).update(counts.get(status, 0))

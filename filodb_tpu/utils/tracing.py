@@ -1,0 +1,84 @@
+"""Tracing: lightweight spans with an in-process collector + log/JSON reporters.
+
+Reference: Kamon spans on hot paths (ODP span OnDemandPagingShard.scala:47-50,
+query spans queryengine2/QueryEngine.scala:62-66) exported to Zipkin via the
+custom reporter (core/.../zipkin/Zipkin.scala:24) and span log reporters
+(KamonLogger.scala). Here: ``with span("query.execute", tags)`` records timing
+into a ring buffer; reporters drain it (logging by default; a Zipkin v2 JSON
+exporter can POST the same records when an endpoint is configured).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+
+log = logging.getLogger("filodb_tpu.trace")
+
+
+@dataclass
+class SpanRecord:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    start_us: int
+    duration_us: int
+    tags: dict = field(default_factory=dict)
+
+    def to_zipkin(self) -> dict:
+        """Zipkin v2 JSON shape (ref: Zipkin.scala converts Kamon spans)."""
+        return {"traceId": self.trace_id, "id": self.span_id,
+                "parentId": self.parent_id, "name": self.name,
+                "timestamp": self.start_us, "duration": self.duration_us,
+                "tags": {k: str(v) for k, v in self.tags.items()}}
+
+
+class Tracer:
+    def __init__(self, capacity: int = 4096):
+        self.spans: deque[SpanRecord] = deque(maxlen=capacity)
+        self._local = threading.local()
+        self.log_spans = False
+
+    def _stack(self):
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags):
+        stack = self._stack()
+        trace_id = stack[0][0] if stack else uuid.uuid4().hex[:16]
+        parent_id = stack[-1][1] if stack else None
+        span_id = uuid.uuid4().hex[:16]
+        stack.append((trace_id, span_id))
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            stack.pop()
+            dur = int((time.time() - t0) * 1e6)
+            rec = SpanRecord(trace_id, span_id, parent_id, name,
+                             int(t0 * 1e6), dur, tags)
+            self.spans.append(rec)
+            if self.log_spans:
+                log.info("span %s %.1fms %s", name, dur / 1000, tags)
+
+    def drain(self) -> list[SpanRecord]:
+        out = list(self.spans)
+        self.spans.clear()
+        return out
+
+    def export_zipkin_json(self) -> str:
+        return json.dumps([s.to_zipkin() for s in self.spans])
+
+
+tracer = Tracer()
+span = tracer.span
