@@ -19,6 +19,7 @@ TPU-native shape of the same responsibilities:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -62,6 +63,11 @@ class TimeSeriesShard:
         self.config = config
         self.index = PartKeyIndex()
         self._part_key_to_id: dict[bytes, int] = {}
+        # guards the donating device append vs concurrent query dispatch: the
+        # scatter invalidates (donates) the old store buffers, so query leaves
+        # must capture arrays AND dispatch their kernels under this lock
+        # (ref analog: per-shard single ingest thread + ChunkMap read locks)
+        self.lock = threading.RLock()
         self._device = device
         self._dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
         self.bucket_les: np.ndarray | None = None
@@ -160,7 +166,8 @@ class TimeSeriesShard:
         vals = np.concatenate(self._stage_val, axis=0)
         self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
         self._staged = 0
-        written = self.store.append(pids, ts, vals)
+        with self.lock:
+            written = self.store.append(pids, ts, vals)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset

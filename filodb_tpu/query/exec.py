@@ -434,6 +434,14 @@ class SelectRawPartitionsExec(ExecPlan):
     start_ms: int = 0
     end_ms: int = 0
 
+    def execute(self, ctx: QueryContext):
+        # hold the shard lock across array capture AND the transformer chain's
+        # kernel dispatch: a concurrent ingest flush donates (invalidates) the
+        # store buffers (see TimeSeriesShard.lock)
+        shard = ctx.memstore.shard(ctx.dataset, self.shard)
+        with shard.lock:
+            return super().execute(ctx)
+
     def do_execute(self, ctx) -> SeriesSelection:
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
         if shard.store is None:   # histogram shard with no data yet

@@ -1,0 +1,54 @@
+"""Ingestion stress: sustained record throughput into one shard.
+
+Reference: stress/src/main/scala/filodb.stress/IngestionStress.scala (+
+MemStoreStress). Run: python stress/ingestion_stress.py [n_series] [n_samples]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder, RecordContainer
+from filodb_tpu.core.schemas import GAUGE, Schemas, part_key_of, shard_key_of
+from filodb_tpu.core.record import fnv1a64
+
+
+def main(n_series=100_000, n_samples=100, batch_ts=10):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=1 << 21, samples_per_series=256,
+                      flush_batch_size=1 << 20)
+    shard = ms.setup("stress", GAUGE, 0, cfg)
+    base = 1_700_000_000_000
+
+    # Pre-build label sets + hashes once (gateway does this incrementally)
+    labels = [{"_metric_": "stress_metric", "_ws_": "w", "_ns_": "n",
+               "host": f"h{i % 1000}", "instance": f"i{i}"} for i in range(n_series)]
+    ph = np.array([fnv1a64(part_key_of(l)) for l in labels], np.uint64)
+    sh = np.array([fnv1a64(shard_key_of(l)) & 0xFFFFFFFF for l in labels], np.uint32)
+    pidx = np.arange(n_series, dtype=np.int32)
+
+    t0 = time.perf_counter()
+    total = 0
+    rng = np.random.default_rng(0)
+    for t_block in range(0, n_samples, batch_ts):
+        k = min(batch_ts, n_samples - t_block)
+        ts = np.repeat(base + (t_block + np.arange(k)) * 10_000, n_series)
+        vals = rng.random(k * n_series)
+        container = RecordContainer(
+            GAUGE, ts.astype(np.int64), vals, np.tile(ph, k), np.tile(sh, k),
+            np.tile(pidx, k), labels)
+        shard.ingest(container)
+        total += len(container)
+    shard.flush()
+    dt = time.perf_counter() - t0
+    print(f"ingested {total:,} samples across {n_series:,} series in {dt:.2f}s "
+          f"= {total / dt:,.0f} samples/s")
+    print(f"series created: {shard.num_series:,}; dropped ooo: "
+          f"{shard.store.stats.out_of_order_dropped}")
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:]]
+    main(*args)

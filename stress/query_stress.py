@@ -1,0 +1,69 @@
+"""Query stress: concurrent PromQL load against an in-memory dataset.
+
+Reference: stress/src/main/scala/filodb.stress/InMemoryQueryStress.scala.
+Run: python stress/query_stress.py [n_series] [n_queries] [concurrency]
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.ingest.stream import SyntheticStream
+from filodb_tpu.query.engine import QueryEngine
+
+QUERIES = [
+    'sum(rate(heap_usage0{{_ws_="demo"}}[5m]))',
+    'avg_over_time(heap_usage0{{instance="Instance-{i}"}}[2m])',
+    'topk(5, heap_usage0)',
+    'quantile(0.9, heap_usage0)',
+    'sum by (dc) (heap_usage0)',
+]
+
+
+def main(n_series=1000, n_queries=200, concurrency=4):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=1 << 14, samples_per_series=1024,
+                      flush_batch_size=1 << 22)
+    ms.setup("stress", "gauge", 0, cfg)
+    start = 1_000_000
+    for off, c in SyntheticStream(n_series=n_series, n_batches=20,
+                                  samples_per_batch=36, start_ms=start,
+                                  kind="counter"):
+        ms.ingest("stress", 0, c, off)
+    ms.flush_all()
+    eng = QueryEngine(ms, "stress")
+    end = start + 720 * 10_000
+    lat: list[float] = []
+    lock = threading.Lock()
+    idx = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= n_queries:
+                    return
+                idx[0] += 1
+            q = QUERIES[i % len(QUERIES)].format(i=i % n_series)
+            t0 = time.perf_counter()
+            eng.query_range(q, start + 600_000, end, 150_000)
+            with lock:
+                lat.append((time.perf_counter() - t0) * 1000)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    dt = time.perf_counter() - t0
+    lat_arr = np.array(lat)
+    print(f"{n_queries} queries, {concurrency} workers, {n_series} series: "
+          f"{n_queries / dt:.1f} qps; p50={np.percentile(lat_arr, 50):.1f}ms "
+          f"p99={np.percentile(lat_arr, 99):.1f}ms")
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:]]
+    main(*args)

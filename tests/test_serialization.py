@@ -1,0 +1,25 @@
+"""Result wire-format round-trip (ref analog: coordinator/.../client/
+SerializationSpec.scala — Kryo round-trips of query results)."""
+
+import numpy as np
+
+from filodb_tpu.query.rangevector import (RangeVectorKey, ResultMatrix,
+                                          deserialize_matrix, serialize_matrix)
+
+
+def test_matrix_wire_roundtrip(rng):
+    out_ts = np.arange(0, 1000, 100, dtype=np.int64)
+    vals = rng.normal(size=(3, 10))
+    vals[1, 4] = np.nan
+    keys = [RangeVectorKey.of({"_metric_": "m", "host": f"h{i}"}) for i in range(3)]
+    m = ResultMatrix(out_ts, vals, keys)
+    back = deserialize_matrix(serialize_matrix(m))
+    np.testing.assert_array_equal(back.out_ts, out_ts)
+    np.testing.assert_array_equal(back.values, vals)
+    assert back.keys == keys
+
+
+def test_empty_matrix_roundtrip():
+    m = ResultMatrix(np.zeros(0, np.int64), np.zeros((0, 0)), [])
+    back = deserialize_matrix(serialize_matrix(m))
+    assert back.num_series == 0
