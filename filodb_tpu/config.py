@@ -29,6 +29,10 @@ DEFAULTS: dict[str, Any] = {
         "groups_per_shard": 16,
         "retention": "3h",
         "dtype": "float32",
+        # periodic purge of series that went quiet > retention ago, measured in
+        # *data time* (max ingested ts), so backfilled workloads behave the same
+        # as live ones (ref: TimeSeriesShard.purgeExpiredPartitions cadence)
+        "purge_interval": "10m",
     },
     "query": {
         "stale_sample_after": "5m",

@@ -61,6 +61,13 @@ def _compact(ts, val, n, cutoff):
     return new_ts, new_val, new_n
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _free_rows(ts, n, pids):
+    ts = ts.at[pids, :].set(TS_PAD, mode="drop")
+    n = n.at[pids].set(0, mode="drop")
+    return ts, n
+
+
 def _pad_size(m: int) -> int:
     """Bucket flush sizes to powers of two to bound jit recompilations."""
     size = 1024
@@ -242,6 +249,24 @@ class SeriesStore:
         new_first = np.array(self.ts[:, 0])
         self.first_ts = np.where(self.n_host > 0, new_first, -1)
         self.stats.compactions += 1
+
+    def free_rows(self, part_ids: np.ndarray) -> None:
+        """Release the rows of purged partitions so their slots can be reused
+        (ref: TimeSeriesShard partition purge frees the partition's memory).
+        Stale val cells stay in HBM but are masked by n=0; the ts rows are
+        reset to padding so grid/first-ts scans never see them. Buffers are
+        donated in-place — no transient second copy of the [S, C] arrays."""
+        if len(part_ids) == 0:
+            return
+        m = len(part_ids)
+        P = _pad_size(m)
+        # padded entries use row S -> dropped by the out-of-bounds scatter mode
+        pp = np.full(P, self.S, np.int32)
+        pp[:m] = np.asarray(part_ids, np.int32)
+        self.ts, self.n = _free_rows(self.ts, self.n, jnp.asarray(pp))
+        self.n_host[part_ids] = 0
+        self.first_ts[part_ids] = -1
+        self.last_ts[part_ids] = -(1 << 62)
 
     # -- query access -------------------------------------------------------
 

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .chunkstore import SeriesStore
+from .eviction import BloomFilter, CapacityEvictionPolicy, EvictionPolicy
 from .filters import Filter
 from .partkey_index import PartKeyIndex
 from .record import RecordContainer
@@ -49,13 +50,16 @@ class ShardStats:
     rows_ingested: int = 0
     series_created: int = 0
     unknown_schema_dropped: int = 0
+    partitions_purged: int = 0
+    evicted_part_key_reingests: int = 0
 
 
 class TimeSeriesShard:
     """All state for one shard of one dataset."""
 
     def __init__(self, dataset: str, schema: Schema, shard_num: int, config: StoreConfig,
-                 device=None, sink: ChunkSink | None = None):
+                 device=None, sink: ChunkSink | None = None,
+                 eviction_policy: EvictionPolicy | None = None):
         import jax.numpy as jnp
         self.dataset = dataset
         self.schema = schema
@@ -63,6 +67,12 @@ class TimeSeriesShard:
         self.config = config
         self.index = PartKeyIndex()
         self._part_key_to_id: dict[bytes, int] = {}
+        self._part_key_of_id: dict[int, bytes] = {}
+        # purged slots available for reuse + membership filter of evicted keys
+        # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
+        self._free_pids: list[int] = []
+        self._evicted_keys = BloomFilter()
+        self.eviction_policy = eviction_policy or CapacityEvictionPolicy()
         # guards the donating device append vs concurrent query dispatch: the
         # scatter invalidates (donates) the old store buffers, so query leaves
         # must capture arrays AND dispatch their kernels under this lock
@@ -92,7 +102,8 @@ class TimeSeriesShard:
         G = config.groups_per_shard
         self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
         self._pending_group_offset = np.full(G, -1, np.int64)
-        self._persisted_parts = 0
+        self._new_part_pids: list[int] = []   # created since last part-key persist
+        self._meta_written = False
         # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
         # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
         self.downsample: tuple | None = None
@@ -105,13 +116,21 @@ class TimeSeriesShard:
         new partitions (and index entries) as needed."""
         mapping = np.empty(len(container.label_sets), np.int32)
         first_ts = int(container.ts.min()) if len(container) else 0
+        with self.lock:
+            return self._resolve_part_ids_locked(container, mapping, first_ts)
+
+    def _resolve_part_ids_locked(self, container, mapping, first_ts) -> np.ndarray:
         for i, labels in enumerate(container.label_sets):
             pk = part_key_of(labels, self.schema.options)
             pid = self._part_key_to_id.get(pk)
             if pid is None:
-                pid = len(self.index)
+                if pk in self._evicted_keys:
+                    self.stats.evicted_part_key_reingests += 1
+                pid = self._free_pids.pop() if self._free_pids else len(self.index)
                 self._part_key_to_id[pk] = pid
+                self._part_key_of_id[pid] = pk
                 self.index.add_part_key(pid, labels, start_time=first_ts)
+                self._new_part_pids.append(pid)
                 self.stats.series_created += 1
             mapping[i] = pid
         return mapping[container.part_idx]
@@ -172,9 +191,11 @@ class TimeSeriesShard:
             # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset
         # capacity pressure -> compact out data older than retention
-        if self.store.n_host.max(initial=0) >= self.config.samples_per_series:
+        # (policy pluggable; ref: PartitionEvictionPolicy.scala)
+        if self.eviction_policy.should_evict(self.store, self.config):
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
-            self.store.compact(cutoff)
+            with self.lock:
+                self.store.compact(cutoff)
         return written
 
     # -- persistence flush pipeline (ref: TimeSeriesShard.doFlushSteps :814) --
@@ -205,16 +226,17 @@ class TimeSeriesShard:
             from .downsample import downsample_records
             res_ms, publish = self.downsample
             publish(self, downsample_records(pids, ts, vals, res_ms))
-        if self.bucket_les is not None and self._persisted_parts == 0:
+        if self.bucket_les is not None and not self._meta_written:
             if hasattr(self.sink, "write_meta"):
                 self.sink.write_meta(self.dataset, self.shard_num,
                                      {"bucket_les": list(map(float, self.bucket_les))})
+            self._meta_written = True
         # new part keys ride along with any group flush (ref: writeTimeBuckets)
-        if self._persisted_parts < len(self.index):
+        if self._new_part_pids:
             entries = [(pid, self.index.labels_of(pid), self.index.start_time(pid))
-                       for pid in range(self._persisted_parts, len(self.index))]
+                       for pid in self._new_part_pids]
             self.sink.write_part_keys(self.dataset, self.shard_num, entries)
-            self._persisted_parts = len(self.index)
+            self._new_part_pids = []
         self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
         off = int(self._pending_group_offset[group])
         if off >= 0:
@@ -240,18 +262,45 @@ class TimeSeriesShard:
                                          self.config.samples_per_series,
                                          dtype=self._dtype, device=self._device,
                                          nbuckets=len(self.bucket_les))
-        # 1. part keys -> index (ids were assigned densely in order)
+        # 1. part keys -> index (ids dense in creation order; a purged slot may
+        #    have been re-persisted under a new series — the last entry wins)
+        latest: dict[int, tuple[dict, int]] = {}
         for pid, labels, start in self.sink.read_part_keys(self.dataset, self.shard_num) or ():
+            latest[pid] = (labels, start)
+        for pid in sorted(latest):
+            while len(self.index) < pid:   # gap: entry lost; treat as a free hole
+                hole = len(self.index)
+                self.index.add_part_key(hole, {}, 0, end_time=-1)
+                self._free_pids.append(hole)
+            labels, start = latest[pid]
+            if not labels:                 # purge tombstone won: slot is free
+                self.index.add_part_key(pid, {}, 0, end_time=-1)
+                self._free_pids.append(pid)
+                continue
             pk = part_key_of(labels, self.schema.options)
             self._part_key_to_id[pk] = pid
+            self._part_key_of_id[pid] = pk
             self.index.add_part_key(pid, labels, start)
-        self._persisted_parts = len(self.index)
-        # 2. chunks -> device store (batched appends, flush order == time order)
+        # 2. chunks -> device store (batched appends, flush order == time order).
+        #    Chunks of purged partitions are skipped; for a reused slot, samples
+        #    older than the current owner's start time belong to the purged
+        #    predecessor and are dropped.
+        own_start = {pid: start for pid, (labels, start) in latest.items() if labels}
+        start_of = np.full(len(self.index) + 1, 1 << 62, np.int64)
+        for pid, start in own_start.items():
+            start_of[pid] = start
         for group, records in self.sink.read_chunksets(self.dataset, self.shard_num) or ():
-            pids = np.concatenate([np.full(len(r.ts), r.part_id, np.int32) for r in records])
-            ts = np.concatenate([r.ts for r in records])
-            vals = np.concatenate([r.values for r in records])
-            self.store.append(pids, ts, vals)
+            keep = [r for r in records if r.part_id in own_start]
+            if not keep:
+                continue
+            pids = np.concatenate([np.full(len(r.ts), r.part_id, np.int32) for r in keep])
+            ts = np.concatenate([r.ts for r in keep])
+            vals = np.concatenate([r.values for r in keep])
+            owned = ts >= start_of[pids]
+            if not owned.all():
+                pids, ts, vals = pids[owned], ts[owned], vals[owned]
+            if len(pids):
+                self.store.append(pids, ts, vals)
         # 3. checkpoints -> watermarks; replay the bus past them
         cps = self.sink.read_checkpoints(self.dataset, self.shard_num)
         for g, off in cps.items():
@@ -267,6 +316,55 @@ class TimeSeriesShard:
                 replayed += self.stats.rows_ingested - before
             self.flush()
         return replayed
+
+    # -- purge (ref: TimeSeriesShard.purgeExpiredPartitions :751) ------------
+
+    def purge_expired_partitions(self, cutoff_ms: int) -> int:
+        """Remove partitions whose last sample is older than ``cutoff_ms``:
+        index entries tombstoned, HBM rows freed for reuse, part keys recorded
+        in the evicted-keys filter so a returning series is detected. Returns
+        the number of partitions purged."""
+        self.flush()
+        if self.store is None:
+            return 0
+        # the whole purge mutates index + store + id maps; query threads read the
+        # same structures concurrently, so it all happens under the shard lock
+        with self.lock:
+            # mark end-times of inactive series (the reference persists endTime
+            # when a partition goes quiet; the host last_ts mirror is authoritative)
+            last = self.store.last_ts
+            inactive = np.nonzero((self.store.n_host > 0) & (last < cutoff_ms))[0]
+            for pid in inactive.tolist():
+                if self.index.labels_of(pid):
+                    self.index.update_end_time(pid, int(last[pid]))
+            purged = self.index.part_ids_ended_before(cutoff_ms)
+            # never purge series with data still staged for a pending flush group
+            if len(purged) and self.sink is not None:
+                pending = {int(p) for chunks in self._pending_chunks
+                           for (pids, _, _) in chunks for p in pids}
+                if pending:
+                    purged = np.asarray(
+                        [p for p in purged.tolist() if p not in pending], np.int32)
+            if len(purged) == 0:
+                return 0
+            for pid in purged.tolist():
+                pk = self._part_key_of_id.pop(pid, None)
+                if pk is not None:
+                    del self._part_key_to_id[pk]
+                    self._evicted_keys.add(pk)
+            self.index.remove_part_keys(purged)
+            self.store.free_rows(purged)
+            if self._new_part_pids:
+                gone = set(purged.tolist())
+                self._new_part_pids = [p for p in self._new_part_pids if p not in gone]
+            self._free_pids.extend(purged.tolist())
+        # durable tombstones so recovery neither resurrects the purged series nor
+        # attributes its persisted chunks to a later owner of the reused slot
+        if self.sink is not None:
+            self.sink.write_part_keys(self.dataset, self.shard_num,
+                                      [(int(pid), {}, -1) for pid in purged.tolist()])
+        self.stats.partitions_purged += len(purged)
+        return len(purged)
 
     # -- on-demand paging (ref: OnDemandPagingShard.scala:26,58 +
     #    DemandPagedChunkStore.scala:35 — cold chunks paged in for queries) -----
@@ -324,17 +422,21 @@ class TimeSeriesShard:
     def part_ids_from_filters(self, filters: list[Filter], start: int, end: int,
                               limit: int | None = None) -> np.ndarray:
         self.flush()
-        return self.index.part_ids_from_filters(filters, start, end, limit)
+        # under the shard lock: a concurrent purge mutates postings in place
+        with self.lock:
+            return self.index.part_ids_from_filters(filters, start, end, limit)
 
     def label_values(self, label: str, filters=None, top_k=None) -> list[str]:
-        return self.index.label_values(label, filters, top_k=top_k)
+        with self.lock:
+            return self.index.label_values(label, filters, top_k=top_k)
 
     def label_names(self, filters=None) -> list[str]:
-        return self.index.label_names(filters)
+        with self.lock:
+            return self.index.label_names(filters)
 
     @property
     def num_series(self) -> int:
-        return len(self.index)
+        return len(self._part_key_to_id)
 
 
 class TimeSeriesMemStore:
@@ -348,7 +450,8 @@ class TimeSeriesMemStore:
 
     def setup(self, dataset: str, schema: Schema | str, shard: int,
               config: StoreConfig | None = None, device=None,
-              sink: ChunkSink | None = None) -> TimeSeriesShard:
+              sink: ChunkSink | None = None,
+              eviction_policy: EvictionPolicy | None = None) -> TimeSeriesShard:
         if isinstance(schema, str):
             schema = self.schemas[schema]
         cfg = config or self._configs.get(dataset) or StoreConfig()
@@ -357,7 +460,8 @@ class TimeSeriesMemStore:
         key = (dataset, shard)
         if key in self._shards:
             raise ValueError(f"shard {shard} of {dataset} already set up")
-        s = TimeSeriesShard(dataset, schema, shard, cfg, device=device, sink=sink)
+        s = TimeSeriesShard(dataset, schema, shard, cfg, device=device, sink=sink,
+                            eviction_policy=eviction_policy)
         self._shards[key] = s
         return s
 

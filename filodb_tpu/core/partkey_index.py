@@ -37,10 +37,17 @@ class _Postings:
     def array(self) -> np.ndarray:
         if self._new:
             fresh = np.asarray(self._new, dtype=np.int32)
-            # part ids are assigned in increasing order, so appends are presorted
-            self._arr = np.concatenate([self._arr, fresh]) if len(self._arr) else fresh
+            # part ids are usually assigned in increasing order (presorted); slot
+            # reuse after a purge can break that, so re-sort only when needed
+            arr = np.concatenate([self._arr, fresh]) if len(self._arr) else fresh
+            if len(arr) > 1 and not (np.diff(arr) > 0).all():
+                arr = np.unique(arr)
+            self._arr = arr
             self._new = []
         return self._arr
+
+    def remove(self, part_ids: np.ndarray) -> None:
+        self._arr = np.setdiff1d(self.array(), part_ids, assume_unique=False)
 
     def __len__(self) -> int:
         return len(self._arr) + len(self._new)
@@ -63,10 +70,17 @@ class PartKeyIndex:
 
     def add_part_key(self, part_id: int, labels: dict[str, str], start_time: int,
                      end_time: int = LIVE_END) -> None:
-        assert part_id == len(self._labels), "part ids must be assigned densely in order"
-        self._labels.append(labels)
-        self._start.append(start_time)
-        self._end.append(end_time)
+        if part_id == len(self._labels):
+            self._labels.append(labels)
+            self._start.append(start_time)
+            self._end.append(end_time)
+        else:
+            # reuse of a purged slot (ref: TimeSeriesShard partId free list)
+            assert part_id < len(self._labels) and not self._labels[part_id], \
+                "part ids must be assigned densely or reuse a purged slot"
+            self._labels[part_id] = labels
+            self._start[part_id] = start_time
+            self._end[part_id] = end_time
         for name, value in labels.items():
             p = self._inv[name].get(value)
             if p is None:
@@ -142,7 +156,32 @@ class PartKeyIndex:
     def part_ids_ended_before(self, ts: int) -> np.ndarray:
         """For purge (ref: PartKeyLuceneIndex.partIdsEndedBefore)."""
         ends = np.asarray(self._end, dtype=np.int64)
-        return np.nonzero(ends < ts)[0].astype(np.int32)
+        live = np.asarray([bool(lbl) for lbl in self._labels])
+        return np.nonzero((ends < ts) & live)[0].astype(np.int32)
+
+    def remove_part_keys(self, part_ids: np.ndarray) -> None:
+        """Tombstone purged partitions and drop them from every posting list
+        (ref: PartKeyLuceneIndex.removePartKeys). Slots become reusable via
+        ``add_part_key`` with the same id."""
+        if len(part_ids) == 0:
+            return
+        removed = np.asarray(part_ids, np.int32)
+        touched: dict[str, set[str]] = defaultdict(set)
+        for pid in removed.tolist():
+            for name, value in self._labels[pid].items():
+                touched[name].add(value)
+            self._labels[pid] = {}
+            self._start[pid] = 0
+            self._end[pid] = -1          # matches no [start, end] overlap query
+        for name, values in touched.items():
+            for value in values:
+                p = self._inv[name].get(value)
+                if p is not None:
+                    p.remove(removed)
+                    if not len(p):
+                        del self._inv[name][value]
+            if not self._inv[name]:
+                del self._inv[name]
 
     def label_values(self, label: str, filters: list[Filter] | None = None,
                      start_time: int = 0, end_time: int = 1 << 62,

@@ -39,6 +39,8 @@ class FileBus:
         return off
 
     def _frames(self) -> Iterator[tuple[int, bytes]]:
+        if not os.path.exists(self.path):
+            return  # nothing published yet (another process may own the first write)
         with open(self.path, "rb") as f:
             while True:
                 hdr = f.read(_FRAME.size)

@@ -31,7 +31,7 @@ class IngestionConsumer(threading.Thread):
     recoverStream with RecoveryInProgress -> IngestionStarted events)."""
 
     def __init__(self, shard, bus: FileBus, schemas, manager: ShardManager,
-                 dataset: str, poll_s: float = 0.5):
+                 dataset: str, poll_s: float = 0.5, purge_interval_s: float = 600.0):
         super().__init__(daemon=True, name=f"ingest-{dataset}-{shard.shard_num}")
         self.shard = shard
         self.bus = bus
@@ -39,6 +39,7 @@ class IngestionConsumer(threading.Thread):
         self.manager = manager
         self.dataset = dataset
         self.poll_s = poll_s
+        self.purge_interval_s = purge_interval_s
         self._stop_ev = threading.Event()
         self._offset = 0
 
@@ -53,6 +54,7 @@ class IngestionConsumer(threading.Thread):
             self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ACTIVE)
             rows = registry.counter("filodb_ingested_rows",
                                     {"dataset": self.dataset, "shard": str(sh.shard_num)})
+            last_purge = time.monotonic()
             while not self._stop_ev.wait(self.poll_s):
                 for off, container in self.bus.consume(self.schemas, self._offset):
                     sh.ingest(container, off)
@@ -61,6 +63,14 @@ class IngestionConsumer(threading.Thread):
                 sh.flush()
                 if sh.sink is not None:
                     sh.flush_all_groups()
+                if time.monotonic() - last_purge >= self.purge_interval_s:
+                    last_purge = time.monotonic()
+                    lead = int(sh.store.last_ts.max(initial=0)) if sh.store is not None else 0
+                    if lead > 0:
+                        n = sh.purge_expired_partitions(lead - sh.config.retention_ms)
+                        if n:
+                            log.info("purged %d expired partitions from shard %d",
+                                     n, sh.shard_num)
         except Exception:  # noqa: BLE001
             log.exception("ingestion failed for shard %s", sh.shard_num)
             self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ERROR)
@@ -96,7 +106,9 @@ class FiloServer:
             if cfg.get("bus_dir"):
                 bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
                 c = IngestionConsumer(shard, bus, self.memstore.schemas,
-                                      self.manager, dataset)
+                                      self.manager, dataset,
+                                      purge_interval_s=parse_duration_ms(
+                                          cfg.get("store.purge_interval", "10m")) / 1000.0)
                 self.consumers.append(c)
                 c.start()
             else:

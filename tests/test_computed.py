@@ -1,0 +1,112 @@
+"""Computed-column expression tests (ref: ComputedColumnSpec-style coverage)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import computed
+from filodb_tpu.core.computed import (BadArgument, NoSuchFunction,
+                                      NotComputedColumn, WrongNumberArguments,
+                                      analyze)
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE, ColumnType
+
+
+def _container(n=6):
+    b = RecordBuilder(GAUGE)
+    base = 1_700_000_000_000
+    for i in range(n):
+        labels = {"_metric_": "m", "host": f"host-{i % 2}", "dc": "us-east"}
+        if i % 2:
+            labels["rack"] = f"r{i}"
+        b.add(labels, base + i * 45_000, float(i) * 1.5)
+    return b.build()
+
+
+def test_not_computed_and_unknown():
+    with pytest.raises(NotComputedColumn):
+        analyze("plain_column", GAUGE)
+    with pytest.raises(NoSuchFunction):
+        analyze(":nope arg", GAUGE)
+    with pytest.raises(WrongNumberArguments):
+        analyze(":round timestamp", GAUGE)
+
+
+def test_const_string():
+    c = analyze(":string prod", GAUGE)
+    assert c.ctype == ColumnType.STRING
+    out = c.compute(_container())
+    assert out == ["prod"] * 6
+
+
+def test_get_or_else_label_default():
+    c = analyze(":getOrElse rack none", GAUGE)
+    out = c.compute(_container())
+    assert out[0] == "none" and out[1] == "r1" and out[2] == "none"
+    # data columns are rejected — :getOrElse is for label tags
+    with pytest.raises(BadArgument):
+        analyze(":getOrElse timestamp 0", GAUGE)
+
+
+def test_round_double_and_ts():
+    cont = _container()
+    c = analyze(":round value 1.0", GAUGE)
+    np.testing.assert_allclose(c.compute(cont), np.floor(cont.values))
+    c2 = analyze(":round timestamp 60000", GAUGE)
+    out = c2.compute(cont)
+    assert (out % 60000 == 0).all() and (out <= cont.ts).all()
+    with pytest.raises(BadArgument):
+        analyze(":round value -5", GAUGE)
+    with pytest.raises(BadArgument):
+        analyze(":round nosuch 10", GAUGE)
+
+
+def test_string_prefix():
+    c = analyze(":stringPrefix host 4", GAUGE)
+    assert set(c.compute(_container())) == {"host"}
+
+
+def test_hash_label_and_numeric():
+    cont = _container()
+    c = analyze(":hash host 8", GAUGE)
+    out = c.compute(cont)
+    assert out.dtype == np.int32 and ((0 <= out) & (out < 8)).all()
+    # same label value -> same bucket
+    h0 = [o for o, ls in zip(out, (cont.label_sets[i] for i in cont.part_idx))
+          if ls["host"] == "host-0"]
+    assert len(set(h0)) == 1
+    cn = analyze(":hash timestamp 4", GAUGE)
+    outn = cn.compute(cont)
+    assert ((0 <= outn) & (outn < 4)).all()
+    with pytest.raises(BadArgument):
+        analyze(":hash host 0", GAUGE)
+
+
+def test_timeslice():
+    cont = _container()
+    c = analyze(":timeslice timestamp 1m", GAUGE)
+    out = c.compute(cont)
+    assert c.ctype == ColumnType.TIMESTAMP
+    assert (out % 60_000 == 0).all()
+    assert ((cont.ts - out) < 60_000).all()
+    with pytest.raises(BadArgument):
+        analyze(":timeslice timestamp xyz", GAUGE)
+    with pytest.raises(BadArgument):
+        analyze(":timeslice value 1m", GAUGE)
+
+
+def test_month_of_year():
+    b = RecordBuilder(GAUGE)
+    # 2023-01-15 and 2023-12-31 UTC
+    b.add({"_metric_": "m"}, 1673740800000, 1.0)
+    b.add({"_metric_": "m"}, 1704000000000, 2.0)
+    cont = b.build()
+    c = analyze(":monthOfYear timestamp", GAUGE)
+    out = c.compute(cont)
+    assert list(out) == [1, 12]
+
+
+def test_registry_matches_reference_set():
+    # ComputedColumn.scala:28-35 — the seven stock computations
+    assert set(computed.ALL_COMPUTATIONS) == {
+        "string", "getOrElse", "round", "timeslice", "monthOfYear",
+        "stringPrefix", "hash"}

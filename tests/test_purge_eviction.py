@@ -1,0 +1,149 @@
+"""Purge-by-endtime, pid reuse, eviction policies, evicted-key filter.
+
+Reference behaviors: TimeSeriesShard.purgeExpiredPartitions (:751), the
+evictedPartKeys bloom filter (:93-96, :1092), PartitionEvictionPolicy.scala.
+"""
+
+import numpy as np
+
+from filodb_tpu.core.eviction import (BloomFilter, CapacityEvictionPolicy,
+                                      CompositeEvictionPolicy,
+                                      HeadroomEvictionPolicy)
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import FileColumnStore
+
+BASE = 1_700_000_000_000
+
+
+def _ingest(shard, names, t0, nsamples=5, step=10_000):
+    b = RecordBuilder(GAUGE)
+    for name in names:
+        for k in range(nsamples):
+            b.add({"_metric_": "m", "host": name}, t0 + k * step, float(k))
+    shard.ingest(b.build())
+    shard.flush()
+
+
+def _mk_shard(tmp_path=None, **cfg):
+    ms = TimeSeriesMemStore()
+    sink = FileColumnStore(str(tmp_path)) if tmp_path is not None else None
+    config = StoreConfig(max_series_per_shard=32, samples_per_series=64,
+                         flush_batch_size=10**9, groups_per_shard=4, **cfg)
+    return ms, ms.setup("prometheus", GAUGE, 0, config, sink=sink)
+
+
+def test_purge_removes_expired_and_reuses_slots():
+    ms, shard = _mk_shard()
+    _ingest(shard, ["old-0", "old-1"], BASE)
+    _ingest(shard, ["live-0"], BASE + 10_000_000)
+    assert shard.num_series == 3
+    n = shard.purge_expired_partitions(BASE + 5_000_000)
+    assert n == 2 and shard.num_series == 1
+    assert shard.stats.partitions_purged == 2
+    # the purged series no longer matches queries; the live one does
+    from filodb_tpu.core.filters import Equals
+    pids = shard.part_ids_from_filters([Equals("host", "old-0")], 0, 1 << 60)
+    assert len(pids) == 0
+    pids = shard.part_ids_from_filters([Equals("_metric_", "m")], 0, 1 << 60)
+    assert pids.tolist() == [2]
+    assert shard.label_values("host") == ["live-0"]
+    # freed slots are reused for new series, and the store rows were reset
+    _ingest(shard, ["new-0", "new-1"], BASE + 10_000_000)
+    assert shard.num_series == 3
+    new_pids = shard.part_ids_from_filters([Equals("host", "new-0")], 0, 1 << 60)
+    assert new_pids.tolist()[0] in (0, 1)
+    ts, vals = shard.store.series_snapshot(int(new_pids[0]))
+    assert len(ts) == 5 and (ts >= BASE + 10_000_000).all()
+
+
+def test_purge_detects_returning_series():
+    ms, shard = _mk_shard()
+    _ingest(shard, ["ghost"], BASE)
+    shard.purge_expired_partitions(BASE + 10_000_000)
+    assert shard.stats.evicted_part_key_reingests == 0
+    _ingest(shard, ["ghost"], BASE + 20_000_000)
+    assert shard.stats.evicted_part_key_reingests == 1
+
+
+def test_purge_with_sink_skips_pending_and_recovers(tmp_path):
+    ms, shard = _mk_shard(tmp_path)
+    _ingest(shard, ["old"], BASE)
+    # staged-for-persistence data protects the partition from purge
+    assert shard.purge_expired_partitions(BASE + 5_000_000) == 0
+    shard.flush_all_groups()
+    assert shard.purge_expired_partitions(BASE + 5_000_000) == 1
+    _ingest(shard, ["fresh"], BASE + 6_000_000)   # reuses pid 0
+    shard.flush_all_groups()
+    # recovery keeps the LAST entry for the reused slot, and the purged
+    # predecessor's persisted chunks are NOT attributed to the new owner
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("prometheus", GAUGE, 0, shard.config,
+                       sink=FileColumnStore(str(tmp_path)))
+    shard2.recover()
+    assert shard2.index.labels_of(0).get("host") == "fresh"
+    assert shard2.label_values("host") == ["fresh"]
+    ts, _ = shard2.store.series_snapshot(0)
+    assert len(ts) == 5 and (ts >= BASE + 6_000_000).all()
+
+
+def test_purged_series_stays_dead_after_recovery(tmp_path):
+    ms, shard = _mk_shard(tmp_path)
+    _ingest(shard, ["doomed", "keeper"], BASE)
+    _ingest(shard, ["keeper"], BASE + 10_000_000, nsamples=1)
+    shard.flush_all_groups()
+    assert shard.purge_expired_partitions(BASE + 5_000_000) == 1
+    # restart WITHOUT reusing the slot: the tombstone must win over the
+    # original part-key entry and its chunks (no resurrection)
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("prometheus", GAUGE, 0, shard.config,
+                       sink=FileColumnStore(str(tmp_path)))
+    shard2.recover()
+    assert shard2.label_values("host") == ["keeper"]
+    assert shard2.num_series == 1
+    assert shard2.store.n_host[list(shard2._free_pids)].sum() == 0
+    # the freed slot is reusable after restart
+    _ingest(shard2, ["reborn"], BASE + 11_000_000)
+    assert sorted(shard2.label_values("host")) == ["keeper", "reborn"]
+
+
+def test_eviction_policies():
+    cfg = StoreConfig(samples_per_series=100)
+
+    class FakeStore:
+        def __init__(self, maxn):
+            self.n_host = np.array([maxn], np.int32)
+
+    cap = CapacityEvictionPolicy()
+    assert not cap.should_evict(FakeStore(99), cfg)
+    assert cap.should_evict(FakeStore(100), cfg)
+    head = HeadroomEvictionPolicy(0.2)
+    assert not head.should_evict(FakeStore(79), cfg)
+    assert head.should_evict(FakeStore(80), cfg)
+    comp = CompositeEvictionPolicy(cap, head)
+    assert comp.should_evict(FakeStore(85), cfg)       # headroom fires
+    assert not comp.should_evict(FakeStore(10), cfg)   # neither fires
+
+
+def test_headroom_policy_triggers_compaction():
+    ms = TimeSeriesMemStore()
+    config = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                         flush_batch_size=10**9, retention_ms=100_000)
+    shard = ms.setup("prometheus", GAUGE, 0, config,
+                     eviction_policy=HeadroomEvictionPolicy(0.5))
+    _ingest(shard, ["a"], BASE, nsamples=40)
+    assert shard.store.stats.compactions == 1
+    # retention window kept only the recent samples
+    ts, _ = shard.store.series_snapshot(0)
+    assert len(ts) < 40 and len(ts) > 0
+
+
+def test_bloom_filter():
+    bf = BloomFilter(capacity=1000)
+    keys = [f"series-{i}".encode() for i in range(500)]
+    for k in keys:
+        bf.add(k)
+    assert all(k in bf for k in keys)
+    fp = sum(f"other-{i}".encode() in bf for i in range(2000))
+    assert fp < 2000 * 0.05   # low false-positive rate at this load
