@@ -161,31 +161,34 @@ class TimeSeriesShard:
                 pids, ts, vals = pids[keep], ts[keep], vals[keep]
         if len(pids) == 0:
             return
-        self._stage_pid.append(pids)
-        self._stage_ts.append(ts)
-        self._stage_val.append(vals)
-        self._staged += len(ts)
-        self._pending_offset = max(self._pending_offset, offset)
-        self.stats.rows_ingested += len(ts)
-        if self.sink is not None:
-            groups = pids % self.config.groups_per_shard
-            for g in np.unique(groups):
-                sel = groups == g
-                self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
-                self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
+        # staging mutations share the shard lock: HTTP writers / gateways may
+        # ingest from several threads, and query paths call flush()
+        with self.lock:
+            self._stage_pid.append(pids)
+            self._stage_ts.append(ts)
+            self._stage_val.append(vals)
+            self._staged += len(ts)
+            self._pending_offset = max(self._pending_offset, offset)
+            self.stats.rows_ingested += len(ts)
+            if self.sink is not None:
+                groups = pids % self.config.groups_per_shard
+                for g in np.unique(groups):
+                    sel = groups == g
+                    self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
+                    self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
         if self._staged >= self.config.flush_batch_size:
             self.flush()
 
     def flush(self) -> int:
         """Push staged samples to the device store; advance group watermarks."""
-        if not self._staged:
-            return 0
-        pids = np.concatenate(self._stage_pid)
-        ts = np.concatenate(self._stage_ts)
-        vals = np.concatenate(self._stage_val, axis=0)
-        self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
-        self._staged = 0
         with self.lock:
+            if not self._staged:
+                return 0
+            pids = np.concatenate(self._stage_pid)
+            ts = np.concatenate(self._stage_ts)
+            vals = np.concatenate(self._stage_val, axis=0)
+            self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
+            self._staged = 0
             written = self.store.append(pids, ts, vals)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
@@ -265,8 +268,11 @@ class TimeSeriesShard:
         # 1. part keys -> index (ids dense in creation order; a purged slot may
         #    have been re-persisted under a new series — the last entry wins)
         latest: dict[int, tuple[dict, int]] = {}
+        last_live_pk: dict[int, bytes] = {}   # most recent real owner of a slot
         for pid, labels, start in self.sink.read_part_keys(self.dataset, self.shard_num) or ():
             latest[pid] = (labels, start)
+            if labels:
+                last_live_pk[pid] = part_key_of(labels, self.schema.options)
         for pid in sorted(latest):
             while len(self.index) < pid:   # gap: entry lost; treat as a free hole
                 hole = len(self.index)
@@ -276,6 +282,8 @@ class TimeSeriesShard:
             if not labels:                 # purge tombstone won: slot is free
                 self.index.add_part_key(pid, {}, 0, end_time=-1)
                 self._free_pids.append(pid)
+                if pid in last_live_pk:    # returning-series detection survives
+                    self._evicted_keys.add(last_live_pk[pid])   # the restart
                 continue
             pk = part_key_of(labels, self.schema.options)
             self._part_key_to_id[pk] = pid
@@ -340,11 +348,11 @@ class TimeSeriesShard:
             purged = self.index.part_ids_ended_before(cutoff_ms)
             # never purge series with data still staged for a pending flush group
             if len(purged) and self.sink is not None:
-                pending = {int(p) for chunks in self._pending_chunks
-                           for (pids, _, _) in chunks for p in pids}
-                if pending:
-                    purged = np.asarray(
-                        [p for p in purged.tolist() if p not in pending], np.int32)
+                staged = [pids for chunks in self._pending_chunks
+                          for (pids, _, _) in chunks]
+                if staged:
+                    pending = np.unique(np.concatenate(staged))
+                    purged = np.setdiff1d(purged, pending).astype(np.int32)
             if len(purged) == 0:
                 return 0
             for pid in purged.tolist():

@@ -68,9 +68,12 @@ class FiloHttpServer:
     datasets (ref: FiloHttpServer / akka-http binding)."""
 
     def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
-                 cluster=None):
+                 cluster=None, writers: dict | None = None):
+        """``writers``: dataset -> callable(per_shard: dict[shard, container])
+        receiving remote-write batches atomically (bus publish or direct ingest)."""
         self.engines = engines
         self.cluster = cluster
+        self.writers = writers or {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -117,13 +120,21 @@ class FiloHttpServer:
 
     def _route(self, h) -> None:
         url = urlparse(h.path)
+        path = url.path
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        # remote read/write carry snappy-compressed protobuf bodies — handle
+        # them before the urlencoded body parsing below consumes rfile
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/(read|write)", path)
+        if m and h.command == "POST":
+            self._remote_storage(h, m.group(1), m.group(2))
+            return
+
         if h.command == "POST":
             ln = int(h.headers.get("Content-Length") or 0)
             if ln:
                 body = h.rfile.read(ln).decode()
                 q.update({k: v[0] for k, v in parse_qs(body).items()})
-        path = url.path
 
         if path == "/__health":
             h._send(200, {"status": "healthy"})
@@ -180,6 +191,48 @@ class FiloHttpServer:
             h._send(200, {"status": "success", "data": data})
             return
         h._send(404, {"status": "error", "error": f"unknown path {path}"})
+
+    # -- Prometheus remote storage protocol (snappy + protobuf) ---------------
+
+    def _remote_storage(self, h, dataset: str, which: str) -> None:
+        from google.protobuf.message import DecodeError
+
+        engine = self.engines.get(dataset)
+        if engine is None:
+            h._send(404, {"status": "error", "error": f"no dataset {dataset}"})
+            return
+        body = h.rfile.read(int(h.headers.get("Content-Length") or 0))
+        try:
+            self._remote_storage_inner(h, engine, dataset, which, body)
+        except (ValueError, DecodeError) as e:
+            # bad snappy framing / protobuf — client error, not a server fault
+            h._send(400, {"status": "error", "errorType": "bad_data",
+                          "error": f"malformed remote-{which} body: {e}"})
+
+    def _remote_storage_inner(self, h, engine, dataset: str, which: str,
+                              body: bytes) -> None:
+        from ..promql import remote
+
+        if which == "read":
+            payload = remote.read_request(body, engine)
+            h.send_response(200)
+            h.send_header("Content-Type", "application/x-protobuf")
+            h.send_header("Content-Encoding", "snappy")
+            h.send_header("Content-Length", str(len(payload)))
+            h.end_headers()
+            h.wfile.write(payload)
+            return
+        writer = (self.writers or {}).get(dataset)
+        if writer is None:
+            h._send(501, {"status": "error",
+                          "error": f"no remote-write sink configured for {dataset}"})
+            return
+        schema = engine.memstore._dataset_schema[dataset]
+        per_shard = remote.write_request_to_containers(body, schema, engine.mapper)
+        writer(per_shard)
+        h.send_response(204)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
 
     def _cluster_status(self, path: str):
         if self.cluster is None:

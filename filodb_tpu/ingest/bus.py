@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from typing import Iterator
 
 from ..core.record import RecordContainer
@@ -24,6 +25,7 @@ class FileBus:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._next_offset = 0
+        self._publish_lock = threading.Lock()   # concurrent producers in-process
         if os.path.exists(path):
             for off, _ in self._frames():
                 self._next_offset = off + 1
@@ -31,11 +33,12 @@ class FileBus:
     def publish(self, container: RecordContainer) -> int:
         """Append a container; returns its offset."""
         payload = container.to_bytes()
-        off = self._next_offset
-        with open(self.path, "ab") as f:
-            f.write(_FRAME.pack(off, len(payload)))
-            f.write(payload)
-        self._next_offset = off + 1
+        with self._publish_lock:
+            off = self._next_offset
+            with open(self.path, "ab") as f:
+                f.write(_FRAME.pack(off, len(payload)))
+                f.write(payload)
+            self._next_offset = off + 1
         return off
 
     def _frames(self) -> Iterator[tuple[int, bytes]]:

@@ -1,0 +1,69 @@
+"""Prometheus remote read/write protocol conversions.
+
+Reference: prometheus/.../query/PrometheusModel.scala (toFiloDBLogicalPlans /
+remote-read protobuf conversion) + http route wiring in PrometheusApiRoute.
+Wire framing: snappy-block-compressed protobuf (``utils/snappy.py``), messages
+from ``remote_storage.proto`` (public Prometheus remote storage spec).
+"""
+
+from __future__ import annotations
+
+from ..core import filters as F
+from ..core.record import RecordBuilder, fnv1a64
+from ..core.schemas import Schema, part_key_of, shard_key_of
+from ..utils import snappy
+from . import remote_storage_pb2 as pb
+
+_MATCHER_TO_FILTER = {
+    pb.LabelMatcher.EQ: F.Equals,
+    pb.LabelMatcher.NEQ: F.NotEquals,
+    pb.LabelMatcher.RE: F.EqualsRegex,
+    pb.LabelMatcher.NRE: F.NotEqualsRegex,
+}
+
+
+def matchers_to_filters(matchers) -> list:
+    """LabelMatcher protobufs -> index filters (__name__ -> metric column)."""
+    return [_MATCHER_TO_FILTER[m.type](
+                "_metric_" if m.name == "__name__" else m.name, m.value)
+            for m in matchers]
+
+
+def read_request(body: bytes, engine) -> bytes:
+    """snappy(ReadRequest) -> snappy(ReadResponse) against one dataset engine."""
+    req = pb.ReadRequest()
+    req.ParseFromString(snappy.decompress(body))
+    resp = pb.ReadResponse()
+    for q in req.queries:
+        result = resp.results.add()
+        filters = matchers_to_filters(q.matchers)
+        for labels, ts, vals in engine.raw_series(
+                filters, q.start_timestamp_ms, q.end_timestamp_ms):
+            series = result.timeseries.add()
+            for name in sorted(labels):
+                wire_name = "__name__" if name == "_metric_" else name
+                series.labels.add(name=wire_name, value=labels[name])
+            for t, v in zip(ts.tolist(), vals.tolist()):
+                series.samples.add(value=float(v), timestamp_ms=int(t))
+    return snappy.compress(resp.SerializeToString())
+
+
+def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
+    """snappy(WriteRequest) -> {shard: RecordContainer} routed like the gateway
+    (shard-key hash selects the shard group, part hash spreads within it)."""
+    req = pb.WriteRequest()
+    req.ParseFromString(snappy.decompress(body))
+    builders: dict[int, RecordBuilder] = {}
+    opts = schema.options
+    for series in req.timeseries:
+        labels = {("_metric_" if lp.name == "__name__" else lp.name): lp.value
+                  for lp in series.labels}
+        shard = mapper.shard_of(
+            fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
+            fnv1a64(part_key_of(labels, opts)))
+        b = builders.get(shard)
+        if b is None:
+            b = builders[shard] = RecordBuilder(schema)
+        for s in series.samples:
+            b.add(labels, int(s.timestamp_ms), float(s.value))
+    return {shard: b.build() for shard, b in builders.items()}

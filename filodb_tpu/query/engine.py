@@ -82,3 +82,27 @@ class QueryEngine:
             pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
             out.extend(shard.index.labels_of(int(p)) for p in pids)
         return out
+
+    def raw_series(self, filters, start_ms: int, end_ms: int):
+        """Yield (labels, ts[int64], vals[f64]) of raw samples in range — the
+        remote-read path (ref: PrometheusModel remote-read conversion reads raw
+        chunks, not periodic samples). Scalar schemas only."""
+        import numpy as np
+        for shard in self.memstore.shards_of(self.dataset):
+            if shard.schema.is_histogram:
+                continue   # remote-read protocol carries scalar samples
+            pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
+            if len(pids) == 0 or shard.store is None:
+                continue
+            with shard.lock:
+                if shard.needs_paging(pids, start_ms):
+                    ts_a, val_a, n_a = shard.read_with_paging(pids, start_ms, end_ms)
+                    rows = [(ts_a[i, :n_a[i]], val_a[i, :n_a[i]])
+                            for i in range(len(pids))]
+                else:
+                    rows = [shard.store.series_snapshot(int(p)) for p in pids]
+            for p, (t, v) in zip(pids, rows):
+                keep = (t >= start_ms) & (t <= end_ms)
+                if keep.any():
+                    yield (shard.index.labels_of(int(p)),
+                           np.asarray(t[keep]), np.asarray(v[keep], np.float64))

@@ -233,7 +233,12 @@ class AggregateMapReduce(Transformer):
             gid_of_key[i] = uniq.setdefault(gk, len(uniq))
         G = max(len(uniq), 1)
         R = m.values.shape[0]
-        if m.rows is None:
+        if not gkeys:
+            # empty selection on this shard: the leaf still carries padded
+            # all-NaN rows; map them all to group 0 (counts are 0, and with no
+            # group keys the merge never reads this shard's groups)
+            gids = np.zeros(R, np.int32)
+        elif m.rows is None:
             gids = gid_of_key
         else:
             # un-compacted matrix: scatter group ids to store rows; rows outside

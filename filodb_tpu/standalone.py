@@ -20,6 +20,7 @@ from .ingest.bus import FileBus
 from .parallel.cluster import ShardManager, ShardStatus
 from .parallel.shardmapper import ShardMapper
 from .query.engine import QueryEngine
+from .query.rangevector import QueryError
 from .utils.metrics import ShardHealthStats, registry
 from .utils.tracing import tracer
 
@@ -94,17 +95,20 @@ class FiloServer:
     def start(self) -> "FiloServer":
         cfg = self.config
         dataset = cfg["dataset"]
-        num_shards = cfg["num_shards"]
+        # shard ids live in a power-of-two space (hash routing, spread); a
+        # non-pow2 count would leave routable ids with no owning shard
+        num_shards = _pow2(cfg["num_shards"])
         self.manager.add_dataset(dataset, num_shards)
         sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
         store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
+        buses: dict[int, FileBus] = {}
         for shard_num in self.manager.shards_of_node(dataset, self.node):
             shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
                                         store_cfg, sink=sink)
             if cfg.get("bus_dir"):
-                bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
+                bus = buses[shard_num] = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
                 c = IngestionConsumer(shard, bus, self.memstore.schemas,
                                       self.manager, dataset,
                                       purge_interval_s=parse_duration_ms(
@@ -116,8 +120,25 @@ class FiloServer:
         mapper = ShardMapper(_pow2(num_shards), spread=cfg["spread"])
         self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
                                             cfg.query_config())
+
+        # remote-write sink: durable bus publish when configured, else direct
+        # ingest. The whole batch is validated against owned shards BEFORE
+        # anything publishes, so a rejected batch is all-or-nothing.
+        owned = set(buses) if buses else \
+            {s.shard_num for s in self.memstore.shards_of(dataset)}
+
+        def writer(per_shard: dict, _b=buses, _ds=dataset):
+            unowned = sorted(set(per_shard) - owned)
+            if unowned:
+                raise QueryError(f"shards {unowned} are not owned by this node")
+            for shard, container in per_shard.items():
+                if _b:
+                    _b[shard].publish(container)
+                else:
+                    self.memstore.ingest(_ds, shard, container)
         self.http = FiloHttpServer(self.engines, host=cfg["http.host"],
-                                   port=cfg["http.port"], cluster=self.manager).start()
+                                   port=cfg["http.port"], cluster=self.manager,
+                                   writers={dataset: writer}).start()
         if cfg.get("profiler.enabled"):
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(

@@ -106,6 +106,10 @@ def test_purged_series_stays_dead_after_recovery(tmp_path):
     # the freed slot is reusable after restart
     _ingest(shard2, ["reborn"], BASE + 11_000_000)
     assert sorted(shard2.label_values("host")) == ["keeper", "reborn"]
+    # returning-series detection survives the restart (bloom repopulated
+    # from the tombstoned slot's last live owner)
+    _ingest(shard2, ["doomed"], BASE + 12_000_000)
+    assert shard2.stats.evicted_part_key_reingests == 1
 
 
 def test_eviction_policies():
