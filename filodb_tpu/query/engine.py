@@ -91,18 +91,22 @@ class QueryEngine:
         for shard in self.memstore.shards_of(self.dataset):
             if shard.schema.is_histogram:
                 continue   # remote-read protocol carries scalar samples
-            pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
-            if len(pids) == 0 or shard.store is None:
-                continue
+            # resolve ids, capture arrays, AND read labels under one lock
+            # acquisition: a concurrent purge reuses freed slots, which would
+            # attribute a new series' samples to the old series' labels (same
+            # reason SelectRawPartitionsExec holds the lock across both steps)
             with shard.lock:
+                pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
+                if len(pids) == 0 or shard.store is None:
+                    continue
+                labels = [shard.index.labels_of(int(p)) for p in pids]
                 if shard.needs_paging(pids, start_ms):
                     ts_a, val_a, n_a = shard.read_with_paging(pids, start_ms, end_ms)
                     rows = [(ts_a[i, :n_a[i]], val_a[i, :n_a[i]])
                             for i in range(len(pids))]
                 else:
                     rows = [shard.store.series_snapshot(int(p)) for p in pids]
-            for p, (t, v) in zip(pids, rows):
+            for lbl, (t, v) in zip(labels, rows):
                 keep = (t >= start_ms) & (t <= end_ms)
                 if keep.any():
-                    yield (shard.index.labels_of(int(p)),
-                           np.asarray(t[keep]), np.asarray(v[keep], np.float64))
+                    yield (lbl, np.asarray(t[keep]), np.asarray(v[keep], np.float64))

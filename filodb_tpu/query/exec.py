@@ -480,9 +480,12 @@ class SelectRawPartitionsExec(ExecPlan):
             rows[: len(pids)] = pids
             rid = jnp.asarray(rows)
             sel_n = jnp.where(jnp.arange(P) < len(pids), jnp.take(n, rid), 0)
+            # P > len(pids): arrays carry pad rows beyond the keys — expose the
+            # identity row map so downstream compaction/group-scatter skips them
+            sel_rows = None if P == len(pids) else np.arange(len(pids), dtype=np.int32)
             return SeriesSelection(jnp.take(ts, rid, axis=0),
                                    jnp.take(val, rid, axis=0),
-                                   sel_n.astype(jnp.int32), keys, None, grid, les)
+                                   sel_n.astype(jnp.int32), keys, sel_rows, grid, les)
         # wide selection: no gather — disable non-selected rows via n = 0
         if len(pids) == store.S or len(pids) == total:
             n_eff = n

@@ -117,7 +117,7 @@ class FiloServer:
                 c.start()
             else:
                 self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
-        mapper = ShardMapper(_pow2(num_shards), spread=cfg["spread"])
+        mapper = ShardMapper(num_shards, spread=cfg["spread"])
         self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
                                             cfg.query_config())
 

@@ -43,6 +43,14 @@ def decompress(data: bytes) -> bytes:
     """Decompress a snappy block (raises ValueError on malformed input)."""
     if not data:
         raise ValueError("empty snappy block")
+    try:
+        return _decompress(data)
+    except IndexError:
+        # any out-of-range read means a truncated tag/varint/offset
+        raise ValueError("truncated snappy block") from None
+
+
+def _decompress(data: bytes) -> bytes:
     total, pos = _read_uvarint(data, 0)
     out = bytearray()
     n = len(data)

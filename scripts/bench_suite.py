@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Benchmark suite — parity with the reference's jmh suites (SURVEY.md §6).
+
+Each sub-benchmark mirrors the *workload definition* of one reference jmh suite
+(jmh/src/main/scala/filodb.jmh/) and prints one JSON line per metric:
+
+    {"suite": "...", "metric": "...", "value": N, "unit": "..."}
+
+Suites (reference file in parens):
+
+  ingestion     container build + memstore ingest hot path  (IngestionBenchmark.scala)
+  encoding      delta-delta / NibblePack / XOR codec throughput, python + C++
+                (EncodingBenchmark.scala, BasicFiloBenchmark.scala)
+  partkey_index 1M-series tag index: add rate, equals/regex lookups, top-k
+                (PartKeyIndexBenchmark.scala)
+  hist_ingest   histogram container ingest + 2D-delta encode  (HistogramIngestBenchmark.scala)
+  hist_query    sum(rate(hist[5m])) + histogram_quantile  (HistogramQueryBenchmark.scala)
+  query_hicard  8000-series single-shard sum(rate) query throughput
+                (QueryHiCardInMemoryBenchmark.scala: 15m @ 10s, quarter queried)
+  query_ingest  interleaved ingest + query  (QueryAndIngestBenchmark.scala)
+  gateway       Influx line-protocol parse throughput  (GatewayBenchmark.scala)
+
+``--full`` uses reference-scale sizes (1M index series etc.); default sizes are
+CI-friendly. ``--suite name`` runs one suite. The north-star query benchmark
+stays in /root/repo/bench.py (QueryInMemoryBenchmark equivalent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def emit(suite: str, metric: str, value: float, unit: str) -> None:
+    print(json.dumps({"suite": suite, "metric": metric,
+                      "value": round(float(value), 3), "unit": unit}), flush=True)
+
+
+def timed(fn, *, min_s: float = 0.3, max_iters: int = 50) -> tuple[float, int]:
+    """Run fn repeatedly for >= min_s; return (total seconds, iterations)."""
+    fn()                                # warmup (jit compile / cache fill)
+    t0 = time.perf_counter()
+    iters = 0
+    while True:
+        fn()
+        iters += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_s or iters >= max_iters:
+            return dt, iters
+
+
+# ---------------------------------------------------------------- fixtures
+
+BASE = 1_700_000_000_000
+IV = 10_000
+
+
+def _gauge_containers(n_series: int, n_samples: int, per_container: int = 1000):
+    """linearMultiSeries-style data grouped into ~1000-record containers
+    (ref IngestionBenchmark: 100k records in 1000-record containers)."""
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    containers = []
+    b = RecordBuilder(GAUGE)
+    count = 0
+    for t in range(n_samples):
+        for s in range(n_series):
+            b.add({"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app",
+                   "host": f"h{s}", "job": f"App-{s % 8}"},
+                  BASE + t * IV, float(s * 100 + t))
+            count += 1
+            if count % per_container == 0:
+                containers.append(b.build())
+                b = RecordBuilder(GAUGE)
+    if count % per_container:
+        containers.append(b.build())
+    return containers
+
+
+# ---------------------------------------------------------------- suites
+
+def bench_ingestion(full: bool) -> None:
+    """Ref IngestionBenchmark: RecordBuilder build + the partition-resolve +
+    ingest hot loop into a memstore with a null sink."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.schemas import GAUGE
+
+    n_series, n_samples = (1000, 100) if full else (500, 40)
+    t0 = time.perf_counter()
+    containers = _gauge_containers(n_series, n_samples)
+    build_s = time.perf_counter() - t0
+    n_records = n_series * n_samples
+    emit("ingestion", "record_build_throughput", n_records / build_s, "records/s")
+
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=n_samples + 8,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", GAUGE, 0, cfg)
+    t0 = time.perf_counter()
+    for c in containers:
+        ms.ingest("bench", 0, c)
+    ms.flush_all()
+    ingest_s = time.perf_counter() - t0
+    emit("ingestion", "ingest_throughput", n_records / ingest_s, "records/s")
+
+    # re-ingest = pure hot path (every partition already exists: the
+    # PartitionSet-probe side of ref ingestBinaryRecords)
+    t0 = time.perf_counter()
+    for c in containers:
+        ms.ingest("bench", 0, c)
+    ms.flush_all()
+    emit("ingestion", "ingest_hot_throughput",
+         n_records / (time.perf_counter() - t0), "records/s")
+
+
+def bench_encoding(full: bool) -> None:
+    """Ref EncodingBenchmark/BasicFiloBenchmark: codec encode/decode speeds."""
+    from filodb_tpu.memory import deltadelta, native, nibblepack
+
+    n = 100_000 if full else 20_000
+    rng = np.random.default_rng(7)
+    ts = BASE + np.arange(n, dtype=np.int64) * IV + rng.integers(-50, 50, n)
+    doubles = np.cumsum(rng.exponential(5.0, n))
+
+    for name, enc, dec, data, nbytes in [
+        ("deltadelta_ts", deltadelta.encode, lambda b: deltadelta.decode(b),
+         ts, n * 8),
+        ("nibblepack_doubles", nibblepack.pack_doubles,
+         lambda b: nibblepack.unpack_doubles(b, n), doubles, n * 8),
+    ]:
+        buf = enc(data)
+        dt, it = timed(lambda: enc(data))
+        emit("encoding", f"{name}_encode", nbytes * it / dt / 1e6, "MB/s")
+        dt, it = timed(lambda: dec(buf))
+        emit("encoding", f"{name}_decode", nbytes * it / dt / 1e6, "MB/s")
+        emit("encoding", f"{name}_ratio", nbytes / len(buf), "x")
+
+    if native.available():
+        u = doubles.view(np.uint64)
+        buf = native.pack_doubles(doubles)
+        dt, it = timed(lambda: native.pack_doubles(doubles))
+        emit("encoding", "native_pack_doubles", n * 8 * it / dt / 1e6, "MB/s")
+        dt, it = timed(lambda: native.unpack_doubles(buf, n))
+        emit("encoding", "native_unpack_doubles", n * 8 * it / dt / 1e6, "MB/s")
+
+
+def bench_partkey_index(full: bool) -> None:
+    """Ref PartKeyIndexBenchmark: 1M part keys, 20-filter lookup batches."""
+    from filodb_tpu.core import filters as F
+    from filodb_tpu.core.partkey_index import PartKeyIndex
+
+    n = 1_000_000 if full else 100_000
+    idx = PartKeyIndex()
+    now = BASE
+    t0 = time.perf_counter()
+    for i in range(n):
+        idx.add_part_key(i, {"__name__": "heap_usage", "job": f"App-{i % 100}",
+                             "host": f"H{i % 1000}", "instance": f"I{i}"}, now)
+    add_s = time.perf_counter() - t0
+    emit("partkey_index", "add_rate", n / add_s, "keys/s")
+
+    def equals_lookup():
+        for i in range(20):
+            idx.part_ids_from_filters(
+                [F.Equals("job", f"App-{i}"), F.Equals("host", "H0"),
+                 F.Equals("__name__", "heap_usage")], now, now + 1000)
+
+    dt, it = timed(equals_lookup)
+    emit("partkey_index", "equals_lookup", 20 * it / dt, "lookups/s")
+
+    def regex_lookup():
+        for i in range(20):
+            idx.part_ids_from_filters(
+                [F.Equals("job", f"App-{i}"), F.EqualsRegex("host", "H[0-9]"),
+                 F.Equals("__name__", "heap_usage")], now, now + 1000)
+
+    dt, it = timed(regex_lookup, max_iters=20)
+    emit("partkey_index", "regex_lookup", 20 * it / dt, "lookups/s")
+
+    dt, it = timed(lambda: idx.label_values("job", top_k=10), max_iters=20)
+    emit("partkey_index", "labelvalues_topk", it / dt, "ops/s")
+
+
+def bench_hist_ingest(full: bool) -> None:
+    """Ref HistogramIngestBenchmark: ingest native-histogram records."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+    from filodb_tpu.memory import hist as H
+
+    n_series, n_samples, B = (100, 300, 64) if full else (50, 100, 64)
+    rng = np.random.default_rng(3)
+    les = np.concatenate([2.0 ** np.arange(B - 1), [np.inf]])
+    counts = [np.cumsum(np.cumsum(rng.poisson(0.3, (n_samples, B)), axis=0), axis=1)
+              .astype(np.float64) for _ in range(n_series)]
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=n_samples + 8,
+                      flush_batch_size=10**9, dtype="float64")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", PROM_HISTOGRAM, 0, cfg)
+    t0 = time.perf_counter()
+    for s in range(n_series):
+        b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+        for t in range(n_samples):
+            b.add({"_metric_": "req_latency", "host": f"h{s}"},
+                  BASE + t * IV, counts[s][t])
+        ms.ingest("bench", 0, b.build())
+    ms.flush_all()
+    total = n_series * n_samples
+    emit("hist_ingest", "ingest_throughput",
+         total / (time.perf_counter() - t0), "hist_records/s")
+
+    one = counts[0]
+    dt, it = timed(lambda: H.encode_hist_series(one))
+    emit("hist_ingest", "encode_2d_delta", n_samples * it / dt, "hists/s")
+
+
+def bench_hist_query(full: bool) -> None:
+    """Ref HistogramQueryBenchmark: quantile-of-rate over native hists."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series, n_samples, B = (100, 300, 64) if full else (40, 120, 64)
+    rng = np.random.default_rng(4)
+    les = np.concatenate([2.0 ** np.arange(B - 1), [np.inf]])
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=n_samples + 8,
+                      flush_batch_size=10**9, dtype="float64")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", PROM_HISTOGRAM, 0, cfg)
+    for s in range(n_series):
+        b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+        c = np.cumsum(np.cumsum(rng.poisson(0.3, (n_samples, B)), axis=0),
+                      axis=1).astype(np.float64)
+        for t in range(n_samples):
+            b.add({"_metric_": "req_latency", "host": f"h{s}"},
+                  BASE + t * IV, c[t])
+        ms.ingest("bench", 0, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "bench")
+    start, end = BASE + 600_000, BASE + (n_samples - 10) * IV
+
+    def q():
+        eng.query_range('histogram_quantile(0.9, sum(rate(req_latency[5m])))',
+                        start, end, 60_000)
+
+    dt, it = timed(q, max_iters=30)
+    emit("hist_query", "quantile_of_sum_rate", it / dt, "queries/s")
+    emit("hist_query", "quantile_of_sum_rate_p50", dt / it * 1000, "ms")
+
+
+def bench_query_hicard(full: bool) -> None:
+    """Ref QueryHiCardInMemoryBenchmark: 8000 series, 15m @ 10s, a quarter
+    queried per sum(rate) query."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series = 8000 if full else 2000
+    n_samples = 90                       # 15 minutes @ 10s
+    rng = np.random.default_rng(11)
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", PROM_COUNTER, 0, cfg)
+    per_job = 4                           # -> n_series/4 match one job filter
+    for s in range(n_series):
+        b = RecordBuilder(PROM_COUNTER)
+        vals = np.cumsum(rng.exponential(5.0, n_samples))
+        for t in range(n_samples):
+            b.add({"_metric_": "request_total", "job": f"J{s % per_job}",
+                   "instance": f"i{s}"}, BASE + t * IV, float(vals[t]))
+        ms.ingest("bench", 0, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "bench")
+    start, end = BASE + 300_000, BASE + (n_samples - 1) * IV
+
+    def q():
+        eng.query_range('sum(rate(request_total{job="J0"}[1m]))',
+                        start, end, 60_000)
+
+    dt, it = timed(q, max_iters=30)
+    emit("query_hicard", "sum_rate_quarter_series", it / dt, "queries/s")
+    emit("query_hicard", "sum_rate_p50", dt / it * 1000, "ms")
+
+
+def bench_query_ingest(full: bool) -> None:
+    """Ref QueryAndIngestBenchmark: queries while ingest keeps running."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series, n_samples = (1000, 100) if full else (400, 60)
+    containers = _gauge_containers(n_series, n_samples)
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=2 * n_samples + 8,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", GAUGE, 0, cfg)
+    for c in containers[: len(containers) // 2]:
+        ms.ingest("bench", 0, c)
+    ms.flush_all()
+    eng = QueryEngine(ms, "bench")
+    start = BASE + 120_000
+    end = BASE + (n_samples // 2 - 1) * IV
+    t0 = time.perf_counter()
+    n_q = 0
+    rest = containers[len(containers) // 2:]
+    for i, c in enumerate(rest):
+        ms.ingest("bench", 0, c)
+        if i % 4 == 0:
+            eng.query_range('sum(rate(heap_usage[1m]))', start, end, 30_000)
+            n_q += 1
+    ms.flush_all()
+    dt = time.perf_counter() - t0
+    n_rec = sum(len(c.ts) for c in rest)
+    emit("query_ingest", "mixed_ingest_throughput", n_rec / dt, "records/s")
+    emit("query_ingest", "mixed_query_throughput", n_q / dt, "queries/s")
+
+
+def bench_gateway(full: bool) -> None:
+    """Ref GatewayBenchmark: Influx line-protocol parse + shard-hash rate."""
+    from filodb_tpu.ingest.gateway import parse_influx_line
+
+    n = 50_000 if full else 10_000
+    lines = [
+        f"cpu,host=h{i % 100},dc=us-east usage_user={i % 90}.5,usage_sys=1.25 "
+        f"{(BASE + i) * 1_000_000}" for i in range(n)
+    ]
+
+    def parse_all():
+        for ln in lines:
+            parse_influx_line(ln)
+
+    dt, it = timed(parse_all, max_iters=10)
+    emit("gateway", "influx_parse", n * it / dt, "lines/s")
+
+
+SUITES = {
+    "ingestion": bench_ingestion,
+    "encoding": bench_encoding,
+    "partkey_index": bench_partkey_index,
+    "hist_ingest": bench_hist_ingest,
+    "hist_query": bench_hist_query,
+    "query_hicard": bench_query_hicard,
+    "query_ingest": bench_query_ingest,
+    "gateway": bench_gateway,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--suite", choices=sorted(SUITES), action="append",
+                    help="run only these suites (default: all)")
+    ap.add_argument("--full", action="store_true",
+                    help="reference-scale sizes (1M index keys, 8000 series, ...)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (for dev boxes without a TPU)")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+    for name in (args.suite or sorted(SUITES)):
+        SUITES[name](args.full)
+
+
+if __name__ == "__main__":
+    main()

@@ -202,3 +202,40 @@ def test_rate_on_counter_schema():
     (key, out_ts, got), = list(r.matrix.iter_series())
     want = eval_range_fn("rate", ts, vals, OUT_TS, 120_000)
     np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_aggregate_over_padded_narrow_gather():
+    """Regression: a narrow selection whose match count is not a power of two is
+    padded by the leaf gather (e.g. 40 of 100 series -> 64 rows); the aggregate
+    map phase must skip the pad rows (gids/keys/values row alignment)."""
+    ms = TimeSeriesMemStore()
+    n_series = 100
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    ms.setup("padded", GAUGE, 0, cfg)
+    for i in range(n_series):
+        b = RecordBuilder(GAUGE)
+        for t in range(40):
+            b.add({"_metric_": "m", "grp": f"g{i % 5}", "inst": f"i{i}"},
+                  START + t * INTERVAL, float(i))
+        ms.ingest("padded", 0, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "padded")
+    # grp="g0"|"g1" matches 40 of 100 series -> narrow gather pads to 64 rows
+    r = eng.query_range('sum by (grp) (avg_over_time(m{grp=~"g[01]"}[2m]))',
+                        START + 200_000, START + 390_000, 30_000)
+    got = {k.as_dict()["grp"]: vals for k, _, vals in r.matrix.iter_series()}
+    assert set(got) == {"g0", "g1"}
+    # grp gk sums values i over i % 5 == k: sum over i in {k, k+5, ..., k+95}
+    for g in (0, 1):
+        want = sum(range(g, 100, 5))
+        np.testing.assert_allclose(got[f"g{g}"], want)
+    # order-statistics path over the same padded selection
+    r = eng.query_range('topk(2, last_over_time(m{grp=~"g[01]"}[2m]))',
+                        START + 200_000, START + 390_000, 30_000)
+    vals = np.asarray(r.matrix.values)
+    assert np.isfinite(vals).sum(axis=0).max() <= 2     # k survivors per step
+    # globally highest-valued matched series (i=96, i=95) win at every step
+    finite_rows = np.isfinite(vals).any(axis=1)
+    winners = {r.matrix.keys[i].as_dict()["inst"] for i in np.nonzero(finite_rows)[0]}
+    assert winners == {"i96", "i95"}

@@ -47,6 +47,13 @@ class TestSnappy:
         with pytest.raises(ValueError):
             # declared length mismatch
             snappy.decompress(bytes([50]) + bytes([0 << 2]) + b"x")
+        with pytest.raises(ValueError):
+            # 1-byte-offset copy tag with its offset byte truncated
+            # (regression: used to escape as IndexError -> HTTP 500)
+            snappy.decompress(bytes([10, 1]))
+        with pytest.raises(ValueError):
+            # truncated header varint
+            snappy.decompress(b"\x80")
 
 
 def _store_with_data(num_shards=2):
