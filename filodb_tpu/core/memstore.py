@@ -343,7 +343,7 @@ class TimeSeriesShard:
             last = self.store.last_ts
             inactive = np.nonzero((self.store.n_host > 0) & (last < cutoff_ms))[0]
             for pid in inactive.tolist():
-                if self.index.labels_of(pid):
+                if self.index.is_live(pid):
                     self.index.update_end_time(pid, int(last[pid]))
             purged = self.index.part_ids_ended_before(cutoff_ms)
             # never purge series with data still staged for a pending flush group

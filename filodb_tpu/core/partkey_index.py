@@ -9,10 +9,19 @@ must not bottleneck 1M-series workloads (ref bar: PartKeyIndexBenchmark). Postin
 are kept as append lists compacted lazily into sorted int32 numpy arrays; filter
 evaluation is numpy set algebra (intersect/union/setdiff) over postings, with regex
 applied per *distinct label value* (not per series).
+
+Label storage is dictionary-encoded (ref: DictUTF8Vector/UTF8Vector,
+memory/.../format/vectors/DictUTF8Vector.scala): each distinct label name and
+value string is stored once in a pool, and a partition's labels are (name_id,
+value_id) u32 pairs in a shared arena — ~16 bytes per label versus a per-series
+Python dict, the difference between ~40MB and >400MB of index at 1M series.
+Start/end times live in growable int64 numpy arrays so time-range masking in
+queries is a zero-copy slice, not a 1M-element list conversion.
 """
 
 from __future__ import annotations
 
+from array import array
 from collections import Counter, defaultdict
 
 import numpy as np
@@ -25,11 +34,12 @@ _EMPTY = np.empty(0, dtype=np.int32)
 class _Postings:
     """Append-friendly posting list with lazy sorted-array compaction."""
 
-    __slots__ = ("_new", "_arr")
+    __slots__ = ("_new", "_arr", "vid")
 
-    def __init__(self):
+    def __init__(self, vid: int = 0):
         self._new: list[int] = []
         self._arr: np.ndarray = _EMPTY
+        self.vid = vid                   # id of this value in its name's pool
 
     def add(self, part_id: int) -> None:
         self._new.append(part_id)
@@ -53,39 +63,105 @@ class _Postings:
         return len(self._arr) + len(self._new)
 
 
+class _I64Vec:
+    """Growable int64 column with zero-copy numpy views."""
+
+    __slots__ = ("_buf", "n")
+
+    def __init__(self):
+        self._buf = np.empty(64, np.int64)
+        self.n = 0
+
+    def append(self, v: int) -> None:
+        if self.n == len(self._buf):
+            grown = np.empty(2 * len(self._buf), np.int64)
+            grown[: self.n] = self._buf
+            self._buf = grown
+        self._buf[self.n] = v
+        self.n += 1
+
+    def view(self) -> np.ndarray:
+        return self._buf[: self.n]
+
+    def __getitem__(self, i: int) -> int:
+        return int(self._buf[i])
+
+    def __setitem__(self, i: int, v: int) -> None:
+        self._buf[i] = v
+
+
 class PartKeyIndex:
     """Inverted index over one shard's partitions."""
 
     def __init__(self):
-        # label name -> label value -> postings
+        # label name -> label value -> postings (value str stored once, here)
         self._inv: dict[str, dict[str, _Postings]] = defaultdict(dict)
-        self._labels: list[dict[str, str]] = []       # part_id -> label dict
-        self._start: list[int] = []                    # part_id -> first sample ts (ms)
-        self._end: list[int] = []                      # part_id -> last sample ts / MAX while live
+        # dictionary encoding pools (ref: DictUTF8Vector)
+        self._name_id: dict[str, int] = {}
+        self._name_pool: list[str] = []
+        self._val_pool: list[list[str]] = []   # name_id -> vid -> value str
+        # value -> vid survives postings removal so churned values re-intern
+        # under their original vid (no duplicate pool entries under churn)
+        self._vid_of: list[dict[str, int]] = []
+        self._dead_pairs = 0                   # arena pairs orphaned by purge
+        # per-partition label pairs in one shared arena of u32
+        self._arena = array("I")
+        self._off: array = array("Q")          # part_id -> arena offset (pairs)
+        self._cnt: array = array("I")          # part_id -> number of labels
+        self._start = _I64Vec()                # part_id -> first sample ts (ms)
+        self._end = _I64Vec()                  # part_id -> last ts / MAX while live
 
     LIVE_END = np.iinfo(np.int64).max
 
     def __len__(self) -> int:
-        return len(self._labels)
+        return len(self._off)
+
+    def _intern(self, name: str, value: str) -> tuple[int, int, _Postings]:
+        nid = self._name_id.get(name)
+        if nid is None:
+            nid = self._name_id[name] = len(self._name_pool)
+            self._name_pool.append(name)
+            self._val_pool.append([])
+            self._vid_of.append({})
+        vals = self._inv[name]
+        p = vals.get(value)
+        if p is None:
+            vid = self._vid_of[nid].get(value)
+            if vid is None:
+                pool = self._val_pool[nid]
+                vid = self._vid_of[nid][value] = len(pool)
+                pool.append(value)
+            # reuse the pooled (canonical) string instance as the _inv key
+            p = vals[self._val_pool[nid][vid]] = _Postings(vid)
+        return nid, p.vid, p
 
     def add_part_key(self, part_id: int, labels: dict[str, str], start_time: int,
                      end_time: int = LIVE_END) -> None:
-        if part_id == len(self._labels):
-            self._labels.append(labels)
+        if part_id == len(self._off):
+            self._off.append(len(self._arena) // 2)
+            self._cnt.append(len(labels))
             self._start.append(start_time)
             self._end.append(end_time)
+            for name, value in labels.items():
+                nid, vid, p = self._intern(name, value)
+                self._arena.append(nid)
+                self._arena.append(vid)
+                p.add(part_id)
         else:
-            # reuse of a purged slot (ref: TimeSeriesShard partId free list)
-            assert part_id < len(self._labels) and not self._labels[part_id], \
+            # reuse of a purged slot (ref: TimeSeriesShard partId free list);
+            # new pairs append to the arena, the old region is dead space until
+            # the dead ratio triggers compaction (see maybe_compact_arena)
+            assert part_id < len(self._off) and self._cnt[part_id] == 0, \
                 "part ids must be assigned densely or reuse a purged slot"
-            self._labels[part_id] = labels
+            self._off[part_id] = len(self._arena) // 2
+            self._cnt[part_id] = len(labels)
             self._start[part_id] = start_time
             self._end[part_id] = end_time
-        for name, value in labels.items():
-            p = self._inv[name].get(value)
-            if p is None:
-                p = self._inv[name][value] = _Postings()
-            p.add(part_id)
+            for name, value in labels.items():
+                nid, vid, p = self._intern(name, value)
+                self._arena.append(nid)
+                self._arena.append(vid)
+                p.add(part_id)
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
         self._end[part_id] = end_time
@@ -96,8 +172,27 @@ class PartKeyIndex:
     def end_time(self, part_id: int) -> int:
         return self._end[part_id]
 
+    def is_live(self, part_id: int) -> bool:
+        """O(1) liveness check (a purged slot has no labels)."""
+        return self._cnt[part_id] > 0
+
     def labels_of(self, part_id: int) -> dict[str, str]:
-        return self._labels[part_id]
+        o = self._off[part_id] * 2
+        out = {}
+        arena = self._arena
+        for i in range(o, o + 2 * self._cnt[part_id], 2):
+            nid = arena[i]
+            out[self._name_pool[nid]] = self._val_pool[nid][arena[i + 1]]
+        return out
+
+    def arena_bytes(self) -> int:
+        """Approximate index label-storage footprint (for stats/benchmarks)."""
+        pools = sum(len(s) for s in self._name_pool)
+        pools += sum(len(v) for pool in self._val_pool for v in pool)
+        return (self._arena.itemsize * len(self._arena)
+                + self._off.itemsize * len(self._off)
+                + self._cnt.itemsize * len(self._cnt)
+                + 16 * self._start.n + pools)
 
     # ---- queries ----------------------------------------------------------
 
@@ -138,7 +233,7 @@ class PartKeyIndex:
             if result is not None and len(result) == 0:
                 return _EMPTY
         if result is None:
-            result = np.arange(len(self._labels), dtype=np.int32)
+            result = np.arange(len(self._off), dtype=np.int32)
         for f in negations:
             # series *lacking* the label entirely also match a negative filter
             pos = self._postings_for(
@@ -146,8 +241,8 @@ class PartKeyIndex:
             )
             result = np.setdiff1d(result, pos, assume_unique=True)
         if len(result):
-            starts = np.asarray(self._start, dtype=np.int64)[result]
-            ends = np.asarray(self._end, dtype=np.int64)[result]
+            starts = self._start.view()[result]
+            ends = self._end.view()[result]
             result = result[(starts <= end_time) & (ends >= start_time)]
         if limit is not None:
             result = result[:limit]
@@ -155,8 +250,9 @@ class PartKeyIndex:
 
     def part_ids_ended_before(self, ts: int) -> np.ndarray:
         """For purge (ref: PartKeyLuceneIndex.partIdsEndedBefore)."""
-        ends = np.asarray(self._end, dtype=np.int64)
-        live = np.asarray([bool(lbl) for lbl in self._labels])
+        ends = self._end.view()
+        live = np.frombuffer(self._cnt, np.uint32, count=len(self._cnt)) > 0 \
+            if len(self._cnt) else np.empty(0, bool)
         return np.nonzero((ends < ts) & live)[0].astype(np.int32)
 
     def remove_part_keys(self, part_ids: np.ndarray) -> None:
@@ -168,9 +264,10 @@ class PartKeyIndex:
         removed = np.asarray(part_ids, np.int32)
         touched: dict[str, set[str]] = defaultdict(set)
         for pid in removed.tolist():
-            for name, value in self._labels[pid].items():
+            for name, value in self.labels_of(pid).items():
                 touched[name].add(value)
-            self._labels[pid] = {}
+            self._dead_pairs += self._cnt[pid]
+            self._cnt[pid] = 0
             self._start[pid] = 0
             self._end[pid] = -1          # matches no [start, end] overlap query
         for name, values in touched.items():
@@ -180,8 +277,31 @@ class PartKeyIndex:
                     p.remove(removed)
                     if not len(p):
                         del self._inv[name][value]
+                        # value string stays in the pool: vids are stable and a
+                        # re-added value re-interns under a fresh vid
             if not self._inv[name]:
                 del self._inv[name]
+        self.maybe_compact_arena()
+
+    def maybe_compact_arena(self, min_dead_ratio: float = 0.5) -> bool:
+        """Rebuild the label arena from live partitions when purge churn has
+        orphaned more than ``min_dead_ratio`` of it (the Lucene analog is
+        segment merging reclaiming deleted docs). Offsets move; vids do not.
+        Returns True if a compaction ran."""
+        live_pairs = len(self._arena) // 2 - self._dead_pairs
+        if self._dead_pairs == 0 or self._dead_pairs <= live_pairs * min_dead_ratio:
+            return False
+        fresh = array("I")
+        for pid in range(len(self._off)):
+            c = self._cnt[pid]
+            if c == 0:
+                continue
+            o = self._off[pid] * 2
+            self._off[pid] = len(fresh) // 2
+            fresh.extend(self._arena[o:o + 2 * c])
+        self._arena = fresh
+        self._dead_pairs = 0
+        return True
 
     def label_values(self, label: str, filters: list[Filter] | None = None,
                      start_time: int = 0, end_time: int = 1 << 62,
@@ -211,5 +331,5 @@ class PartKeyIndex:
         matching = self.part_ids_from_filters(filters, start_time, end_time)
         names: set[str] = set()
         for pid in matching.tolist():
-            names.update(self._labels[pid])
+            names.update(self.labels_of(pid))
         return sorted(names)

@@ -79,8 +79,10 @@ class QueryEngine:
     def series(self, filters, start_ms: int, end_ms: int) -> list[dict[str, str]]:
         out = []
         for shard in self.memstore.shards_of(self.dataset):
-            pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
-            out.extend(shard.index.labels_of(int(p)) for p in pids)
+            # ids and labels under one lock: a concurrent purge reuses slots
+            with shard.lock:
+                pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
+                out.extend(shard.index.labels_of(int(p)) for p in pids)
         return out
 
     def raw_series(self, filters, start_ms: int, end_ms: int):

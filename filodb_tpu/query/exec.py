@@ -47,15 +47,20 @@ class QueryContext:
 class SeriesSelection:
     """Leaf output: device store arrays + which rows are selected.
 
-    ``rows is None`` => arrays are already compacted to the selection (P rows).
-    Otherwise arrays cover the full store [S, C] and ``rows``/``n`` encode the
-    selection (n is zeroed outside it).
+    Three states, distinguished by ``rows`` and the array row count R:
+    - ``rows is None``: arrays are exactly the selection (R == len(keys)).
+    - ``rows`` = identity map [0..P): arrays are the gathered selection padded
+      to R = pow2(P) rows; pad rows have n=0 and carry no key.
+    - ``rows`` = store-row ids: arrays cover the full store [S, C]; ``rows[i]``
+      is the array row of key i and ``n`` is zeroed outside the selection.
+    Consumers only ever index arrays *by rows* (compaction, group-id scatter),
+    which is correct in all three states.
     """
     ts: object                # [R, C] int64
     val: object               # [R, C] float (or [R, C, B] histogram buckets)
     n: object                 # [R] int32 (0 => row disabled)
     keys: list[RangeVectorKey]
-    rows: np.ndarray | None   # int32 [P] store-row of each key, or None
+    rows: np.ndarray | None   # int32 [P] array-row of each key, or None
     grid: tuple | None = None  # (base_ts, interval_ms) => MXU band-matmul path
     bucket_les: np.ndarray | None = None  # histogram bucket tops [B]
 

@@ -185,6 +185,7 @@ def bench_partkey_index(full: bool) -> None:
 
     dt, it = timed(lambda: idx.label_values("job", top_k=10), max_iters=20)
     emit("partkey_index", "labelvalues_topk", it / dt, "ops/s")
+    emit("partkey_index", "label_storage", idx.arena_bytes() / n, "bytes/series")
 
 
 def bench_hist_ingest(full: bool) -> None:

@@ -140,3 +140,52 @@ def test_memstore_ingest_query_roundtrip():
     assert shard.num_series == 5
     ts, _ = shard.store.series_snapshot(int(pids[0]))
     assert len(ts) == 23
+
+
+def test_partkey_index_dict_encoding():
+    """Label storage is dictionary-encoded (ref: DictUTF8Vector): each distinct
+    string lives once in a pool; per-partition storage is u32 id pairs."""
+    idx = PartKeyIndex()
+    n = 2000
+    for i in range(n):
+        # fresh str objects each add — naive storage would keep all of them
+        idx.add_part_key(i, {"_metric_"[:]: "heap" + "_usage",
+                             "dc": "us-" + ("east" if i % 2 else "west"),
+                             "host": f"h{i}"}, start_time=0)
+    # canonical instances: equal values across partitions are the same object
+    assert idx.labels_of(0)["dc"] is idx.labels_of(2)["dc"]
+    assert idx.labels_of(0)["_metric_"] is idx.labels_of(1999)["_metric_"]
+    # arena footprint: 3 labels x 8B pairs + 12B offsets/counts + 16B times
+    # + pools (host values dominate: ~2000 * ~5 chars)
+    assert idx.arena_bytes() < n * 80
+    # behavior parity after purge + slot reuse
+    idx.remove_part_keys(np.arange(10, dtype=np.int32))
+    idx.add_part_key(3, {"dc": "eu-central", "host": "h3b"}, start_time=99)
+    assert idx.labels_of(3) == {"dc": "eu-central", "host": "h3b"}
+    got = idx.part_ids_from_filters([F.Equals("dc", "eu-central")], 0, 10**15)
+    np.testing.assert_array_equal(got, [3])
+    assert idx.start_time(3) == 99
+
+
+def test_partkey_index_churn_bounded():
+    """Purge-and-readd churn must not grow pools or the arena without bound:
+    re-added values reuse their original vid, and the arena compacts when
+    mostly dead (ref analog: Lucene segment merge reclaiming deleted docs)."""
+    idx = PartKeyIndex()
+    for cycle in range(20):
+        for i in range(50):
+            idx.add_part_key(i, {"pod": f"pod-{i}", "app": "web"}, start_time=cycle)
+        idx.remove_part_keys(np.arange(50, dtype=np.int32))
+    # value pool holds each distinct string once despite 20 churn cycles
+    assert len(idx._val_pool[idx._name_id["pod"]]) == 50
+    # arena stays bounded (compaction): within 2x of a single generation
+    idx2 = PartKeyIndex()
+    for i in range(50):
+        idx2.add_part_key(i, {"pod": f"pod-{i}", "app": "web"}, start_time=0)
+    assert idx.arena_bytes() <= 2 * idx2.arena_bytes()
+    # behavior still correct after heavy churn
+    for i in range(50):
+        idx.add_part_key(i, {"pod": f"pod-{i}", "app": "web"}, start_time=99)
+    got = idx.part_ids_from_filters([F.Equals("pod", "pod-7")], 0, 10**15)
+    np.testing.assert_array_equal(got, [7])
+    assert idx.labels_of(7) == {"pod": "pod-7", "app": "web"}
