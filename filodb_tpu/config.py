@@ -37,6 +37,12 @@ DEFAULTS: dict[str, Any] = {
     "query": {
         "stale_sample_after": "5m",
         "sample_limit": 1_000_000,
+        # priority query scheduler (ref: QueryActor priority mailbox +
+        # dedicated query scheduler, filodb-defaults.conf query thread pools;
+        # timeout ref: query ask-timeout)
+        "num_threads": 4,
+        "queue_size": 64,
+        "timeout": "60s",
     },
     "downsample": {
         "enabled": False,

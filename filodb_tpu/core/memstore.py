@@ -72,6 +72,10 @@ class TimeSeriesShard:
         # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
         self._free_pids: list[int] = []
         self._evicted_keys = BloomFilter()
+        # memoized RangeVectorKey per queried pid: the dict-encoded index
+        # reconstructs labels on demand, so query leaves cache the key object
+        # (built once per series lifetime, dropped on purge)
+        self._rv_keys: dict[int, object] = {}
         self.eviction_policy = eviction_policy or CapacityEvictionPolicy()
         # guards the donating device append vs concurrent query dispatch: the
         # scatter invalidates (donates) the old store buffers, so query leaves
@@ -362,6 +366,8 @@ class TimeSeriesShard:
                     self._evicted_keys.add(pk)
             self.index.remove_part_keys(purged)
             self.store.free_rows(purged)
+            for pid in purged.tolist():
+                self._rv_keys.pop(pid, None)
             if self._new_part_pids:
                 gone = set(purged.tolist())
                 self._new_part_pids = [p for p in self._new_part_pids if p not in gone]
@@ -426,6 +432,16 @@ class TimeSeriesShard:
         return ts_arr, val_arr, n_arr
 
     # -- queries ------------------------------------------------------------
+
+    def rv_key_of(self, pid: int):
+        """Memoized RangeVectorKey for a live pid (query-leaf hot path: avoids
+        re-materializing the dict-encoded labels on every query). Call under
+        the shard lock; purge drops cache entries for reused slots."""
+        k = self._rv_keys.get(pid)
+        if k is None:
+            from ..query.rangevector import RangeVectorKey
+            k = self._rv_keys[pid] = RangeVectorKey.of(self.index.labels_of(pid))
+        return k
 
     def part_ids_from_filters(self, filters: list[Filter], start: int, end: int,
                               limit: int | None = None) -> np.ndarray:

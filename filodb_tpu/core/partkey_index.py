@@ -284,22 +284,40 @@ class PartKeyIndex:
         self.maybe_compact_arena()
 
     def maybe_compact_arena(self, min_dead_ratio: float = 0.5) -> bool:
-        """Rebuild the label arena from live partitions when purge churn has
-        orphaned more than ``min_dead_ratio`` of it (the Lucene analog is
-        segment merging reclaiming deleted docs). Offsets move; vids do not.
-        Returns True if a compaction ran."""
-        live_pairs = len(self._arena) // 2 - self._dead_pairs
-        if self._dead_pairs == 0 or self._dead_pairs <= live_pairs * min_dead_ratio:
+        """Rebuild the label arena AND the value pools from live partitions when
+        purge churn has orphaned more than ``min_dead_ratio`` of the arena (the
+        Lucene analog is segment merging reclaiming deleted docs). Value strings
+        with no live postings are dropped from the pools, so unique-value churn
+        (e.g. a new pod name per deploy) stays bounded by *live* cardinality.
+        Offsets and vids both move. Returns True if a compaction ran."""
+        total = len(self._arena) // 2
+        if self._dead_pairs == 0 or self._dead_pairs <= total * min_dead_ratio:
             return False
+        # re-pool: keep only values that still have live postings; vids renumber
+        new_pools: list[list[str]] = [[] for _ in self._name_pool]
+        new_vid_of: list[dict[str, int]] = [{} for _ in self._name_pool]
+        vid_map: list[dict[int, int]] = [{} for _ in self._name_pool]
+        for name, vals in self._inv.items():
+            nid = self._name_id[name]
+            for value, p in vals.items():
+                new_vid = new_vid_of[nid][value] = len(new_pools[nid])
+                new_pools[nid].append(value)
+                vid_map[nid][p.vid] = new_vid
+                p.vid = new_vid
         fresh = array("I")
+        arena = self._arena
         for pid in range(len(self._off)):
             c = self._cnt[pid]
             if c == 0:
                 continue
             o = self._off[pid] * 2
             self._off[pid] = len(fresh) // 2
-            fresh.extend(self._arena[o:o + 2 * c])
+            for i in range(o, o + 2 * c, 2):
+                fresh.append(arena[i])
+                fresh.append(vid_map[arena[i]][arena[i + 1]])
         self._arena = fresh
+        self._val_pool = new_pools
+        self._vid_of = new_vid_of
         self._dead_pairs = 0
         return True
 

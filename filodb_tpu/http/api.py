@@ -12,6 +12,7 @@ import json
 import re
 import threading
 import traceback
+from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -19,6 +20,7 @@ from ..core import filters as F
 from ..promql.parser import ParseError
 from ..query.engine import QueryEngine
 from ..query.rangevector import QueryError
+from ..query.scheduler import Priority, SchedulerBusy
 
 
 def matrix_to_prom_json(result) -> dict:
@@ -68,12 +70,16 @@ class FiloHttpServer:
     datasets (ref: FiloHttpServer / akka-http binding)."""
 
     def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
-                 cluster=None, writers: dict | None = None):
+                 cluster=None, writers: dict | None = None, scheduler=None):
         """``writers``: dataset -> callable(per_shard: dict[shard, container])
-        receiving remote-write batches atomically (bus publish or direct ingest)."""
+        receiving remote-write batches atomically (bus publish or direct ingest).
+        ``scheduler``: optional QueryScheduler — query work runs through its
+        priority lanes (ref: QueryActor priority mailbox) instead of directly
+        on the HTTP handler thread."""
         self.engines = engines
         self.cluster = cluster
         self.writers = writers or {}
+        self.scheduler = scheduler
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -94,6 +100,12 @@ class FiloHttpServer:
                 except (QueryError, ParseError) as e:
                     self._send(422, {"status": "error", "errorType": "bad_data",
                                      "error": str(e)})
+                except SchedulerBusy as e:
+                    self._send(503, {"status": "error", "errorType": "unavailable",
+                                     "error": str(e)})
+                except FuturesTimeout:
+                    self._send(504, {"status": "error", "errorType": "timeout",
+                                     "error": "query timed out"})
                 except Exception as e:  # noqa: BLE001
                     traceback.print_exc()
                     self._send(500, {"status": "error", "errorType": "internal",
@@ -115,6 +127,12 @@ class FiloHttpServer:
 
     def stop(self):
         self._server.shutdown()
+
+    def _run(self, fn, priority: Priority):
+        """Run query work through the priority scheduler when configured."""
+        if self.scheduler is None:
+            return fn()
+        return self.scheduler.run(fn, priority)
 
     # -- routing -------------------------------------------------------------
 
@@ -159,22 +177,31 @@ class FiloHttpServer:
                 h._send(404, {"status": "error", "error": f"no dataset {m.group(1)}"})
                 return
             if m.group(2) == "query_range":
-                res = engine.query_range(q["query"], _parse_time(q["start"]),
-                                         _parse_time(q["end"]), _parse_step(q["step"]))
+                res = self._run(
+                    lambda: engine.query_range(q["query"], _parse_time(q["start"]),
+                                               _parse_time(q["end"]),
+                                               _parse_step(q["step"])),
+                    Priority.QUERY)
             else:
-                res = engine.query_instant(q["query"], _parse_time(q["time"]))
+                res = self._run(
+                    lambda: engine.query_instant(q["query"], _parse_time(q["time"])),
+                    Priority.QUERY)
             h._send(200, {"status": "success", "data": matrix_to_prom_json(res)})
             return
 
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/labels", path)
         if m:
             engine = self.engines[m.group(1)]
-            h._send(200, {"status": "success", "data": engine.label_names()})
+            h._send(200, {"status": "success",
+                          "data": self._run(engine.label_names, Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/label/([^/]+)/values", path)
         if m:
             engine = self.engines[m.group(1)]
-            h._send(200, {"status": "success", "data": engine.label_values(m.group(2))})
+            name = m.group(2)
+            h._send(200, {"status": "success",
+                          "data": self._run(lambda: engine.label_values(name),
+                                            Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/series", path)
         if m:
@@ -182,13 +209,18 @@ class FiloHttpServer:
             filters = _selector_to_filters(q["match[]"])
             start = _parse_time(q.get("start", "0"))
             end = _parse_time(q.get("end", "9999999999"))
-            data = []
-            for labels in engine.series(filters, start, end):
-                d = dict(labels)
-                if "_metric_" in d:
-                    d["__name__"] = d.pop("_metric_")
-                data.append(d)
-            h._send(200, {"status": "success", "data": data})
+
+            def fetch_series():
+                data = []
+                for labels in engine.series(filters, start, end):
+                    d = dict(labels)
+                    if "_metric_" in d:
+                        d["__name__"] = d.pop("_metric_")
+                    data.append(d)
+                return data
+
+            h._send(200, {"status": "success",
+                          "data": self._run(fetch_series, Priority.METADATA)})
             return
         h._send(404, {"status": "error", "error": f"unknown path {path}"})
 
@@ -214,7 +246,10 @@ class FiloHttpServer:
         from ..promql import remote
 
         if which == "read":
-            payload = remote.read_request(body, engine)
+            # remote read is a full data-reading query — it goes through the
+            # scheduler's QUERY lane like query_range, not the handler thread
+            payload = self._run(lambda: remote.read_request(body, engine),
+                                Priority.QUERY)
             h.send_response(200)
             h.send_header("Content-Type", "application/x-protobuf")
             h.send_header("Content-Encoding", "snappy")

@@ -459,7 +459,7 @@ class SelectRawPartitionsExec(ExecPlan):
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
                                    jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
-        keys = [RangeVectorKey.of(shard.index.labels_of(int(p))) for p in pids]
+        keys = [shard.rv_key_of(int(p)) for p in pids]
         store = shard.store
         les = getattr(shard, "bucket_les", None)
         # on-demand paging: query reaches behind resident data -> merge cold

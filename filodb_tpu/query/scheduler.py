@@ -1,0 +1,122 @@
+"""Priority query scheduler — the QueryActor priority-mailbox equivalent.
+
+Reference: coordinator/.../QueryActor.scala:22-34 — a bounded priority mailbox
+where admin/status commands jump ahead of query work, and queries execute on a
+dedicated query scheduler so ingest threads are never blocked. Here: a fixed
+worker pool draining a priority heap (FIFO within a class), with a queue bound
+that sheds load as 503-style errors instead of queueing unboundedly.
+
+Priorities (lower runs first, matching the reference's mailbox ordering where
+ThrowException/status admin messages outrank LogicalPlan2Query):
+  ADMIN (0)    — status/health probes injected into the query lane
+  METADATA (1) — label values / series lookups (cheap, index-only)
+  QUERY (2)    — PromQL execution
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from enum import IntEnum
+
+from ..utils.metrics import registry
+
+
+class Priority(IntEnum):
+    ADMIN = 0
+    METADATA = 1
+    QUERY = 2
+
+
+class SchedulerBusy(RuntimeError):
+    """Raised when the bounded queue is full (maps to HTTP 503)."""
+
+
+class QueryScheduler:
+    """Bounded priority-queue worker pool for query execution."""
+
+    def __init__(self, num_threads: int = 4, max_queue: int = 64,
+                 timeout_s: float = 60.0, name: str = "query-sched"):
+        self.timeout_s = timeout_s
+        self._heap: list[tuple[int, int, Future, object]] = []
+        self._seq = itertools.count()      # FIFO tiebreak within a priority
+        self._cv = threading.Condition()
+        self._max_queue = max_queue
+        self._shutdown = False
+        self._queued = registry.gauge(f"{name}_queued")
+        self._active = registry.gauge(f"{name}_active")
+        self._rejected = registry.counter(f"{name}_rejected")
+        self._completed = registry.counter(f"{name}_completed")
+        self._n_active = 0
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"{name}-{i}", daemon=True)
+            for i in range(num_threads)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn, priority: Priority = Priority.QUERY) -> Future:
+        """Enqueue ``fn`` for execution; raises SchedulerBusy over the bound.
+
+        ADMIN work is never shed — the reference guarantees status probes get
+        through even when the query mailbox is saturated.
+        """
+        fut: Future = Future()
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("scheduler is shut down")
+            if priority != Priority.ADMIN and len(self._heap) >= self._max_queue:
+                self._rejected.increment()
+                raise SchedulerBusy(
+                    f"query queue full ({self._max_queue} waiting); retry later")
+            heapq.heappush(self._heap, (int(priority), next(self._seq), fut, fn))
+            self._queued.update(len(self._heap))
+            self._cv.notify()
+        return fut
+
+    def run(self, fn, priority: Priority = Priority.QUERY,
+            timeout_s: float | None = None):
+        """Submit and wait — the blocking path used by the HTTP handlers.
+        Times out with concurrent.futures.TimeoutError (mapped to HTTP 504);
+        the abandoned task still completes on its worker."""
+        return self.submit(fn, priority).result(
+            timeout=self.timeout_s if timeout_s is None else timeout_s)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown and not self._heap:
+                    return
+                _, _, fut, fn = heapq.heappop(self._heap)
+                self._queued.update(len(self._heap))
+                self._n_active += 1
+                self._active.update(self._n_active)
+            try:
+                if fut.set_running_or_notify_cancel():
+                    try:
+                        fut.set_result(fn())
+                    except BaseException as e:  # noqa: BLE001 — delivered to caller
+                        fut.set_exception(e)
+            finally:
+                with self._cv:
+                    self._n_active -= 1
+                    self._active.update(self._n_active)
+                self._completed.increment()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"queued": len(self._heap), "active": self._n_active,
+                    "rejected": self._rejected.value,
+                    "completed": self._completed.value}
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=5.0)

@@ -89,6 +89,7 @@ class FiloServer:
         self.manager.add_node(node_name)
         self.consumers: list[IngestionConsumer] = []
         self.http: FiloHttpServer | None = None
+        self.scheduler = None
         self.engines: dict[str, QueryEngine] = {}
         self.profiler = None
 
@@ -136,9 +137,15 @@ class FiloServer:
                     _b[shard].publish(container)
                 else:
                     self.memstore.ingest(_ds, shard, container)
+        from .query.scheduler import QueryScheduler
+        self.scheduler = QueryScheduler(
+            num_threads=cfg["query.num_threads"],
+            max_queue=cfg["query.queue_size"],
+            timeout_s=parse_duration_ms(cfg["query.timeout"]) / 1000.0)
         self.http = FiloHttpServer(self.engines, host=cfg["http.host"],
                                    port=cfg["http.port"], cluster=self.manager,
-                                   writers={dataset: writer}).start()
+                                   writers={dataset: writer},
+                                   scheduler=self.scheduler).start()
         if cfg.get("profiler.enabled"):
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(
@@ -155,6 +162,8 @@ class FiloServer:
             c.join(timeout=3)
         if self.http:
             self.http.stop()
+        if self.scheduler:
+            self.scheduler.shutdown()
         if self.profiler:
             self.profiler.stop()
 

@@ -176,8 +176,9 @@ def test_partkey_index_churn_bounded():
         for i in range(50):
             idx.add_part_key(i, {"pod": f"pod-{i}", "app": "web"}, start_time=cycle)
         idx.remove_part_keys(np.arange(50, dtype=np.int32))
-    # value pool holds each distinct string once despite 20 churn cycles
-    assert len(idx._val_pool[idx._name_id["pod"]]) == 50
+    # value pool stays bounded by live-ish cardinality despite 20 churn cycles
+    # (vid reuse between compactions; compaction drops unreferenced values)
+    assert len(idx._val_pool[idx._name_id["pod"]]) <= 50
     # arena stays bounded (compaction): within 2x of a single generation
     idx2 = PartKeyIndex()
     for i in range(50):
@@ -189,3 +190,24 @@ def test_partkey_index_churn_bounded():
     got = idx.part_ids_from_filters([F.Equals("pod", "pod-7")], 0, 10**15)
     np.testing.assert_array_equal(got, [7])
     assert idx.labels_of(7) == {"pod": "pod-7", "app": "web"}
+
+
+def test_partkey_index_unique_value_churn_pools_bounded():
+    """Unique-value churn (new pod name per deploy) must not leak pool strings:
+    compaction drops values with no live postings."""
+    idx = PartKeyIndex()
+    for cycle in range(30):
+        for i in range(20):
+            idx.add_part_key(i, {"pod": f"pod-{cycle}-{i}", "app": "web"}, 0)
+        idx.remove_part_keys(np.arange(20, dtype=np.int32))
+    # one last live generation
+    for i in range(20):
+        idx.add_part_key(i, {"pod": f"pod-final-{i}", "app": "web"}, 0)
+    idx.maybe_compact_arena(min_dead_ratio=0.0)
+    pod_pool = idx._val_pool[idx._name_id["pod"]]
+    assert len(pod_pool) == 20, f"pool leaked: {len(pod_pool)} entries"
+    # vids renumbered consistently: lookups and labels still correct
+    got = idx.part_ids_from_filters([F.Equals("pod", "pod-final-3")], 0, 10**15)
+    np.testing.assert_array_equal(got, [3])
+    assert idx.labels_of(3) == {"pod": "pod-final-3", "app": "web"}
+    assert idx.label_values("pod", top_k=3)

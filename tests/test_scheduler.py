@@ -1,0 +1,131 @@
+"""Priority query scheduler tests (ref analog: QueryActor priority-mailbox
+behavior — admin commands outrank queries; bounded mailbox sheds load)."""
+
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.query.scheduler import Priority, QueryScheduler, SchedulerBusy
+
+
+def test_priority_ordering_admin_first():
+    """With one busy worker, an ADMIN task submitted after many QUERY tasks
+    must still run before them."""
+    sched = QueryScheduler(num_threads=1, max_queue=32)
+    order = []
+    release = threading.Event()
+    try:
+        # occupy the single worker so later submissions queue up
+        blocker = sched.submit(lambda: release.wait(5))
+        time.sleep(0.05)
+        futs = [sched.submit(lambda i=i: order.append(("q", i))) for i in range(4)]
+        admin = sched.submit(lambda: order.append(("admin",)), Priority.ADMIN)
+        meta = sched.submit(lambda: order.append(("meta",)), Priority.METADATA)
+        release.set()
+        for f in [blocker, admin, meta, *futs]:
+            f.result(timeout=5)
+        assert order[0] == ("admin",)
+        assert order[1] == ("meta",)
+        assert [o for o in order[2:]] == [("q", i) for i in range(4)]
+    finally:
+        sched.shutdown()
+
+
+def test_bounded_queue_sheds_queries_not_admin():
+    sched = QueryScheduler(num_threads=1, max_queue=2)
+    release = threading.Event()
+    try:
+        blocker = sched.submit(lambda: release.wait(5))
+        time.sleep(0.05)
+        sched.submit(lambda: None)
+        sched.submit(lambda: None)
+        with pytest.raises(SchedulerBusy):
+            sched.submit(lambda: None)
+        # ADMIN is never shed even when the queue is full
+        admin = sched.submit(lambda: "ok", Priority.ADMIN)
+        release.set()
+        assert admin.result(timeout=5) == "ok"
+        assert blocker.result(timeout=5) in (True, False)
+        assert sched.stats()["rejected"] == 1
+    finally:
+        sched.shutdown()
+
+
+def test_exceptions_propagate_to_caller():
+    sched = QueryScheduler(num_threads=2)
+    try:
+        with pytest.raises(ZeroDivisionError):
+            sched.run(lambda: 1 // 0, timeout_s=5)
+        assert sched.run(lambda: 42, timeout_s=5) == 42
+    finally:
+        sched.shutdown()
+
+
+def test_http_busy_returns_503():
+    """End-to-end: a saturated scheduler surfaces as HTTP 503, and health/
+    cluster-status endpoints (not scheduled) still answer."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.http.api import FiloHttpServer
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    ms.setup("ds", "gauge", 0, StoreConfig(max_series_per_shard=8,
+                                           samples_per_series=32,
+                                           flush_batch_size=10**9))
+    sched = QueryScheduler(num_threads=1, max_queue=1)
+    srv = FiloHttpServer({"ds": QueryEngine(ms, "ds")}, port=0, scheduler=sched)
+    srv.start()
+    try:
+        release = threading.Event()
+        sched.submit(lambda: release.wait(10))     # occupy the worker
+        time.sleep(0.05)
+        sched.submit(lambda: None)                 # fill the queue
+        base = f"http://127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/promql/ds/api/v1/query?query=up&time=0", timeout=5)
+        assert ei.value.code == 503
+        body = json.load(ei.value)
+        assert body["errorType"] == "unavailable"
+        health = json.load(urllib.request.urlopen(f"{base}/__health", timeout=5))
+        assert health["status"] == "healthy"
+        release.set()
+    finally:
+        srv.stop()
+        sched.shutdown()
+
+
+def test_slow_query_returns_504():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.http.api import FiloHttpServer
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    ms.setup("ds", "gauge", 0, StoreConfig(max_series_per_shard=8,
+                                           samples_per_series=32,
+                                           flush_batch_size=10**9))
+    sched = QueryScheduler(num_threads=1, max_queue=4, timeout_s=0.2)
+    srv = FiloHttpServer({"ds": QueryEngine(ms, "ds")}, port=0, scheduler=sched)
+    srv.start()
+    try:
+        release = threading.Event()
+        sched.submit(lambda: release.wait(10))     # make queries wait > timeout
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/promql/ds/api/v1/query?query=up&time=0",
+                timeout=5)
+        assert ei.value.code == 504
+        assert json.load(ei.value)["errorType"] == "timeout"
+        release.set()
+    finally:
+        srv.stop()
+        sched.shutdown()
