@@ -51,6 +51,8 @@ DEFAULTS: dict[str, Any] = {
     "http": {"host": "127.0.0.1", "port": 8080},
     "data_dir": None,            # enables the durable FileColumnStore when set
     "bus_dir": None,             # enables FileBus ingestion when set
+    "bus_addr": None,            # "host:port" of a BrokerServer (overrides bus_dir):
+                                 # shard N consumes broker partition N
     "profiler": {"enabled": False, "interval": "100ms"},
     "tracing": {"log_spans": False},
 }

@@ -1,0 +1,247 @@
+"""TCP log broker — the Kafka-broker-equivalent data plane.
+
+Reference: kafka/src/main/scala/filodb/kafka/KafkaIngestionStream.scala (one
+shard == one partition; consumers seek to the checkpointed offset and replay)
+and PartitionStrategy (shard -> partition routing). The reference outsources
+the broker to Kafka; here the broker itself is part of the framework: a
+threaded TCP server fronting one durable append-only log per partition (the
+same offset-addressed frame format as FileBus, so logs are interchangeable
+between in-process and brokered deployments).
+
+Wire protocol (all little-endian, one request/response per round trip):
+
+  request  = op:u8  partition:u32  offset:u64  payload_len:u32  payload
+  response = status:u8  offset:u64  payload_len:u32  payload
+
+  ops: PUBLISH (payload=container bytes; the offset field carries a random
+                nonzero publish id — the broker remembers recent ids per
+                partition and returns the original offset on a retry instead
+                of appending a duplicate; returns assigned offset)
+       FETCH   (offset=from_offset; payload_len field carries max_frames;
+                returns concatenated [offset:u64 len:u32 bytes] entries)
+       END     (returns the partition's end offset)
+
+`BrokerBus` is a drop-in for FileBus (publish/consume/end_offset), so the
+standalone server's IngestionConsumer works unchanged against a remote broker.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from typing import Iterator
+
+from ..core.record import RecordContainer
+from .bus import FileBus
+
+_REQ = struct.Struct("<B I Q I")
+_RESP = struct.Struct("<B Q I")
+_ENTRY = struct.Struct("<Q I")
+
+OP_PUBLISH, OP_FETCH, OP_END = 1, 2, 3
+ST_OK, ST_ERR = 0, 1
+
+_MAX_PAYLOAD = 64 << 20     # refuse absurd frames instead of OOMing
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+class BrokerServer:
+    """Hosts partitions 0..num_partitions-1, each a durable FileBus log."""
+
+    def __init__(self, data_dir: str, num_partitions: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        os.makedirs(data_dir, exist_ok=True)
+        self._parts = [FileBus(os.path.join(data_dir, f"partition{p}.log"))
+                       for p in range(num_partitions)]
+        # publish idempotence: recent publish-id -> offset per partition, so a
+        # client retry after a lost response doesn't append a duplicate frame
+        self._recent_ids: list[dict[int, int]] = [{} for _ in range(num_partitions)]
+        self._publish_locks = [threading.Lock() for _ in range(num_partitions)]
+        # live client connections, so stop() actually severs them (handler
+        # threads would otherwise keep serving a "stopped" broker)
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+                try:
+                    while True:
+                        hdr = _recv_exact(self.request, _REQ.size)
+                        op, part, offset, plen = _REQ.unpack(hdr)
+                        if plen > _MAX_PAYLOAD:
+                            raise ValueError(f"frame too large: {plen}")
+                        payload = _recv_exact(self.request, plen) \
+                            if op == OP_PUBLISH and plen else b""
+                        self.request.sendall(outer._serve(op, part, offset,
+                                                          plen, payload))
+                except (ConnectionError, OSError):
+                    pass    # client went away or the broker is stopping
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    def _serve(self, op: int, part: int, offset: int, plen: int,
+               payload: bytes) -> bytes:
+        try:
+            if not 0 <= part < len(self._parts):
+                raise ValueError(f"no partition {part}")
+            bus = self._parts[part]
+            if op == OP_PUBLISH:
+                pub_id = offset                 # request offset field = publish id
+                with self._publish_locks[part]:
+                    recent = self._recent_ids[part]
+                    if pub_id and pub_id in recent:
+                        return _RESP.pack(ST_OK, recent[pub_id], 0)
+                    off = bus.publish_bytes(payload)
+                    if pub_id:
+                        recent[pub_id] = off
+                        if len(recent) > 4096:  # bounded window of retry-able ids
+                            for k in list(recent)[:2048]:
+                                del recent[k]
+                return _RESP.pack(ST_OK, off, 0)
+            if op == OP_FETCH:
+                max_frames = plen or 1024
+                out = bytearray()
+                n = 0
+                for off, frame in bus.frames_from(offset):
+                    out += _ENTRY.pack(off, len(frame))
+                    out += frame
+                    n += 1
+                    if n >= max_frames:
+                        break
+                return _RESP.pack(ST_OK, bus.end_offset, len(out)) + bytes(out)
+            if op == OP_END:
+                return _RESP.pack(ST_OK, bus.end_offset, 0)
+            raise ValueError(f"unknown op {op}")
+        except Exception as e:  # noqa: BLE001 — delivered to the client
+            msg = str(e).encode()[:1024]
+            return _RESP.pack(ST_ERR, 0, len(msg)) + msg
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def start(self) -> "BrokerServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="filo-broker")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+
+
+class BrokerBus:
+    """Client for one broker partition; drop-in for FileBus."""
+
+    def __init__(self, addr: str, partition: int):
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.partition = partition
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()   # one in-flight request per client
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr, timeout=30)
+        return self._sock
+
+    def _request(self, op: int, offset: int = 0, plen: int = 0,
+                 payload: bytes = b"") -> tuple[int, bytes]:
+        with self._lock:
+            for attempt in (0, 1):      # one reconnect on a stale connection
+                try:
+                    s = self._conn()
+                    s.sendall(_REQ.pack(op, self.partition, offset, plen) + payload)
+                    st, off, rlen = _RESP.unpack(_recv_exact(s, _RESP.size))
+                    body = _recv_exact(s, rlen) if rlen else b""
+                    break
+                except (ConnectionError, OSError):
+                    self.close()
+                    if attempt:
+                        raise
+        if st == ST_ERR:
+            raise RuntimeError(f"broker error: {body.decode(errors='replace')}")
+        return off, body
+
+    def publish(self, container: RecordContainer) -> int:
+        payload = container.to_bytes()
+        # stable random id across the internal reconnect retry: if the broker
+        # committed the append but the response was lost, the retry is a no-op
+        pub_id = int.from_bytes(os.urandom(8), "little") | 1
+        off, _ = self._request(OP_PUBLISH, offset=pub_id,
+                               plen=len(payload), payload=payload)
+        return off
+
+    def consume(self, schemas, from_offset: int = 0) -> Iterator[tuple[int, RecordContainer]]:
+        """Replay containers from ``from_offset`` up to the end offset observed
+        on the FIRST fetch (ref: Kafka seek + poll). The snapshot matters: a
+        poll-loop consumer must regain control between polls to flush/
+        checkpoint/purge, so under sustained publish load this terminates
+        instead of chasing the moving end forever (FileBus.consume contract)."""
+        next_off = from_offset
+        end: int | None = None
+        while True:
+            resp_end, body = self._request(OP_FETCH, offset=next_off)
+            if end is None:
+                end = resp_end
+            pos = 0
+            got = 0
+            while pos < len(body):
+                off, ln = _ENTRY.unpack_from(body, pos)
+                pos += _ENTRY.size
+                if off >= end:
+                    return
+                yield off, RecordContainer.from_bytes(body[pos:pos + ln], schemas)
+                pos += ln
+                next_off = off + 1
+                got += 1
+            if not got or next_off >= end:
+                return
+
+    @property
+    def end_offset(self) -> int:
+        off, _ = self._request(OP_END)
+        return off
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

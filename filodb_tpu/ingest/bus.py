@@ -3,7 +3,10 @@
 Reference: kafka/src/main/scala/filodb/kafka/KafkaIngestionStream.scala
 (1 shard == 1 partition, seek to checkpointed offset, replay). Here: one
 append-only log file per (dataset, shard) of length-prefixed RecordContainer
-frames; offsets are frame ordinals. The same interface can front a real broker.
+frames; offsets are frame ordinals. A byte-position index (built on open,
+maintained on append) makes seek-to-offset O(1), like a Kafka segment index.
+The same interface can front a real broker — see ingest/broker.py for the
+framework's own TCP broker speaking this log format.
 """
 
 from __future__ import annotations
@@ -24,43 +27,77 @@ class FileBus:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._next_offset = 0
         self._publish_lock = threading.Lock()   # concurrent producers in-process
-        if os.path.exists(path):
-            for off, _ in self._frames():
-                self._next_offset = off + 1
+        # offset -> byte position of its frame header (the seek index)
+        self._positions: list[int] = []
+        self.resync()
 
     def publish(self, container: RecordContainer) -> int:
         """Append a container; returns its offset."""
-        payload = container.to_bytes()
+        return self.publish_bytes(container.to_bytes())
+
+    def publish_bytes(self, payload: bytes) -> int:
         with self._publish_lock:
-            off = self._next_offset
+            off = len(self._positions)
             with open(self.path, "ab") as f:
-                f.write(_FRAME.pack(off, len(payload)))
-                f.write(payload)
-            self._next_offset = off + 1
+                pos = f.tell()
+                # one write call: keeps the frame contiguous even if another
+                # appender (against the single-writer contract) interleaves
+                f.write(_FRAME.pack(off, len(payload)) + payload)
+            self._positions.append(pos)
         return off
 
-    def _frames(self) -> Iterator[tuple[int, bytes]]:
-        if not os.path.exists(self.path):
-            return  # nothing published yet (another process may own the first write)
+    def frames_from(self, from_offset: int = 0) -> Iterator[tuple[int, bytes]]:
+        """Raw frames from ``from_offset``, seeking straight to its position."""
+        end = len(self._positions)               # snapshot: stable under appends
+        if from_offset >= end:
+            return
         with open(self.path, "rb") as f:
-            while True:
+            f.seek(self._positions[from_offset])
+            for off in range(from_offset, end):
                 hdr = f.read(_FRAME.size)
                 if len(hdr) < _FRAME.size:
                     return
-                off, ln = _FRAME.unpack(hdr)
+                stored_off, ln = _FRAME.unpack(hdr)
                 payload = f.read(ln)
                 if len(payload) < ln:
-                    return  # truncated tail (torn write) — stop cleanly
-                yield off, payload
+                    return                       # torn tail — stop cleanly
+                yield stored_off, payload
 
     def consume(self, schemas, from_offset: int = 0) -> Iterator[tuple[int, RecordContainer]]:
-        """Replay containers from ``from_offset`` (ref: Kafka seek-to-checkpoint)."""
-        for off, payload in self._frames():
-            if off >= from_offset:
-                yield off, RecordContainer.from_bytes(payload, schemas)
+        """Replay containers from ``from_offset`` (ref: Kafka seek-to-checkpoint).
+
+        Picks up frames appended by *other processes* too: the index is
+        re-synced from the file when the caller asks past our known end.
+        """
+        if from_offset >= len(self._positions):
+            self.resync()
+        for off, payload in self.frames_from(from_offset):
+            yield off, RecordContainer.from_bytes(payload, schemas)
+
+    def resync(self) -> None:
+        """Re-scan the log tail for frames appended by another process."""
+        with self._publish_lock:
+            if not os.path.exists(self.path):
+                return
+            size = os.path.getsize(self.path)
+            pos = 0
+            if self._positions:
+                # start from the last known frame to find its end
+                last = self._positions[-1]
+                with open(self.path, "rb") as f:
+                    f.seek(last)
+                    _, ln = _FRAME.unpack(f.read(_FRAME.size))
+                pos = last + _FRAME.size + ln
+            with open(self.path, "rb") as f:
+                while pos + _FRAME.size <= size:
+                    f.seek(pos)
+                    _, ln = _FRAME.unpack(f.read(_FRAME.size))
+                    if pos + _FRAME.size + ln > size:
+                        break
+                    self._positions.append(pos)
+                    pos += _FRAME.size + ln
 
     @property
     def end_offset(self) -> int:
-        return self._next_offset
+        return len(self._positions)

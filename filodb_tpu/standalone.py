@@ -56,11 +56,27 @@ class IngestionConsumer(threading.Thread):
             rows = registry.counter("filodb_ingested_rows",
                                     {"dataset": self.dataset, "shard": str(sh.shard_num)})
             last_purge = time.monotonic()
-            while not self._stop_ev.wait(self.poll_s):
-                for off, container in self.bus.consume(self.schemas, self._offset):
-                    sh.ingest(container, off)
-                    rows.increment(len(container))
-                    self._offset = off + 1
+            backoff = 0.0
+            while not self._stop_ev.wait(backoff or self.poll_s):
+                # transient bus outages (e.g. a broker restart) must not kill
+                # the shard: back off and retry, ERROR only while disconnected
+                # (ref: IngestionError events -> resync, not actor death)
+                try:
+                    for off, container in self.bus.consume(self.schemas, self._offset):
+                        sh.ingest(container, off)
+                        rows.increment(len(container))
+                        self._offset = off + 1
+                except (ConnectionError, OSError, RuntimeError):
+                    backoff = min(max(1.0, backoff * 2), 30.0)
+                    log.warning("bus unavailable for shard %s; retrying in %.0fs",
+                                sh.shard_num, backoff)
+                    self.manager.set_status(self.dataset, sh.shard_num,
+                                            ShardStatus.ERROR)
+                    continue
+                if backoff:
+                    backoff = 0.0
+                    self.manager.set_status(self.dataset, sh.shard_num,
+                                            ShardStatus.ACTIVE)
                 sh.flush()
                 if sh.sink is not None:
                     sh.flush_all_groups()
@@ -108,8 +124,15 @@ class FiloServer:
         for shard_num in self.manager.shards_of_node(dataset, self.node):
             shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
                                         store_cfg, sink=sink)
-            if cfg.get("bus_dir"):
-                bus = buses[shard_num] = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
+            if cfg.get("bus_addr") or cfg.get("bus_dir"):
+                if cfg.get("bus_addr"):
+                    # remote broker: shard N == broker partition N (ref: Kafka
+                    # PartitionStrategy, 1 shard == 1 partition)
+                    from .ingest.broker import BrokerBus
+                    bus = BrokerBus(cfg["bus_addr"], shard_num)
+                else:
+                    bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
+                buses[shard_num] = bus
                 c = IngestionConsumer(shard, bus, self.memstore.schemas,
                                       self.manager, dataset,
                                       purge_interval_s=parse_duration_ms(
