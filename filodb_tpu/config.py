@@ -55,6 +55,14 @@ DEFAULTS: dict[str, Any] = {
                                  # shard N consumes broker partition N
     "profiler": {"enabled": False, "interval": "100ms"},
     "tracing": {"log_spans": False},
+    # multi-host membership (ref: akka-bootstrapper + Akka gossip deathwatch):
+    # registrar = shared member file; self_addr defaults to the HTTP address
+    "cluster": {"registrar": None, "self_addr": None,
+                "heartbeat_interval": "5s", "stale_after": "30s",
+                # wait for this many members before assigning shards, so every
+                # node computes the same assignment (akka-bootstrapper
+                # expected-contact-points analog)
+                "min_members": 1, "join_timeout": "30s"},
 }
 
 _DUR = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}

@@ -1,0 +1,226 @@
+"""Multi-host cluster bootstrap: seed discovery + JAX distributed init +
+membership with heartbeat failure detection.
+
+Reference: akka-bootstrapper/.../AkkaBootstrapper.scala:31 (strategy-driven
+seed discovery, then join-or-become-seed), WhitelistClusterSeedDiscovery.scala:18
+(static seed list), DnsSrvClusterSeedDiscovery.scala / ConsulClient.scala
+(registration-based discovery — nodes register themselves and discover peers
+from the registrar), plus Akka Cluster gossip deathwatch feeding
+ShardManager.remove_node (coordinator/.../NodeClusterActor.scala:187).
+
+TPU-native translation: the cluster's data plane is JAX collectives over
+ICI/DCN, so "joining the cluster" means agreeing on the jax.distributed
+world — a coordinator address, a process count, and a stable process id per
+host. Seed discovery produces exactly that tuple: the lexicographically first
+member is the coordinator (deterministic without an election, the analog of
+akka-bootstrapper's "lowest address becomes seed"), and each member's rank is
+its index in the sorted member list. Membership liveness is heartbeat-based
+(registrar timestamps), feeding ShardManager reassignment on failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+
+# --------------------------------------------------------------------------
+# Seed discovery strategies (ref: akka-bootstrapper discovery hierarchy)
+# --------------------------------------------------------------------------
+
+class SeedDiscovery:
+    """Strategy interface: produce the member list this node should join."""
+
+    def discover(self) -> list[str]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def register(self, addr: str) -> None:
+        """Registration-based strategies record this node; static ones no-op."""
+
+
+class WhitelistSeedDiscovery(SeedDiscovery):
+    """Static seed list (ref: WhitelistClusterSeedDiscovery.scala:18)."""
+
+    def __init__(self, seeds: list[str]):
+        self.seeds = [s.strip() for s in seeds if s.strip()]
+
+    def discover(self) -> list[str]:
+        return list(self.seeds)
+
+
+class EnvSeedDiscovery(WhitelistSeedDiscovery):
+    """Seeds from an environment variable (comma-separated host:port)."""
+
+    def __init__(self, var: str = "FILODB_SEEDS"):
+        super().__init__(os.environ.get(var, "").split(","))
+
+
+class FileRegistrarDiscovery(SeedDiscovery):
+    """Shared-directory registrar: each node owns one member file it rewrites
+    atomically on heartbeat; discovery reads all member files (the Consul/
+    DNS-SRV analog for environments without either — ref: ConsulClient.scala
+    registration + query). Per-node files mean no cross-process write races
+    and no unbounded growth; members silent past ``stale_s`` are gone."""
+
+    def __init__(self, path: str, stale_s: float = 30.0):
+        self.path = path
+        self.stale_s = stale_s
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _member_file(self, addr: str) -> str:
+        safe = addr.replace(":", "_").replace("/", "_")
+        return os.path.join(self.path, f"{safe}.member")
+
+    def register(self, addr: str) -> None:
+        tmp = self._member_file(addr) + ".tmp"
+        with self._lock:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"addr": addr, "ts": time.time()}))
+            os.replace(tmp, self._member_file(addr))
+
+    heartbeat = register     # a re-registration refreshes the timestamp
+
+    def discover(self) -> list[str]:
+        now = time.time()
+        out = []
+        for name in os.listdir(self.path):
+            if not name.endswith(".member"):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    m = json.loads(f.read())
+                if now - m["ts"] <= self.stale_s:
+                    out.append(m["addr"])
+            except (OSError, ValueError, KeyError):
+                continue     # torn read of a concurrent rewrite — skip
+        return sorted(out)
+
+
+# --------------------------------------------------------------------------
+# Bootstrap: discovery -> jax.distributed world
+# --------------------------------------------------------------------------
+
+@dataclass
+class ClusterWorld:
+    """The agreed jax.distributed topology."""
+    coordinator: str          # host:port of process 0
+    num_processes: int
+    process_id: int
+    members: list[str]        # sorted member addresses
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+class ClusterBootstrap:
+    """Join-or-become-seed (ref: AkkaBootstrapper.bootstrap): discover peers,
+    derive a deterministic world, and (optionally) bring up jax.distributed."""
+
+    def __init__(self, discovery: SeedDiscovery, self_addr: str,
+                 settle_s: float = 0.0):
+        self.discovery = discovery
+        self.self_addr = self_addr
+        self.settle_s = settle_s
+
+    def resolve_world(self, min_members: int = 1,
+                      timeout_s: float = 30.0) -> ClusterWorld:
+        """Register, wait for at least ``min_members`` peers to appear (the
+        akka-bootstrapper expected-contact-points analog), and compute the
+        world. Deterministic across members: everyone sorts the same member
+        list, so everyone agrees on coordinator and ranks without an election."""
+        self.discovery.register(self.self_addr)
+        if self.settle_s:
+            time.sleep(self.settle_s)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            members = self.discovery.discover()
+            if self.self_addr not in members:
+                members = sorted(members + [self.self_addr])
+            if len(members) >= min_members or time.monotonic() >= deadline:
+                break
+            time.sleep(0.2)
+        if len(members) < min_members:
+            raise TimeoutError(
+                f"only {len(members)}/{min_members} members after {timeout_s}s")
+        return ClusterWorld(coordinator=members[0], num_processes=len(members),
+                            process_id=members.index(self.self_addr),
+                            members=members)
+
+    def initialize_jax(self, world: ClusterWorld | None = None) -> ClusterWorld:
+        """Bring up the JAX distributed runtime for a >1-process world
+        (single-process worlds skip it — local jax.devices() is the mesh)."""
+        import jax
+        world = world or self.resolve_world()
+        if world.num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=world.coordinator,
+                num_processes=world.num_processes,
+                process_id=world.process_id)
+        return world
+
+
+# --------------------------------------------------------------------------
+# Membership + heartbeat failure detection -> ShardManager reassignment
+# --------------------------------------------------------------------------
+
+class MembershipMonitor(threading.Thread):
+    """Heartbeats this node into the registrar and watches peers' timestamps;
+    a silent peer is reported down (ref: Akka gossip deathwatch ->
+    ShardManager.remove_node auto-reassignment, doc/sharding.md
+    'Automatic Reassignment')."""
+
+    def __init__(self, registrar: FileRegistrarDiscovery, self_addr: str,
+                 on_down, on_up=None, on_self_stale=None, interval_s: float = 5.0):
+        super().__init__(daemon=True, name="membership-monitor")
+        self.registrar = registrar
+        self.self_addr = self_addr
+        self.on_down = on_down
+        self.on_up = on_up
+        # fired when OUR OWN heartbeat gap exceeded stale_s — peers have
+        # declared us dead and reassigned our shards, so we must fail-stop
+        # (the Akka quarantine analog: a removed-but-alive node restarts)
+        self.on_self_stale = on_self_stale
+        self.interval_s = interval_s
+        self._stop_ev = threading.Event()
+        self._known: set[str] = set()
+        self._last_beat: float | None = None
+
+    def poll_once(self) -> None:
+        now = time.monotonic()
+        if (self._last_beat is not None
+                and now - self._last_beat > self.registrar.stale_s
+                and self.on_self_stale is not None):
+            # do NOT heartbeat: peers already consider us dead — re-announcing
+            # while still holding shards would create double ownership
+            self._stop_ev.set()
+            self.on_self_stale()
+            return
+        self.registrar.heartbeat(self.self_addr)
+        self._last_beat = now
+        live = set(self.registrar.discover())
+        for gone in sorted(self._known - live - {self.self_addr}):
+            self.on_down(gone)
+        if self.on_up is not None:
+            for fresh in sorted(live - self._known):
+                self.on_up(fresh)
+        self._known = live
+
+    def run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A free TCP port for the jax.distributed coordinator service."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -47,11 +47,25 @@ class IngestionConsumer(threading.Thread):
     def run(self):
         sh = self.shard
         try:
-            if sh.sink is not None:
-                self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.RECOVERY)
-                sh.recover(self.bus, self.schemas)
-                wm = sh.group_watermarks
-                self._offset = int(self.bus.end_offset)
+            # recovery prelude retries transient bus outages too — a broker
+            # restarting while we start must not permanently kill the shard
+            backoff = 0.0
+            while True:
+                try:
+                    if sh.sink is not None:
+                        self.manager.set_status(self.dataset, sh.shard_num,
+                                                ShardStatus.RECOVERY)
+                        sh.recover(self.bus, self.schemas)
+                        self._offset = int(self.bus.end_offset)
+                    break
+                except (ConnectionError, OSError):
+                    backoff = min(max(1.0, backoff * 2), 30.0)
+                    log.warning("bus unavailable for shard %s recovery; "
+                                "retrying in %.0fs", sh.shard_num, backoff)
+                    self.manager.set_status(self.dataset, sh.shard_num,
+                                            ShardStatus.ERROR)
+                    if self._stop_ev.wait(backoff):
+                        return
             self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ACTIVE)
             rows = registry.counter("filodb_ingested_rows",
                                     {"dataset": self.dataset, "shard": str(sh.shard_num)})
@@ -60,13 +74,16 @@ class IngestionConsumer(threading.Thread):
             while not self._stop_ev.wait(backoff or self.poll_s):
                 # transient bus outages (e.g. a broker restart) must not kill
                 # the shard: back off and retry, ERROR only while disconnected
-                # (ref: IngestionError events -> resync, not actor death)
+                # (ref: IngestionError events -> resync, not actor death).
+                # Only network faults count as transient — a broker-reported
+                # error (RuntimeError, e.g. bad partition) or an ingest fault
+                # is permanent and fails the shard loudly via the outer handler
                 try:
                     for off, container in self.bus.consume(self.schemas, self._offset):
                         sh.ingest(container, off)
                         rows.increment(len(container))
                         self._offset = off + 1
-                except (ConnectionError, OSError, RuntimeError):
+                except (ConnectionError, OSError):
                     backoff = min(max(1.0, backoff * 2), 30.0)
                     log.warning("bus unavailable for shard %s; retrying in %.0fs",
                                 sh.shard_num, backoff)
@@ -108,6 +125,69 @@ class FiloServer:
         self.scheduler = None
         self.engines: dict[str, QueryEngine] = {}
         self.profiler = None
+        self.membership = None
+        self._registrar = None
+        self._running: set[int] = set()
+        self._buses: dict[int, object] = {}
+        # guards _running/_buses: mutated by the membership-monitor thread
+        # (resync/quarantine) while HTTP writers snapshot them
+        self._shards_lock = threading.Lock()
+        self._sink = None
+        self._store_cfg = None
+
+    def _start_shard(self, dataset: str, shard_num: int) -> None:
+        """Bring up one owned shard: store + (optionally) its bus consumer
+        (ref: IngestionActor.startIngestion per assigned shard)."""
+        cfg = self.config
+        shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
+                                    self._store_cfg, sink=self._sink)
+        if cfg.get("bus_addr") or cfg.get("bus_dir"):
+            if cfg.get("bus_addr"):
+                # remote broker: shard N == broker partition N (ref: Kafka
+                # PartitionStrategy, 1 shard == 1 partition)
+                from .ingest.broker import BrokerBus
+                bus = BrokerBus(cfg["bus_addr"], shard_num)
+            else:
+                bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
+            c = IngestionConsumer(shard, bus, self.memstore.schemas,
+                                  self.manager, dataset,
+                                  purge_interval_s=parse_duration_ms(
+                                      cfg.get("store.purge_interval", "10m")) / 1000.0)
+            with self._shards_lock:
+                self._buses[shard_num] = bus
+                self._running.add(shard_num)
+            self.consumers.append(c)
+            c.start()
+        else:
+            with self._shards_lock:
+                self._running.add(shard_num)
+            self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
+
+    def _quarantine(self) -> None:
+        """Our heartbeat lapsed past stale_after: peers have declared us dead
+        and reassigned our shards, so continuing to consume would double-own
+        them. Fail-stop ingestion; an operator restart rejoins cleanly
+        (ref: Akka quarantine — a removed-but-alive node must restart)."""
+        log.error("node %s quarantined (heartbeat lapsed); stopping ingestion — "
+                  "restart to rejoin", self.node)
+        for c in self.consumers:
+            c.stop()
+        with self._shards_lock:
+            stopped = sorted(self._running)
+            self._running.clear()
+            self._buses.clear()
+        for ds in list(self.engines):
+            for s in stopped:
+                if self.manager.node_of(ds, s) == self.node:
+                    self.manager.set_status(ds, s, ShardStatus.STOPPED)
+
+    def _on_shard_event(self, ev) -> None:
+        """Resync (ref: IngestionActor.resync on shard snapshots): an
+        assignment targeting this node starts the shard's consumer."""
+        if ev.kind == "AssignmentStarted" and ev.node == self.node \
+                and ev.shard not in self._running:
+            log.info("resync: starting reassigned shard %s", ev.shard)
+            self._start_shard(ev.dataset, ev.shard)
 
     def start(self) -> "FiloServer":
         cfg = self.config
@@ -115,32 +195,34 @@ class FiloServer:
         # shard ids live in a power-of-two space (hash routing, spread); a
         # non-pow2 count would leave routable ids with no owning shard
         num_shards = _pow2(cfg["num_shards"])
+        if cfg.get("cluster.registrar"):
+            # multi-host join BEFORE shard assignment: wait for min_members in
+            # the registrar and seed the manager with the *sorted* member list,
+            # so every node computes the identical assignment (the reference
+            # avoids this by putting the one ShardManager in a cluster
+            # singleton; here determinism replaces the singleton)
+            from .parallel.bootstrap import (ClusterBootstrap,
+                                             FileRegistrarDiscovery)
+            self_addr = cfg.get("cluster.self_addr") or \
+                f"{cfg['http.host']}:{cfg['http.port']}"
+            self._registrar = FileRegistrarDiscovery(
+                cfg["cluster.registrar"],
+                stale_s=parse_duration_ms(cfg["cluster.stale_after"]) / 1000.0)
+            world = ClusterBootstrap(self._registrar, self_addr).resolve_world(
+                min_members=cfg["cluster.min_members"],
+                timeout_s=parse_duration_ms(cfg["cluster.join_timeout"]) / 1000.0)
+            self.manager.nodes.remove(self.node)
+            self.node = self_addr
+            for m in world.members:
+                self.manager.add_node(m)
         self.manager.add_dataset(dataset, num_shards)
-        sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
-        store_cfg = cfg.store_config()
+        self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
+        self._store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
-        buses: dict[int, FileBus] = {}
         for shard_num in self.manager.shards_of_node(dataset, self.node):
-            shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
-                                        store_cfg, sink=sink)
-            if cfg.get("bus_addr") or cfg.get("bus_dir"):
-                if cfg.get("bus_addr"):
-                    # remote broker: shard N == broker partition N (ref: Kafka
-                    # PartitionStrategy, 1 shard == 1 partition)
-                    from .ingest.broker import BrokerBus
-                    bus = BrokerBus(cfg["bus_addr"], shard_num)
-                else:
-                    bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
-                buses[shard_num] = bus
-                c = IngestionConsumer(shard, bus, self.memstore.schemas,
-                                      self.manager, dataset,
-                                      purge_interval_s=parse_duration_ms(
-                                          cfg.get("store.purge_interval", "10m")) / 1000.0)
-                self.consumers.append(c)
-                c.start()
-            else:
-                self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
+            self._start_shard(dataset, shard_num)
+        self.manager.subscribe(self._on_shard_event)
         mapper = ShardMapper(num_shards, spread=cfg["spread"])
         self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
                                             cfg.query_config())
@@ -148,16 +230,16 @@ class FiloServer:
         # remote-write sink: durable bus publish when configured, else direct
         # ingest. The whole batch is validated against owned shards BEFORE
         # anything publishes, so a rejected batch is all-or-nothing.
-        owned = set(buses) if buses else \
-            {s.shard_num for s in self.memstore.shards_of(dataset)}
-
-        def writer(per_shard: dict, _b=buses, _ds=dataset):
+        def writer(per_shard: dict, _ds=dataset):
+            with self._shards_lock:
+                buses = dict(self._buses)
+                owned = set(buses) if buses else set(self._running)
             unowned = sorted(set(per_shard) - owned)
             if unowned:
                 raise QueryError(f"shards {unowned} are not owned by this node")
             for shard, container in per_shard.items():
-                if _b:
-                    _b[shard].publish(container)
+                if buses:
+                    buses[shard].publish(container)
                 else:
                     self.memstore.ingest(_ds, shard, container)
         from .query.scheduler import QueryScheduler
@@ -169,6 +251,17 @@ class FiloServer:
                                    port=cfg["http.port"], cluster=self.manager,
                                    writers={dataset: writer},
                                    scheduler=self.scheduler).start()
+        if cfg.get("cluster.registrar"):
+            # watch peers: a silent peer's shards are reassigned to survivors,
+            # whose _on_shard_event resync starts the consumers
+            # (ref: gossip deathwatch -> ShardManager auto-reassignment)
+            from .parallel.bootstrap import MembershipMonitor
+            self.membership = MembershipMonitor(
+                self._registrar, self.node, on_down=self.manager.remove_node,
+                on_up=self.manager.add_node, on_self_stale=self._quarantine,
+                interval_s=parse_duration_ms(cfg["cluster.heartbeat_interval"]) / 1000.0)
+            self.membership.poll_once()
+            self.membership.start()
         if cfg.get("profiler.enabled"):
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(
@@ -187,6 +280,8 @@ class FiloServer:
             self.http.stop()
         if self.scheduler:
             self.scheduler.shutdown()
+        if self.membership:
+            self.membership.stop()
         if self.profiler:
             self.profiler.stop()
 

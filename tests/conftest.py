@@ -28,3 +28,8 @@ assert len(jax.devices()) == 8, "expected an 8-device virtual CPU mesh"
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / wall-clock-heavy tests")

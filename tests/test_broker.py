@@ -1,6 +1,7 @@
 """TCP log broker tests (ref analog: kafka SourceSinkSuite — publish/consume
 round trips, seek-to-checkpoint replay, one shard == one partition)."""
 
+import contextlib
 import threading
 
 import numpy as np
@@ -228,12 +229,5 @@ def test_consumer_survives_broker_outage(tmp_path):
     finally:
         if srv:
             srv.shutdown()
-        with contextlib_suppress():
+        with contextlib.suppress(Exception):
             broker.stop()
-
-
-class contextlib_suppress:
-    def __enter__(self):
-        return self
-    def __exit__(self, *exc):
-        return True
