@@ -51,6 +51,7 @@ class ShardStats:
     series_created: int = 0
     unknown_schema_dropped: int = 0
     partitions_purged: int = 0
+    partitions_evicted: int = 0
     evicted_part_key_reingests: int = 0
 
 
@@ -107,6 +108,7 @@ class TimeSeriesShard:
         self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
         self._pending_group_offset = np.full(G, -1, np.int64)
         self._new_part_pids: list[int] = []   # created since last part-key persist
+        self._pending_tombstones: list[int] = []   # released pids awaiting durable tombstone
         self._meta_written = False
         # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
         # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
@@ -115,19 +117,25 @@ class TimeSeriesShard:
 
     # -- partition resolution ----------------------------------------------
 
-    def _resolve_part_ids(self, container: RecordContainer) -> np.ndarray:
-        """Map the container's distinct label sets to dense part ids, creating
-        new partitions (and index entries) as needed."""
-        mapping = np.empty(len(container.label_sets), np.int32)
-        first_ts = int(container.ts.min()) if len(container) else 0
-        with self.lock:
-            return self._resolve_part_ids_locked(container, mapping, first_ts)
-
-    def _resolve_part_ids_locked(self, container, mapping, first_ts) -> np.ndarray:
-        for i, labels in enumerate(container.label_sets):
+    def _resolve_segment_locked(self, container, mapping, first_ts, start) -> int:
+        """Resolve label sets from ``start`` onward to dense part ids, creating
+        new partitions (and index entries) as needed. Under slot pressure, evict
+        least-recently-active partitions to make room (ref: TimeSeriesShard
+        ``ensureFreeSpace``, :1315). Returns the index one past the last label
+        set resolved: when a new slot is needed but every eviction candidate is
+        a series resolved earlier in this same container (its samples not yet
+        staged), resolution stops there so the caller can stage the prefix —
+        which makes those series evictable — and re-enter."""
+        S = self.config.max_series_per_shard
+        protected: set[int] = set()
+        for i in range(start, len(container.label_sets)):
+            labels = container.label_sets[i]
             pk = part_key_of(labels, self.schema.options)
             pid = self._part_key_to_id.get(pk)
             if pid is None:
+                if not self._free_pids and len(self.index) >= S:
+                    if not self._ensure_free_space_locked(protected):
+                        return i   # blocked on this container's own series
                 if pk in self._evicted_keys:
                     self.stats.evicted_part_key_reingests += 1
                 pid = self._free_pids.pop() if self._free_pids else len(self.index)
@@ -137,7 +145,87 @@ class TimeSeriesShard:
                 self._new_part_pids.append(pid)
                 self.stats.series_created += 1
             mapping[i] = pid
-        return mapping[container.part_idx]
+            protected.add(pid)
+        return len(container.label_sets)
+
+    def _ensure_free_space_locked(self, protected: set[int]) -> bool:
+        """Evict the least-recently-active partitions so a new series can be
+        admitted instead of erroring (ref: TimeSeriesShard.ensureFreeSpace
+        :1315 + evictedPartKeys bloom :93-96). Eviction frees the HBM rows,
+        tombstones the index entries, and records the part keys so a returning
+        series is detected. Returns False when every occupied slot belongs to
+        ``protected`` (series whose samples are still pending in the caller's
+        container) and nothing can move."""
+        self._flush_staged_locked()   # staged rows must land before slots move
+        occupied = np.fromiter(self._part_key_of_id.keys(), np.int64,
+                               count=len(self._part_key_of_id))
+        if protected:
+            occupied = occupied[~np.isin(
+                occupied, np.fromiter(protected, np.int64, count=len(protected)))]
+        if occupied.size == 0:
+            return False
+        # amortize: evict a small batch, least-recently-active first
+        k = min(occupied.size, max(1, self.config.max_series_per_shard // 16))
+        last = self.store.last_ts[occupied]
+        victims = (occupied[np.argpartition(last, k - 1)[:k]]
+                   if k < occupied.size else occupied)
+        self._release_partitions_locked(victims.astype(np.int32))
+        self.stats.partitions_evicted += int(victims.size)
+        return True
+
+    def _release_partitions_locked(self, pids: np.ndarray) -> None:
+        """Shared teardown for purge and eviction: drop id maps (recording the
+        keys in the evicted-keys filter), tombstone index entries, free HBM
+        rows, and make the slots reusable. Durable tombstones (queued here,
+        written outside the lock by the next drain point) ensure recovery
+        neither resurrects the series nor attributes its persisted chunks to a
+        later owner of the reused slot."""
+        pid_list = pids.tolist()
+        for pid in pid_list:
+            pk = self._part_key_of_id.pop(pid, None)
+            if pk is not None:
+                del self._part_key_to_id[pk]
+                self._evicted_keys.add(pk)
+        self.index.remove_part_keys(pids)
+        self.store.free_rows(pids)
+        for pid in pid_list:
+            self._rv_keys.pop(pid, None)
+        if self._new_part_pids:
+            gone = set(pid_list)
+            self._new_part_pids = [p for p in self._new_part_pids if p not in gone]
+        self._free_pids.extend(pid_list)
+        if self.sink is not None:
+            # unpersisted samples of a released partition must never reach the
+            # sink: a later flush_group would write them under a pid whose slot
+            # may belong to a new owner by recovery time (the purge path avoids
+            # this by refusing to purge pids with pending chunks; eviction
+            # cannot refuse, so it scrubs them instead)
+            gone_arr = np.asarray(pid_list, np.int32)
+            for g, pending in enumerate(self._pending_chunks):
+                if not pending:
+                    continue
+                kept = []
+                for pids_, ts_, vals_ in pending:
+                    m = ~np.isin(pids_, gone_arr)
+                    if m.all():
+                        kept.append((pids_, ts_, vals_))
+                    elif m.any():
+                        kept.append((pids_[m], ts_[m], vals_[m]))
+                self._pending_chunks[g] = kept
+            self._pending_tombstones.extend(pid_list)
+
+    def _drain_tombstones(self) -> list[int]:
+        """Atomically take the queued durable tombstones (written to the sink
+        outside the shard lock — sink I/O must not stall ingest/query threads)."""
+        with self.lock:
+            tomb, self._pending_tombstones = self._pending_tombstones, []
+        return tomb
+
+    def _write_tombstones(self) -> None:
+        tomb = self._drain_tombstones()
+        if tomb and self.sink is not None:
+            self.sink.write_part_keys(self.dataset, self.shard_num,
+                                      [(int(pid), {}, -1) for pid in tomb])
 
     # -- ingest -------------------------------------------------------------
 
@@ -157,43 +245,76 @@ class TimeSeriesShard:
                                      self.config.samples_per_series,
                                      dtype=self._dtype, device=self._device,
                                      nbuckets=nb)
-        pids = self._resolve_part_ids(container)
-        ts, vals = container.ts, container.values
+        n_sets = len(container.label_sets)
+        if n_sets == 0 or len(container) == 0:
+            return
+        mapping = np.empty(n_sets, np.int32)
+        first_ts = int(container.ts.min())
+        # resolution + staging share the shard lock: HTTP writers / gateways may
+        # ingest from several threads, and query paths call flush(). Resolution
+        # is segmented: when slot pressure forces eviction but every candidate
+        # is a series from this very container, the resolved prefix is staged
+        # and landed on device first so those series become evictable.
+        with self.lock:
+            start = 0
+            while start < n_sets:
+                done = self._resolve_segment_locked(container, mapping,
+                                                    first_ts, start)
+                self._stage_segment_locked(container, mapping, start, done,
+                                           offset, recovery_watermarks)
+                if done < n_sets:
+                    self._flush_staged_locked()
+                start = done
+        if self._staged >= self.config.flush_batch_size:
+            self.flush()
+
+    def _stage_segment_locked(self, container, mapping, start, done, offset,
+                              recovery_watermarks) -> None:
+        """Stage the samples of label sets ``[start, done)`` (the common case —
+        the whole container — avoids the mask)."""
+        if start == 0 and done == len(container.label_sets):
+            pids = mapping[container.part_idx]
+            ts, vals = container.ts, container.values
+        else:
+            sel = (container.part_idx >= start) & (container.part_idx < done)
+            pids = mapping[container.part_idx[sel]]
+            ts, vals = container.ts[sel], container.values[sel]
         if recovery_watermarks is not None:
             keep = recovery_watermarks[pids % self.config.groups_per_shard] < offset
             if not keep.all():
                 pids, ts, vals = pids[keep], ts[keep], vals[keep]
         if len(pids) == 0:
             return
-        # staging mutations share the shard lock: HTTP writers / gateways may
-        # ingest from several threads, and query paths call flush()
-        with self.lock:
-            self._stage_pid.append(pids)
-            self._stage_ts.append(ts)
-            self._stage_val.append(vals)
-            self._staged += len(ts)
-            self._pending_offset = max(self._pending_offset, offset)
-            self.stats.rows_ingested += len(ts)
-            if self.sink is not None:
-                groups = pids % self.config.groups_per_shard
-                for g in np.unique(groups):
-                    sel = groups == g
-                    self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
-                    self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
-        if self._staged >= self.config.flush_batch_size:
-            self.flush()
+        self._stage_pid.append(pids)
+        self._stage_ts.append(ts)
+        self._stage_val.append(vals)
+        self._staged += len(ts)
+        self._pending_offset = max(self._pending_offset, offset)
+        self.stats.rows_ingested += len(ts)
+        if self.sink is not None:
+            groups = pids % self.config.groups_per_shard
+            for g in np.unique(groups):
+                sel = groups == g
+                self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
+                self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
+
+    def _flush_staged_locked(self) -> int:
+        """Land staged samples on the device store (caller holds the lock)."""
+        if not self._staged:
+            return 0
+        pids = np.concatenate(self._stage_pid)
+        ts = np.concatenate(self._stage_ts)
+        vals = np.concatenate(self._stage_val, axis=0)
+        self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
+        self._staged = 0
+        return self.store.append(pids, ts, vals)
 
     def flush(self) -> int:
         """Push staged samples to the device store; advance group watermarks."""
         with self.lock:
             if not self._staged:
                 return 0
-            pids = np.concatenate(self._stage_pid)
-            ts = np.concatenate(self._stage_ts)
-            vals = np.concatenate(self._stage_val, axis=0)
-            self._stage_pid.clear(); self._stage_ts.clear(); self._stage_val.clear()
-            self._staged = 0
-            written = self.store.append(pids, ts, vals)
+            written = self._flush_staged_locked()
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset
@@ -214,6 +335,9 @@ class TimeSeriesShard:
         if self.sink is None:
             return 0
         self.flush()                      # device state first
+        # tombstones of released slots must land before any new owner's part
+        # key so recovery resolves slot reuse to the latest owner
+        self._write_tombstones()
         pending = self._pending_chunks[group]
         if not pending:
             return 0
@@ -359,24 +483,8 @@ class TimeSeriesShard:
                     purged = np.setdiff1d(purged, pending).astype(np.int32)
             if len(purged) == 0:
                 return 0
-            for pid in purged.tolist():
-                pk = self._part_key_of_id.pop(pid, None)
-                if pk is not None:
-                    del self._part_key_to_id[pk]
-                    self._evicted_keys.add(pk)
-            self.index.remove_part_keys(purged)
-            self.store.free_rows(purged)
-            for pid in purged.tolist():
-                self._rv_keys.pop(pid, None)
-            if self._new_part_pids:
-                gone = set(purged.tolist())
-                self._new_part_pids = [p for p in self._new_part_pids if p not in gone]
-            self._free_pids.extend(purged.tolist())
-        # durable tombstones so recovery neither resurrects the purged series nor
-        # attributes its persisted chunks to a later owner of the reused slot
-        if self.sink is not None:
-            self.sink.write_part_keys(self.dataset, self.shard_num,
-                                      [(int(pid), {}, -1) for pid in purged.tolist()])
+            self._release_partitions_locked(purged)
+        self._write_tombstones()   # durable write happens outside the lock
         self.stats.partitions_purged += len(purged)
         return len(purged)
 

@@ -128,6 +128,20 @@ class FiloHttpServer:
     def stop(self):
         self._server.shutdown()
 
+    def _sync_shard_stats(self) -> None:
+        """Refresh per-shard ingest/eviction gauges on each scrape (ref:
+        TimeSeriesShardStats Kamon counters, TimeSeriesShard.scala:36-97)."""
+        from dataclasses import asdict
+
+        from ..utils.metrics import registry
+        for ds, e in self.engines.items():
+            for s in e.memstore.shards_of(ds):
+                tags = {"dataset": ds, "shard": str(s.shard_num)}
+                for k, v in asdict(s.stats).items():
+                    registry.gauge(f"filodb_shard_{k}", tags).update(float(v))
+                registry.gauge("filodb_shard_num_series", tags).update(
+                    float(s.num_series))
+
     def _run(self, fn, priority: Priority):
         """Run query work through the priority scheduler when configured."""
         if self.scheduler is None:
@@ -159,6 +173,7 @@ class FiloHttpServer:
             return
         if path == "/metrics":
             from ..utils.metrics import registry
+            self._sync_shard_stats()
             body = registry.expose_prometheus().encode()
             h.send_response(200)
             h.send_header("Content-Type", "text/plain; version=0.0.4")

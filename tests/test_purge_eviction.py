@@ -112,6 +112,105 @@ def test_purged_series_stays_dead_after_recovery(tmp_path):
     assert shard2.stats.evicted_part_key_reingests == 1
 
 
+def test_live_eviction_under_series_pressure():
+    """Ingesting past max_series_per_shard must evict least-recently-active
+    partitions and keep going, never crash (ref: TimeSeriesShard.ensureFreeSpace
+    :1315 + evictedPartKeys bloom :93-96)."""
+    ms, shard = _mk_shard()   # max_series_per_shard=32
+    # 2x capacity, spread over containers with advancing timestamps
+    for i in range(8):
+        _ingest(shard, [f"s{i * 8 + j}" for j in range(8)], BASE + i * 1_000_000)
+    assert shard.num_series <= 32
+    assert shard.stats.partitions_evicted >= 32
+    assert shard.stats.series_created == 64
+    # the most recent series is live with intact data
+    from filodb_tpu.core.filters import Equals
+    pids = shard.part_ids_from_filters([Equals("host", "s63")], 0, 1 << 60)
+    assert len(pids) == 1
+    ts, vals = shard.store.series_snapshot(int(pids[0]))
+    assert len(ts) == 5 and (vals == np.arange(5)).all()
+    # the oldest series was evicted (least recently active first)
+    assert len(shard.part_ids_from_filters([Equals("host", "s0")], 0, 1 << 60)) == 0
+    # a returning evicted series is detected
+    _ingest(shard, ["s0"], BASE + 9_000_000)
+    assert shard.stats.evicted_part_key_reingests >= 1
+
+
+def test_live_eviction_single_container_overflow():
+    """One container introducing 2x capacity distinct series: resolution must
+    segment (stage the resolved prefix, then continue) instead of deadlocking
+    on its own unflushed series."""
+    ms, shard = _mk_shard()
+    _ingest(shard, [f"big{i:03d}" for i in range(64)], BASE)
+    assert shard.num_series <= 32
+    assert shard.stats.series_created == 64
+    assert shard.stats.partitions_evicted >= 32
+    # the last-resolved series survives with correct samples
+    from filodb_tpu.core.filters import Equals
+    pids = shard.part_ids_from_filters([Equals("host", "big063")], 0, 1 << 60)
+    assert len(pids) == 1
+    ts, vals = shard.store.series_snapshot(int(pids[0]))
+    assert len(ts) == 5 and (vals == np.arange(5)).all()
+
+
+def test_live_eviction_with_sink_recovery(tmp_path):
+    """Evicted-under-pressure series must stay dead after restart: durable
+    tombstones win over their part keys and orphan their persisted chunks."""
+    ms = TimeSeriesMemStore()
+    config = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                         flush_batch_size=10**9, groups_per_shard=4)
+    shard = ms.setup("prometheus", GAUGE, 0, config,
+                     sink=FileColumnStore(str(tmp_path)))
+    for i in range(4):
+        _ingest(shard, [f"e{i * 4 + j}" for j in range(4)], BASE + i * 1_000_000)
+    assert shard.num_series <= 8 and shard.stats.partitions_evicted > 0
+    shard.flush_all_groups()
+    live = set(shard.label_values("host"))
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("prometheus", GAUGE, 0, config,
+                       sink=FileColumnStore(str(tmp_path)))
+    shard2.recover()
+    assert set(shard2.label_values("host")) == live
+    assert shard2.num_series == shard.num_series
+    # recovered slots hold only their current owner's data
+    from filodb_tpu.core.filters import Equals
+    pids = shard2.part_ids_from_filters([Equals("host", "e15")], 0, 1 << 60)
+    ts, vals = shard2.store.series_snapshot(int(pids[0]))
+    assert len(ts) == 5 and (ts >= BASE + 3_000_000).all()
+
+
+def test_eviction_scrubs_pending_sink_chunks(tmp_path):
+    """An evicted partition's unpersisted chunks must never reach the sink:
+    they would be attributed to the slot's next owner on recovery (whose
+    start time can fall below the evicted series' tail)."""
+    ms = TimeSeriesMemStore()
+    config = StoreConfig(max_series_per_shard=2, samples_per_series=64,
+                         flush_batch_size=10**9, groups_per_shard=1)
+    shard = ms.setup("prometheus", GAUGE, 0, config,
+                     sink=FileColumnStore(str(tmp_path)))
+    b = RecordBuilder(GAUGE)
+    b.add({"_metric_": "m", "host": "A"}, BASE + 100_000, 1.0)
+    b.add({"_metric_": "m", "host": "A"}, BASE + 200_000, 2.0)
+    b.add({"_metric_": "m", "host": "B"}, BASE + 900_000, 3.0)
+    shard.ingest(b.build())      # A+B pending for the sink, NOT group-flushed
+    b = RecordBuilder(GAUGE)     # C: first_ts below A's tail -> evicts A (LRA)
+    b.add({"_metric_": "m", "host": "C"}, BASE + 150_000, 5.0)
+    b.add({"_metric_": "m", "host": "C"}, BASE + 950_000, 6.0)
+    shard.ingest(b.build())
+    assert shard.stats.partitions_evicted == 1
+    shard.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("prometheus", GAUGE, 0, config,
+                       sink=FileColumnStore(str(tmp_path)))
+    shard2.recover()
+    assert sorted(shard2.label_values("host")) == ["B", "C"]
+    from filodb_tpu.core.filters import Equals
+    pids = shard2.part_ids_from_filters([Equals("host", "C")], 0, 1 << 60)
+    ts, vals = shard2.store.series_snapshot(int(pids[0]))
+    assert ts.tolist() == [BASE + 150_000, BASE + 950_000]
+    assert vals.tolist() == [5.0, 6.0]
+
+
 def test_eviction_policies():
     cfg = StoreConfig(samples_per_series=100)
 
