@@ -202,6 +202,15 @@ class SeriesStore:
                 self.grid_ok = False
             if self.grid_interval is None:
                 return
+            # interval just established: starts recorded before it was known
+            # (earlier batches) must land on the grid too, else their offsets
+            # in grid_offsets() would silently misalign
+            live = self.n_host > 0
+            if self.grid_ok and live.any():
+                starts = self.first_ts[live]
+                if (((starts - self.grid_base) % self.grid_interval) != 0).any():
+                    self.grid_ok = False
+                    return
         iv = self.grid_interval
         ok = ((t - self.grid_base) % iv == 0).all()
         # contiguity within the batch
@@ -214,31 +223,32 @@ class SeriesStore:
             if existing.any():
                 heads = t[first_pos[existing]]
                 ok = (heads == self.last_ts[uniq[existing]] + iv).all()
-        # uniform start: every new series must begin at the shard's start cell
-        if ok:
-            fresh = self.n_host[uniq] == 0
-            if fresh.any():
-                start = self.first_ts[uniq[~fresh]].min() if (~fresh).any() else None
-                live = self.n_host > 0
-                if start is None and live.any():
-                    start = self.first_ts[live].min()
-                if start is not None:
-                    ok = (t[first_pos[fresh]] == start).all()
         if not ok:
             self.grid_ok = False
 
     def grid_info(self):
-        """(base_ts, interval_ms) when the MXU grid fast path applies, else None.
-        base_ts is the uniform start timestamp (sample k at base + k*interval)."""
+        """(base_ts, interval_ms) when the shard stays on a common scrape grid
+        (common interval, on-grid timestamps, per-series contiguity), else None.
+
+        Series may START at different grid cells — churn (a new pod appearing
+        mid-stream) no longer demotes the shard: per-series start cells come
+        from :meth:`grid_offsets`, and the query layer runs the band-matmul
+        path on the majority start cohort, correcting minority rows via the
+        general kernels. Compaction shifts every row's offset uniformly, so
+        the majority cohort survives it."""
         if not self.grid_ok or not self.grid_interval:
             return None
-        live = self.n_host > 0
-        if not live.any():
+        if not (self.n_host > 0).any():
             return None
-        starts = self.first_ts[live]
-        if (starts != starts[0]).any():
-            return None
-        return int(starts[0]), int(self.grid_interval)
+        return int(self.grid_base), int(self.grid_interval)
+
+    def grid_offsets(self, rows: np.ndarray) -> np.ndarray:
+        """Start cell of each given row (its first sample's grid cell index
+        relative to ``grid_base``); 0 for empty rows."""
+        first = self.first_ts[rows]
+        return np.where(first >= 0,
+                        (first - self.grid_base) // self.grid_interval,
+                        0).astype(np.int64)
 
     def compact(self, cutoff_ts: int) -> None:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim

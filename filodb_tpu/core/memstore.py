@@ -107,8 +107,12 @@ class TimeSeriesShard:
         G = config.groups_per_shard
         self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
         self._pending_group_offset = np.full(G, -1, np.int64)
-        self._new_part_pids: list[int] = []   # created since last part-key persist
-        self._pending_tombstones: list[int] = []   # released pids awaiting durable tombstone
+        # ordered part-key event log awaiting durable persist: creations
+        # (pid, labels, start) and release tombstones (pid, {}, -1) in event
+        # order, so recovery's last-entry-wins resolves slot reuse correctly
+        # regardless of which thread drains the log
+        self._partkey_log: list[tuple[int, dict, int]] = []
+        self._sink_lock = threading.Lock()   # serializes drain+write batches
         self._meta_written = False
         # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
         # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
@@ -142,7 +146,8 @@ class TimeSeriesShard:
                 self._part_key_to_id[pk] = pid
                 self._part_key_of_id[pid] = pk
                 self.index.add_part_key(pid, labels, start_time=first_ts)
-                self._new_part_pids.append(pid)
+                if self.sink is not None:
+                    self._partkey_log.append((pid, labels, first_ts))
                 self.stats.series_created += 1
             mapping[i] = pid
             protected.add(pid)
@@ -190,9 +195,6 @@ class TimeSeriesShard:
         self.store.free_rows(pids)
         for pid in pid_list:
             self._rv_keys.pop(pid, None)
-        if self._new_part_pids:
-            gone = set(pid_list)
-            self._new_part_pids = [p for p in self._new_part_pids if p not in gone]
         self._free_pids.extend(pid_list)
         if self.sink is not None:
             # unpersisted samples of a released partition must never reach the
@@ -212,20 +214,24 @@ class TimeSeriesShard:
                     elif m.any():
                         kept.append((pids_[m], ts_[m], vals_[m]))
                 self._pending_chunks[g] = kept
-            self._pending_tombstones.extend(pid_list)
+            self._partkey_log.extend((pid, {}, -1) for pid in pid_list)
 
-    def _drain_tombstones(self) -> list[int]:
-        """Atomically take the queued durable tombstones (written to the sink
-        outside the shard lock — sink I/O must not stall ingest/query threads)."""
-        with self.lock:
-            tomb, self._pending_tombstones = self._pending_tombstones, []
-        return tomb
-
-    def _write_tombstones(self) -> None:
-        tomb = self._drain_tombstones()
-        if tomb and self.sink is not None:
-            self.sink.write_part_keys(self.dataset, self.shard_num,
-                                      [(int(pid), {}, -1) for pid in tomb])
+    def _flush_partkey_log(self) -> None:
+        """Persist queued part-key events. The drain and the sink write happen
+        inside one critical section (``_sink_lock``, NOT the shard lock — sink
+        I/O must not stall ingest/query threads): two concurrent drains could
+        otherwise write their batches out of event order, letting a released
+        slot's tombstone land after its new owner's key and erase that series
+        on recovery."""
+        if self.sink is None:
+            return
+        with self._sink_lock:
+            with self.lock:
+                log, self._partkey_log = self._partkey_log, []
+            if log:
+                self.sink.write_part_keys(
+                    self.dataset, self.shard_num,
+                    [(int(pid), labels, int(start)) for pid, labels, start in log])
 
     # -- ingest -------------------------------------------------------------
 
@@ -335,9 +341,9 @@ class TimeSeriesShard:
         if self.sink is None:
             return 0
         self.flush()                      # device state first
-        # tombstones of released slots must land before any new owner's part
-        # key so recovery resolves slot reuse to the latest owner
-        self._write_tombstones()
+        # part-key events (creations + tombstones, in order) land before the
+        # chunks that reference them
+        self._flush_partkey_log()
         pending = self._pending_chunks[group]
         if not pending:
             return 0
@@ -362,12 +368,6 @@ class TimeSeriesShard:
                 self.sink.write_meta(self.dataset, self.shard_num,
                                      {"bucket_les": list(map(float, self.bucket_les))})
             self._meta_written = True
-        # new part keys ride along with any group flush (ref: writeTimeBuckets)
-        if self._new_part_pids:
-            entries = [(pid, self.index.labels_of(pid), self.index.start_time(pid))
-                       for pid in self._new_part_pids]
-            self.sink.write_part_keys(self.dataset, self.shard_num, entries)
-            self._new_part_pids = []
         self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
         off = int(self._pending_group_offset[group])
         if off >= 0:
@@ -484,7 +484,7 @@ class TimeSeriesShard:
             if len(purged) == 0:
                 return 0
             self._release_partitions_locked(purged)
-        self._write_tombstones()   # durable write happens outside the lock
+        self._flush_partkey_log()   # durable write happens outside the shard lock
         self.stats.partitions_purged += len(purged)
         return len(purged)
 

@@ -63,6 +63,10 @@ class SeriesSelection:
     rows: np.ndarray | None   # int32 [P] array-row of each key, or None
     grid: tuple | None = None  # (base_ts, interval_ms) => MXU band-matmul path
     bucket_les: np.ndarray | None = None  # histogram bucket tops [B]
+    # array-row indices of live selected series whose start cell differs from
+    # the majority cohort grid/base_ts was shifted to (churn): the grid kernel
+    # result is wrong for exactly these rows; PSM recomputes them generally
+    grid_minority: np.ndarray | None = None
 
 
 @dataclass
@@ -86,6 +90,31 @@ def _pow2(n: int, floor: int = 8) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def _gather_rows_padded(ts, val, n, rows: np.ndarray):
+    """Gather the given array rows padded to a pow2 row count (kernel-shape
+    stability); pad rows get n = 0 (disabled). Returns (ts, val, n, P)."""
+    P = _pow2(len(rows))
+    pad = np.zeros(P, np.int32)
+    pad[:len(rows)] = rows
+    rid = jnp.asarray(pad)
+    n_g = jnp.where(jnp.arange(P) < len(rows), jnp.take(n, rid), 0)
+    return (jnp.take(ts, rid, axis=0), jnp.take(val, rid, axis=0),
+            n_g.astype(jnp.int32), P)
+
+
+def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1):
+    """Patch grid-kernel output for churned rows: series whose start cell
+    differs from the majority cohort (the band matrices assume the majority
+    start) are recomputed through the general searchsorted kernels — an
+    [M, C] row gather for a small M, scattered back into the [R, T] result."""
+    rows = np.asarray(data.grid_minority, np.int32)
+    M = len(rows)
+    sub_ts, sub_val, sub_n, _ = _gather_rows_padded(data.ts, data.val, data.n, rows)
+    corr = rangefns.periodic_samples(sub_ts, sub_val, sub_n,
+                                     out_ts, window, fn, a0, a1)
+    return vals.at[jnp.asarray(rows)].set(corr[:M].astype(vals.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -128,12 +157,16 @@ class PeriodicSamplesMapper(Transformer):
             data.grid is not None
             and max(abs(int(out_ts[0]) - data.grid[0]),
                     abs(int(out_ts[-1]) - data.grid[0])) + window < 2**31)
+        minority = data.grid_minority
         if data.bucket_les is not None:
             # native histograms require the grid path (ref: HistogramVector is
             # only read through chunked functions; general hist path is TODO)
             if not (grid_usable and fn in gridfns.HIST_GRID_FNS):
                 raise QueryError(f"function {fn} not supported on histogram "
                                  "series (or shard not grid-aligned)")
+            if minority is not None and len(minority):
+                raise QueryError("histogram series with mixed start cohorts "
+                                 "not yet supported")
             base_ts, interval_ms = data.grid
             vals = gridfns.periodic_samples_grid_hist(
                 data.val, data.n, out_ts, window, fn, base_ts, interval_ms,
@@ -144,6 +177,9 @@ class PeriodicSamplesMapper(Transformer):
             vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
                                                  fn, base_ts, interval_ms,
                                                  stale_ms=ctx.stale_ms)
+            if minority is not None and len(minority):
+                vals = _correct_minority_cohort(data, vals, out_ts, window,
+                                                fn, a0, a1)
         else:
             vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_ts,
                                              window, fn, a0, a1)
@@ -478,19 +514,35 @@ class SelectRawPartitionsExec(ExecPlan):
         if len(pids) == 0:
             return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None,
                                    grid, les)
+        # mixed start cohorts (churn): shift the grid base to the majority
+        # cohort's start cell; the few minority rows are recorded so PSM can
+        # recompute them generally. Too much churn => general path outright.
+        minority_sel = None
+        if grid is not None:
+            base, iv = grid
+            goff = store.grid_offsets(pids)
+            live = store.n_host[pids] > 0
+            if live.any():
+                u, cnts = np.unique(goff[live], return_counts=True)
+                o_maj = int(u[np.argmax(cnts)])
+                mins = live & (goff != o_maj)
+                m = int(mins.sum())
+                if m > 0.25 * int(live.sum()):
+                    grid = None
+                else:
+                    grid = (base + o_maj * iv, iv)
+                    if m:
+                        minority_sel = mins
         if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
             # narrow selection: gather rows once, padded to a power of two
-            P = _pow2(len(pids))
-            rows = np.zeros(P, np.int32)
-            rows[: len(pids)] = pids
-            rid = jnp.asarray(rows)
-            sel_n = jnp.where(jnp.arange(P) < len(pids), jnp.take(n, rid), 0)
+            sel_ts, sel_val, sel_n, P = _gather_rows_padded(ts, val, n, pids)
             # P > len(pids): arrays carry pad rows beyond the keys — expose the
             # identity row map so downstream compaction/group-scatter skips them
             sel_rows = None if P == len(pids) else np.arange(len(pids), dtype=np.int32)
-            return SeriesSelection(jnp.take(ts, rid, axis=0),
-                                   jnp.take(val, rid, axis=0),
-                                   sel_n.astype(jnp.int32), keys, sel_rows, grid, les)
+            g_min = (np.nonzero(minority_sel)[0].astype(np.int32)
+                     if minority_sel is not None else None)
+            return SeriesSelection(sel_ts, sel_val, sel_n, keys, sel_rows, grid, les,
+                                   g_min)
         # wide selection: no gather — disable non-selected rows via n = 0
         if len(pids) == store.S or len(pids) == total:
             n_eff = n
@@ -498,7 +550,10 @@ class SelectRawPartitionsExec(ExecPlan):
             mask = np.zeros(store.S, bool)
             mask[pids] = True
             n_eff = jnp.where(jnp.asarray(mask), n, 0)
-        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les)
+        g_min = (pids[minority_sel].astype(np.int32)
+                 if minority_sel is not None else None)
+        return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les,
+                               g_min)
 
 
 @dataclass

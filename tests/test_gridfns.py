@@ -74,10 +74,24 @@ def test_store_grid_tracking_aligned():
                   np.array([BASE + k * IV] * 2, np.int64),
                   np.array([1.0, 2.0]))
     assert st.grid_info() == (BASE, IV)
-    # a new series joining later breaks uniform start -> fast path off
+    # a new series joining later no longer demotes the shard — it forms its
+    # own start cohort, visible through grid_offsets
     st.append(np.array([2], np.int32), np.array([BASE + 3 * IV], np.int64),
               np.array([9.0]))
-    assert st.grid_info() is None
+    assert st.grid_info() == (BASE, IV)
+    assert st.grid_offsets(np.arange(3)).tolist() == [0, 0, 3]
+
+
+def test_store_grid_survives_compaction():
+    st = SeriesStore(max_series=4, capacity=32)
+    for k in range(20):
+        st.append(np.array([0, 1], np.int32),
+                  np.array([BASE + k * IV] * 2, np.int64),
+                  np.array([1.0, 2.0]))
+    st.compact(BASE + 10 * IV)
+    # offsets shift uniformly: the majority cohort survives compaction
+    assert st.grid_info() == (BASE, IV)
+    assert st.grid_offsets(np.arange(2)).tolist() == [10, 10]
 
 
 def test_store_grid_tracking_irregular():
@@ -116,3 +130,52 @@ def test_engine_uses_grid_path_same_results():
     (k1, t1, v1), = list(r1.matrix.iter_series())
     (k2, t2, v2), = list(r2.matrix.iter_series())
     np.testing.assert_allclose(v1, v2, rtol=1e-12)
+
+
+def _series_by_host(result):
+    return {k.as_dict()["host"]: np.asarray(v)
+            for k, _, v in result.matrix.iter_series()}
+
+
+def test_engine_grid_path_survives_churn_and_compaction():
+    """New series appearing mid-stream (a new pod) and compaction must keep
+    the shard on the MXU grid path, with results matching the general path
+    bit-for-bit: majority cohort via band matmuls, churned rows corrected."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("prometheus", GAUGE, 0, cfg)
+    b = RecordBuilder(GAUGE)
+    for t in range(50):
+        for s in range(3):
+            b.add({"_metric_": "m", "host": f"h{s}"}, BASE + t * IV, float(s * 10 + t))
+        if t >= 20:   # h3 appears mid-stream — a different start cohort
+            b.add({"_metric_": "m", "host": "h3"}, BASE + t * IV, float(100 + t))
+    shard.ingest(b.build())
+    shard.flush()
+    assert shard.store.grid_info() is not None
+    assert shard.store.grid_offsets(np.arange(4)).tolist() == [0, 0, 0, 20]
+    eng = QueryEngine(ms, "prometheus")
+    q = ("rate(m[2m])", BASE + 250_000, BASE + 480_000, 30_000)
+    r1 = eng.query_range(*q)
+    shard.store.grid_ok = False
+    r2 = eng.query_range(*q)
+    shard.store.grid_ok = True
+    g1, g2 = _series_by_host(r1), _series_by_host(r2)
+    assert set(g1) == {"h0", "h1", "h2", "h3"} and set(g2) == set(g1)
+    for h in g1:
+        np.testing.assert_array_equal(g1[h], g2[h], err_msg=f"host {h}")
+    # compaction shifts every offset uniformly: still on the grid path
+    shard.store.compact(BASE + 10 * IV)
+    assert shard.store.grid_info() is not None
+    r3 = eng.query_range(*q)
+    shard.store.grid_ok = False
+    r4 = eng.query_range(*q)
+    g3, g4 = _series_by_host(r3), _series_by_host(r4)
+    for h in g3:
+        np.testing.assert_array_equal(g3[h], g4[h], err_msg=f"post-compact {h}")
