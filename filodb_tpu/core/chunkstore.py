@@ -108,6 +108,9 @@ class SeriesStore:
         self.grid_base: int | None = None
         self.grid_interval: int | None = None
         self.grid_ok = True
+        # start-cohort summary cache: recomputing per-row offsets per QUERY is
+        # an O(S) host pass; starts only change on new series/compact/free
+        self._cohorts = None
         self.stats = SeriesStoreStats()
 
     # -- ingest -------------------------------------------------------------
@@ -163,6 +166,8 @@ class SeriesStore:
         uniq, first_pos = np.unique(r, return_index=True)
         newly = uniq[self.n_host[uniq] == 0]
         self.first_ts[newly] = t[first_pos[self.n_host[uniq] == 0]]
+        if len(newly):
+            self._cohorts = None   # new starts can change the cohort summary
         self._track_grid(r, t, uniq, first_pos)
         np.maximum.at(self.last_ts, r, t)
         counts = np.bincount(r, minlength=self.S).astype(np.int32)
@@ -250,6 +255,24 @@ class SeriesStore:
                         (first - self.grid_base) // self.grid_interval,
                         0).astype(np.int64)
 
+    def grid_cohorts(self):
+        """Cached start-cohort summary over live rows: ``("uniform", off)``
+        when every live series starts at the same grid cell (the overwhelmingly
+        common shape — one scrape cohort), else ``("mixed", offsets[S])``.
+        Invalidated whenever starts can move (new series, compaction, frees)."""
+        if self._cohorts is None:
+            live = self.n_host > 0
+            if not live.any():
+                self._cohorts = ("uniform", 0)
+            else:
+                offs = self.grid_offsets(np.arange(self.S))
+                lv = offs[live]
+                if (lv == lv[0]).all():
+                    self._cohorts = ("uniform", int(lv[0]))
+                else:
+                    self._cohorts = ("mixed", offs)
+        return self._cohorts
+
     def compact(self, cutoff_ts: int) -> None:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
         by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
@@ -258,6 +281,7 @@ class SeriesStore:
         self.n_host = np.array(self.n)  # fresh writable host copy
         new_first = np.array(self.ts[:, 0])
         self.first_ts = np.where(self.n_host > 0, new_first, -1)
+        self._cohorts = None
         self.stats.compactions += 1
 
     def free_rows(self, part_ids: np.ndarray) -> None:
@@ -277,6 +301,7 @@ class SeriesStore:
         self.n_host[part_ids] = 0
         self.first_ts[part_ids] = -1
         self.last_ts[part_ids] = -(1 << 62)
+        self._cohorts = None
 
     # -- query access -------------------------------------------------------
 

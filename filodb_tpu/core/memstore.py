@@ -83,6 +83,10 @@ class TimeSeriesShard:
         # must capture arrays AND dispatch their kernels under this lock
         # (ref analog: per-shard single ingest thread + ChunkMap read locks)
         self.lock = threading.RLock()
+        # bumped whenever partitions are released (purge/eviction): lazily
+        # materialized query artifacts (LazyKeys) check it to detect slot
+        # reuse instead of silently reporting the new owner's labels
+        self.release_epoch = 0
         self._device = device
         self._dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
         self.bucket_les: np.ndarray | None = None
@@ -186,6 +190,7 @@ class TimeSeriesShard:
         neither resurrects the series nor attributes its persisted chunks to a
         later owner of the reused slot."""
         pid_list = pids.tolist()
+        self.release_epoch += 1
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
@@ -341,13 +346,18 @@ class TimeSeriesShard:
         if self.sink is None:
             return 0
         self.flush()                      # device state first
+        with self.lock:
+            pending = self._pending_chunks[group]
+            self._pending_chunks[group] = []
         # part-key events (creations + tombstones, in order) land before the
-        # chunks that reference them
+        # chunks that reference them. Order matters: the chunk snapshot is
+        # taken FIRST — every pid in it was resolved (and so logged) before
+        # its samples were staged, hence this drain necessarily covers it. A
+        # drain before the snapshot would let a concurrently-created series
+        # slip its chunks into this flush with its key still queued.
         self._flush_partkey_log()
-        pending = self._pending_chunks[group]
         if not pending:
             return 0
-        self._pending_chunks[group] = []
         pids = np.concatenate([p for p, _, _ in pending])
         ts = np.concatenate([t for _, t, _ in pending])
         vals = np.concatenate([v for _, _, v in pending])

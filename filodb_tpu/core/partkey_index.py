@@ -110,6 +110,11 @@ class PartKeyIndex:
         self._cnt: array = array("I")          # part_id -> number of labels
         self._start = _I64Vec()                # part_id -> first sample ts (ms)
         self._end = _I64Vec()                  # part_id -> last ts / MAX while live
+        # scalar aggregates for the wide-query fast path: when no entry has
+        # ever ended and max(start) <= query end, the per-entry time filter
+        # (two O(S) gathers per query) is provably a no-op
+        self._max_start = -(1 << 62)
+        self._num_ended = 0
 
     LIVE_END = np.iinfo(np.int64).max
 
@@ -137,6 +142,10 @@ class PartKeyIndex:
 
     def add_part_key(self, part_id: int, labels: dict[str, str], start_time: int,
                      end_time: int = LIVE_END) -> None:
+        if start_time > self._max_start:
+            self._max_start = start_time
+        if end_time != self.LIVE_END:
+            self._num_ended += 1
         if part_id == len(self._off):
             self._off.append(len(self._arena) // 2)
             self._cnt.append(len(labels))
@@ -164,6 +173,8 @@ class PartKeyIndex:
                 p.add(part_id)
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
+        if self._end[part_id] == self.LIVE_END and end_time != self.LIVE_END:
+            self._num_ended += 1
         self._end[part_id] = end_time
 
     def start_time(self, part_id: int) -> int:
@@ -240,7 +251,8 @@ class PartKeyIndex:
                 Equals(f.label, f.value) if isinstance(f, NotEquals) else EqualsRegex(f.label, f.pattern)
             )
             result = np.setdiff1d(result, pos, assume_unique=True)
-        if len(result):
+        if len(result) and not (self._num_ended == 0
+                                and self._max_start <= end_time):
             starts = self._start.view()[result]
             ends = self._end.view()[result]
             result = result[(starts <= end_time) & (ends >= start_time)]
@@ -269,6 +281,8 @@ class PartKeyIndex:
             self._dead_pairs += self._cnt[pid]
             self._cnt[pid] = 0
             self._start[pid] = 0
+            if self._end[pid] == self.LIVE_END:
+                self._num_ended += 1     # disables the all-live fast path
             self._end[pid] = -1          # matches no [start, end] overlap query
         for name, values in touched.items():
             for value in values:

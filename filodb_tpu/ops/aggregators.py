@@ -19,6 +19,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 BASIC_OPS = ("sum", "min", "max", "avg", "count", "stddev", "stdvar", "group")
 
@@ -78,41 +79,69 @@ def partial_aggregate(op: str, values, group_ids, num_groups: int):
     raise ValueError(f"not a basic segment op: {op}")
 
 
-def combine_partials(op: str, a: dict, b: dict) -> dict:
+def resolve_partials(parts):
+    """Normalize a partials carrier: a lazily-fetched device bundle (e.g.
+    fusedgrid.PaddedPartials) resolves to its host dict here — at present/
+    merge time, outside any shard lock."""
+    return parts.resolve() if hasattr(parts, "resolve") else parts
+
+
+def _xp_of(*dicts):
+    """numpy for host partials, jnp for device partials. Partial state is
+    tiny ([G, T]); once fetched to host, finishing in numpy avoids device
+    round-trips (material on a tunneled link). Mixed inputs resolve to host."""
+    vals = [v for d in dicts for v in d.values()]
+    if vals and all(isinstance(v, jax.Array) for v in vals):
+        return jnp
+    return np
+
+
+def combine_partials(op: str, a, b) -> dict:
     """Reduce phase across shards (host or psum path)."""
+    a, b = resolve_partials(a), resolve_partials(b)
+    xp = _xp_of(a, b)
+    if xp is not jnp:
+        a = jax.device_get(a)
+        b = jax.device_get(b)
     out = {}
     for k in a:
         if k == "min":
-            out[k] = jnp.minimum(a[k], b[k])
+            out[k] = xp.minimum(a[k], b[k])
         elif k == "max":
-            out[k] = jnp.maximum(a[k], b[k])
+            out[k] = xp.maximum(a[k], b[k])
         else:
             out[k] = a[k] + b[k]
     return out
 
 
-def present_partials(op: str, parts: dict):
+def present_partials(op: str, parts):
     """Present phase: partial state -> final [G, T] values (NaN where empty)."""
+    parts = resolve_partials(parts)
+    xp = _xp_of(parts)
     cnt = parts["count"]
     empty = cnt == 0
-    cnt = jnp.where(empty, 1.0, cnt)  # avoid 0/0 noise; result masked below
+    cnt = xp.where(empty, 1.0, cnt)  # avoid 0/0 noise; result masked below
     if op == "count":
-        return jnp.where(empty, jnp.nan, cnt)
+        return xp.where(empty, xp.nan, cnt)
     if op == "group":
-        return jnp.where(empty, jnp.nan, 1.0)
+        return xp.where(empty, xp.nan, 1.0)
     if op == "sum":
-        return jnp.where(empty, jnp.nan, parts["sum"])
+        return xp.where(empty, xp.nan, parts["sum"])
     if op == "min":
-        return jnp.where(empty, jnp.nan, parts["min"])
+        return xp.where(empty, xp.nan, parts["min"])
     if op == "max":
-        return jnp.where(empty, jnp.nan, parts["max"])
+        return xp.where(empty, xp.nan, parts["max"])
     if op == "avg":
-        return jnp.where(empty, jnp.nan, parts["sum"] / cnt)
+        return xp.where(empty, xp.nan, parts["sum"] / cnt)
     if op in ("stddev", "stdvar"):
         mean = parts["sum"] / cnt
-        var = jnp.maximum(parts["sumsq"] / cnt - mean * mean, 0.0)
-        r = var if op == "stdvar" else jnp.sqrt(var)
-        return jnp.where(empty, jnp.nan, r)
+        import contextlib
+        guard = (np.errstate(invalid="ignore", divide="ignore")
+                 if xp is not jnp else contextlib.nullcontext())
+        with guard:
+            var = xp.maximum(parts["sumsq"] / cnt - mean * mean, 0.0)
+            r = var if op == "stdvar" else xp.sqrt(var)
+        return xp.where(empty, xp.nan, r)
     raise ValueError(op)
 
 

@@ -94,14 +94,46 @@ def _pow2(n: int, floor: int = 8) -> int:
 
 def _gather_rows_padded(ts, val, n, rows: np.ndarray):
     """Gather the given array rows padded to a pow2 row count (kernel-shape
-    stability); pad rows get n = 0 (disabled). Returns (ts, val, n, P)."""
-    P = _pow2(len(rows))
+    stability). Pad rows are fully disabled: n = 0 AND timestamps forced to
+    the pad sentinel — the general kernels derive windows from timestamps, so
+    a pad row aliasing row 0's real data would otherwise produce phantom
+    (non-NaN) outputs that aggregation counts as present."""
+    from ..core.chunkstore import TS_PAD
+    M = len(rows)
+    P = _pow2(M)
     pad = np.zeros(P, np.int32)
-    pad[:len(rows)] = rows
+    pad[:M] = rows
     rid = jnp.asarray(pad)
-    n_g = jnp.where(jnp.arange(P) < len(rows), jnp.take(n, rid), 0)
-    return (jnp.take(ts, rid, axis=0), jnp.take(val, rid, axis=0),
-            n_g.astype(jnp.int32), P)
+    real = jnp.arange(P) < M
+    n_g = jnp.where(real, jnp.take(n, rid), 0)
+    ts_g = jnp.where(real[:, None], jnp.take(ts, rid, axis=0), TS_PAD)
+    return ts_g, jnp.take(val, rid, axis=0), n_g.astype(jnp.int32), P
+
+
+@dataclass
+class FusedWindowData:
+    """Lazy PeriodicSamplesMapper output on a grid-aligned f32 selection: the
+    window function has NOT run yet. AggregateMapReduce recognizes this and
+    fuses window evaluation + aggregation into one single-pass Pallas kernel
+    (ops/fusedgrid.py) — the [S, T] rate matrix never hits HBM. Any other
+    consumer materializes through the standard grid kernel first."""
+    sel: SeriesSelection
+    out_ts: np.ndarray
+    window: int
+    fn: str
+    stale_ms: int
+
+    def materialize(self) -> MatrixView:
+        from ..ops import gridfns
+        base_ts, interval_ms = self.sel.grid
+        vals = gridfns.periodic_samples_grid(
+            self.sel.val, self.sel.n, self.out_ts, self.window, self.fn,
+            base_ts, interval_ms, stale_ms=self.stale_ms)
+        minority = self.sel.grid_minority
+        if minority is not None and len(minority):
+            vals = _correct_minority_cohort(self.sel, vals, self.out_ts,
+                                            self.window, self.fn, 0.0, 0.0)
+        return MatrixView(self.out_ts, vals, self.sel.keys, self.sel.rows)
 
 
 def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1):
@@ -173,6 +205,13 @@ class PeriodicSamplesMapper(Transformer):
                 stale_ms=ctx.stale_ms)
             return MatrixView(out_ts, vals, data.keys, data.rows, data.bucket_les)
         if grid_usable and fn in gridfns.GRID_FNS:
+            from ..ops import fusedgrid
+            S, C = data.val.shape
+            if (fn in fusedgrid.FUSED_FNS and data.val.dtype == jnp.float32
+                    and fusedgrid.fusable(S, C, len(out_ts), 1)):
+                # defer: a following AggregateMapReduce can fuse the window
+                # function with the aggregation in one HBM pass
+                return FusedWindowData(data, out_ts, window, fn, ctx.stale_ms)
             base_ts, interval_ms = data.grid
             vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
                                                  fn, base_ts, interval_ms,
@@ -234,6 +273,67 @@ class ScalarOperationMapper(Transformer):
         return ResultMatrix(m.out_ts, vals, keys)
 
 
+class LazyKeys:
+    """Sequence of RangeVectorKeys materialized on first access per element.
+    Wide selections (a 1M-series sum()) must not pay a Python loop over every
+    series at the leaf — global aggregation never reads the keys at all.
+
+    Deferred materialization races partition release: an eviction/purge can
+    reuse a pid slot after the leaf snapshot, and rv_key_of would then return
+    the NEW owner's labels. The shard's release_epoch (captured under the
+    shard lock at leaf time) detects that and fails the query loudly —
+    a retry is correct; silently mislabeled series are not."""
+
+    def __init__(self, shard, pids):
+        self._shard = shard
+        self._pids = pids
+        self._epoch = shard.release_epoch
+
+    def _check(self):
+        if self._shard.release_epoch != self._epoch:
+            raise QueryError("selection invalidated by concurrent partition "
+                             "release (eviction/purge); retry the query")
+
+    def __len__(self):
+        return len(self._pids)
+
+    def __getitem__(self, i):
+        with self._shard.lock:   # label arena mutates during release
+            self._check()
+            if isinstance(i, slice):
+                return [self._shard.rv_key_of(int(p)) for p in self._pids[i]]
+            return self._shard.rv_key_of(int(self._pids[i]))
+
+    def __iter__(self):
+        with self._shard.lock:
+            self._check()
+            keys = [self._shard.rv_key_of(int(p)) for p in self._pids]
+        return iter(keys)
+
+
+def _group_ids_for(keys, rows, R, by, without):
+    """Dense per-array-row group ids for aggregation: (gids [R], group key
+    list, G). Rows outside the selection keep group 0 — harmless, their
+    values are all-NaN / zero-count."""
+    if len(keys) and not by and not without:
+        # global aggregation: one group, keys never materialized
+        return np.zeros(R, np.int32), [RangeVectorKey(())], 1
+    gkeys = group_keys_of(keys, by, without)
+    uniq: dict[RangeVectorKey, int] = {}
+    gid_of_key = np.empty(len(gkeys), np.int32)
+    for i, gk in enumerate(gkeys):
+        gid_of_key[i] = uniq.setdefault(gk, len(uniq))
+    G = max(len(uniq), 1)
+    if not gkeys:
+        gids = np.zeros(R, np.int32)
+    elif rows is None:
+        gids = gid_of_key
+    else:
+        gids = np.zeros(R, np.int32)
+        gids[rows] = gid_of_key
+    return gids, list(uniq), G
+
+
 def group_keys_of(keys, by, without):
     """Aggregation group key per series (metric label always dropped —
     Prometheus aggregation semantics; ref AggrOverRangeVectors map phase)."""
@@ -262,30 +362,20 @@ class AggregateMapReduce(Transformer):
             # order-statistics aggregators reduce on full matrices at the
             # reduce node (exact; candidate pruning is a later optimization)
             return _as_matrix(data)
+        if isinstance(data, FusedWindowData):
+            from ..ops import fusedgrid
+            if self.operator in fusedgrid.FUSED_OPS:
+                fused = self._apply_fused(data, ctx)
+                if fused is not None:
+                    return fused
+            data = data.materialize()
         if isinstance(data, MatrixView):
             m = data
         else:
             mm = _as_matrix(data)
             m = MatrixView(mm.out_ts, mm.values, mm.keys, None, mm.bucket_les)
-        gkeys = group_keys_of(m.keys, self.by, self.without)
-        uniq: dict[RangeVectorKey, int] = {}
-        gid_of_key = np.empty(len(gkeys), np.int32)
-        for i, gk in enumerate(gkeys):
-            gid_of_key[i] = uniq.setdefault(gk, len(uniq))
-        G = max(len(uniq), 1)
-        R = m.values.shape[0]
-        if not gkeys:
-            # empty selection on this shard: the leaf still carries padded
-            # all-NaN rows; map them all to group 0 (counts are 0, and with no
-            # group keys the merge never reads this shard's groups)
-            gids = np.zeros(R, np.int32)
-        elif m.rows is None:
-            gids = gid_of_key
-        else:
-            # un-compacted matrix: scatter group ids to store rows; rows outside
-            # the selection keep group 0 — harmless, their values are all-NaN
-            gids = np.zeros(R, np.int32)
-            gids[m.rows] = gid_of_key
+        gids, uniq, G = _group_ids_for(m.keys, m.rows, m.values.shape[0],
+                                       self.by, self.without)
         vals = m.values
         les = m.bucket_les
         if les is not None:
@@ -295,6 +385,46 @@ class AggregateMapReduce(Transformer):
             vals = vals.reshape(R_, T_ * B_)   # bucket-wise reduce (hSum)
         parts = _segment_partial(self.operator, vals, jnp.asarray(gids), _pow2(G))
         return AggPartial(self.operator, m.out_ts, parts, list(uniq), G, les)
+
+    def _apply_fused(self, data: FusedWindowData, ctx) -> "AggPartial | None":
+        """Single-pass window + aggregation (ops/fusedgrid.py): partial state
+        comes straight off the streaming kernel; churned minority-cohort rows
+        are excluded there (n forced to 0) and folded in via the general path.
+        Returns None when the group count exceeds the kernel's VMEM cap — the
+        caller falls back to the two-step path (segment_sum handles large G)."""
+        from ..ops import fusedgrid
+        sel = data.sel
+        R = sel.val.shape[0]
+        gids, uniq, G = _group_ids_for(sel.keys, sel.rows, R, self.by, self.without)
+        Gp = _pow2(G)
+        if Gp > fusedgrid.MAX_GROUPS:
+            return None
+        base_ts, interval_ms = sel.grid
+        n_eff = sel.n
+        minority = sel.grid_minority
+        has_minority = minority is not None and len(minority)
+        if has_minority:
+            n_eff = n_eff.at[jnp.asarray(np.asarray(minority))].set(0)
+        if G == 1 and not self.by and not self.without:
+            gids_dev = fusedgrid.zero_gids(R)   # cached: no per-query upload
+        else:
+            gids_dev = jnp.asarray(gids)
+        # fetch=False: the leaf holds the shard lock through this dispatch —
+        # the blocking host fetch happens at present/merge time, outside it
+        parts = fusedgrid.fused_grid_aggregate(
+            self.operator, data.fn, sel.val, n_eff, gids_dev, Gp,
+            data.out_ts, data.window, base_ts, interval_ms, fetch=False)
+        if has_minority:
+            rows = np.asarray(minority, np.int32)
+            sub_ts, sub_val, sub_n, P = _gather_rows_padded(sel.ts, sel.val,
+                                                            sel.n, rows)
+            corr = rangefns.periodic_samples(sub_ts, sub_val, sub_n,
+                                             data.out_ts, data.window, data.fn)
+            mgids = np.zeros(P, np.int32)
+            mgids[:len(rows)] = gids[rows]
+            mparts = _segment_partial(self.operator, corr, jnp.asarray(mgids), Gp)
+            parts = aggregators.combine_partials(self.operator, parts, mparts)
+        return AggPartial(self.operator, data.out_ts, parts, list(uniq), G, None)
 
 
 @dataclass
@@ -436,6 +566,8 @@ def _go_to_py_template(s: str) -> str:
 def _as_matrix(data) -> ResultMatrix:
     if isinstance(data, ResultMatrix):
         return data
+    if isinstance(data, FusedWindowData):
+        return data.materialize().compact()
     if isinstance(data, MatrixView):
         return data.compact()
     if isinstance(data, AggPartial):
@@ -486,7 +618,12 @@ class SelectRawPartitionsExec(ExecPlan):
         # store buffers (see TimeSeriesShard.lock)
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
         with shard.lock:
-            return super().execute(ctx)
+            result = super().execute(ctx)
+            if isinstance(result, FusedWindowData):
+                # a lazy window view must not escape the lock: its kernel
+                # dispatch would race a concurrent ingest flush's donation
+                result = result.materialize()
+            return result
 
     def do_execute(self, ctx) -> SeriesSelection:
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
@@ -495,7 +632,12 @@ class SelectRawPartitionsExec(ExecPlan):
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
                                    jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
-        keys = [shard.rv_key_of(int(p)) for p in pids]
+        if len(pids) > GATHER_THRESHOLD:
+            # wide selection: defer key materialization (global aggregates
+            # never read them; per-series outputs pay the cost on iteration)
+            keys = LazyKeys(shard, pids)
+        else:
+            keys = [shard.rv_key_of(int(p)) for p in pids]
         store = shard.store
         les = getattr(shard, "bucket_les", None)
         # on-demand paging: query reaches behind resident data -> merge cold
@@ -520,19 +662,24 @@ class SelectRawPartitionsExec(ExecPlan):
         minority_sel = None
         if grid is not None:
             base, iv = grid
-            goff = store.grid_offsets(pids)
-            live = store.n_host[pids] > 0
-            if live.any():
-                u, cnts = np.unique(goff[live], return_counts=True)
-                o_maj = int(u[np.argmax(cnts)])
-                mins = live & (goff != o_maj)
-                m = int(mins.sum())
-                if m > 0.25 * int(live.sum()):
-                    grid = None
-                else:
-                    grid = (base + o_maj * iv, iv)
-                    if m:
-                        minority_sel = mins
+            kind, coh = store.grid_cohorts()
+            if kind == "uniform":     # one scrape cohort — zero per-query work
+                grid = (base + coh * iv, iv)
+            else:
+                goff = coh[pids]
+                live = store.n_host[pids] > 0
+                if live.any():
+                    lv = goff[live]
+                    u, cnts = np.unique(lv, return_counts=True)
+                    o_maj = int(u[np.argmax(cnts)])
+                    mins = live & (goff != o_maj)
+                    m = int(mins.sum())
+                    if m > 0.25 * int(live.sum()):
+                        grid = None
+                    else:
+                        grid = (base + o_maj * iv, iv)
+                        if m:
+                            minority_sel = mins
         if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
             # narrow selection: gather rows once, padded to a power of two
             sel_ts, sel_val, sel_n, P = _gather_rows_padded(ts, val, n, pids)
@@ -614,7 +761,7 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
     for p in partials:
         # scatter this shard's groups into the global group space
         idx = np.array([all_keys[k] for k in p.group_keys], np.int32)
-        for name, arr in p.parts.items():
+        for name, arr in aggregators.resolve_partials(p.parts).items():
             arr = np.asarray(arr)[: p.num_groups]
             if name == "min":
                 base = np.full((Gpad, T), np.inf)

@@ -132,6 +132,49 @@ def test_engine_uses_grid_path_same_results():
     np.testing.assert_allclose(v1, v2, rtol=1e-12)
 
 
+def test_fused_aggregate_matches_general_paths():
+    """sum/avg/count(rate|increase|delta) by(grp) on an f32 grid store with a
+    churned cohort: the single-pass fused kernel (PSM+AggregateMapReduce) must
+    match the forced general path within f32 tolerance."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    shard = ms.setup("prometheus", GAUGE, 0, cfg)
+    rng = np.random.default_rng(11)
+    b = RecordBuilder(GAUGE)
+    counters = np.cumsum(rng.exponential(5, (6, 50)), axis=1)
+    for t in range(50):
+        for s in range(6):
+            if s == 5 and t < 15:
+                continue   # churned series joins late
+            b.add({"_metric_": "m", "host": f"h{s}", "grp": f"g{s % 2}"},
+                  BASE + t * IV, float(counters[s, t]))
+    shard.ingest(b.build())
+    shard.flush()
+    assert shard.store.grid_info() is not None
+    eng = QueryEngine(ms, "prometheus")
+    for q in ("sum(rate(m[2m]))", "sum by (grp) (rate(m[2m]))",
+              "avg by (grp) (increase(m[2m]))", "count(delta(m[2m]))",
+              "stddev by (grp) (rate(m[2m]))"):
+        r1 = eng.query_range(q, BASE + 250_000, BASE + 480_000, 30_000)
+        shard.store.grid_ok = False
+        r2 = eng.query_range(q, BASE + 250_000, BASE + 480_000, 30_000)
+        shard.store.grid_ok = True
+        s1 = {k.as_dict().get("grp", ""): np.asarray(v)
+              for k, _, v in r1.matrix.iter_series()}
+        s2 = {k.as_dict().get("grp", ""): np.asarray(v)
+              for k, _, v in r2.matrix.iter_series()}
+        assert set(s1) == set(s2), q
+        for g in s1:
+            np.testing.assert_allclose(s1[g], s2[g], rtol=2e-4, atol=1e-3,
+                                       equal_nan=True, err_msg=f"{q} grp={g}")
+
+
 def _series_by_host(result):
     return {k.as_dict()["host"]: np.asarray(v)
             for k, _, v in result.matrix.iter_series()}
