@@ -20,22 +20,44 @@ read of the [S, C] f32 value store. A direct-kernel measurement and a pure
 HBM-streaming probe (the roofline on this chip/link) are reported alongside so
 engine overhead and day-to-day tunnel bandwidth variance are visible.
 
-Baseline: the reference publishes no absolute numbers (BASELINE.md). We use a
-conservative JVM estimate derived from the workload definition: the chunked
-ChunkedRateFunction path touches every (series, window) at an optimistic 100M
-window-evaluations/sec on the JVM => 1M series x 48 steps ~= 0.5s per query.
-vs_baseline = estimated_jvm_ms / measured_ms.
+Baseline: the reference publishes no absolute numbers and this image has no
+JVM (BASELINE.md "Methodology"), so the baseline is MEASURED at bench time:
+scripts/baseline_proxy.cpp, a tuned C++ implementation of the reference's
+ChunkedRateFunction algorithm on this host, deliberately more favorable than
+the JVM path (no chunk decompression, O(1) precomputed window edges, no
+iterator/boxing overhead). vs_baseline = measured_proxy_ms / measured_ms.
+If the proxy cannot be built, falls back to the documented 480ms estimate.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-JVM_BASELINE_MS = 480.0  # see docstring: 1M series x 48 steps @ 100M evals/s
+JVM_BASELINE_EST_MS = 480.0  # fallback estimate: 1M series x 48 steps @ 100M evals/s
+
+
+def measure_baseline_proxy():
+    """Compile + run the C++ chunked-path proxy; (p50_ms, how)."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "scripts", "baseline_proxy.cpp")
+    exe = "/tmp/filodb_baseline_proxy"
+    try:
+        subprocess.run(["g++", "-O3", "-march=native", "-funroll-loops",
+                        "-o", exe, src], check=True, capture_output=True,
+                       timeout=120)
+        out = subprocess.run([exe], check=True, capture_output=True,
+                             timeout=600).stdout
+        return float(json.loads(out)["proxy_p50_ms"]), "measured_cpp_proxy"
+    except Exception as e:  # no toolchain on this host: documented estimate
+        print(f"baseline proxy unavailable ({e}); using estimate",
+              file=sys.stderr)
+        return JVM_BASELINE_EST_MS, "estimate_100M_evals_per_sec"
 
 NUM_SERIES = 1 << 20       # 1,048,576
 NUM_SAMPLES = 720          # 2h @ 10s
@@ -193,12 +215,13 @@ def main():
     kp50 = float(np.percentile(klat, 50))
 
     roofline_ms = stream_probe(shard.store.val)
+    baseline_ms, baseline_how = measure_baseline_proxy()
 
     result = {
         "metric": "promql_sum_rate_5m_p50_latency_1M_series",
         "value": round(p50, 2),
         "unit": "ms",
-        "vs_baseline": round(JVM_BASELINE_MS / p50, 2),
+        "vs_baseline": round(baseline_ms / p50, 2),
         "detail": {
             "series": NUM_SERIES,
             "samples_per_series": NUM_SAMPLES,
@@ -208,6 +231,8 @@ def main():
             "direct_kernel_p50_ms": round(kp50, 2),
             "engine_overhead_pct": round((p50 / kp50 - 1) * 100, 1),
             "hbm_stream_roofline_ms": round(roofline_ms, 2),
+            "baseline_p50_ms": round(baseline_ms, 2),
+            "baseline_method": baseline_how,
             "setup_register_1M_series_s": round(reg_s, 1),
             "device": str(dev),
             "latencies_ms": [round(x, 1) for x in lat],

@@ -83,10 +83,10 @@ class TimeSeriesShard:
         # must capture arrays AND dispatch their kernels under this lock
         # (ref analog: per-shard single ingest thread + ChunkMap read locks)
         self.lock = threading.RLock()
-        # bumped whenever partitions are released (purge/eviction): lazily
-        # materialized query artifacts (LazyKeys) check it to detect slot
-        # reuse instead of silently reporting the new owner's labels
-        self.release_epoch = 0
+        # per-slot release counters (purge/eviction): lazily materialized
+        # query artifacts (LazyKeys) snapshot the epochs of THEIR pids and
+        # detect slot reuse without being invalidated by unrelated releases
+        self.slot_epoch = np.zeros(config.max_series_per_shard, np.uint32)
         self._device = device
         self._dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
         self.bucket_les: np.ndarray | None = None
@@ -190,7 +190,7 @@ class TimeSeriesShard:
         neither resurrects the series nor attributes its persisted chunks to a
         later owner of the reused slot."""
         pid_list = pids.tolist()
-        self.release_epoch += 1
+        self.slot_epoch[pids] += 1
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
@@ -349,41 +349,71 @@ class TimeSeriesShard:
         with self.lock:
             pending = self._pending_chunks[group]
             self._pending_chunks[group] = []
-        # part-key events (creations + tombstones, in order) land before the
-        # chunks that reference them. Order matters: the chunk snapshot is
-        # taken FIRST — every pid in it was resolved (and so logged) before
-        # its samples were staged, hence this drain necessarily covers it. A
-        # drain before the snapshot would let a concurrently-created series
-        # slip its chunks into this flush with its key still queued.
-        self._flush_partkey_log()
-        if not pending:
-            return 0
-        pids = np.concatenate([p for p, _, _ in pending])
-        ts = np.concatenate([t for _, t, _ in pending])
-        vals = np.concatenate([v for _, _, v in pending])
-        order = np.argsort(pids, kind="stable")
-        pids, ts, vals = pids[order], ts[order], vals[order]
-        bounds = np.concatenate([[0], np.nonzero(np.diff(pids))[0] + 1, [len(pids)]])
-        records = [
-            ChunkSetRecord(int(pids[bounds[i]]), ts[bounds[i]:bounds[i + 1]],
-                           vals[bounds[i]:bounds[i + 1]])
-            for i in range(len(bounds) - 1)
-        ]
-        if self.downsample is not None and vals.ndim == 1:
-            from .downsample import downsample_records
-            res_ms, publish = self.downsample
-            publish(self, downsample_records(pids, ts, vals, res_ms))
-        if self.bucket_les is not None and not self._meta_written:
-            if hasattr(self.sink, "write_meta"):
-                self.sink.write_meta(self.dataset, self.shard_num,
-                                     {"bucket_les": list(map(float, self.bucket_les))})
-            self._meta_written = True
-        self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
+            # per-sample-batch slot epochs: if the persist below fails and a
+            # release ran meanwhile, the requeue scrubs exactly the released
+            # (possibly reused) slots' samples
+            pend_epochs = [self.slot_epoch[p].copy() for (p, _, _) in pending]
+        try:
+            # part-key events (creations + tombstones, in order) land before
+            # the chunks that reference them. Order matters: the chunk
+            # snapshot is taken FIRST — every pid in it was resolved (and so
+            # logged) before its samples were staged, hence this drain
+            # necessarily covers it. A drain before the snapshot would let a
+            # concurrently-created series slip its chunks into this flush
+            # with its key still queued.
+            self._flush_partkey_log()
+            if not pending:
+                return 0
+            pids = np.concatenate([p for p, _, _ in pending])
+            ts = np.concatenate([t for _, t, _ in pending])
+            vals = np.concatenate([v for _, _, v in pending])
+            order = np.argsort(pids, kind="stable")
+            pids, ts, vals = pids[order], ts[order], vals[order]
+            bounds = np.concatenate([[0], np.nonzero(np.diff(pids))[0] + 1,
+                                     [len(pids)]])
+            records = [
+                ChunkSetRecord(int(pids[bounds[i]]), ts[bounds[i]:bounds[i + 1]],
+                               vals[bounds[i]:bounds[i + 1]])
+                for i in range(len(bounds) - 1)
+            ]
+            if self.downsample is not None and vals.ndim == 1:
+                from .downsample import downsample_records
+                res_ms, publish = self.downsample
+                publish(self, downsample_records(pids, ts, vals, res_ms))
+            if self.bucket_les is not None and not self._meta_written:
+                if hasattr(self.sink, "write_meta"):
+                    self.sink.write_meta(self.dataset, self.shard_num,
+                                         {"bucket_les": list(map(float, self.bucket_les))})
+                self._meta_written = True
+            self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
+        except Exception:
+            # transient sink failure must not lose the snapshot: requeue it
+            # for the next flush attempt (recovery replay dedupes any rows a
+            # partially-completed write already persisted)
+            self._requeue_pending(group, pending, pend_epochs)
+            raise
         off = int(self._pending_group_offset[group])
         if off >= 0:
+            # a checkpoint failure does NOT requeue: the chunks are durable,
+            # the watermark merely lags and recommits on the next flush
             self.sink.write_checkpoint(self.dataset, self.shard_num, group, off)
             self.group_watermarks[group] = off
         return len(records)
+
+    def _requeue_pending(self, group, pending, pend_epochs) -> None:
+        """Return a failed flush's chunk snapshot to the pending queue (at the
+        front, preserving order), scrubbing samples whose partition was
+        released while the snapshot was outside ``_pending_chunks`` — the
+        release-time scrub could not see them there."""
+        with self.lock:
+            kept = []
+            for (pids_, ts_, vals_), eps in zip(pending, pend_epochs):
+                m = self.slot_epoch[pids_] == eps
+                if m.all():
+                    kept.append((pids_, ts_, vals_))
+                elif m.any():
+                    kept.append((pids_[m], ts_[m], vals_[m]))
+            self._pending_chunks[group] = kept + self._pending_chunks[group]
 
     def flush_all_groups(self) -> None:
         for g in range(self.config.groups_per_shard):

@@ -144,6 +144,8 @@ class PartKeyIndex:
                      end_time: int = LIVE_END) -> None:
         if start_time > self._max_start:
             self._max_start = start_time
+        if part_id < len(self._off) and self._end[part_id] != self.LIVE_END:
+            self._num_ended -= 1   # slot reuse: its tombstone leaves the count
         if end_time != self.LIVE_END:
             self._num_ended += 1
         if part_id == len(self._off):
@@ -173,8 +175,9 @@ class PartKeyIndex:
                 p.add(part_id)
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
-        if self._end[part_id] == self.LIVE_END and end_time != self.LIVE_END:
-            self._num_ended += 1
+        was_live = self._end[part_id] == self.LIVE_END
+        if was_live != (end_time == self.LIVE_END):
+            self._num_ended += 1 if was_live else -1
         self._end[part_id] = end_time
 
     def start_time(self, part_id: int) -> int:

@@ -280,17 +280,18 @@ class LazyKeys:
 
     Deferred materialization races partition release: an eviction/purge can
     reuse a pid slot after the leaf snapshot, and rv_key_of would then return
-    the NEW owner's labels. The shard's release_epoch (captured under the
-    shard lock at leaf time) detects that and fails the query loudly —
-    a retry is correct; silently mislabeled series are not."""
+    the NEW owner's labels. Per-slot release epochs (captured under the shard
+    lock at leaf time) detect that for exactly the selected pids and fail the
+    query loudly — a retry is correct; silently mislabeled series are not.
+    Releases of unrelated partitions do not invalidate the selection."""
 
     def __init__(self, shard, pids):
         self._shard = shard
         self._pids = pids
-        self._epoch = shard.release_epoch
+        self._epochs = shard.slot_epoch[pids].copy()
 
     def _check(self):
-        if self._shard.release_epoch != self._epoch:
+        if (self._shard.slot_epoch[self._pids] != self._epochs).any():
             raise QueryError("selection invalidated by concurrent partition "
                              "release (eviction/purge); retry the query")
 

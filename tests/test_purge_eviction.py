@@ -211,6 +211,37 @@ def test_eviction_scrubs_pending_sink_chunks(tmp_path):
     assert vals.tolist() == [5.0, 6.0]
 
 
+def test_flush_group_requeues_on_sink_failure(tmp_path):
+    """A transient sink failure during flush_group must not lose the chunk
+    snapshot: it is requeued and the next flush persists it."""
+    ms = TimeSeriesMemStore()
+    config = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                         flush_batch_size=10**9, groups_per_shard=1)
+    sink = FileColumnStore(str(tmp_path))
+    shard = ms.setup("prometheus", GAUGE, 0, config, sink=sink)
+    _ingest(shard, ["a", "b"], BASE)
+    boom = {"n": 0}
+    orig = sink.write_chunkset
+
+    def flaky(*args, **kw):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise OSError("sink down")
+        return orig(*args, **kw)
+
+    sink.write_chunkset = flaky
+    import pytest
+    with pytest.raises(OSError):
+        shard.flush_group(0)
+    assert shard.flush_group(0) > 0   # retry persists the requeued snapshot
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("prometheus", GAUGE, 0, config,
+                       sink=FileColumnStore(str(tmp_path)))
+    shard2.recover()
+    ts, vals = shard2.store.series_snapshot(0)
+    assert len(ts) == 5 and vals.tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
 def test_eviction_policies():
     cfg = StoreConfig(samples_per_series=100)
 
