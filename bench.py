@@ -46,7 +46,7 @@ def measure_baseline_proxy():
     """Compile + run the C++ chunked-path proxy; (p50_ms, how)."""
     src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "scripts", "baseline_proxy.cpp")
-    exe = "/tmp/filodb_baseline_proxy"
+    exe = f"/tmp/filodb_baseline_proxy.{os.getpid()}"   # concurrent-run safe
     try:
         subprocess.run(["g++", "-O3", "-march=native", "-funroll-loops",
                         "-o", exe, src], check=True, capture_output=True,

@@ -81,6 +81,32 @@ def grid_downsample(val, n, base_ts: int, interval_ms: int, resolution_ms: int,
     return blocks
 
 
+def _group_by_series_bucket(pids, ts, vals, resolution_ms: int):
+    """Shared (series, time-bucket) grouping: time-sorted values per group,
+    dense group index, and each group's pid + bucket-end timestamp."""
+    bucket = ts // resolution_ms
+    order = np.lexsort((ts, bucket, pids))
+    p, b, v = pids[order], bucket[order], vals[order]
+    newgrp = np.concatenate([[True], (p[1:] != p[:-1]) | (b[1:] != b[:-1])])
+    gidx = np.cumsum(newgrp) - 1
+    out_pids = p[newgrp]
+    out_ts = (b[newgrp] + 1) * resolution_ms - 1    # bucket-end timestamp
+    return v, gidx, int(gidx[-1] + 1), out_pids, out_ts
+
+
+def downsample_records_hist(pids, ts, vals, resolution_ms: int) -> dict[str, tuple]:
+    """Histogram flavor: vals [N, B] cumulative bucket counts -> per-(series,
+    time-bucket) per-bucket sums (ref: HistSumDownsampler ``hSum``,
+    ChunkDownsampler.scala:26,136 — histReader.sum over the bucket's rows)."""
+    if len(pids) == 0:
+        return {}
+    v, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+        pids, ts, vals, resolution_ms)
+    sums = np.zeros((ngroups, v.shape[1]))
+    np.add.at(sums, gidx, v)
+    return {"hSum": (out_pids, out_ts, sums)}
+
+
 def downsample_records(pids, ts, vals, resolution_ms: int,
                        aggs=DOWNSAMPLERS) -> dict[str, tuple]:
     """Host-side inline downsampling of one flush group's raw samples (ref:
@@ -89,15 +115,8 @@ def downsample_records(pids, ts, vals, resolution_ms: int,
     keyed on (series, bucket)."""
     if len(pids) == 0:
         return {}
-    bucket = ts // resolution_ms
-    # group key (series, bucket)
-    order = np.lexsort((ts, bucket, pids))
-    p, b, t, v = pids[order], bucket[order], ts[order], vals[order]
-    newgrp = np.concatenate([[True], (p[1:] != p[:-1]) | (b[1:] != b[:-1])])
-    gidx = np.cumsum(newgrp) - 1
-    ngroups = gidx[-1] + 1
-    out_pids = p[newgrp]
-    out_ts = (b[newgrp] + 1) * resolution_ms - 1    # bucket-end timestamp
+    v, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+        pids, ts, vals, resolution_ms)
     res: dict[str, tuple] = {}
     sums = np.bincount(gidx, weights=v, minlength=ngroups)
     cnts = np.bincount(gidx, minlength=ngroups).astype(np.float64)

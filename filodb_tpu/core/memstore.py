@@ -233,10 +233,18 @@ class TimeSeriesShard:
         with self._sink_lock:
             with self.lock:
                 log, self._partkey_log = self._partkey_log, []
-            if log:
+            if not log:
+                return
+            try:
                 self.sink.write_part_keys(
                     self.dataset, self.shard_num,
                     [(int(pid), labels, int(start)) for pid, labels, start in log])
+            except Exception:
+                # transient sink failure: the events must survive for retry —
+                # prepend (they predate anything queued meanwhile)
+                with self.lock:
+                    self._partkey_log = log + self._partkey_log
+                raise
 
     # -- ingest -------------------------------------------------------------
 
@@ -376,10 +384,6 @@ class TimeSeriesShard:
                                vals[bounds[i]:bounds[i + 1]])
                 for i in range(len(bounds) - 1)
             ]
-            if self.downsample is not None and vals.ndim == 1:
-                from .downsample import downsample_records
-                res_ms, publish = self.downsample
-                publish(self, downsample_records(pids, ts, vals, res_ms))
             if self.bucket_les is not None and not self._meta_written:
                 if hasattr(self.sink, "write_meta"):
                     self.sink.write_meta(self.dataset, self.shard_num,
@@ -388,10 +392,18 @@ class TimeSeriesShard:
             self.sink.write_chunkset(self.dataset, self.shard_num, group, records)
         except Exception:
             # transient sink failure must not lose the snapshot: requeue it
-            # for the next flush attempt (recovery replay dedupes any rows a
-            # partially-completed write already persisted)
+            # for the next flush attempt. A fully-written duplicate frame from
+            # a partially-completed attempt is deduped at recovery replay by
+            # the store's out-of-order drop; a torn tail frame is skipped by
+            # the sink reader (WAL semantics).
             self._requeue_pending(group, pending, pend_epochs)
             raise
+        # inline downsample publishes only after the chunks are durably
+        # written: a requeued retry must not double-publish the same buckets
+        if self.downsample is not None and vals.ndim == 1:
+            from .downsample import downsample_records
+            res_ms, publish = self.downsample
+            publish(self, downsample_records(pids, ts, vals, res_ms))
         off = int(self._pending_group_offset[group])
         if off >= 0:
             # a checkpoint failure does NOT requeue: the chunks are durable,

@@ -110,10 +110,12 @@ class FileColumnStore(ChunkSink):
             frames.append(struct.pack("<IIIII", r.part_id, len(r.ts), nb,
                                       len(ts_enc), len(val_enc)) + ts_enc + val_enc)
         payload = b"".join(frames)
+        # one buffered append minimizes the torn-frame window; the reader
+        # treats a torn tail as truncation (WAL semantics)
+        buf = (_CHUNK_HDR.pack(group, len(records), 0)
+               + struct.pack("<I", len(payload)) + payload)
         with open(os.path.join(self._dir(dataset, shard), "chunks.log"), "ab") as f:
-            f.write(_CHUNK_HDR.pack(group, len(records), 0))
-            f.write(struct.pack("<I", len(payload)))
-            f.write(payload)
+            f.write(buf)
 
     def read_chunksets(self, dataset, shard, start_ms: int = 0,
                        end_ms: int = 1 << 62):
@@ -127,22 +129,32 @@ class FileColumnStore(ChunkSink):
                 hdr = f.read(_CHUNK_HDR.size)
                 if len(hdr) < _CHUNK_HDR.size:
                     return
-                group, n_rec, _ = _CHUNK_HDR.unpack(hdr)
-                (plen,) = struct.unpack("<I", f.read(4))
-                payload = f.read(plen)
-                records = []
-                off = 0
-                for _ in range(n_rec):
-                    pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII", payload, off)
-                    off += 20
-                    ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
-                    if nb:
-                        vals = histcodec.decode_hist_series(payload[off:off + vlen]).astype(np.float64)
-                    else:
-                        vals = _unpack_doubles(payload[off:off + vlen], n)
-                    off += vlen
-                    if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
-                        records.append(ChunkSetRecord(pid, ts, vals))
+                try:
+                    group, n_rec, _ = _CHUNK_HDR.unpack(hdr)
+                    raw_len = f.read(4)
+                    if len(raw_len) < 4:
+                        return        # torn tail: a crashed append; truncate
+                    (plen,) = struct.unpack("<I", raw_len)
+                    payload = f.read(plen)
+                    if len(payload) < plen:
+                        return        # torn tail
+                    records = []
+                    off = 0
+                    for _ in range(n_rec):
+                        pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII",
+                                                                    payload, off)
+                        off += 20
+                        ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
+                        if nb:
+                            vals = histcodec.decode_hist_series(
+                                payload[off:off + vlen]).astype(np.float64)
+                        else:
+                            vals = _unpack_doubles(payload[off:off + vlen], n)
+                        off += vlen
+                        if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
+                            records.append(ChunkSetRecord(pid, ts, vals))
+                except (struct.error, ValueError, IndexError):
+                    return            # corrupt tail frame: stop at last good one
                 if records:
                     yield group, records
 
@@ -161,9 +173,13 @@ class FileColumnStore(ChunkSink):
             return
         with open(path) as f:
             for line in f:
-                if line.strip():
+                if not line.strip():
+                    continue
+                try:
                     e = json.loads(line)
-                    yield e["id"], e["labels"], e["start"]
+                except ValueError:
+                    return            # torn tail line from a crashed append
+                yield e["id"], e["labels"], e["start"]
 
     def write_meta(self, dataset, shard, meta: dict):
         path = os.path.join(self._dir(dataset, shard), "meta.json")

@@ -17,7 +17,8 @@ from collections import defaultdict
 
 import numpy as np
 
-from ..core.downsample import DOWNSAMPLERS, downsample_records
+from ..core.downsample import (DOWNSAMPLERS, downsample_records,
+                               downsample_records_hist)
 from ..core.store import ChunkSetRecord, FileColumnStore
 
 
@@ -39,9 +40,13 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
                            for p in per_series_ts])
     ts = np.concatenate([t for p in per_series_ts for t in per_series_ts[p]])
     vals = np.concatenate([v for p in per_series_val for v in per_series_val[p]])
-    if vals.ndim > 1:
-        raise NotImplementedError("histogram batch downsampling lands in a later round")
-    dsrec = downsample_records(pids, ts, vals, resolution_ms, aggs)
+    if vals.ndim == 2:
+        # native histogram dataset: hSum downsampling (per-bucket sums)
+        dsrec = downsample_records_hist(pids, ts, vals, resolution_ms)
+        meta = store.read_meta(dataset, shard) if hasattr(store, "read_meta") else {}
+    else:
+        dsrec = downsample_records(pids, ts, vals, resolution_ms, aggs)
+        meta = None
     written = {}
     for agg, (opids, ots, ovals) in dsrec.items():
         ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
@@ -57,26 +62,33 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
         entries = list(store.read_part_keys(dataset, shard) or ())
         if entries:
             store.write_part_keys(ds_name, shard, entries)
+        if meta and hasattr(store, "write_meta"):
+            store.write_meta(ds_name, shard, meta)   # bucket scheme rides along
         written[agg] = len(recs)
     return written
 
 
 def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
                      resolution_ms: int, agg: str, memstore, config=None):
-    """Load a batch-downsampled dataset into a memstore for querying."""
+    """Load a batch-downsampled dataset into a memstore for querying
+    (histogram datasets rebuild with their bucket scheme from the meta)."""
     from ..core.memstore import StoreConfig
-    from ..core.schemas import GAUGE
+    from ..core.record import RecordBuilder
+    from ..core.schemas import GAUGE, PROM_HISTOGRAM
     ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
-    shard_obj = memstore.setup(ds_name, GAUGE, shard, config or StoreConfig())
+    meta = store.read_meta(ds_name, shard) if hasattr(store, "read_meta") else {}
+    les = np.asarray(meta["bucket_les"]) if meta.get("bucket_les") else None
+    schema = PROM_HISTOGRAM if les is not None else GAUGE
+    shard_obj = memstore.setup(ds_name, schema, shard, config or StoreConfig())
     labels_by_pid = {pid: labels for pid, labels, _ in
                      (store.read_part_keys(ds_name, shard) or ())}
     for _g, records in store.read_chunksets(ds_name, shard) or ():
         for r in records:
-            from ..core.record import RecordBuilder
-            b = RecordBuilder(GAUGE)
+            b = RecordBuilder(schema, bucket_les=les)
             labels = labels_by_pid.get(r.part_id, {"_metric_": "unknown"})
-            for t, v in zip(r.ts, r.values):
-                b.add(labels, int(t), float(v))
+            for t, v in zip(r.ts, np.asarray(r.values)):
+                b.add(labels, int(t),
+                      v.astype(np.float64) if les is not None else float(v))
             shard_obj.ingest(b.build())
     shard_obj.flush()
     return shard_obj

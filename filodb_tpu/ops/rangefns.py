@@ -241,6 +241,32 @@ def _kernel(fn: str, w_cap: int, acc_name: str):
     return jax.jit(functools.partial(_periodic, fn, w_cap=w_cap, acc=acc))
 
 
+HIST_FNS = {"rate", "increase", "delta", "sum_over_time", "last_sample",
+            "last_over_time"}
+
+
+def periodic_samples_hist(ts, val, n, out_ts, window_ms, fn: str,
+                          arg0: float = 0.0, w_cap: int = 256,
+                          accum: str = "float64"):
+    """General (off-grid) histogram range functions: val [S, C, B] cumulative
+    bucket counts -> [S, T, B], any timestamp layout.
+
+    Buckets share their series' timestamps, so the scalar kernel is vmapped
+    over the bucket axis — the searchsorted window edges depend only on the
+    (unbatched) timestamps and are computed once, while per-bucket counter
+    correction and extrapolation batch across B (ref: HistogramVector read
+    through chunked range functions, RateFunctions.scala applied per bucket).
+    """
+    assert fn in HIST_FNS, f"{fn} not supported on histograms"
+    k = _kernel(fn, w_cap, accum)
+
+    def one_bucket(vb):
+        return k(ts, vb, n, jnp.asarray(out_ts), jnp.int64(window_ms),
+                 jnp.float64(arg0), jnp.float64(0.0))
+
+    return jnp.moveaxis(jax.vmap(one_bucket, in_axes=2)(val), 0, 2)
+
+
 def periodic_samples(ts, val, n, out_ts, window_ms, fn: str,
                      arg0: float = 0.0, arg1: float = 0.0, w_cap: int = 256,
                      accum: str = "float64"):

@@ -136,7 +136,8 @@ class FusedWindowData:
         return MatrixView(self.out_ts, vals, self.sel.keys, self.sel.rows)
 
 
-def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1):
+def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1,
+                             hist: bool = False):
     """Patch grid-kernel output for churned rows: series whose start cell
     differs from the majority cohort (the band matrices assume the majority
     start) are recomputed through the general searchsorted kernels — an
@@ -144,8 +145,12 @@ def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1):
     rows = np.asarray(data.grid_minority, np.int32)
     M = len(rows)
     sub_ts, sub_val, sub_n, _ = _gather_rows_padded(data.ts, data.val, data.n, rows)
-    corr = rangefns.periodic_samples(sub_ts, sub_val, sub_n,
-                                     out_ts, window, fn, a0, a1)
+    if hist:
+        corr = rangefns.periodic_samples_hist(sub_ts, sub_val, sub_n,
+                                              out_ts, window, fn, a0)
+    else:
+        corr = rangefns.periodic_samples(sub_ts, sub_val, sub_n,
+                                         out_ts, window, fn, a0, a1)
     return vals.at[jnp.asarray(rows)].set(corr[:M].astype(vals.dtype))
 
 
@@ -191,18 +196,21 @@ class PeriodicSamplesMapper(Transformer):
                     abs(int(out_ts[-1]) - data.grid[0])) + window < 2**31)
         minority = data.grid_minority
         if data.bucket_les is not None:
-            # native histograms require the grid path (ref: HistogramVector is
-            # only read through chunked functions; general hist path is TODO)
-            if not (grid_usable and fn in gridfns.HIST_GRID_FNS):
-                raise QueryError(f"function {fn} not supported on histogram "
-                                 "series (or shard not grid-aligned)")
-            if minority is not None and len(minority):
-                raise QueryError("histogram series with mixed start cohorts "
-                                 "not yet supported")
-            base_ts, interval_ms = data.grid
-            vals = gridfns.periodic_samples_grid_hist(
-                data.val, data.n, out_ts, window, fn, base_ts, interval_ms,
-                stale_ms=ctx.stale_ms)
+            if fn not in rangefns.HIST_FNS:
+                raise QueryError(f"function {fn} not supported on histogram series")
+            if grid_usable and fn in gridfns.HIST_GRID_FNS:
+                base_ts, interval_ms = data.grid
+                vals = gridfns.periodic_samples_grid_hist(
+                    data.val, data.n, out_ts, window, fn, base_ts, interval_ms,
+                    stale_ms=ctx.stale_ms)
+                if minority is not None and len(minority):
+                    vals = _correct_minority_cohort(data, vals, out_ts, window,
+                                                    fn, a0, a1, hist=True)
+            else:
+                # off-grid shard: general searchsorted hist path (ref:
+                # HistogramVector read through chunked range functions)
+                vals = rangefns.periodic_samples_hist(data.ts, data.val, data.n,
+                                                      out_ts, window, fn, a0)
             return MatrixView(out_ts, vals, data.keys, data.rows, data.bucket_les)
         if grid_usable and fn in gridfns.GRID_FNS:
             from ..ops import fusedgrid

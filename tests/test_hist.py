@@ -128,6 +128,126 @@ def test_hist_sum_over_time_and_bucket(hist_engine):
     np.testing.assert_allclose(vals, want)
 
 
+def test_hist_off_grid_rate_matches_golden():
+    """Histogram queries on an off-grid shard (irregular timestamps) take the
+    general searchsorted hist path and must match the per-bucket golden model
+    (previously: QueryError; ref HistogramVector read through chunked range
+    functions for arbitrary layouts)."""
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("histds", PROM_HISTOGRAM, 0, cfg)
+    les = np.array([1.0, 2.0, 4.0, np.inf])
+    rng = np.random.default_rng(17)
+    # irregular scrape times (jittered): defeats the grid tracker
+    tgrid = BASE + np.cumsum(rng.integers(7_000, 14_000, 60))
+    data = {}
+    for s in range(2):
+        counts = make_hist_series(60, 4, np.random.default_rng(40 + s))
+        b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+        for t in range(60):
+            b.add({"_metric_": "lat", "pod": f"p{s}"}, int(tgrid[t]),
+                  counts[t].astype(np.float64))
+        shard.ingest(b.build())
+        data[s] = counts
+    shard.flush()
+    assert shard.store.grid_info() is None   # truly off-grid
+    eng = QueryEngine(ms, "histds")
+    start, end, step = BASE + 300_000, BASE + 500_000, 45_000
+    r = eng.query_range("histogram_quantile(0.9, sum(rate(lat[2m])))",
+                        start, end, step)
+    (key, ts, vals), = list(r.matrix.iter_series())
+    out_ts = np.arange(start, end + 1, step)
+    from .prom_reference import eval_range_fn
+    summed = np.zeros((len(out_ts), 4))
+    for s, counts in data.items():
+        for bk in range(4):
+            summed[:, bk] += eval_range_fn("rate", tgrid,
+                                           counts[:, bk].astype(float),
+                                           out_ts, 120_000)
+    want = np.array([H.histogram_quantile(0.9, les, summed[t])
+                     for t in range(len(out_ts))])
+    np.testing.assert_allclose(vals, want, rtol=1e-9, equal_nan=True)
+
+
+def test_hist_churned_cohort_matches_general():
+    """A late-joining histogram series keeps the shard on the grid path; its
+    rows are corrected via the general hist kernels bit-for-bit."""
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("histds", PROM_HISTOGRAM, 0, cfg)
+    les = np.array([1.0, 4.0, np.inf])
+    b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+    series = {s: make_hist_series(80, 3, np.random.default_rng(60 + s))
+              for s in range(4)}
+    for t in range(80):
+        for s in range(4):
+            if s == 3 and t < 30:
+                continue   # churned pod
+            b.add({"_metric_": "lat", "pod": f"p{s}"}, BASE + t * IV,
+                  series[s][t].astype(np.float64))
+    shard.ingest(b.build())
+    shard.flush()
+    assert shard.store.grid_info() is not None
+    eng = QueryEngine(ms, "histds")
+    q = ("histogram_quantile(0.9, rate(lat[2m]))",
+         BASE + 400_000, BASE + 700_000, 60_000)
+    r1 = eng.query_range(*q)
+    shard.store.grid_ok = False
+    r2 = eng.query_range(*q)
+    shard.store.grid_ok = True
+    g1 = {k.as_dict()["pod"]: np.asarray(v) for k, _, v in r1.matrix.iter_series()}
+    g2 = {k.as_dict()["pod"]: np.asarray(v) for k, _, v in r2.matrix.iter_series()}
+    assert set(g1) == {"p0", "p1", "p2", "p3"}
+    for p in g1:
+        np.testing.assert_array_equal(g1[p], g2[p], err_msg=p)
+
+
+def test_hist_batch_downsample_and_query(tmp_path):
+    """hSum batch downsampling of a native-histogram dataset: per-bucket sums
+    per resolution bucket, persisted with the bucket scheme, loadable and
+    queryable (histogram_quantile works on the downsampled dataset)."""
+    from filodb_tpu.core.store import FileColumnStore
+    from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
+                                                   run_batch_downsample)
+    sink = FileColumnStore(str(tmp_path))
+    cfg = StoreConfig(max_series_per_shard=4, samples_per_series=128,
+                      flush_batch_size=10**9, groups_per_shard=1, dtype="float64")
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("histds", PROM_HISTOGRAM, 0, cfg, sink=sink)
+    les = np.array([1.0, 2.0, np.inf])
+    counts = make_hist_series(30, 3, np.random.default_rng(9))
+    b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+    for t in range(30):
+        b.add({"_metric_": "lat", "pod": "p0"}, BASE + t * IV,
+              counts[t].astype(np.float64))
+    shard.ingest(b.build(), offset=0)
+    shard.flush_all_groups()
+    RES = 60_000   # 1m buckets over 10s samples: 6 samples per bucket
+    written = run_batch_downsample(sink, "histds", 0, RES)
+    assert written == {"hSum": 1}
+    ms2 = TimeSeriesMemStore()
+    ds = load_downsampled(sink, "histds", 0, RES, "hSum", ms2,
+                          StoreConfig(max_series_per_shard=4,
+                                      samples_per_series=64,
+                                      flush_batch_size=10**9, dtype="float64"))
+    np.testing.assert_allclose(ds.bucket_les, les)
+    ts0, v0 = ds.store.series_snapshot(0)
+    assert v0.shape[1] == 3
+    # golden: per-bucket sums grouped by each sample's 1m time bucket
+    tgrid = BASE + np.arange(30) * IV
+    want = np.stack([counts[tgrid // RES == bk].sum(axis=0)
+                     for bk in np.unique(tgrid // RES)])
+    np.testing.assert_allclose(v0, want)
+    # the downsampled dataset answers quantile queries
+    eng = QueryEngine(ms2, "histds:ds_1m:hSum")
+    r = eng.query_range("histogram_quantile(0.5, lat)",
+                        int(ts0[1]), int(ts0[3]), RES)
+    (_k, _t, vals), = list(r.matrix.iter_series())
+    assert np.isfinite(vals).all()
+
+
 def test_hist_unsupported_fn_raises(hist_engine):
     eng, _, _ = hist_engine
     from filodb_tpu.query.rangevector import QueryError
