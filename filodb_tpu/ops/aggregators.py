@@ -145,6 +145,80 @@ def present_partials(op: str, parts):
     raise ValueError(op)
 
 
+# ---- mergeable quantile sketch (ref: AggrOverRangeVectors quantile uses a
+# t-digest; the TPU-native shape is a DDSketch-style log-bucketed histogram:
+# fixed [G, B, T] count tensors that psum/merge exactly and bound the
+# RELATIVE error of the presented quantile by (gamma-1)/(gamma+1)) ----------
+
+SKETCH_GAMMA = 1.04            # rel. error (gamma-1)/(gamma+1) ~ 1.96%
+SKETCH_MIN = 1e-12             # values below collapse into the zero bucket
+SKETCH_BUCKETS = 2048          # per sign: covers 1e-12 .. ~7e22 at gamma=1.04
+# layout: [0..B) negative buckets (mirrored, descending magnitude),
+#         [B] zero, (B..2B] positive buckets
+SKETCH_WIDTH = 2 * SKETCH_BUCKETS + 1
+
+
+def quantile_sketch(values, group_ids, num_groups: int):
+    """Map phase: [P, T] values -> [G, W, T] log-bucket counts (host numpy).
+
+    Mergeable across shards by addition (or psum). NaN values are absent.
+    """
+    vals = np.asarray(values, np.float64)
+    gids = np.asarray(group_ids)
+    P, T = vals.shape
+    B = SKETCH_BUCKETS
+    lg = np.log(SKETCH_GAMMA)
+    mag = np.abs(vals)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        bi = np.ceil(np.log(mag / SKETCH_MIN) / lg)
+        bi = np.nan_to_num(bi, nan=1.0, posinf=B, neginf=1.0)
+    bi = np.clip(bi, 1, B).astype(np.int64)
+    idx = np.where(mag <= SKETCH_MIN, B,
+                   np.where(vals > 0, B + bi, B - bi))      # [P, T]
+    present = ~np.isnan(vals)
+    counts = np.zeros((num_groups, SKETCH_WIDTH, T), np.float32)
+    t_idx = np.broadcast_to(np.arange(T)[None, :], (P, T))
+    g_idx = np.broadcast_to(gids[:, None], (P, T))
+    np.add.at(counts, (g_idx[present], idx[present], t_idx[present]), 1.0)
+    return counts
+
+
+def present_quantile_sketch(counts, q: float):
+    """[G, W, T] counts -> [G, T] phi-quantile estimates.
+
+    PromQL semantics: rank = q*(n-1) with linear interpolation between the
+    two straddling order statistics; each order statistic is located in the
+    sketch and represented by its bucket's geometric midpoint, so the
+    per-value relative error stays bounded by (gamma-1)/(gamma+1) ~ 1%."""
+    G, W, T = counts.shape
+    B = SKETCH_BUCKETS
+    total = counts.sum(axis=1)                               # [G, T]
+    rank = np.maximum(q, 0.0) * np.maximum(total - 1, 0)     # PromQL phi rank
+    lo_r = np.floor(rank)
+    frac = rank - lo_r
+    cum = np.cumsum(counts, axis=1)
+    # order statistic at 0-indexed rank r sits in the first bucket whose
+    # cumulative count reaches r+1
+    sel_lo = (cum < lo_r[:, None, :] + 1 - 1e-9).sum(axis=1)
+    sel_hi = (cum < np.minimum(lo_r + 2, np.maximum(total, 1))[:, None, :]
+              - 1e-9).sum(axis=1)
+    sel_lo = np.clip(sel_lo, 0, W - 1)
+    sel_hi = np.clip(sel_hi, 0, W - 1)
+    # bucket -> representative value
+    k = np.arange(W, dtype=np.float64)
+    pos = k - B
+    mags = SKETCH_MIN * np.power(SKETCH_GAMMA, np.abs(pos)) * 2 / (1 + SKETCH_GAMMA)
+    rep = np.sign(pos) * mags
+    rep[B] = 0.0
+    out = rep[sel_lo] * (1 - frac) + rep[sel_hi] * frac
+    out = np.where(total > 0, out, np.nan)
+    if q < 0:
+        out = np.where(total > 0, -np.inf, np.nan)
+    if q > 1:
+        out = np.where(total > 0, np.inf, np.nan)
+    return out
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def topk_mask(values, group_ids, num_groups: int, k: int, bottom: bool = False):
     """Per-step top-k filter: True where values[p, t] is among the k largest

@@ -366,11 +366,13 @@ class AggregateMapReduce(Transformer):
     by: tuple = ()
     without: tuple = ()
 
+    # order-statistics aggregators with too many groups fall back to full
+    # matrices; G is small in practice (topk is usually global)
+    ORDER_STAT_MAX_GROUPS = 64
+
     def apply(self, data, ctx):
         if self.operator in ("topk", "bottomk", "quantile", "count_values"):
-            # order-statistics aggregators reduce on full matrices at the
-            # reduce node (exact; candidate pruning is a later optimization)
-            return _as_matrix(data)
+            return self._map_order_stat(data, ctx)
         if isinstance(data, FusedWindowData):
             from ..ops import fusedgrid
             if self.operator in fusedgrid.FUSED_OPS:
@@ -435,6 +437,256 @@ class AggregateMapReduce(Transformer):
             parts = aggregators.combine_partials(self.operator, parts, mparts)
         return AggPartial(self.operator, data.out_ts, parts, list(uniq), G, None)
 
+    def _map_order_stat(self, data, ctx):
+        """Map phase for topk/bottomk/quantile/count_values: per-shard partial
+        state instead of shipping the full [P, T] matrix to the reduce node
+        (ref: RowAggregator partial state incl. t-digest,
+        AggrOverRangeVectors.scala:244-)."""
+        if isinstance(data, FusedWindowData):
+            data = data.materialize()
+        if isinstance(data, MatrixView):
+            m = data
+        else:
+            mm = _as_matrix(data)
+            m = MatrixView(mm.out_ts, mm.values, mm.keys, None, mm.bucket_les)
+        return _order_stat_map(m, self.operator, self.params, self.by,
+                               self.without, cap=self.ORDER_STAT_MAX_GROUPS)
+
+
+# quantile partial memory gate: fall back to the exact full matrix when the
+# dense sketch would dwarf what it replaces
+_SKETCH_BYTES_CAP = 64 << 20
+
+
+def _order_stat_map(m: MatrixView, op, params, by, without, cap=None):
+    """Shared map phase; with ``cap`` set, large group counts (or oversized
+    sketches) fall back to the exact full matrix. The reduce node calls this
+    WITHOUT a cap to normalize a fallen-back shard into partial form when its
+    siblings produced partials."""
+    if m.bucket_les is not None:
+        raise QueryError(f"{op} not supported on histograms")
+    R = m.values.shape[0]
+    gids, uniq, G = _group_ids_for(m.keys, m.rows, R, by, without)
+    T = len(m.out_ts)
+    if cap is not None and G > cap:
+        return m.compact()               # exact full-matrix fallback
+    if op in ("topk", "bottomk"):
+        k = max(int(params[0]), 0)       # topk(0, ...) selects nothing
+        return _map_topk(m, gids, uniq, G, k, op == "bottomk")
+    if op == "quantile":
+        if (cap is not None
+                and G * aggregators.SKETCH_WIDTH * T * 4 > _SKETCH_BYTES_CAP):
+            return m.compact()
+        counts = aggregators.quantile_sketch(np.asarray(m.values), gids, G)
+        return SketchPartial(float(params[0]), m.out_ts, list(uniq), counts)
+    # count_values: vectorized host histogram of distinct values
+    vals_h = np.asarray(m.values)
+    label = str(params[0])
+    present = ~np.isnan(vals_h)
+    p_idx, t_idx = np.nonzero(present)
+    v = vals_h[p_idx, t_idx]
+    g = gids[p_idx] if len(gids) else np.zeros(0, np.int32)
+    uvals, vinv = np.unique(v, return_inverse=True)
+    pair = g.astype(np.int64) * max(len(uvals), 1) + vinv
+    upairs, pinv = np.unique(pair, return_inverse=True)
+    counts = np.zeros((len(upairs), T))
+    np.add.at(counts, (pinv, t_idx), 1.0)
+    entries: dict = {}
+    for i, pr in enumerate(upairs):
+        gi, vi = divmod(int(pr), max(len(uvals), 1))
+        key = (gi, "%g" % uvals[vi])
+        # distinct floats can share a "%g" rendering: counts accumulate
+        if key in entries:
+            entries[key] = entries[key] + counts[i]
+        else:
+            entries[key] = counts[i]
+    return CountValuesPartial(label, m.out_ts, list(uniq), entries)
+
+
+def _map_topk(m: MatrixView, gids, uniq, G: int, k: int, bottom: bool):
+    """Per-shard top-k candidates per (group, step): [G, k, T] values + key
+    refs — only k series' worth of data crosses the reduce. Presence is
+    decided by an exact per-slot mask (selected row AND non-NaN), so real
+    +/-Inf samples survive and un-selected pad rows never leak in."""
+    T0 = len(m.out_ts)
+    R = m.values.shape[0]
+    if k == 0 or not len(m.keys):
+        return TopKPartial(k, bottom, m.out_ts, list(uniq),
+                           np.full((G, 0, T0), np.nan),
+                           np.full((G, 0, T0), -1, np.int64), [])
+    # array row -> key index (rows may be a non-identity store-row mapping)
+    if m.rows is None:
+        valid_rows = np.zeros(R, bool)
+        valid_rows[:len(m.keys)] = True
+        row_to_key = None
+    else:
+        valid_rows = np.zeros(R, bool)
+        valid_rows[m.rows] = True
+        row_to_key = {int(r): i for i, r in enumerate(m.rows)}
+    vals = m.values if isinstance(m.values, jnp.ndarray) else jnp.asarray(m.values)
+    vals = vals.astype(jnp.float64)
+    nanmask = jnp.isnan(vals)
+    vmask = jnp.asarray(valid_rows)
+    garr = jnp.asarray(gids)
+    fill = jnp.inf if bottom else -jnp.inf
+    out_vals = np.full((G, k, T0), np.nan)
+    out_ref = np.full((G, k, T0), -1, np.int64)
+    key_rows: list[int] = []
+    row_slot: dict[int, int] = {}
+    kk = min(k, R)
+    for g in range(G):
+        presence = (vmask & (garr == g))[:, None] & ~nanmask     # [R, T]
+        gv = jnp.where(presence, vals, fill)
+        sv = -gv if bottom else gv
+        top_v, top_i = jax.lax.top_k(sv.T, kk)                   # [T, kk]
+        top_ok = jnp.take_along_axis(presence.T, top_i, axis=1)  # exact mask
+        top_v = np.asarray(top_v)
+        top_i = np.asarray(top_i)
+        ok = np.asarray(top_ok)
+        if bottom:
+            top_v = -top_v
+        for t, s in zip(*np.nonzero(ok)):
+            row = int(top_i[t, s])
+            slot = row_slot.get(row)
+            if slot is None:
+                slot = row_slot[row] = len(key_rows)
+                key_rows.append(row)
+            out_vals[g, s, t] = top_v[t, s]
+            out_ref[g, s, t] = slot
+    ki = (key_rows if row_to_key is None
+          else [row_to_key[r] for r in key_rows])
+    key_table = [m.keys[i] for i in ki]
+    return TopKPartial(k, bottom, m.out_ts, list(uniq), out_vals, out_ref,
+                       key_table)
+
+
+@dataclass
+class TopKPartial:
+    """topk/bottomk partial state: per (group, slot, step) candidate values
+    and their source-series keys."""
+    k: int
+    bottom: bool
+    out_ts: np.ndarray
+    group_keys: list
+    values: np.ndarray            # [G, k, T] f64, NaN = empty slot
+    key_ref: np.ndarray           # [G, k, T] int64 into key_table, -1 = empty
+    key_table: list
+
+
+@dataclass
+class SketchPartial:
+    """quantile partial state: DDSketch-style log-bucket counts [G, W, T]."""
+    q: float
+    out_ts: np.ndarray
+    group_keys: list
+    counts: np.ndarray
+
+
+@dataclass
+class CountValuesPartial:
+    """count_values partial state: (group, value-string) -> [T] counts."""
+    label: str
+    out_ts: np.ndarray
+    group_keys: list
+    entries: dict                  # (gid, vstr) -> np[T]
+
+
+def _as_mview(data) -> MatrixView:
+    if isinstance(data, MatrixView):
+        return data
+    m = _as_matrix(data)
+    return MatrixView(m.out_ts, m.values, m.keys, None, m.bucket_les)
+
+
+def _align_groups(parts):
+    """Union group-key space across shard partials: (mapping, G)."""
+    all_groups: dict[RangeVectorKey, int] = {}
+    for p in parts:
+        for gk in p.group_keys:
+            all_groups.setdefault(gk, len(all_groups))
+    return all_groups, max(len(all_groups), 1)
+
+
+def _merge_sketch(parts: list["SketchPartial"]) -> "SketchPartial":
+    first = parts[0]
+    all_groups, G = _align_groups(parts)
+    W, T = first.counts.shape[1], first.counts.shape[2]
+    merged = np.zeros((G, W, T), np.float32)
+    for p in parts:
+        for gi, gk in enumerate(p.group_keys):
+            merged[all_groups[gk]] += p.counts[gi]
+    return SketchPartial(first.q, first.out_ts, list(all_groups), merged)
+
+
+def _merge_count_values(parts: list["CountValuesPartial"]) -> "CountValuesPartial":
+    first = parts[0]
+    all_groups, _G = _align_groups(parts)
+    entries: dict = {}
+    for p in parts:
+        remap = [all_groups[gk] for gk in p.group_keys]
+        for (gi, vstr), row in p.entries.items():
+            key = (remap[gi] if remap else 0, vstr)
+            if key in entries:
+                entries[key] = entries[key] + row
+            else:
+                entries[key] = row
+    return CountValuesPartial(first.label, first.out_ts, list(all_groups),
+                              entries)
+
+
+def _merge_topk(parts: list[TopKPartial]) -> TopKPartial:
+    first = parts[0]
+    all_groups, G = _align_groups(parts)
+    T = len(first.out_ts)
+    k = first.k
+    key_table: list = []
+    cand_v = np.full((G, 0, T), np.nan)
+    cand_r = np.full((G, 0, T), -1, np.int64)
+    for p in parts:
+        off = len(key_table)
+        key_table.extend(p.key_table)
+        pv = np.full((G, p.values.shape[1], T), np.nan)
+        pr = np.full((G, p.values.shape[1], T), -1, np.int64)
+        for gi, gk in enumerate(p.group_keys):
+            gg = all_groups[gk]
+            pv[gg] = p.values[gi]
+            pr[gg] = np.where(p.key_ref[gi] >= 0, p.key_ref[gi] + off, -1)
+        cand_v = np.concatenate([cand_v, pv], axis=1)
+        cand_r = np.concatenate([cand_r, pr], axis=1)
+    # re-select top k among the candidates per (group, step)
+    fill = np.inf if first.bottom else -np.inf
+    sv = np.where(np.isnan(cand_v), fill, cand_v)
+    sv = sv if first.bottom else -sv                    # ascending sort picks
+    order = np.argsort(sv, axis=1, kind="stable")[:, :k, :]
+    out_v = np.take_along_axis(cand_v, order, axis=1)
+    out_r = np.take_along_axis(cand_r, order, axis=1)
+    return TopKPartial(k, first.bottom, first.out_ts, list(all_groups),
+                       out_v, out_r, key_table)
+
+
+def _present_topk(p: TopKPartial) -> ResultMatrix:
+    """Emit the union of selected source series, each with its value at steps
+    where it made the top k (Prometheus topk keeps original labels)."""
+    T = len(p.out_ts)
+    rows: dict[RangeVectorKey, int] = {}
+    out: list[np.ndarray] = []
+    G, k, _ = p.values.shape
+    for g in range(G):
+        for s in range(k):
+            for t in range(T):
+                ref = p.key_ref[g, s, t]
+                if ref < 0 or np.isnan(p.values[g, s, t]):
+                    continue
+                key = p.key_table[ref]
+                r = rows.get(key)
+                if r is None:
+                    r = rows[key] = len(out)
+                    out.append(np.full(T, np.nan))
+                out[r][t] = p.values[g, s, t]
+    if not out:
+        return ResultMatrix(p.out_ts, np.zeros((0, T)), [])
+    return ResultMatrix(p.out_ts, np.stack(out), list(rows))
+
 
 @dataclass
 class AggPartial:
@@ -466,6 +718,23 @@ class AggregatePresenter(Transformer):
                 B = len(data.bucket_les)
                 vals = vals.reshape(vals.shape[0], -1, B)
             return ResultMatrix(data.out_ts, vals, data.group_keys, data.bucket_les)
+        if isinstance(data, TopKPartial):
+            return _present_topk(data)
+        if isinstance(data, SketchPartial):
+            vals = aggregators.present_quantile_sketch(data.counts, data.q)
+            return ResultMatrix(data.out_ts, vals, data.group_keys)
+        if isinstance(data, CountValuesPartial):
+            T = len(data.out_ts)
+            keys, rows = [], []
+            for (gi, vstr), row in data.entries.items():
+                gk = (data.group_keys[gi] if data.group_keys
+                      else RangeVectorKey(()))
+                keys.append(RangeVectorKey(tuple(sorted(
+                    dict(gk.labels, **{data.label: vstr}).items()))))
+                rows.append(np.where(row > 0, row, np.nan))
+            if not keys:
+                return ResultMatrix(data.out_ts, np.zeros((0, T)), [])
+            return ResultMatrix(data.out_ts, np.stack(rows), keys)
         # full-matrix aggregators
         m = _as_matrix(data)
         gkeys = group_keys_of(m.keys, self.by, self.without)
@@ -579,7 +848,8 @@ def _as_matrix(data) -> ResultMatrix:
         return data.materialize().compact()
     if isinstance(data, MatrixView):
         return data.compact()
-    if isinstance(data, AggPartial):
+    if isinstance(data, (AggPartial, TopKPartial, SketchPartial,
+                         CountValuesPartial)):
         raise QueryError("aggregate partial where matrix expected (missing presenter)")
     if isinstance(data, SeriesSelection):
         raise QueryError("raw series where matrix expected (missing periodic mapper)")
@@ -746,6 +1016,19 @@ class ReduceAggregateExec(ExecPlan):
         results = [c.execute(ctx) for c in self.children]
         if results and isinstance(results[0], AggPartial):
             return _merge_partials(self.operator, results)
+        kinds = {TopKPartial: _merge_topk, SketchPartial: _merge_sketch,
+                 CountValuesPartial: _merge_count_values}
+        for kind, merge in kinds.items():
+            if not any(isinstance(r, kind) for r in results):
+                continue
+            # the per-shard group cap is data-dependent, so a sibling shard
+            # may have fallen back to a full matrix: normalize it here (the
+            # matrix has full information; the reverse is impossible)
+            norm = [r if isinstance(r, kind)
+                    else _order_stat_map(_as_mview(r), self.operator,
+                                         self.params, self.by, self.without)
+                    for r in results]
+            return merge(norm)
         mats = [_as_matrix(r).to_host() for r in results]
         mats = [m for m in mats if m.num_series]
         if not mats:

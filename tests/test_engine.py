@@ -125,7 +125,9 @@ def test_quantile_aggregation(engine):
                       if False else golden("last_over_time", i, OUT_TS, 300_000)
                       for i in range(6)])
     want = np.quantile(stack, 0.5, axis=0)
-    np.testing.assert_allclose(vals, want, rtol=1e-12)
+    # quantile flows through a mergeable log-bucket sketch (the reference uses
+    # a t-digest — likewise approximate); error bounded by (gamma-1)/(gamma+1)
+    np.testing.assert_allclose(vals, want, rtol=0.02)
 
 
 def test_scalar_ops_and_instant_fn(engine):

@@ -1,0 +1,149 @@
+"""Order-statistics aggregators with partial-state map phases across shards:
+topk/bottomk (exact per-shard candidates), quantile (mergeable log-bucket
+sketch), count_values (vectorized value histogram). Ref: RowAggregator partial
+state incl. t-digest, AggrOverRangeVectors.scala:244-. The reduce node must
+never receive a full [P, T] matrix for these."""
+
+import numpy as np
+import pytest
+
+import filodb_tpu.query.exec as qe
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.query.engine import QueryEngine
+
+BASE = 1_700_000_000_000
+IV = 10_000
+NSH = 2
+PER_SHARD = 8
+
+
+@pytest.fixture(scope="module")
+def eng2():
+    """Two shards x 8 gauge series with distinct constant offsets."""
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    for sh in range(NSH):
+        shard = ms.setup("prometheus", GAUGE, sh, cfg)
+        b = RecordBuilder(GAUGE)
+        for t in range(40):
+            for i in range(PER_SHARD):
+                g = sh * PER_SHARD + i
+                b.add({"_metric_": "m", "inst": f"i{g}", "grp": f"g{g % 2}"},
+                      BASE + t * IV, 100.0 * g + t)
+        shard.ingest(b.build())
+        shard.flush()
+    return QueryEngine(ms, "prometheus")
+
+
+def _series(r):
+    return {tuple(sorted(k.as_dict().items())): (np.asarray(t), np.asarray(v))
+            for k, t, v in r.matrix.iter_series()}
+
+
+def test_topk_partials_cross_shards(eng2, monkeypatch):
+    seen = {}
+    orig = qe._merge_topk
+
+    def spy(parts):
+        seen["types"] = {type(p).__name__ for p in parts}
+        seen["n"] = len(parts)
+        return orig(parts)
+
+    monkeypatch.setattr(qe, "_merge_topk", spy)
+    r = eng2.query_range("topk(3, m)", BASE + 200_000, BASE + 380_000, 30_000)
+    s = _series(r)
+    # global top 3 = the 3 highest-offset series, which live on shard 1
+    insts = {dict(d)["inst"] for d in s}
+    assert insts == {"i15", "i14", "i13"}
+    for d, (t, v) in s.items():
+        g = int(dict(d)["inst"][1:])
+        np.testing.assert_allclose(v, 100.0 * g + (t - BASE) // IV)
+    assert seen["n"] == NSH and seen["types"] == {"TopKPartial"}
+
+
+def test_bottomk_grouped(eng2):
+    r = eng2.query_range("bottomk(2, m) by (grp)",
+                         BASE + 200_000, BASE + 290_000, 30_000)
+    insts = {dict(d)["inst"] for d in _series(r)}
+    # lowest 2 of each parity group: g0 -> i0,i2 ; g1 -> i1,i3
+    assert insts == {"i0", "i2", "i1", "i3"}
+
+
+def test_quantile_sketch_across_shards(eng2, monkeypatch):
+    seen = {}
+    orig = qe._merge_sketch
+
+    def spy(parts):
+        seen["n"] = len(parts)
+        return orig(parts)
+
+    monkeypatch.setattr(qe, "_merge_sketch", spy)
+    r = eng2.query_range("quantile(0.25, m)", BASE + 200_000, BASE + 380_000,
+                         30_000)
+    ((d, (t, v)),) = list(_series(r).items())
+    cells = (t - BASE) // IV
+    stack = np.stack([100.0 * g + cells for g in range(16)])
+    want = np.quantile(stack, 0.25, axis=0)
+    np.testing.assert_allclose(v, want, rtol=0.02)
+    assert seen["n"] == NSH
+
+
+def test_count_values_across_shards(eng2):
+    # at each instant all 16 series hold distinct values except the metric is
+    # staircase: count_values of the floor'd hundreds bucket
+    r = eng2.query_range("count_values(\"v\", m - (m % 100))",
+                         BASE + 200_000, BASE + 260_000, 30_000)
+    s = _series(r)
+    # each series' value rounds to its own hundred -> 16 distinct counts of 1
+    assert len(s) == 16
+    for d, (t, v) in s.items():
+        assert "v" in dict(d)
+        np.testing.assert_allclose(v, 1.0)
+
+
+def test_topk_of_infinite_and_k_zero(eng2):
+    # +Inf from division by zero is a real sample and must win topk
+    r = eng2.query_range("topk(1, m / (m - m))",
+                         BASE + 200_000, BASE + 260_000, 30_000)
+    s = _series(r)
+    assert len(s) >= 1
+    for _d, (t, v) in s.items():
+        assert np.isposinf(v).all()
+    # topk(0, ...) selects nothing
+    r = eng2.query_range("topk(0, m)", BASE + 200_000, BASE + 260_000, 30_000)
+    assert len(_series(r)) == 0
+
+
+def test_mixed_partial_and_fallback_children(eng2, monkeypatch):
+    """One shard over the group cap falls back to a full matrix while its
+    sibling produces a TopKPartial: the reduce normalizes and still answers."""
+    orig = qe._order_stat_map
+    calls = {"n": 0}
+
+    def flaky_cap(m, op, params, by, without, cap=None):
+        calls["n"] += 1
+        # force the FIRST shard's map call to take the matrix fallback
+        if cap is not None and calls["n"] == 1:
+            return m.compact()
+        return orig(m, op, params, by, without, cap=cap)
+
+    monkeypatch.setattr(qe, "_order_stat_map", flaky_cap)
+    r = eng2.query_range("topk(3, m)", BASE + 200_000, BASE + 380_000, 30_000)
+    insts = {dict(d)["inst"] for d in _series(r)}
+    assert insts == {"i15", "i14", "i13"}
+
+
+def test_order_stats_fallback_when_many_groups(eng2):
+    """Per-instance grouping exceeds the partial-state group cap: the exact
+    full-matrix path must still answer."""
+    old = qe.AggregateMapReduce.ORDER_STAT_MAX_GROUPS
+    qe.AggregateMapReduce.ORDER_STAT_MAX_GROUPS = 4
+    try:
+        r = eng2.query_range("topk(1, m) by (inst)",
+                             BASE + 200_000, BASE + 260_000, 30_000)
+        assert len(_series(r)) == 16   # every singleton group keeps its series
+    finally:
+        qe.AggregateMapReduce.ORDER_STAT_MAX_GROUPS = old
