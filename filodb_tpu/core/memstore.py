@@ -453,22 +453,28 @@ class TimeSeriesShard:
             latest[pid] = (labels, start)
             if labels:
                 last_live_pk[pid] = part_key_of(labels, self.schema.options)
-        for pid in sorted(latest):
-            while len(self.index) < pid:   # gap: entry lost; treat as a free hole
-                hole = len(self.index)
-                self.index.add_part_key(hole, {}, 0, end_time=-1)
-                self._free_pids.append(hole)
-            labels, start = latest[pid]
-            if not labels:                 # purge tombstone won: slot is free
-                self.index.add_part_key(pid, {}, 0, end_time=-1)
-                self._free_pids.append(pid)
-                if pid in last_live_pk:    # returning-series detection survives
-                    self._evicted_keys.add(last_live_pk[pid])   # the restart
-                continue
-            pk = part_key_of(labels, self.schema.options)
-            self._part_key_to_id[pk] = pid
-            self._part_key_of_id[pid] = pk
-            self.index.add_part_key(pid, labels, start)
+        # queries are admitted while recovery streams in (the reference serves
+        # partial data during RecoveryInProgress), so index and store
+        # mutations take the shard lock like any ingest would — an unlocked
+        # store.append would donate (delete) array buffers a concurrent query
+        # has already captured
+        with self.lock:
+            for pid in sorted(latest):
+                while len(self.index) < pid:   # gap: entry lost; free hole
+                    hole = len(self.index)
+                    self.index.add_part_key(hole, {}, 0, end_time=-1)
+                    self._free_pids.append(hole)
+                labels, start = latest[pid]
+                if not labels:             # purge tombstone won: slot is free
+                    self.index.add_part_key(pid, {}, 0, end_time=-1)
+                    self._free_pids.append(pid)
+                    if pid in last_live_pk:   # returning-series detection
+                        self._evicted_keys.add(last_live_pk[pid])
+                    continue
+                pk = part_key_of(labels, self.schema.options)
+                self._part_key_to_id[pk] = pid
+                self._part_key_of_id[pid] = pk
+                self.index.add_part_key(pid, labels, start)
         # 2. chunks -> device store (batched appends, flush order == time order).
         #    Chunks of purged partitions are skipped; for a reused slot, samples
         #    older than the current owner's start time belong to the purged
@@ -488,7 +494,8 @@ class TimeSeriesShard:
             if not owned.all():
                 pids, ts, vals = pids[owned], ts[owned], vals[owned]
             if len(pids):
-                self.store.append(pids, ts, vals)
+                with self.lock:   # append donates the store buffers
+                    self.store.append(pids, ts, vals)
         # 3. checkpoints -> watermarks; replay the bus past them
         cps = self.sink.read_checkpoints(self.dataset, self.shard_num)
         for g, off in cps.items():

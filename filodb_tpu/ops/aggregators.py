@@ -171,10 +171,13 @@ def quantile_sketch(values, group_ids, num_groups: int):
     mag = np.abs(vals)
     with np.errstate(divide="ignore", invalid="ignore"):
         bi = np.ceil(np.log(mag / SKETCH_MIN) / lg)
-        bi = np.nan_to_num(bi, nan=1.0, posinf=B, neginf=1.0)
-    bi = np.clip(bi, 1, B).astype(np.int64)
+        bi = np.nan_to_num(bi, nan=1.0, posinf=B - 1, neginf=1.0)
+    # outermost slot of each sign is reserved for true +/-Inf samples
+    bi = np.clip(bi, 1, B - 1).astype(np.int64)
     idx = np.where(mag <= SKETCH_MIN, B,
                    np.where(vals > 0, B + bi, B - bi))      # [P, T]
+    idx = np.where(np.isposinf(vals), 2 * B, idx)
+    idx = np.where(np.isneginf(vals), 0, idx)
     present = ~np.isnan(vals)
     counts = np.zeros((num_groups, SKETCH_WIDTH, T), np.float32)
     t_idx = np.broadcast_to(np.arange(T)[None, :], (P, T))
@@ -204,13 +207,20 @@ def present_quantile_sketch(counts, q: float):
               - 1e-9).sum(axis=1)
     sel_lo = np.clip(sel_lo, 0, W - 1)
     sel_hi = np.clip(sel_hi, 0, W - 1)
-    # bucket -> representative value
+    # bucket -> representative value; outermost slots are true +/-Inf
     k = np.arange(W, dtype=np.float64)
     pos = k - B
     mags = SKETCH_MIN * np.power(SKETCH_GAMMA, np.abs(pos)) * 2 / (1 + SKETCH_GAMMA)
     rep = np.sign(pos) * mags
     rep[B] = 0.0
-    out = rep[sel_lo] * (1 - frac) + rep[sel_hi] * frac
+    rep[0] = -np.inf
+    rep[W - 1] = np.inf
+    lo_v, hi_v = rep[sel_lo], rep[sel_hi]
+    with np.errstate(invalid="ignore"):
+        interp = lo_v * (1 - frac) + hi_v * frac
+    # integral ranks and equal straddles take the value directly — the
+    # interpolation form would produce inf*0 = NaN for +/-Inf samples
+    out = np.where((frac == 0) | (lo_v == hi_v), lo_v, interp)
     out = np.where(total > 0, out, np.nan)
     if q < 0:
         out = np.where(total > 0, -np.inf, np.nan)

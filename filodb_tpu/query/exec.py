@@ -33,6 +33,7 @@ from .rangevector import QueryError, QueryResult, RangeVectorKey, ResultMatrix
 
 DEFAULT_SAMPLE_LIMIT = 1_000_000
 GATHER_THRESHOLD = 8192      # selections narrower than this gather rows up front
+ODP_BATCH = 4096             # wide on-demand paging proceeds in pid batches
 
 
 @dataclass
@@ -474,8 +475,9 @@ def _order_stat_map(m: MatrixView, op, params, by, without, cap=None):
         k = max(int(params[0]), 0)       # topk(0, ...) selects nothing
         return _map_topk(m, gids, uniq, G, k, op == "bottomk")
     if op == "quantile":
-        if (cap is not None
-                and G * aggregators.SKETCH_WIDTH * T * 4 > _SKETCH_BYTES_CAP):
+        # the bytes gate holds even for reduce-side normalization (cap=None):
+        # a dense sketch for a huge group count must never be allocated
+        if G * aggregators.SKETCH_WIDTH * T * 4 > _SKETCH_BYTES_CAP:
             return m.compact()
         counts = aggregators.quantile_sketch(np.asarray(m.values), gids, G)
         return SketchPartial(float(params[0]), m.out_ts, list(uniq), counts)
@@ -529,6 +531,11 @@ def _map_topk(m: MatrixView, gids, uniq, G: int, k: int, bottom: bool):
     vmask = jnp.asarray(valid_rows)
     garr = jnp.asarray(gids)
     fill = jnp.inf if bottom else -jnp.inf
+    fmax = np.finfo(np.float64).max
+    # real +/-Inf samples must outrank fill rows at equal sort value: clamp
+    # them to +/-DBL_MAX in the SORT domain only (reported values come from
+    # the original matrix via the selected indices)
+    sortable = jnp.clip(vals, -fmax, fmax)
     out_vals = np.full((G, k, T0), np.nan)
     out_ref = np.full((G, k, T0), -1, np.int64)
     key_rows: list[int] = []
@@ -536,15 +543,13 @@ def _map_topk(m: MatrixView, gids, uniq, G: int, k: int, bottom: bool):
     kk = min(k, R)
     for g in range(G):
         presence = (vmask & (garr == g))[:, None] & ~nanmask     # [R, T]
-        gv = jnp.where(presence, vals, fill)
+        gv = jnp.where(presence, sortable, fill)
         sv = -gv if bottom else gv
-        top_v, top_i = jax.lax.top_k(sv.T, kk)                   # [T, kk]
+        _, top_i = jax.lax.top_k(sv.T, kk)                       # [T, kk]
         top_ok = jnp.take_along_axis(presence.T, top_i, axis=1)  # exact mask
-        top_v = np.asarray(top_v)
+        top_v = np.asarray(jnp.take_along_axis(vals.T, top_i, axis=1))
         top_i = np.asarray(top_i)
         ok = np.asarray(top_ok)
-        if bottom:
-            top_v = -top_v
         for t, s in zip(*np.nonzero(ok)):
             row = int(top_i[t, s])
             slot = row_slot.get(row)
@@ -589,6 +594,38 @@ class CountValuesPartial:
     out_ts: np.ndarray
     group_keys: list
     entries: dict                  # (gid, vstr) -> np[T]
+
+
+@dataclass
+class _WideODP:
+    """do_execute marker: the selection needs wide on-demand paging. The
+    leaf's execute() converts it via _paged_batches OUTSIDE the long-held
+    shard lock; ExecPlan.execute passes it through untransformed."""
+    pids: np.ndarray
+
+
+def _merge_heterogeneous(results, op, params, by, without):
+    """Merge a mixed list of aggregation partials (normalizing any member
+    that fell back to a full matrix). Returns None when no partials are
+    present — the caller concatenates matrices instead."""
+    if results and all(isinstance(r, AggPartial) for r in results):
+        return _merge_partials(op, results)
+    kinds = {TopKPartial: _merge_topk, SketchPartial: _merge_sketch,
+             CountValuesPartial: _merge_count_values}
+    for kind, merge in kinds.items():
+        if not any(isinstance(r, kind) for r in results):
+            continue
+        norm = [r if isinstance(r, kind)
+                else _order_stat_map(_as_mview(r), op, params, by, without)
+                for r in results]
+        if not all(isinstance(r, kind) for r in norm):
+            # normalization refused (e.g. a quantile sketch over the memory
+            # gate): partial state cannot be reconstituted into a matrix, so
+            # fail loudly rather than merge wrong
+            raise QueryError(f"{op} grouping too wide to merge across shards; "
+                             "narrow the by() clause")
+        return merge(norm)
+    return None
 
 
 def _as_mview(data) -> MatrixView:
@@ -653,9 +690,12 @@ def _merge_topk(parts: list[TopKPartial]) -> TopKPartial:
             pr[gg] = np.where(p.key_ref[gi] >= 0, p.key_ref[gi] + off, -1)
         cand_v = np.concatenate([cand_v, pv], axis=1)
         cand_r = np.concatenate([cand_r, pr], axis=1)
-    # re-select top k among the candidates per (group, step)
+    # re-select top k among the candidates per (group, step); real +/-Inf
+    # candidates clamp to +/-DBL_MAX in the sort domain so empty (fill) slots
+    # never displace them on ties
     fill = np.inf if first.bottom else -np.inf
-    sv = np.where(np.isnan(cand_v), fill, cand_v)
+    fmax = np.finfo(np.float64).max
+    sv = np.where(np.isnan(cand_v), fill, np.clip(cand_v, -fmax, fmax))
     sv = sv if first.bottom else -sv                    # ascending sort picks
     order = np.argsort(sv, axis=1, kind="stable")[:, :k, :]
     out_v = np.take_along_axis(cand_v, order, axis=1)
@@ -866,6 +906,8 @@ class ExecPlan:
 
     def execute(self, ctx: QueryContext):
         data = self.do_execute(ctx)
+        if isinstance(data, _WideODP):
+            return data        # converted by the leaf's execute wrapper
         for t in self.transformers:
             data = t.apply(data, ctx)
         return data
@@ -902,7 +944,71 @@ class SelectRawPartitionsExec(ExecPlan):
                 # a lazy window view must not escape the lock: its kernel
                 # dispatch would race a concurrent ingest flush's donation
                 result = result.materialize()
-            return result
+        if isinstance(result, _WideODP):
+            # batched paging runs OUTSIDE the long-held lock: each batch
+            # re-locks only around its store snapshot, so ingest is not
+            # stalled for the duration of a wide historical scan
+            return self._paged_batches(ctx, shard, result.pids)
+        return result
+
+    def _paged_selection(self, shard, pids, keys) -> SeriesSelection:
+        ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms, self.end_ms)
+        return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
+                               jnp.asarray(n_h), keys, None, None)
+
+    @staticmethod
+    def _batch_distributive(t) -> bool:
+        """True when applying ``t`` per pid-batch then merging equals applying
+        it to the whole selection (row-wise transforms and the aggregation map
+        phase are; absent()/sort need the complete result)."""
+        if isinstance(t, (PeriodicSamplesMapper, AggregateMapReduce,
+                          ScalarOperationMapper)):
+            return True
+        if isinstance(t, InstantVectorFunctionMapper):
+            return t.function != "absent"
+        return False
+
+    def _paged_batches(self, ctx, shard, pids):
+        """Wide on-demand paging: bounded memory via pid batches — each batch
+        pages its cold chunks, runs the (distributive prefix of the)
+        transformer chain, and the per-batch results merge exactly like shard
+        results do at a reduce node; the non-distributive suffix applies to
+        the merged whole (ref: OnDemandPagingShard.scala:58 pages any width)."""
+        n_dist = 0
+        while (n_dist < len(self.transformers)
+               and self._batch_distributive(self.transformers[n_dist])):
+            n_dist += 1
+        prefix, suffix = self.transformers[:n_dist], self.transformers[n_dist:]
+        agg = next((t for t in prefix if isinstance(t, AggregateMapReduce)), None)
+        outs = []
+        for i in range(0, len(pids), ODP_BATCH):
+            sub = pids[i:i + ODP_BATCH]
+            with shard.lock:   # store snapshot + key materialization only
+                keys = [shard.rv_key_of(int(p)) for p in sub]
+                data = self._paged_selection(shard, sub, keys)
+            for t in prefix:
+                data = t.apply(data, ctx)
+            if isinstance(data, FusedWindowData):
+                data = data.materialize()
+            outs.append(data)
+        merged = None
+        if agg is not None:
+            merged = _merge_heterogeneous(outs, agg.operator, agg.params,
+                                          agg.by, agg.without)
+        if merged is None:
+            mats = [_as_matrix(o).to_host() for o in outs]
+            nonempty = [m for m in mats if m.num_series]
+            if nonempty:
+                vals = np.concatenate([np.asarray(m.values) for m in nonempty],
+                                      axis=0)
+                keys = [k for m in nonempty for k in m.keys]
+                merged = ResultMatrix(nonempty[0].out_ts, vals, keys,
+                                      nonempty[0].bucket_les)
+            else:
+                merged = mats[0]
+        for t in suffix:
+            merged = t.apply(merged, ctx)
+        return merged
 
     def do_execute(self, ctx) -> SeriesSelection:
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
@@ -911,24 +1017,21 @@ class SelectRawPartitionsExec(ExecPlan):
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
                                    jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
+        store = shard.store
+        les = getattr(shard, "bucket_les", None)
+        # on-demand paging: query reaches behind resident data -> merge cold
+        # chunks from the sink (ref: OnDemandPagingShard.scanPartitions)
+        if les is None and shard.needs_paging(pids, self.start_ms):
+            if len(pids) > ODP_BATCH:
+                return _WideODP(pids)
+            return self._paged_selection(
+                shard, pids, [shard.rv_key_of(int(p)) for p in pids])
         if len(pids) > GATHER_THRESHOLD:
             # wide selection: defer key materialization (global aggregates
             # never read them; per-series outputs pay the cost on iteration)
             keys = LazyKeys(shard, pids)
         else:
             keys = [shard.rv_key_of(int(p)) for p in pids]
-        store = shard.store
-        les = getattr(shard, "bucket_les", None)
-        # on-demand paging: query reaches behind resident data -> merge cold
-        # chunks from the sink (ref: OnDemandPagingShard.scanPartitions)
-        if les is None and shard.needs_paging(pids, self.start_ms):
-            if len(pids) > GATHER_THRESHOLD:
-                raise QueryError(
-                    f"{len(pids)} series need on-demand paging beyond memory "
-                    "retention; narrow the selection or query a downsampled dataset")
-            ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms, self.end_ms)
-            return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
-                                   jnp.asarray(n_h), keys, None, None)
         ts, val, n = store.arrays()
         total = len(shard.index)
         grid = store.grid_info()
@@ -1014,21 +1117,13 @@ class ReduceAggregateExec(ExecPlan):
 
     def do_execute(self, ctx):
         results = [c.execute(ctx) for c in self.children]
-        if results and isinstance(results[0], AggPartial):
-            return _merge_partials(self.operator, results)
-        kinds = {TopKPartial: _merge_topk, SketchPartial: _merge_sketch,
-                 CountValuesPartial: _merge_count_values}
-        for kind, merge in kinds.items():
-            if not any(isinstance(r, kind) for r in results):
-                continue
-            # the per-shard group cap is data-dependent, so a sibling shard
-            # may have fallen back to a full matrix: normalize it here (the
-            # matrix has full information; the reverse is impossible)
-            norm = [r if isinstance(r, kind)
-                    else _order_stat_map(_as_mview(r), self.operator,
-                                         self.params, self.by, self.without)
-                    for r in results]
-            return merge(norm)
+        # the per-shard group cap is data-dependent, so a sibling shard may
+        # have fallen back to a full matrix: normalization happens inside
+        # (the matrix has full information; the reverse is impossible)
+        merged = _merge_heterogeneous(results, self.operator, self.params,
+                                      self.by, self.without)
+        if merged is not None:
+            return merged
         mats = [_as_matrix(r).to_host() for r in results]
         mats = [m for m in mats if m.num_series]
         if not mats:

@@ -115,6 +115,18 @@ def test_topk_of_infinite_and_k_zero(eng2):
     # topk(0, ...) selects nothing
     r = eng2.query_range("topk(0, m)", BASE + 200_000, BASE + 260_000, 30_000)
     assert len(_series(r)) == 0
+    # -Inf is a real sample: bottomk must keep it (fill-value ties must not
+    # displace it) and quantile(1) of +Inf data reports +Inf, not a clamp
+    r = eng2.query_range("bottomk(1, 0 - (m / (m - m)))",
+                         BASE + 200_000, BASE + 260_000, 30_000)
+    s = _series(r)
+    assert len(s) >= 1
+    for _d, (t, v) in s.items():
+        assert np.isneginf(v).all()
+    r = eng2.query_range("quantile(1, m / (m - m))",
+                         BASE + 200_000, BASE + 260_000, 30_000)
+    ((_d, (_t, v)),) = list(_series(r).items())
+    assert np.isposinf(v).all()
 
 
 def test_mixed_partial_and_fallback_children(eng2, monkeypatch):

@@ -177,3 +177,40 @@ def test_on_demand_paging(tmp_path):
     want = eval_range_fn("sum_over_time", tgrid, np.arange(30.0),
                          np.arange(BASE + 60_000, BASE + 290_001, 30_000), 60_000)
     np.testing.assert_allclose(vals, want[~np.isnan(want)])
+
+
+def test_wide_on_demand_paging_batches(tmp_path, monkeypatch):
+    """Selections wider than one paging batch stream through in bounded-memory
+    pid batches whose per-batch results merge (previously a hard QueryError;
+    ref: OnDemandPagingShard.scala:58 pages any width)."""
+    import filodb_tpu.query.exec as qe
+    monkeypatch.setattr(qe, "ODP_BATCH", 64)
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    N = 200
+    cfg = StoreConfig(max_series_per_shard=256, samples_per_series=32,
+                      flush_batch_size=10**9, groups_per_shard=1,
+                      retention_ms=200_000, dtype="float64")
+    shard = ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+    b = RecordBuilder(GAUGE)
+    for t in range(30):
+        for i in range(N):
+            b.add({"_metric_": "m", "host": f"h{i}"}, BASE + t * IV, float(t))
+    shard.ingest(b.build(), offset=0)
+    shard.flush_all_groups()
+    shard.store.compact(BASE + 20 * IV)     # early samples now sink-only
+    from filodb_tpu.query.engine import QueryEngine
+    eng = QueryEngine(ms, "prometheus")
+    # aggregated: partials merge across batches
+    r = eng.query_range("sum(count_over_time(m[1m]))",
+                        BASE + 60_000, BASE + 290_000, 30_000)
+    (_k, ts, vals), = list(r.matrix.iter_series())
+    np.testing.assert_allclose(vals, 7.0 * N)   # 7 samples per 1m window, all series
+    # per-series: matrices concatenate across batches
+    r = eng.query_range("last_over_time(m[1m])",
+                        BASE + 60_000, BASE + 90_000, 30_000)
+    assert r.matrix.num_series == N
+    # order statistics: partials merge across batches too
+    r = eng.query_range("topk(3, sum_over_time(m[1m]))",
+                        BASE + 60_000, BASE + 90_000, 30_000)
+    assert r.matrix.num_series <= 3
