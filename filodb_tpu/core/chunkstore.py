@@ -93,7 +93,9 @@ class SeriesStore:
         self.C = capacity
         self.dtype = dtype
         self.nbuckets = nbuckets   # 0 = scalar values; >0 = histogram [S, C, B]
-        dev = device or jax.devices()[0]
+        # local_devices, not devices: under multi-host jax.distributed the
+        # global list leads with rank 0's (non-addressable) device
+        dev = device or jax.local_devices()[0]
         vshape = (max_series, capacity) if not nbuckets else (max_series, capacity, nbuckets)
         self.ts = jax.device_put(jnp.full((max_series, capacity), TS_PAD, jnp.int64), dev)
         self.val = jax.device_put(jnp.zeros(vshape, dtype), dev)

@@ -558,11 +558,10 @@ class TimeSeriesShard:
         first = self.store.first_ts[pids]
         return bool((first[first >= 0] > start_ms).any())
 
-    def read_with_paging(self, pids: np.ndarray, start_ms: int, end_ms: int):
-        """Merged (ts [P, C'], val [P, C'], n [P]) host arrays combining paged
-        cold chunks (from the sink) with resident device data, deduped on the
-        per-series resident first-timestamp boundary."""
-        from .chunkstore import TS_PAD
+    def read_cold_for(self, pids: np.ndarray, start_ms: int, end_ms: int):
+        """Sink-side cold chunks for the given pids: pid -> ([ts...], [vals...]).
+        Needs NO shard lock — sink logs are append-only and torn-tolerant, so
+        wide paged scans must not stall ingest while reading disk."""
         cold_ts: dict[int, list] = {int(p): [] for p in pids}
         cold_val: dict[int, list] = {int(p): [] for p in pids}
         reader = getattr(self.sink, "read_chunksets", None)
@@ -572,6 +571,17 @@ class TimeSeriesShard:
                     if r.part_id in cold_ts:
                         cold_ts[r.part_id].append(r.ts)
                         cold_val[r.part_id].append(np.asarray(r.values))
+        return cold_ts, cold_val
+
+    def read_with_paging(self, pids: np.ndarray, start_ms: int, end_ms: int,
+                         cold=None):
+        """Merged (ts [P, C'], val [P, C'], n [P]) host arrays combining paged
+        cold chunks (from the sink) with resident device data, deduped on the
+        per-series resident first-timestamp boundary. ``cold`` accepts a
+        pre-fetched read_cold_for result (gathered outside the shard lock)."""
+        from .chunkstore import TS_PAD
+        cold_ts, cold_val = cold if cold is not None else \
+            self.read_cold_for(pids, start_ms, end_ms)
         rows_ts, rows_val = [], []
         for p in pids:
             p = int(p)

@@ -21,11 +21,14 @@ its index in the sorted member list. Membership liveness is heartbeat-based
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import threading
 import time
 from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
 
 
 # --------------------------------------------------------------------------
@@ -76,18 +79,23 @@ class FileRegistrarDiscovery(SeedDiscovery):
         safe = addr.replace(":", "_").replace("/", "_")
         return os.path.join(self.path, f"{safe}.member")
 
-    def register(self, addr: str) -> None:
+    def register(self, addr: str, claims: dict | None = None) -> None:
+        """Heartbeat, optionally carrying the node's shard ownership claims
+        ({dataset: [shard ids]}). Claims let a (re)joining node adopt the
+        incumbent assignment instead of computing a fresh one — the file
+        registrar's stand-in for the reference's cluster-singleton
+        ShardManager state."""
         tmp = self._member_file(addr) + ".tmp"
         with self._lock:
             with open(tmp, "w") as f:
-                f.write(json.dumps({"addr": addr, "ts": time.time()}))
+                f.write(json.dumps({"addr": addr, "ts": time.time(),
+                                    "claims": claims or {}}))
             os.replace(tmp, self._member_file(addr))
 
     heartbeat = register     # a re-registration refreshes the timestamp
 
-    def discover(self) -> list[str]:
+    def _live_entries(self):
         now = time.time()
-        out = []
         for name in os.listdir(self.path):
             if not name.endswith(".member"):
                 continue
@@ -95,10 +103,16 @@ class FileRegistrarDiscovery(SeedDiscovery):
                 with open(os.path.join(self.path, name)) as f:
                     m = json.loads(f.read())
                 if now - m["ts"] <= self.stale_s:
-                    out.append(m["addr"])
+                    yield m
             except (OSError, ValueError, KeyError):
                 continue     # torn read of a concurrent rewrite — skip
-        return sorted(out)
+
+    def discover(self) -> list[str]:
+        return sorted(m["addr"] for m in self._live_entries())
+
+    def claims(self) -> dict[str, dict]:
+        """Live members' shard-ownership claims: addr -> {dataset: [ids]}."""
+        return {m["addr"]: m.get("claims") or {} for m in self._live_entries()}
 
 
 # --------------------------------------------------------------------------
@@ -182,6 +196,9 @@ class MembershipMonitor(threading.Thread):
         self.self_addr = self_addr
         self.on_down = on_down
         self.on_up = on_up
+        # optional provider of this node's shard-ownership claims, published
+        # with every heartbeat so late joiners adopt the incumbent assignment
+        self.claims_fn = None
         # fired when OUR OWN heartbeat gap exceeded stale_s — peers have
         # declared us dead and reassigned our shards, so we must fail-stop
         # (the Akka quarantine analog: a removed-but-alive node restarts)
@@ -201,7 +218,10 @@ class MembershipMonitor(threading.Thread):
             self._stop_ev.set()
             self.on_self_stale()
             return
-        self.registrar.heartbeat(self.self_addr)
+        if self.claims_fn is not None:
+            self.registrar.heartbeat(self.self_addr, self.claims_fn())
+        else:
+            self.registrar.heartbeat(self.self_addr)
         self._last_beat = now
         live = set(self.registrar.discover())
         for gone in sorted(self._known - live - {self.self_addr}):
@@ -211,9 +231,31 @@ class MembershipMonitor(threading.Thread):
                 self.on_up(fresh)
         self._known = live
 
+    def publish_now(self) -> None:
+        """Push a fresh heartbeat (with current claims) immediately — called
+        on assignment changes so joiners reading the registrar see takeover
+        state without waiting out the heartbeat interval."""
+        try:
+            if self.claims_fn is not None:
+                self.registrar.heartbeat(self.self_addr, self.claims_fn())
+            else:
+                self.registrar.heartbeat(self.self_addr)
+        except Exception:
+            log.exception("claim publish failed")
+
     def run(self) -> None:
+        # a transient registrar error (e.g. OSError on a shared/NFS heartbeat
+        # file) must not silently kill the monitor thread: the node would stop
+        # heartbeating but never reach the self-stale check, so peers would
+        # reassign its shards WHILE it keeps ingesting — the exact double-
+        # ownership the quarantine exists to prevent. Failed polls leave
+        # _last_beat unset, so a lapse long enough trips on_self_stale above.
         while not self._stop_ev.wait(self.interval_s):
-            self.poll_once()
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("membership poll failed; treating as a missed "
+                              "heartbeat")
 
     def stop(self) -> None:
         self._stop_ev.set()

@@ -103,12 +103,21 @@ class ShardManager:
 
     # -- datasets ------------------------------------------------------------
 
-    def add_dataset(self, dataset: str, num_shards: int) -> None:
-        """Ref: NodeClusterActor SetupDataset -> ShardManager.addDataset."""
+    def add_dataset(self, dataset: str, num_shards: int,
+                    claimed: dict[int, str] | None = None) -> None:
+        """Ref: NodeClusterActor SetupDataset -> ShardManager.addDataset.
+
+        ``claimed`` seeds incumbent ownership (shard -> node) learned from
+        peers' registrar heartbeats: a (re)joining node adopts the cluster's
+        existing assignment — including post-takeover state — instead of
+        computing a fresh full assignment that would double-own shards."""
         if dataset in self.map:
             return
         self.map[dataset] = {s: (None, ShardStatus.UNASSIGNED)
                              for s in range(num_shards)}
+        for s, node in (claimed or {}).items():
+            if 0 <= s < num_shards and node in self.nodes:
+                self.map[dataset][s] = (node, ShardStatus.ASSIGNED)
         self._assign_unassigned(dataset)
 
     def _assign_unassigned(self, dataset: str) -> None:

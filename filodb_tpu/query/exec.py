@@ -951,8 +951,9 @@ class SelectRawPartitionsExec(ExecPlan):
             return self._paged_batches(ctx, shard, result.pids)
         return result
 
-    def _paged_selection(self, shard, pids, keys) -> SeriesSelection:
-        ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms, self.end_ms)
+    def _paged_selection(self, shard, pids, keys, cold=None) -> SeriesSelection:
+        ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms,
+                                                  self.end_ms, cold=cold)
         return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
                                jnp.asarray(n_h), keys, None, None)
 
@@ -983,9 +984,12 @@ class SelectRawPartitionsExec(ExecPlan):
         outs = []
         for i in range(0, len(pids), ODP_BATCH):
             sub = pids[i:i + ODP_BATCH]
-            with shard.lock:   # store snapshot + key materialization only
+            # the sink disk scan runs lock-free (append-only logs); only the
+            # resident-store snapshot + key materialization need the lock
+            cold = shard.read_cold_for(sub, self.start_ms, self.end_ms)
+            with shard.lock:
                 keys = [shard.rv_key_of(int(p)) for p in sub]
-                data = self._paged_selection(shard, sub, keys)
+                data = self._paged_selection(shard, sub, keys, cold=cold)
             for t in prefix:
                 data = t.apply(data, ctx)
             if isinstance(data, FusedWindowData):

@@ -129,8 +129,10 @@ class FiloServer:
         self._registrar = None
         self._running: set[int] = set()
         self._buses: dict[int, object] = {}
-        # guards _running/_buses: mutated by the membership-monitor thread
-        # (resync/quarantine) while HTTP writers snapshot them
+        self._quarantined = False
+        # guards _running/_buses/consumers/_quarantined: mutated by the
+        # membership-monitor thread (resync/quarantine) while HTTP writers
+        # snapshot them and resync events start consumers
         self._shards_lock = threading.Lock()
         self._sink = None
         self._store_cfg = None
@@ -138,9 +140,31 @@ class FiloServer:
     def _start_shard(self, dataset: str, shard_num: int) -> None:
         """Bring up one owned shard: store + (optionally) its bus consumer
         (ref: IngestionActor.startIngestion per assigned shard)."""
+        # claim the shard atomically: a resync event racing quarantine (or a
+        # duplicate event) must not start a consumer that quarantine already
+        # stopped — or never saw
+        with self._shards_lock:
+            if self._quarantined or shard_num in self._running:
+                return
+            self._running.add(shard_num)
+        try:
+            self._start_shard_claimed(dataset, shard_num)
+        except Exception:
+            # a failed start (disk error, broker refused) releases the claim
+            # so a later resync can retry — a leaked claim would silently
+            # no-op every retry and accept writes for a shard with no store
+            with self._shards_lock:
+                self._running.discard(shard_num)
+            raise
+
+    def _start_shard_claimed(self, dataset: str, shard_num: int) -> None:
         cfg = self.config
-        shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
-                                    self._store_cfg, sink=self._sink)
+        try:
+            shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
+                                        self._store_cfg, sink=self._sink)
+        except ValueError:
+            # a retried start after a partial failure: the store exists
+            shard = self.memstore.shard(dataset, shard_num)
         if cfg.get("bus_addr") or cfg.get("bus_dir"):
             if cfg.get("bus_addr"):
                 # remote broker: shard N == broker partition N (ref: Kafka
@@ -154,13 +178,13 @@ class FiloServer:
                                   purge_interval_s=parse_duration_ms(
                                       cfg.get("store.purge_interval", "10m")) / 1000.0)
             with self._shards_lock:
+                if self._quarantined:       # raced quarantine: do not start
+                    self._running.discard(shard_num)
+                    return
                 self._buses[shard_num] = bus
-                self._running.add(shard_num)
-            self.consumers.append(c)
+                self.consumers.append(c)
             c.start()
         else:
-            with self._shards_lock:
-                self._running.add(shard_num)
             self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
 
     def _quarantine(self) -> None:
@@ -170,12 +194,14 @@ class FiloServer:
         (ref: Akka quarantine — a removed-but-alive node must restart)."""
         log.error("node %s quarantined (heartbeat lapsed); stopping ingestion — "
                   "restart to rejoin", self.node)
-        for c in self.consumers:
-            c.stop()
         with self._shards_lock:
+            self._quarantined = True        # no further _start_shard succeeds
+            consumers = list(self.consumers)
             stopped = sorted(self._running)
             self._running.clear()
             self._buses.clear()
+        for c in consumers:
+            c.stop()
         for ds in list(self.engines):
             for s in stopped:
                 if self.manager.node_of(ds, s) == self.node:
@@ -188,6 +214,10 @@ class FiloServer:
                 and ev.shard not in self._running:
             log.info("resync: starting reassigned shard %s", ev.shard)
             self._start_shard(ev.dataset, ev.shard)
+            if self.membership is not None:
+                # publish the takeover immediately: a node joining right now
+                # must see the updated ownership claims
+                self.membership.publish_now()
 
     def start(self) -> "FiloServer":
         cfg = self.config
@@ -208,6 +238,12 @@ class FiloServer:
             self._registrar = FileRegistrarDiscovery(
                 cfg["cluster.registrar"],
                 stale_s=parse_duration_ms(cfg["cluster.stale_after"]) / 1000.0)
+            if cfg["cluster.min_members"] <= 1:
+                log.warning(
+                    "cluster.registrar is set but cluster.min_members=1: two "
+                    "nodes cold-starting concurrently can each resolve a "
+                    "single-member world and double-own shards — set "
+                    "min_members to the expected cluster size")
             world = ClusterBootstrap(self._registrar, self_addr).resolve_world(
                 min_members=cfg["cluster.min_members"],
                 timeout_s=parse_duration_ms(cfg["cluster.join_timeout"]) / 1000.0)
@@ -215,7 +251,24 @@ class FiloServer:
             self.node = self_addr
             for m in world.members:
                 self.manager.add_node(m)
-        self.manager.add_dataset(dataset, num_shards)
+            # adopt incumbent ownership published in peers' heartbeats: a
+            # node (re)joining an established cluster must not recompute a
+            # fresh full assignment (the survivors keep their takeover state;
+            # ref: the cluster-singleton ShardManager avoids this upstream).
+            # Settle one heartbeat first (only when live peers exist) so an
+            # in-flight takeover's claims have landed in the registrar.
+            if any(m != self_addr for m in self._registrar.discover()):
+                time.sleep(
+                    parse_duration_ms(cfg["cluster.heartbeat_interval"]) / 1000.0)
+            claimed: dict[int, str] = {}
+            for peer, peer_claims in self._registrar.claims().items():
+                if peer == self_addr:
+                    continue
+                for s in peer_claims.get(dataset, ()):
+                    claimed[int(s)] = peer
+            self.manager.add_dataset(dataset, num_shards, claimed=claimed)
+        else:
+            self.manager.add_dataset(dataset, num_shards)
         self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
         self._store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
@@ -260,6 +313,11 @@ class FiloServer:
                 self._registrar, self.node, on_down=self.manager.remove_node,
                 on_up=self.manager.add_node, on_self_stale=self._quarantine,
                 interval_s=parse_duration_ms(cfg["cluster.heartbeat_interval"]) / 1000.0)
+            # publish current ownership with each heartbeat so late joiners
+            # adopt the incumbent assignment (rejoin without split-brain)
+            self.membership.claims_fn = lambda: {
+                ds: [int(s) for s in self.manager.shards_of_node(ds, self.node)]
+                for ds in list(self.engines)}
             self.membership.poll_once()
             self.membership.start()
         if cfg.get("profiler.enabled"):

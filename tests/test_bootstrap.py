@@ -83,17 +83,58 @@ CHILD = textwrap.dedent("""
     os.environ.pop("XLA_FLAGS", None)
     import jax
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
     from filodb_tpu.parallel.bootstrap import ClusterBootstrap, FileRegistrarDiscovery
     reg_path, self_addr = sys.argv[1], sys.argv[2]
     boot = ClusterBootstrap(FileRegistrarDiscovery(reg_path), self_addr)
     world = boot.resolve_world(min_members=2, timeout_s=30)
     boot.initialize_jax(world)
+    import numpy as np
     import jax.numpy as jnp
     ndev = jax.local_device_count()
     x = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(jnp.ones(ndev))
     print(f"WORLD rank={world.process_id}/{world.num_processes} "
           f"coord={world.coordinator} procs={jax.process_count()} "
           f"psum={float(x[0])}", flush=True)
+
+    # cross-host sum(rate): each process owns one shard of the dataset and
+    # ingests its own series through the real store; local partial aggregates
+    # ride a psum over the 2-process world — the multi-host analog of
+    # IngestionAndRecoverySpec's query-parity assertion.
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.ops import aggregators, rangefns
+    rank = world.process_id
+    BASE = 1_700_000_000_000
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("prometheus", GAUGE, rank, cfg)
+    b = RecordBuilder(GAUGE)
+    for t in range(40):                         # counters: +(rank+1) per 10s
+        for i in range(4):
+            b.add({"_metric_": "m", "host": f"r{rank}h{i}"},
+                  BASE + t * 10_000, float((rank + 1) * t))
+    shard.ingest(b.build())
+    shard.flush()
+    out_ts = np.arange(BASE + 150_000, BASE + 330_001, 30_000, dtype=np.int64)
+    ts, val, n = shard.store.arrays()
+    mat = rangefns.periodic_samples(ts, val, n, out_ts, 120_000, "rate")
+    parts = aggregators.partial_aggregate(
+        "sum", mat, jnp.zeros(mat.shape[0], jnp.int32), 1)
+    def reduce_fn(s, c):
+        return jax.lax.psum(s, "i"), jax.lax.psum(c, "i")
+    # host arrays in: pmap shards them onto THIS process's local devices (a
+    # committed jax Array could carry another rank's device in its sharding)
+    gs, gc = jax.pmap(reduce_fn, axis_name="i")(
+        np.asarray(parts["sum"])[None], np.asarray(parts["count"])[None])
+    total = aggregators.present_partials(
+        "sum", {"sum": np.asarray(gs[0]), "count": np.asarray(gc[0])})
+    # global: 4 series x 0.1/s (rank 0) + 4 x 0.2/s (rank 1) = 1.2
+    assert np.allclose(np.asarray(total)[0], 1.2, rtol=1e-9), total
+    print(f"GLOBAL_SUM_RATE rank={rank} value={float(np.asarray(total)[0][0]):.6f}",
+          flush=True)
 """)
 
 
@@ -116,7 +157,7 @@ def test_two_process_jax_distributed_bootstrap(tmp_path):
              for a in addrs]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=120)
+        out, _ = p.communicate(timeout=420)   # 1-core box: serialized compiles
         outs.append(out)
         assert p.returncode == 0, out[-2000:]
     world_lines = sorted(ln for o in outs for ln in o.splitlines()
@@ -128,6 +169,12 @@ def test_two_process_jax_distributed_bootstrap(tmp_path):
     assert "procs=2" in world_lines[0] and "procs=2" in world_lines[1]
     assert "rank=0/2" in world_lines[0] and "rank=1/2" in world_lines[1]
     assert total_dev >= 2      # psum spans both processes' devices
+    # the real query path crossed hosts: both ranks computed the identical
+    # correct global sum(rate) from their disjoint shards
+    globals_ = [ln for o in outs for ln in o.splitlines()
+                if ln.startswith("GLOBAL_SUM_RATE")]
+    assert len(globals_) == 2, outs
+    assert all("value=1.200000" in ln for ln in globals_), globals_
 
 
 @pytest.mark.slow
@@ -199,6 +246,18 @@ def test_two_node_elastic_recovery(tmp_path):
             _t.sleep(0.25)
         else:
             raise AssertionError("reassigned shard never served new data")
+        # rejoin after takeover: a restarted node-b must ADOPT the incumbent
+        # assignment published in the survivor's heartbeats — not recompute a
+        # fresh full assignment that would double-own shards (split-brain)
+        b2 = server("node-b:1").start()
+        try:
+            assert {b2.manager.node_of("prometheus", s) for s in (0, 1)} == \
+                {"node-a:1"}
+            assert not b2._running, "rejoining node must not seize owned shards"
+            assert {a.manager.node_of("prometheus", s) for s in (0, 1)} == \
+                {"node-a:1"}
+        finally:
+            b2.shutdown()
     finally:
         a.shutdown()
         broker.stop()
