@@ -55,6 +55,10 @@ DEFAULTS: dict[str, Any] = {
                                  # shard N consumes broker partition N
     "profiler": {"enabled": False, "interval": "100ms"},
     "tracing": {"log_spans": False},
+    # runtime concurrency assertions: lock-discipline checks on donating store
+    # mutations, long-hold lock warnings, donation provenance (ref:
+    # scheduler.enable-assertions, filodb-defaults.conf:117-119)
+    "diagnostics": {"enabled": False},
     # multi-host membership (ref: akka-bootstrapper + Akka gossip deathwatch):
     # registrar = shared member file; self_addr defaults to the HTTP address
     "cluster": {"registrar": None, "self_addr": None,

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import diagnostics
+
 TS_PAD = np.int64(1) << np.int64(62)   # sentinel > any real timestamp
 
 
@@ -113,7 +115,19 @@ class SeriesStore:
         # start-cohort summary cache: recomputing per-row offsets per QUERY is
         # an O(S) host pass; starts only change on new series/compact/free
         self._cohorts = None
+        # concurrency diagnostics: the shard attaches its lock so donating
+        # mutations can assert the locking discipline; the detective records
+        # donation provenance for use-after-donation reports
+        self.owner_lock = None
+        self.detective = diagnostics.DonationDetective()
         self.stats = SeriesStoreStats()
+
+    def _pre_donate(self, what: str) -> None:
+        """Every buffer-donating mutation funnels through here: assert the
+        locking discipline (diagnostics mode) and record provenance."""
+        if self.owner_lock is not None:
+            diagnostics.assert_owned(self.owner_lock, what)
+        self.detective.record(what)
 
     # -- ingest -------------------------------------------------------------
 
@@ -164,6 +178,7 @@ class SeriesStore:
         m = len(r)
         if m == 0:
             return 0
+        self._pre_donate("SeriesStore.append")
         # host bookkeeping
         uniq, first_pos = np.unique(r, return_index=True)
         newly = uniq[self.n_host[uniq] == 0]
@@ -278,6 +293,7 @@ class SeriesStore:
     def compact(self, cutoff_ts: int) -> None:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
         by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
+        self._pre_donate("SeriesStore.compact")
         self.ts, self.val, self.n = _compact(self.ts, self.val, self.n,
                                              jnp.int64(cutoff_ts))
         self.n_host = np.array(self.n)  # fresh writable host copy
@@ -294,6 +310,7 @@ class SeriesStore:
         donated in-place — no transient second copy of the [S, C] arrays."""
         if len(part_ids) == 0:
             return
+        self._pre_donate("SeriesStore.free_rows")
         m = len(part_ids)
         P = _pad_size(m)
         # padded entries use row S -> dropped by the out-of-bounds scatter mode

@@ -82,7 +82,8 @@ class TimeSeriesShard:
         # scatter invalidates (donates) the old store buffers, so query leaves
         # must capture arrays AND dispatch their kernels under this lock
         # (ref analog: per-shard single ingest thread + ChunkMap read locks)
-        self.lock = threading.RLock()
+        from ..utils.diagnostics import TimedRLock
+        self.lock = TimedRLock(f"shard-{shard_num}-lock")
         # per-slot release counters (purge/eviction): lazily materialized
         # query artifacts (LazyKeys) snapshot the epochs of THEIR pids and
         # detect slot reuse without being invalidated by unrelated releases
@@ -98,6 +99,7 @@ class TimeSeriesShard:
             self.store = SeriesStore(config.max_series_per_shard,
                                      config.samples_per_series,
                                      dtype=self._dtype, device=device)
+            self.store.owner_lock = self.lock
         # staging buffers (host)
         self._stage_pid: list[np.ndarray] = []
         self._stage_ts: list[np.ndarray] = []
@@ -264,6 +266,7 @@ class TimeSeriesShard:
                                      self.config.samples_per_series,
                                      dtype=self._dtype, device=self._device,
                                      nbuckets=nb)
+            self.store.owner_lock = self.lock
         n_sets = len(container.label_sets)
         if n_sets == 0 or len(container) == 0:
             return
@@ -445,6 +448,7 @@ class TimeSeriesShard:
                                          self.config.samples_per_series,
                                          dtype=self._dtype, device=self._device,
                                          nbuckets=len(self.bucket_les))
+                self.store.owner_lock = self.lock
         # 1. part keys -> index (ids dense in creation order; a purged slot may
         #    have been re-persisted under a new series — the last entry wins)
         latest: dict[int, tuple[dict, int]] = {}

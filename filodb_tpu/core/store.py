@@ -22,8 +22,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..memory import deltadelta, hist as histcodec, nibblepack
+from ..memory import deltadelta, hist as histcodec, intpack, nibblepack
 from ..memory import native as _native
+
+# nb-field flag marking a bit-packed integer value chunk (high bit: real
+# histogram bucket counts never approach it)
+_INTPACK_FLAG = 0x80000000
 
 # persistence hot path prefers the C++ codecs (bit-identical; tests/test_native.py)
 if _native.available():
@@ -104,6 +108,12 @@ class FileColumnStore(ChunkSink):
             if vals.ndim == 2:     # histogram: 2D-delta + NibblePack codec
                 nb = vals.shape[1]
                 val_enc = histcodec.encode_hist_series(vals)
+            elif len(vals) and intpack.is_integral(vals):
+                # integral chunk (counts, integer gauges): bit-packed int
+                # vector, flagged in the nb field's high bit (ref:
+                # IntBinaryVector bit-packed family)
+                nb = _INTPACK_FLAG
+                val_enc = intpack.pack_ints(vals.astype(np.int64))
             else:
                 nb = 0
                 val_enc = _pack_doubles(vals.astype(np.float64))
@@ -145,7 +155,10 @@ class FileColumnStore(ChunkSink):
                                                                     payload, off)
                         off += 20
                         ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
-                        if nb:
+                        if nb == _INTPACK_FLAG:
+                            vals = intpack.unpack_ints(
+                                payload[off:off + vlen]).astype(np.float64)
+                        elif nb:
                             vals = histcodec.decode_hist_series(
                                 payload[off:off + vlen]).astype(np.float64)
                         else:

@@ -141,6 +141,11 @@ class FiloHttpServer:
                     registry.gauge(f"filodb_shard_{k}", tags).update(float(v))
                 registry.gauge("filodb_shard_num_series", tags).update(
                     float(s.num_series))
+                if hasattr(s.lock, "contentions"):   # TimedRLock diagnostics
+                    registry.gauge("filodb_shard_lock_contentions", tags) \
+                        .update(float(s.lock.contentions))
+                    registry.gauge("filodb_shard_lock_long_holds", tags) \
+                        .update(float(s.lock.long_holds))
 
     def _run(self, fn, priority: Priority):
         """Run query work through the priority scheduler when configured."""

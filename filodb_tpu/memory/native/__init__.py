@@ -45,6 +45,11 @@ def _load():
                                  ctypes.c_int64, ctypes.c_void_p]
     lib.dd_restore.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int64,
                                ctypes.c_int64, ctypes.c_void_p]
+    lib.np_pack_subbyte.restype = ctypes.c_size_t
+    lib.np_pack_subbyte.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_int, ctypes.c_void_p]
+    lib.np_unpack_subbyte.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                      ctypes.c_int, ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -79,6 +84,23 @@ def pack_doubles(vals: np.ndarray) -> bytes:
     xored = np.empty(len(v) - 1, np.uint64)
     lib.xor_chain(bits.ctypes.data, len(v), xored.ctypes.data)
     return bits[:1].tobytes() + pack_u64(xored)
+
+
+def pack_subbyte(off: np.ndarray, bits: int) -> bytes:
+    lib = _load()
+    v = np.ascontiguousarray(off, np.uint64)
+    per = 8 // bits
+    out = np.empty((len(v) + per - 1) // per, np.uint8)
+    n = lib.np_pack_subbyte(v.ctypes.data, len(v), bits, out.ctypes.data)
+    return out[:n].tobytes()
+
+
+def unpack_subbyte(buf, n: int, bits: int) -> np.ndarray:
+    lib = _load()
+    raw = np.ascontiguousarray(np.frombuffer(buf, np.uint8))
+    out = np.empty(n, np.uint64)
+    lib.np_unpack_subbyte(raw.ctypes.data, n, bits, out.ctypes.data)
+    return out
 
 
 def unpack_doubles(buf: bytes, n: int) -> np.ndarray:

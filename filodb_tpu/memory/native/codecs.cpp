@@ -142,4 +142,28 @@ void dd_restore(const uint64_t* zz, size_t n, int64_t first, int64_t slope,
     }
 }
 
+// sub-byte bit-packing for the IntBinaryVector family (bits in {1, 2, 4}):
+// values pack little-endian within each byte (ref: IntBinaryVector.scala
+// bit-packed int vectors; layout spec in memory/intpack.py).
+size_t np_pack_subbyte(const uint64_t* in, size_t n, int bits, uint8_t* out) {
+    int per = 8 / bits;
+    size_t nbytes = (n + (size_t)per - 1) / (size_t)per;
+    for (size_t b = 0; b < nbytes; b++) {
+        uint8_t acc = 0;
+        for (int j = 0; j < per; j++) {
+            size_t i = b * (size_t)per + (size_t)j;
+            if (i < n) acc |= (uint8_t)(in[i] << (j * bits));
+        }
+        out[b] = acc;
+    }
+    return nbytes;
+}
+
+void np_unpack_subbyte(const uint8_t* in, size_t n, int bits, uint64_t* out) {
+    int per = 8 / bits;
+    uint8_t mask = (uint8_t)((1u << bits) - 1u);
+    for (size_t i = 0; i < n; i++)
+        out[i] = (uint64_t)((in[i / (size_t)per] >> ((i % (size_t)per) * (size_t)bits)) & mask);
+}
+
 }  // extern "C"

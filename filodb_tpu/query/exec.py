@@ -938,12 +938,20 @@ class SelectRawPartitionsExec(ExecPlan):
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
         shard = ctx.memstore.shard(ctx.dataset, self.shard)
-        with shard.lock:
-            result = super().execute(ctx)
-            if isinstance(result, FusedWindowData):
-                # a lazy window view must not escape the lock: its kernel
-                # dispatch would race a concurrent ingest flush's donation
-                result = result.materialize()
+        try:
+            with shard.lock:
+                result = super().execute(ctx)
+                if isinstance(result, FusedWindowData):
+                    # a lazy window view must not escape the lock: its kernel
+                    # dispatch would race a concurrent ingest flush's donation
+                    result = result.materialize()
+        except RuntimeError as e:
+            # use-after-donation detective (ref: BlockDetective): name the
+            # donation site instead of jax's opaque "Array has been deleted"
+            if shard.store is not None and "deleted" in str(e):
+                from ..utils.diagnostics import explain_deleted_buffer
+                explain_deleted_buffer(e, shard.store.detective)
+            raise
         if isinstance(result, _WideODP):
             # batched paging runs OUTSIDE the long-held lock: each batch
             # re-locks only around its store snapshot, so ingest is not

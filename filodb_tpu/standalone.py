@@ -221,6 +221,10 @@ class FiloServer:
 
     def start(self) -> "FiloServer":
         cfg = self.config
+        # unconditional: the flag is process-global, so a later server in the
+        # same process must be able to turn it back off
+        from .utils import diagnostics
+        diagnostics.enable(bool(cfg.get("diagnostics.enabled")))
         dataset = cfg["dataset"]
         # shard ids live in a power-of-two space (hash routing, spread); a
         # non-pow2 count would leave routable ids with no owning shard

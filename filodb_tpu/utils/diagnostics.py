@@ -1,0 +1,148 @@
+"""Concurrency diagnostics — config-gated runtime checking of the framework's
+locking/donation discipline.
+
+Reference analogs, re-shaped for this design:
+  - FiloSchedulers.assertThreadName (core/.../memstore/FiloSchedulers.scala:12-16,
+    gated by ``scheduler.enable-assertions``): here the protected resource is
+    not a named scheduler thread but the SHARD LOCK — donation-sensitive store
+    mutations and query array captures must hold it. ``assert_owned`` checks
+    RLock ownership at the hot entry points.
+  - ChunkMap's shared-lock deadlock warnings / leaked-lock counters
+    (memory/.../data/ChunkMap.scala:22-45): ``TimedRLock`` warns when the
+    shard lock is held longer than a threshold and counts contentions.
+  - BlockDetective + reclaim event log (memory/.../BlockDetective.scala):
+    ``DonationDetective`` records who last donated a store's device buffers,
+    and ``explain_deleted_buffer`` turns jax's opaque "Array has been deleted"
+    into an actionable report naming the donation site.
+
+All checks are off by default (zero overhead beyond an ``if``); enable with
+``filodb_tpu.utils.diagnostics.enable()`` or config ``diagnostics.enabled``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+
+log = logging.getLogger(__name__)
+
+enabled = False
+
+HOLD_WARN_S = 5.0      # ChunkMap-style "lock held too long" warning threshold
+
+
+def enable(on: bool = True) -> None:
+    global enabled
+    enabled = on
+
+
+class DiagnosticsError(AssertionError):
+    """A violated concurrency invariant (only raised when diagnostics on)."""
+
+
+def assert_owned(lock, what: str) -> None:
+    """Assert the calling thread holds ``lock`` (an RLock). The donation
+    discipline: store mutations (which donate device buffers) and query
+    array captures must both happen under the shard lock."""
+    if not enabled:
+        return
+    if not lock._is_owned():
+        raise DiagnosticsError(
+            f"{what} requires the shard lock: a concurrent flush would donate "
+            "(delete) device buffers this thread is using — wrap the call in "
+            "`with shard.lock:` (thread "
+            f"{threading.current_thread().name})")
+
+
+class TimedRLock:
+    """RLock wrapper counting contentions and warning on long holds.
+
+    Drop-in for ``threading.RLock()`` (context manager + acquire/release +
+    _is_owned); stats are cheap enough to keep even when diagnostics are off,
+    the long-hold stack capture only happens when on."""
+
+    def __init__(self, name: str = "lock"):
+        self._lock = threading.RLock()
+        self.name = name
+        self.contentions = 0
+        self.long_holds = 0
+        self._acquired_at = 0.0
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(False)
+        if not got:
+            self.contentions += 1
+            if not blocking:
+                return False
+            got = self._lock.acquire(True, timeout)
+            if not got:
+                return False
+        self._depth += 1
+        if self._depth == 1:
+            self._acquired_at = time.monotonic()
+        return True
+
+    def release(self):
+        if self._depth == 1:
+            held = time.monotonic() - self._acquired_at
+            if held > HOLD_WARN_S:
+                self.long_holds += 1
+                if enabled:
+                    log.warning("%s held %.1fs (> %.1fs) — possible lock leak:\n%s",
+                                self.name, held, HOLD_WARN_S,
+                                "".join(traceback.format_stack(limit=8)))
+        self._depth -= 1
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+
+class DonationDetective:
+    """Records the most recent donation of a store's device buffers so a
+    use-after-donation (jax: "Array has been deleted") can name its cause."""
+
+    def __init__(self):
+        self.count = 0
+        self._last_site: str | None = None
+        self._last_when = 0.0
+
+    def record(self, what: str) -> None:
+        self.count += 1
+        if enabled:
+            self._last_site = "".join(traceback.format_stack(limit=6)[:-1])
+            self._last_when = time.time()
+        else:
+            self._last_site = what
+            self._last_when = time.time()
+
+    def explain(self) -> str:
+        if self._last_site is None:
+            return "no donation recorded for this store"
+        age = time.time() - self._last_when
+        return (f"store buffers were last donated {age:.3f}s ago "
+                f"(donation #{self.count}) by:\n{self._last_site}")
+
+
+def explain_deleted_buffer(exc: BaseException, detective: DonationDetective):
+    """If ``exc`` is jax's use-after-donation error AND diagnostics are on,
+    re-raise with the donation provenance attached; otherwise return False
+    (the production path re-raises the original exception untouched)."""
+    if not enabled or "Array has been deleted" not in str(exc):
+        return False
+    raise RuntimeError(
+        "use-after-donation: a captured device array was invalidated by a "
+        "concurrent store mutation. Query code must capture arrays AND "
+        "dispatch kernels under the shard lock. " + detective.explain()
+    ) from exc
