@@ -59,6 +59,10 @@ DEFAULTS: dict[str, Any] = {
     # mutations, long-hold lock warnings, donation provenance (ref:
     # scheduler.enable-assertions, filodb-defaults.conf:117-119)
     "diagnostics": {"enabled": False},
+    # remote storage nodes ("host:port" StoreServers) with replication — the
+    # Cassandra-layer deployment shape; data_dir is the single-node form
+    "store_nodes": [],
+    "store_replication": 2,
     # multi-host membership (ref: akka-bootstrapper + Akka gossip deathwatch):
     # registrar = shared member file; self_addr defaults to the HTTP address
     "cluster": {"registrar": None, "self_addr": None,

@@ -1,0 +1,368 @@
+"""Distributed, durable chunk store — the Cassandra-layer equivalent.
+
+Reference: cassandra/.../columnstore/CassandraColumnStore.scala:47 (chunk +
+ingestion-time-index + partkey tables, token-range ``getScanSplits`` feeding
+Spark batch jobs) and metastore/CheckpointTable.scala. Cassandra supplies
+replication and remote durability; here the same story is built from the
+framework's own parts:
+
+  - ``StoreServer``: a TCP daemon exposing one node's column-store files
+    through three verbs (APPEND for the chunk/part-key logs, PUT for atomic
+    meta/checkpoint replacement, GET for reads) — the "storage node".
+  - ``RemoteStore``: a ChunkSink client speaking that protocol; byte-level
+    formats are identical to FileColumnStore (the chunk-log parser is
+    shared), so local and remote stores interoperate.
+  - ``ReplicatedColumnStore``: fans writes out to ``replication`` replicas
+    chosen on a ring keyed by (dataset, shard); reads fail over to the first
+    healthy replica. Write succeeds if at least one replica accepted
+    (lagging replicas self-heal on the next append of the same log — logs
+    are idempotent to re-reads via recovery's dedup).
+  - ``get_scan_splits``: time-range splits (the token-range analog), aligned
+    to a resolution so batch downsampling over splits never splits a bucket.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+
+from ..utils.netio import recv_exact as _recv_exact
+from .store import ChunkSink, encode_chunkset, iter_chunksets
+
+log = logging.getLogger(__name__)
+
+_REQ = struct.Struct("<BII")      # op, header_len, payload_len
+_RESP = struct.Struct("<BQ")      # status (0 ok), u64 body_len (logs can be big)
+
+OP_APPEND, OP_PUT, OP_GET = 1, 2, 3
+
+_ALLOWED = {"chunks.log", "partkeys.log", "meta.json", "checkpoint.json"}
+
+
+class StoreServer:
+    """One storage node: serves a FileColumnStore directory over TCP."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+        import os
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        hdr = _recv_exact(self.request, _REQ.size)
+                        op, hlen, plen = _REQ.unpack(hdr)
+                        meta = json.loads(_recv_exact(self.request, hlen))
+                        payload = _recv_exact(self.request, plen) if plen else b""
+                        try:
+                            body = outer._serve(op, meta, payload)
+                            self.request.sendall(_RESP.pack(0, len(body)) + body)
+                        except Exception as e:  # noqa: BLE001 - to client
+                            msg = str(e).encode()
+                            self.request.sendall(_RESP.pack(1, len(msg)) + msg)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="store-server")
+
+    def _path(self, meta) -> str:
+        import os
+        name = meta["name"]
+        dataset = str(meta["dataset"]).replace("/", "_").replace("..", "_")
+        if name not in _ALLOWED:
+            raise ValueError(f"unknown store object {name!r}")
+        d = os.path.join(self.root, dataset, f"shard{int(meta['shard'])}")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, name)
+
+    def _serve(self, op: int, meta, payload: bytes) -> bytes:
+        import os
+        path = self._path(meta)
+        if op == OP_APPEND:
+            with open(path, "ab") as f:
+                f.write(payload)
+            return b""
+        if op == OP_PUT:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+            return b""
+        if op == OP_GET:
+            if not os.path.exists(path):
+                return b""
+            offset = int(meta.get("offset", 0))
+            length = meta.get("length")
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(int(length)) if length is not None else f.read()
+        raise ValueError(f"unknown op {op}")
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "StoreServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteStore(ChunkSink):
+    """ChunkSink client of a StoreServer; wire formats match FileColumnStore."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            host, port = self.addr.rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)), timeout=30)
+        return self._sock
+
+    def _request(self, op: int, dataset, shard, name, payload: bytes = b"",
+                 **extra) -> bytes:
+        meta = json.dumps({"dataset": dataset, "shard": shard,
+                           "name": name, **extra}).encode()
+        with self._lock:
+            try:
+                s = self._conn()
+                s.sendall(_REQ.pack(op, len(meta), len(payload)) + meta + payload)
+                status, blen = _RESP.unpack(_recv_exact(s, _RESP.size))
+                body = _recv_exact(s, blen) if blen else b""
+            except (ConnectionError, OSError):
+                self.close()
+                raise
+        if status != 0:
+            raise IOError(f"remote store error: {body.decode()}")
+        return body
+
+    # -- ChunkSink: writes ---------------------------------------------------
+
+    def write_chunkset(self, dataset, shard, group, records):
+        self._request(OP_APPEND, dataset, shard, "chunks.log",
+                      encode_chunkset(group, records))
+
+    def write_part_keys(self, dataset, shard, entries):
+        lines = "".join(
+            json.dumps({"id": pid, "labels": labels, "start": start},
+                       separators=(",", ":")) + "\n"
+            for pid, labels, start in entries)
+        self._request(OP_APPEND, dataset, shard, "partkeys.log", lines.encode())
+
+    def write_meta(self, dataset, shard, meta: dict):
+        self._request(OP_PUT, dataset, shard, "meta.json",
+                      json.dumps(meta).encode())
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        cp = self.read_checkpoints(dataset, shard)
+        cp[group] = offset
+        self._request(OP_PUT, dataset, shard, "checkpoint.json",
+                      json.dumps({str(k): v for k, v in cp.items()}).encode())
+
+    # -- reads ---------------------------------------------------------------
+
+    def read_chunksets(self, dataset, shard, start_ms: int = 0,
+                       end_ms: int = 1 << 62):
+        # stream the log in ranged chunks instead of buffering it whole: the
+        # parser sees a buffered file-like over ranged GETs
+        raw = _RangedReader(self, dataset, shard, "chunks.log")
+        yield from iter_chunksets(io.BufferedReader(raw, 1 << 20),
+                                  start_ms, end_ms)
+
+    def read_part_keys(self, dataset, shard):
+        blob = self._request(OP_GET, dataset, shard, "partkeys.log")
+        for line in blob.decode().splitlines():
+            if not line.strip():
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                return
+            yield e["id"], e["labels"], e["start"]
+
+    def read_meta(self, dataset, shard) -> dict:
+        blob = self._request(OP_GET, dataset, shard, "meta.json")
+        return json.loads(blob) if blob else {}
+
+    def read_checkpoints(self, dataset, shard):
+        blob = self._request(OP_GET, dataset, shard, "checkpoint.json")
+        return {int(k): v for k, v in json.loads(blob).items()} if blob else {}
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class _RangedReader(io.RawIOBase):
+    """File-like over ranged GETs (wrap in io.BufferedReader)."""
+
+    _CHUNK = 4 << 20
+
+    def __init__(self, store: "RemoteStore", dataset, shard, name):
+        self._store = store
+        self._args = (dataset, shard, name)
+        self._pos = 0
+
+    def readable(self):
+        return True
+
+    def readinto(self, b):
+        want = min(len(b), self._CHUNK)
+        blob = self._store._request(OP_GET, *self._args,
+                                    offset=self._pos, length=want)
+        b[:len(blob)] = blob
+        self._pos += len(blob)
+        return len(blob)
+
+
+class ReplicatedColumnStore(ChunkSink):
+    """Replication + failover over N backend stores (local or remote).
+
+    Writes go to ``replication`` replicas chosen on a STABLE ring keyed by
+    crc32(dataset:shard) — Python's hash() randomizes per process, which
+    would strand previously written data. At least one replica must accept a
+    write. Reads consult every reachable replica and serve the one with the
+    most data: an outage can leave a replica with a gappy log, and a partial
+    answer must not mask a complete one (ref: Cassandra replica placement;
+    read-best stands in for read repair)."""
+
+    def __init__(self, backends: list, replication: int = 2):
+        assert backends, "need at least one backend"
+        self.backends = backends
+        self.replication = min(replication, len(backends))
+
+    def _replicas(self, dataset, shard):
+        key = f"{dataset}:{shard}".encode()
+        start = zlib.crc32(key) % len(self.backends)
+        return [self.backends[(start + i) % len(self.backends)]
+                for i in range(self.replication)]
+
+    def _write(self, dataset, shard, fn_name, *args):
+        wrote = 0
+        last_err = None
+        for b in self._replicas(dataset, shard):
+            try:
+                getattr(b, fn_name)(dataset, shard, *args)
+                wrote += 1
+            except Exception as e:  # noqa: BLE001 - replica failure tolerated
+                last_err = e
+                log.warning("replica write %s failed on %r: %s", fn_name, b, e)
+        if wrote == 0:
+            raise IOError(f"all {self.replication} replicas failed") from last_err
+        return wrote
+
+    def write_chunkset(self, dataset, shard, group, records):
+        self._write(dataset, shard, "write_chunkset", group, records)
+
+    def write_part_keys(self, dataset, shard, entries):
+        self._write(dataset, shard, "write_part_keys", list(entries))
+
+    def write_meta(self, dataset, shard, meta):
+        self._write(dataset, shard, "write_meta", meta)
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        self._write(dataset, shard, "write_checkpoint", group, offset)
+
+    def _read_all(self, dataset, shard, fn_name, *args):
+        """Results from every reachable replica: [(backend, result), ...]."""
+        out = []
+        last_err = None
+        for b in self._replicas(dataset, shard):
+            try:
+                res = getattr(b, fn_name)(dataset, shard, *args)
+                out.append((b, list(res) if res is not None and
+                            fn_name in ("read_chunksets", "read_part_keys")
+                            else res))
+            except Exception as e:  # noqa: BLE001 - fail over
+                last_err = e
+                log.warning("replica read %s failed on %r: %s", fn_name, b, e)
+        if not out:
+            raise IOError("all replicas failed") from last_err
+        return out
+
+    def read_chunksets(self, dataset, shard, start_ms: int = 0,
+                       end_ms: int = 1 << 62):
+        # best-replica: most total samples wins (a replica that missed
+        # appends during an outage has a shorter log; its partial answer
+        # must not mask a complete sibling)
+        results = self._read_all(dataset, shard, "read_chunksets",
+                                 start_ms, end_ms)
+        def total(res):
+            return sum(len(r.ts) for _g, recs in res for r in recs)
+        return max((res for _b, res in results), key=total)
+
+    def read_part_keys(self, dataset, shard):
+        results = self._read_all(dataset, shard, "read_part_keys")
+        return max((res or [] for _b, res in results), key=len)
+
+    def read_meta(self, dataset, shard) -> dict:
+        for _b, res in self._read_all(dataset, shard, "read_meta"):
+            if res:
+                return res
+        return {}
+
+    def read_checkpoints(self, dataset, shard):
+        # per-group max across replicas: the freshest durable watermark wins
+        merged: dict[int, int] = {}
+        for _b, res in self._read_all(dataset, shard, "read_checkpoints"):
+            for g, off in (res or {}).items():
+                merged[g] = max(merged.get(g, -1), off)
+        return merged
+
+    def close(self):
+        for b in self.backends:
+            if hasattr(b, "close"):
+                b.close()
+
+
+def get_scan_splits(store, dataset, shard, n_splits: int,
+                    align_ms: int = 60_000) -> list[tuple[int, int]]:
+    """Time-range scan splits over a shard's persisted chunks (the
+    ``getScanSplits`` token-range analog, CassandraColumnStore.scala:47).
+    Boundaries align to ``align_ms`` so a batch job mapping over splits never
+    splits a downsample bucket across two workers."""
+    lo, hi = None, None
+    for _g, records in store.read_chunksets(dataset, shard) or ():
+        for r in records:
+            if len(r.ts):
+                lo = int(r.ts[0]) if lo is None else min(lo, int(r.ts[0]))
+                hi = int(r.ts[-1]) if hi is None else max(hi, int(r.ts[-1]))
+    if lo is None:
+        return []
+    n_splits = max(1, n_splits)
+    lo_al = (lo // align_ms) * align_ms
+    hi_al = ((hi // align_ms) + 1) * align_ms
+    span = hi_al - lo_al
+    per = max(((span // n_splits) // align_ms) * align_ms, align_ms)
+    splits = []
+    start = lo_al
+    while start < hi_al:
+        end = min(start + per, hi_al)
+        if len(splits) == n_splits - 1:
+            end = hi_al
+        splits.append((start, end - 1))    # inclusive ranges, disjoint
+        start = end
+    return splits

@@ -87,6 +87,73 @@ class NullColumnStore(ChunkSink):
 _CHUNK_HDR = struct.Struct("<IIQ")     # group, n_records, flush_seq
 
 
+def encode_chunkset(group: int, records) -> bytes:
+    """One chunk-log frame: header + per-record codec-compressed payload.
+    Shared by the local file store and the remote store client."""
+    frames = []
+    for r in records:
+        ts_enc = deltadelta.encode(r.ts)
+        vals = np.asarray(r.values)
+        if vals.ndim == 2:     # histogram: 2D-delta + NibblePack codec
+            nb = vals.shape[1]
+            val_enc = histcodec.encode_hist_series(vals)
+        elif len(vals) and intpack.is_integral(vals):
+            # integral chunk (counts, integer gauges): bit-packed int
+            # vector, flagged in the nb field's high bit (ref:
+            # IntBinaryVector bit-packed family)
+            nb = _INTPACK_FLAG
+            val_enc = intpack.pack_ints(vals.astype(np.int64))
+        else:
+            nb = 0
+            val_enc = _pack_doubles(vals.astype(np.float64))
+        frames.append(struct.pack("<IIIII", r.part_id, len(r.ts), nb,
+                                  len(ts_enc), len(val_enc)) + ts_enc + val_enc)
+    payload = b"".join(frames)
+    return (_CHUNK_HDR.pack(group, len(records), 0)
+            + struct.pack("<I", len(payload)) + payload)
+
+
+def iter_chunksets(f, start_ms: int = 0, end_ms: int = 1 << 62):
+    """Parse a chunk-log stream (any binary file-like): yields (group,
+    [ChunkSetRecord...]) overlapping [start_ms, end_ms]. Shared by the local
+    file store and the remote store client; a torn or corrupt tail frame
+    truncates (WAL semantics)."""
+    while True:
+        hdr = f.read(_CHUNK_HDR.size)
+        if len(hdr) < _CHUNK_HDR.size:
+            return
+        try:
+            group, n_rec, _ = _CHUNK_HDR.unpack(hdr)
+            raw_len = f.read(4)
+            if len(raw_len) < 4:
+                return            # torn tail: a crashed append; truncate
+            (plen,) = struct.unpack("<I", raw_len)
+            payload = f.read(plen)
+            if len(payload) < plen:
+                return            # torn tail
+            records = []
+            off = 0
+            for _ in range(n_rec):
+                pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII", payload, off)
+                off += 20
+                ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
+                if nb == _INTPACK_FLAG:
+                    vals = intpack.unpack_ints(
+                        payload[off:off + vlen]).astype(np.float64)
+                elif nb:
+                    vals = histcodec.decode_hist_series(
+                        payload[off:off + vlen]).astype(np.float64)
+                else:
+                    vals = _unpack_doubles(payload[off:off + vlen], n)
+                off += vlen
+                if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
+                    records.append(ChunkSetRecord(pid, ts, vals))
+        except (struct.error, ValueError, IndexError):
+            return                # corrupt tail frame: stop at last good one
+        if records:
+            yield group, records
+
+
 class FileColumnStore(ChunkSink):
     """Durable columnar chunk store on local disk (the Cassandra-equivalent)."""
 
@@ -101,29 +168,9 @@ class FileColumnStore(ChunkSink):
     # -- chunks --------------------------------------------------------------
 
     def write_chunkset(self, dataset, shard, group, records):
-        frames = []
-        for r in records:
-            ts_enc = deltadelta.encode(r.ts)
-            vals = np.asarray(r.values)
-            if vals.ndim == 2:     # histogram: 2D-delta + NibblePack codec
-                nb = vals.shape[1]
-                val_enc = histcodec.encode_hist_series(vals)
-            elif len(vals) and intpack.is_integral(vals):
-                # integral chunk (counts, integer gauges): bit-packed int
-                # vector, flagged in the nb field's high bit (ref:
-                # IntBinaryVector bit-packed family)
-                nb = _INTPACK_FLAG
-                val_enc = intpack.pack_ints(vals.astype(np.int64))
-            else:
-                nb = 0
-                val_enc = _pack_doubles(vals.astype(np.float64))
-            frames.append(struct.pack("<IIIII", r.part_id, len(r.ts), nb,
-                                      len(ts_enc), len(val_enc)) + ts_enc + val_enc)
-        payload = b"".join(frames)
         # one buffered append minimizes the torn-frame window; the reader
         # treats a torn tail as truncation (WAL semantics)
-        buf = (_CHUNK_HDR.pack(group, len(records), 0)
-               + struct.pack("<I", len(payload)) + payload)
+        buf = encode_chunkset(group, records)
         with open(os.path.join(self._dir(dataset, shard), "chunks.log"), "ab") as f:
             f.write(buf)
 
@@ -135,41 +182,7 @@ class FileColumnStore(ChunkSink):
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
-            while True:
-                hdr = f.read(_CHUNK_HDR.size)
-                if len(hdr) < _CHUNK_HDR.size:
-                    return
-                try:
-                    group, n_rec, _ = _CHUNK_HDR.unpack(hdr)
-                    raw_len = f.read(4)
-                    if len(raw_len) < 4:
-                        return        # torn tail: a crashed append; truncate
-                    (plen,) = struct.unpack("<I", raw_len)
-                    payload = f.read(plen)
-                    if len(payload) < plen:
-                        return        # torn tail
-                    records = []
-                    off = 0
-                    for _ in range(n_rec):
-                        pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII",
-                                                                    payload, off)
-                        off += 20
-                        ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
-                        if nb == _INTPACK_FLAG:
-                            vals = intpack.unpack_ints(
-                                payload[off:off + vlen]).astype(np.float64)
-                        elif nb:
-                            vals = histcodec.decode_hist_series(
-                                payload[off:off + vlen]).astype(np.float64)
-                        else:
-                            vals = _unpack_doubles(payload[off:off + vlen], n)
-                        off += vlen
-                        if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
-                            records.append(ChunkSetRecord(pid, ts, vals))
-                except (struct.error, ValueError, IndexError):
-                    return            # corrupt tail frame: stop at last good one
-                if records:
-                    yield group, records
+            yield from iter_chunksets(f, start_ms, end_ms)
 
     # -- part keys ------------------------------------------------------------
 

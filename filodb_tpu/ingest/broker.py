@@ -47,14 +47,7 @@ ST_OK, ST_ERR = 0, 1
 _MAX_PAYLOAD = 64 << 20     # refuse absurd frames instead of OOMing
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return bytes(buf)
+from ..utils.netio import recv_exact as _recv_exact  # noqa: E402 - shared framing helper
 
 
 class BrokerServer:

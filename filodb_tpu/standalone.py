@@ -273,7 +273,15 @@ class FiloServer:
             self.manager.add_dataset(dataset, num_shards, claimed=claimed)
         else:
             self.manager.add_dataset(dataset, num_shards)
-        self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
+        if cfg.get("store_nodes"):
+            # remote storage nodes with replication (the Cassandra-layer
+            # deployment shape; ref: CassandraTSStoreFactory wiring)
+            from .core.diststore import RemoteStore, ReplicatedColumnStore
+            self._sink = ReplicatedColumnStore(
+                [RemoteStore(a) for a in cfg["store_nodes"]],
+                replication=cfg.get("store_replication") or 2)
+        else:
+            self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
         self._store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))

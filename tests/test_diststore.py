@@ -1,0 +1,168 @@
+"""Distributed/durable chunk store: remote storage nodes, replication with
+failover, and time-range scan splits (ref: CassandraColumnStore chunk/
+partkey/checkpoint tables + getScanSplits feeding batch jobs)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.diststore import (ReplicatedColumnStore, RemoteStore,
+                                       StoreServer, get_scan_splits)
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import ChunkSetRecord, FileColumnStore
+
+BASE = 1_700_000_000_000
+IV = 10_000
+
+
+def _shard_with(sink, tmp=None):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                      flush_batch_size=10**9, groups_per_shard=2,
+                      dtype="float64")
+    return ms, ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+
+
+def _ingest_demo(shard, n=20):
+    b = RecordBuilder(GAUGE)
+    for t in range(n):
+        for s in range(3):
+            b.add({"_metric_": "m", "host": f"h{s}"}, BASE + t * IV,
+                  float(s * 100 + t))
+    shard.ingest(b.build(), offset=0)
+    shard.flush_all_groups()
+
+
+def test_remote_store_roundtrip_and_recovery(tmp_path):
+    """A shard persisting to a remote storage node recovers from it — the
+    full sink surface (chunks, part keys, meta, checkpoints) over TCP."""
+    srv = StoreServer(str(tmp_path / "node0")).start()
+    try:
+        remote = RemoteStore(f"127.0.0.1:{srv.port}")
+        ms, shard = _shard_with(remote)
+        _ingest_demo(shard)
+        ms2, shard2 = _shard_with(RemoteStore(f"127.0.0.1:{srv.port}"))
+        replayed = shard2.recover()
+        assert shard2.num_series == 3
+        ts0, v0 = shard2.store.series_snapshot(0)
+        assert len(ts0) == 20 and v0[-1] == 19.0
+        cps = remote.read_checkpoints("prometheus", 0)
+        assert set(cps.values()) == {0}
+    finally:
+        srv.stop()
+
+
+def test_replication_and_failover(tmp_path):
+    """RF=2 over three nodes: both replicas hold the data; losing one node
+    keeps reads AND writes working (consistency ONE)."""
+    servers = [StoreServer(str(tmp_path / f"node{i}")).start() for i in range(3)]
+    stores = [RemoteStore(f"127.0.0.1:{s.port}") for s in servers]
+    try:
+        repl = ReplicatedColumnStore(stores, replication=2)
+        ms, shard = _shard_with(repl)
+        _ingest_demo(shard)
+        # exactly two backends hold the shard's chunks
+        holders = [i for i, st in enumerate(stores)
+                   if list(st.read_chunksets("prometheus", 0))]
+        assert len(holders) == 2
+        # kill one replica: reads fail over, writes still succeed
+        servers[holders[0]].stop()
+        stores[holders[0]].close()
+        recs = list(repl.read_chunksets("prometheus", 0))
+        assert recs, "failover read returned nothing"
+        b = RecordBuilder(GAUGE)
+        b.add({"_metric_": "m", "host": "h0"}, BASE + 30 * IV, 99.0)
+        shard.ingest(b.build(), offset=1)
+        shard.flush_all_groups()       # write tolerated with one replica down
+        # a fresh shard recovers through the surviving replica
+        ms2, shard2 = _shard_with(
+            ReplicatedColumnStore(stores, replication=2))
+        shard2.recover()
+        assert shard2.num_series == 3
+        ts0, v0 = shard2.store.series_snapshot(0)
+        assert v0[-1] == 99.0
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_lagging_replica_does_not_mask_complete_one(tmp_path):
+    """A replica that missed appends during an outage answers with a gappy
+    log; reads must serve the most complete replica, and checkpoints merge
+    per-group max (read-best in place of read repair)."""
+    a = FileColumnStore(str(tmp_path / "a"))
+    b = FileColumnStore(str(tmp_path / "b"))
+    repl = ReplicatedColumnStore([a, b], replication=2)
+    ts1 = BASE + np.arange(10) * IV
+    repl.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts1, np.arange(10.0))])
+    repl.write_checkpoint("ds", 0, 0, 5)
+    # replica A "missed" the first write: wipe it, then both receive a second
+    import shutil
+    shutil.rmtree(tmp_path / "a")
+    ts2 = BASE + (10 + np.arange(10)) * IV
+    repl.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts2, np.arange(10.0))])
+    repl.write_checkpoint("ds", 0, 0, 9)
+    total = sum(len(r.ts) for _g, recs in repl.read_chunksets("ds", 0)
+                for r in recs)
+    assert total == 20        # complete replica B wins, not gappy A
+    assert repl.read_checkpoints("ds", 0) == {0: 9}
+
+
+def test_all_replicas_down_raises(tmp_path):
+    srv = StoreServer(str(tmp_path / "n0")).start()
+    st = RemoteStore(f"127.0.0.1:{srv.port}")
+    repl = ReplicatedColumnStore([st], replication=1)
+    srv.stop()
+    st.close()
+    with pytest.raises(IOError):
+        repl.write_part_keys("ds", 0, [(0, {"a": "b"}, 1)])
+
+
+def test_scan_splits_align_and_cover(tmp_path):
+    store = FileColumnStore(str(tmp_path))
+    ts = BASE + np.arange(0, 700) * IV          # ~117 minutes of data
+    store.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts, np.arange(700.0))])
+    splits = get_scan_splits(store, "ds", 0, 4, align_ms=60_000)
+    assert 1 <= len(splits) <= 4
+    # aligned starts, disjoint, covering
+    for i, (lo, hi) in enumerate(splits):
+        assert lo % 60_000 == 0
+        assert (hi + 1) % 60_000 == 0
+        if i:
+            assert lo == splits[i - 1][1] + 1
+    assert splits[0][0] <= int(ts[0]) and splits[-1][1] >= int(ts[-1])
+    assert get_scan_splits(store, "ds", 7, 4) == []   # empty shard
+
+
+def test_batch_downsample_over_splits_matches_single_pass(tmp_path):
+    """Mapping the batch downsampler over scan splits (the Spark-over-token-
+    ranges analog) produces the same records as one full pass."""
+    from filodb_tpu.jobs.batch_downsampler import run_batch_downsample
+    RES = 60_000
+    store = FileColumnStore(str(tmp_path / "a"))
+    store2 = FileColumnStore(str(tmp_path / "b"))
+    ts = BASE + np.arange(0, 360) * IV
+    vals = np.sin(np.arange(360.0)) * 10 + 50
+    for st in (store, store2):
+        st.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts, vals)])
+        st.write_part_keys("ds", 0, [(0, {"_metric_": "m"}, int(ts[0]))])
+    run_batch_downsample(store, "ds", 0, RES)
+    for lo, hi in get_scan_splits(store2, "ds", 0, 3, align_ms=RES):
+        run_batch_downsample(store2, "ds", 0, RES, start_ms=lo, end_ms=hi)
+    one = {r.part_id: r for _g, recs in
+           store.read_chunksets("ds:ds_1m:dAvg", 0) for r in recs}
+    # split runs append multiple chunksets; merge by time
+    split_ts, split_v = [], []
+    for _g, recs in store2.read_chunksets("ds:ds_1m:dAvg", 0):
+        for r in recs:
+            split_ts.append(r.ts)
+            split_v.append(np.asarray(r.values))
+    st_all = np.concatenate(split_ts)
+    sv_all = np.concatenate(split_v)
+    order = np.argsort(st_all)
+    np.testing.assert_array_equal(st_all[order], one[0].ts)
+    np.testing.assert_allclose(sv_all[order], one[0].values)

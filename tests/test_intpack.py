@@ -77,6 +77,10 @@ def test_persistence_uses_int_codec(tmp_path):
            for r in recs}
     np.testing.assert_array_equal(out[0].values, counts)
     np.testing.assert_array_equal(out[1].values, floats)
-    # the integral chunk is materially smaller than 8B/sample
-    import os
-    assert os.path.getsize(tmp_path / "ds" / "shard0" / "chunks.log") > 0
+    # the first (integral) frame really took the int codec: its nb field
+    # carries the flag and the payload is far below 8B/sample
+    import struct
+    blob = (tmp_path / "ds" / "shard0" / "chunks.log").read_bytes()
+    off = struct.calcsize("<IIQ") + 4
+    _pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII", blob, off)
+    assert nb == 0x80000000 and vlen < n * 8 / 3, (hex(nb), vlen, n)
