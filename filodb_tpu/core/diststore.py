@@ -40,7 +40,10 @@ log = logging.getLogger(__name__)
 _REQ = struct.Struct("<BII")      # op, header_len, payload_len
 _RESP = struct.Struct("<BQ")      # status (0 ok), u64 body_len (logs can be big)
 
-OP_APPEND, OP_PUT, OP_GET = 1, 2, 3
+OP_APPEND, OP_PUT, OP_GET, OP_STAT = 1, 2, 3, 4
+
+_MAX_HEADER = 1 << 16             # refuse absurd frames instead of OOMing
+_MAX_PAYLOAD = 256 << 20
 
 _ALLOWED = {"chunks.log", "partkeys.log", "meta.json", "checkpoint.json"}
 
@@ -60,9 +63,12 @@ class StoreServer:
                     while True:
                         hdr = _recv_exact(self.request, _REQ.size)
                         op, hlen, plen = _REQ.unpack(hdr)
-                        meta = json.loads(_recv_exact(self.request, hlen))
+                        if hlen > _MAX_HEADER or plen > _MAX_PAYLOAD:
+                            return   # garbage/hostile frame: drop connection
+                        raw = _recv_exact(self.request, hlen)
                         payload = _recv_exact(self.request, plen) if plen else b""
                         try:
+                            meta = json.loads(raw)
                             body = outer._serve(op, meta, payload)
                             self.request.sendall(_RESP.pack(0, len(body)) + body)
                         except Exception as e:  # noqa: BLE001 - to client
@@ -110,6 +116,9 @@ class StoreServer:
             with open(path, "rb") as f:
                 f.seek(offset)
                 return f.read(int(length)) if length is not None else f.read()
+        if op == OP_STAT:
+            size = os.path.getsize(path) if os.path.exists(path) else 0
+            return struct.pack("<Q", size)
         raise ValueError(f"unknown op {op}")
 
     @property
@@ -199,6 +208,11 @@ class RemoteStore(ChunkSink):
             except ValueError:
                 return
             yield e["id"], e["labels"], e["start"]
+
+    def chunk_log_size(self, dataset, shard) -> int:
+        """Byte size of the shard's chunk log (cheap best-replica probe)."""
+        body = self._request(OP_STAT, dataset, shard, "chunks.log")
+        return struct.unpack("<Q", body)[0] if body else 0
 
     def read_meta(self, dataset, shard) -> dict:
         blob = self._request(OP_GET, dataset, shard, "meta.json")
@@ -305,9 +319,33 @@ class ReplicatedColumnStore(ChunkSink):
 
     def read_chunksets(self, dataset, shard, start_ms: int = 0,
                        end_ms: int = 1 << 62):
-        # best-replica: most total samples wins (a replica that missed
+        # best-replica: the longest chunk log wins (a replica that missed
         # appends during an outage has a shorter log; its partial answer
-        # must not mask a complete sibling)
+        # must not mask a complete sibling). The cheap size probe keeps this
+        # streaming — materializing every replica to count samples would
+        # defeat the ranged reader underneath.
+        probed = []
+        last_err = None
+        for b in self._replicas(dataset, shard):
+            try:
+                size = (b.chunk_log_size(dataset, shard)
+                        if hasattr(b, "chunk_log_size") else None)
+                probed.append((b, size))
+            except Exception as e:  # noqa: BLE001 - fail over
+                last_err = e
+                log.warning("replica stat failed on %r: %s", b, e)
+        if not probed:
+            raise IOError("all replicas failed") from last_err
+        if all(size is not None for _b, size in probed):
+            order = sorted(probed, key=lambda p: -p[1])
+            for b, _size in order:
+                try:
+                    return list(b.read_chunksets(dataset, shard, start_ms, end_ms))
+                except Exception as e:  # noqa: BLE001 - fail over
+                    last_err = e
+                    log.warning("replica read failed on %r: %s", b, e)
+            raise IOError("all replicas failed") from last_err
+        # backends without a size probe (local stores in tests): materialize
         results = self._read_all(dataset, shard, "read_chunksets",
                                  start_ms, end_ms)
         def total(res):

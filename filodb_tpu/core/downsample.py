@@ -22,7 +22,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-DOWNSAMPLERS = ("dMin", "dMax", "dSum", "dCount", "dAvg", "dLast")
+DOWNSAMPLERS = ("dMin", "dMax", "dSum", "dCount", "dAvg", "dLast", "tTime")
 
 
 @dataclass
@@ -69,29 +69,34 @@ def grid_downsample(val, n, base_ts: int, interval_ms: int, resolution_ms: int,
         out["dLast"] = v[:, k - 1::k][:, :Tds]
     if "dCount" in aggs:
         out["dCount"] = cnt
+    if "tTime" in aggs:
+        # last VALID cell's timestamp per bucket (ref: TimeDownsampler)
+        cell_ms = jnp.arange(C, dtype=jnp.float64) * interval_ms + base_ts
+        out["tTime"] = rw(jnp.where(valid, cell_ms[None, :], -jnp.inf),
+                          -jnp.inf, lax.max)
     empty = np.asarray(cnt) == 0
     out_ts = base_ts + (np.arange(Tds) * k + (k - 1)) * interval_ms
     blocks = []
     for agg in aggs:
         if agg not in out:
             continue
-        vals = np.asarray(out[agg], np.float64)
+        vals = np.array(out[agg], np.float64)   # copy: jax buffers are read-only
         vals[empty] = np.nan
         blocks.append(DownsampledBlock(agg, out_ts, vals))
     return blocks
 
 
 def _group_by_series_bucket(pids, ts, vals, resolution_ms: int):
-    """Shared (series, time-bucket) grouping: time-sorted values per group,
-    dense group index, and each group's pid + bucket-end timestamp."""
+    """Shared (series, time-bucket) grouping: time-sorted values+timestamps
+    per group, dense group index, each group's pid + bucket-end timestamp."""
     bucket = ts // resolution_ms
     order = np.lexsort((ts, bucket, pids))
-    p, b, v = pids[order], bucket[order], vals[order]
+    p, b, t, v = pids[order], bucket[order], ts[order], vals[order]
     newgrp = np.concatenate([[True], (p[1:] != p[:-1]) | (b[1:] != b[:-1])])
     gidx = np.cumsum(newgrp) - 1
     out_pids = p[newgrp]
     out_ts = (b[newgrp] + 1) * resolution_ms - 1    # bucket-end timestamp
-    return v, gidx, int(gidx[-1] + 1), out_pids, out_ts
+    return v, t, gidx, int(gidx[-1] + 1), out_pids, out_ts
 
 
 def downsample_records_hist(pids, ts, vals, resolution_ms: int) -> dict[str, tuple]:
@@ -100,7 +105,7 @@ def downsample_records_hist(pids, ts, vals, resolution_ms: int) -> dict[str, tup
     ChunkDownsampler.scala:26,136 — histReader.sum over the bucket's rows)."""
     if len(pids) == 0:
         return {}
-    v, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+    v, _t, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
         pids, ts, vals, resolution_ms)
     sums = np.zeros((ngroups, v.shape[1]))
     np.add.at(sums, gidx, v)
@@ -115,7 +120,7 @@ def downsample_records(pids, ts, vals, resolution_ms: int,
     keyed on (series, bucket)."""
     if len(pids) == 0:
         return {}
-    v, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+    v, t, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
         pids, ts, vals, resolution_ms)
     res: dict[str, tuple] = {}
     sums = np.bincount(gidx, weights=v, minlength=ngroups)
@@ -139,4 +144,44 @@ def downsample_records(pids, ts, vals, resolution_ms: int,
             last = np.zeros(ngroups)
             last[gidx] = v                        # last write wins (time-sorted)
             res[agg] = (out_pids, out_ts, last)
+        elif agg == "tTime":
+            # last actual sample timestamp in the bucket (ref: TimeDownsampler
+            # reads the END row's timestamp, not the bucket boundary)
+            tl = np.zeros(ngroups, np.int64)
+            tl[gidx] = t
+            res[agg] = (out_pids, out_ts, tl.astype(np.float64))
     return res
+
+
+def downsample_avg_ac(pids, ts, avg_vals, cnt_vals, resolution_ms: int):
+    """Second-level average from an (avg, count) pair — count-weighted, so
+    cascaded downsampling (1m -> 1h) stays exact (ref: AvgAcDownsampler,
+    ChunkDownsampler.scala AvgAcD). Returns {"dAvg", "dCount"} records."""
+    if len(pids) == 0:
+        return {}
+    w = np.asarray(avg_vals) * np.asarray(cnt_vals)
+    v2 = np.stack([w, np.asarray(cnt_vals)], axis=1)
+    v, _t, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+        np.asarray(pids), np.asarray(ts), v2, resolution_ms)
+    wsum = np.bincount(gidx, weights=v[:, 0], minlength=ngroups)
+    csum = np.bincount(gidx, weights=v[:, 1], minlength=ngroups)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        avg = np.where(csum > 0, wsum / csum, np.nan)
+    return {"dAvg": (out_pids, out_ts, avg),
+            "dCount": (out_pids, out_ts, csum)}
+
+
+def downsample_avg_sc(pids, ts, sum_vals, cnt_vals, resolution_ms: int):
+    """Second-level average from a (sum, count) pair (ref: AvgScDownsampler)."""
+    if len(pids) == 0:
+        return {}
+    v2 = np.stack([np.asarray(sum_vals), np.asarray(cnt_vals)], axis=1)
+    v, _t, gidx, ngroups, out_pids, out_ts = _group_by_series_bucket(
+        np.asarray(pids), np.asarray(ts), v2, resolution_ms)
+    ssum = np.bincount(gidx, weights=v[:, 0], minlength=ngroups)
+    csum = np.bincount(gidx, weights=v[:, 1], minlength=ngroups)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        avg = np.where(csum > 0, ssum / csum, np.nan)
+    return {"dAvg": (out_pids, out_ts, avg),
+            "dSum": (out_pids, out_ts, ssum),
+            "dCount": (out_pids, out_ts, csum)}

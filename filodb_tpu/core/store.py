@@ -186,6 +186,11 @@ class FileColumnStore(ChunkSink):
 
     # -- part keys ------------------------------------------------------------
 
+    def chunk_log_size(self, dataset, shard) -> int:
+        """Byte size of the shard's chunk log (cheap best-replica probe)."""
+        path = os.path.join(self._dir(dataset, shard), "chunks.log")
+        return os.path.getsize(path) if os.path.exists(path) else 0
+
     def write_part_keys(self, dataset, shard, entries):
         """entries: iterable of (part_id, labels_dict, start_time)."""
         with open(os.path.join(self._dir(dataset, shard), "partkeys.log"), "a") as f:

@@ -50,21 +50,109 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
     written = {}
     for agg, (opids, ots, ovals) in dsrec.items():
         ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
-        # one chunkset per agg; per-series slices
-        order = np.argsort(opids, kind="stable")
-        op, ot, ov = opids[order], ots[order], ovals[order]
-        bounds = np.concatenate([[0], np.nonzero(np.diff(op))[0] + 1, [len(op)]])
-        recs = [ChunkSetRecord(int(op[bounds[i]]), ot[bounds[i]:bounds[i + 1]],
-                               ov[bounds[i]:bounds[i + 1]])
-                for i in range(len(bounds) - 1)]
-        store.write_chunkset(ds_name, shard, 0, recs)
-        # mirror the raw part keys so the downsample dataset is queryable
-        entries = list(store.read_part_keys(dataset, shard) or ())
-        if entries:
-            store.write_part_keys(ds_name, shard, entries)
+        # per-series record split + part-key mirror (shared with the cascade)
+        written[agg] = _write_split_records(store, ds_name, shard,
+                                            opids, ots, ovals,
+                                            src_keys_from=dataset)
         if meta and hasattr(store, "write_meta"):
             store.write_meta(ds_name, shard, meta)   # bucket scheme rides along
-        written[agg] = len(recs)
+    return written
+
+
+def _write_split_records(store, ds_name: str, shard: int, pids, ts, vals,
+                         src_keys_from=None) -> int:
+    """Split (pids, ts, vals) into per-series ChunkSetRecords and persist them
+    (shared by the first-level and cascade batch jobs); optionally mirror the
+    part keys from a source dataset so the output stays queryable."""
+    order = np.argsort(pids, kind="stable")
+    op, ot, ov = pids[order], ts[order], vals[order]
+    bounds = np.concatenate([[0], np.nonzero(np.diff(op))[0] + 1, [len(op)]])
+    recs = [ChunkSetRecord(int(op[bounds[i]]), ot[bounds[i]:bounds[i + 1]],
+                           ov[bounds[i]:bounds[i + 1]])
+            for i in range(len(bounds) - 1)]
+    store.write_chunkset(ds_name, shard, 0, recs)
+    if src_keys_from is not None:
+        entries = list(store.read_part_keys(src_keys_from, shard) or ())
+        if entries:
+            store.write_part_keys(ds_name, shard, entries)
+    return len(recs)
+
+
+def _join_by_pid_ts(a, b):
+    """Vectorized inner join of two (pids, ts, vals) triples on (pid, ts)."""
+    # pid in the high bits (<= 2^20 series), epoch-ms in the low 42 (covers
+    # to year ~2109): fits signed int64
+    ka = a[0].astype(np.int64) << 42 | a[1].astype(np.int64) % (1 << 42)
+    kb = b[0].astype(np.int64) << 42 | b[1].astype(np.int64) % (1 << 42)
+    oa, ob = np.argsort(ka, kind="stable"), np.argsort(kb, kind="stable")
+    ka, kb = ka[oa], kb[ob]
+    pos = np.searchsorted(kb, ka)
+    pos_c = np.clip(pos, 0, len(kb) - 1)
+    hit = kb[pos_c] == ka
+    ia = oa[hit]
+    ib = ob[pos_c[hit]]
+    return a[0][ia], a[1][ia], a[2][ia], b[2][ib]
+
+
+def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
+                           from_res_ms: int, to_res_ms: int,
+                           start_ms: int = 0, end_ms: int = 1 << 62) -> dict[str, int]:
+    """Second-level downsampling: compact an existing downsample family (e.g.
+    1m) to a coarser one (e.g. 1h) over ``[start_ms, end_ms]`` — the periodic
+    job passes its window (plus late-data widening) exactly like the raw
+    batch job, so reruns don't re-append history. Averages cascade through
+    the (sum, count) pair when a dSum dataset exists (ref: AvgScDownsampler
+    dAvgSc), else the (avg, count) pair (AvgAcDownsampler dAvgAc) — both
+    count-weighted and exact. DownsamplerMain runs this 6-hourly upstream."""
+    from ..core.downsample import (downsample_avg_ac, downsample_avg_sc,
+                                   downsample_records)
+
+    src = f"{dataset}:ds_{from_res_ms // 60000}m"
+    dst = f"{dataset}:ds_{to_res_ms // 60000}m"
+
+    def load(agg):
+        pids, ts, vals = [], [], []
+        for _g, recs in store.read_chunksets(f"{src}:{agg}", shard,
+                                             start_ms, end_ms) or ():
+            for r in recs:
+                sel = (r.ts >= start_ms) & (r.ts <= end_ms)
+                if sel.any():
+                    pids.append(np.full(int(sel.sum()), r.part_id, np.int32))
+                    ts.append(r.ts[sel])
+                    vals.append(np.asarray(r.values, np.float64)[sel])
+        if not pids:
+            return None
+        return (np.concatenate(pids), np.concatenate(ts), np.concatenate(vals))
+
+    def write(agg, rec_tuple):
+        opids, ots, ovals = rec_tuple
+        return _write_split_records(store, f"{dst}:{agg}", shard,
+                                    opids, ots, ovals,
+                                    src_keys_from=f"{src}:{agg}")
+
+    written: dict[str, int] = {}
+    # distributive aggregates reduce over their own first-level dataset
+    for agg, op in (("dMin", "dMin"), ("dMax", "dMax"), ("dSum", "dSum"),
+                    ("dCount", "dSum"), ("dLast", "dLast"), ("tTime", "dMax")):
+        loaded = load(agg)
+        if loaded is None:
+            continue
+        pids, ts, vals = loaded
+        out = downsample_records(pids, ts, vals, to_res_ms, aggs=(op,))
+        written[agg] = write(agg, out[op])
+    # the average cascades through (sum, count) when possible, else (avg, count)
+    cn = load("dCount")
+    sm = load("dSum")
+    if cn is not None and sm is not None:
+        pids, ts, svals, cvals = _join_by_pid_ts(sm, cn)
+        out = downsample_avg_sc(pids, ts, svals, cvals, to_res_ms)
+        written["dAvg"] = write("dAvg", out["dAvg"])
+    elif cn is not None:
+        av = load("dAvg")
+        if av is not None:
+            pids, ts, avals, cvals = _join_by_pid_ts(av, cn)
+            out = downsample_avg_ac(pids, ts, avals, cvals, to_res_ms)
+            written["dAvg"] = write("dAvg", out["dAvg"])
     return written
 
 

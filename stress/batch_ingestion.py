@@ -1,0 +1,58 @@
+"""Batch ingestion stress: the bulk backfill shape — large containers through
+ingest -> flush -> durable sink -> batch downsample, with recovery parity.
+
+Reference: stress/src/main/scala/filodb.stress/BatchIngestion.scala (bulk CSV
+ingest with verification).
+Run: python stress/batch_ingestion.py [n_series] [n_samples]
+"""
+
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.store import FileColumnStore
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.jobs.batch_downsampler import run_batch_downsample
+
+
+def main(n_series=2_000, n_samples=300):
+    root = tempfile.mkdtemp(prefix="filodb-batch-")
+    sink = FileColumnStore(root)
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=1 << 12, samples_per_series=512,
+                      flush_batch_size=1 << 19, groups_per_shard=4)
+    shard = ms.setup("batch", GAUGE, 0, cfg, sink=sink)
+    base = 1_700_000_000_000
+    t0 = time.perf_counter()
+    total = 0
+    for t_block in range(0, n_samples, 50):
+        b = RecordBuilder(GAUGE)
+        for t in range(t_block, min(t_block + 50, n_samples)):
+            for i in range(n_series):
+                b.add({"_metric_": "backfill", "s": f"s{i}"},
+                      base + t * 10_000, float(t + i))
+                total += 1
+        shard.ingest(b.build(), offset=t_block)
+        shard.flush_all_groups()
+    dt = time.perf_counter() - t0
+    print(f"backfilled {total:,} samples in {dt:.1f}s = {total / dt:,.0f}/s "
+          f"(durable, {cfg.groups_per_shard} flush groups)")
+    written = run_batch_downsample(sink, "batch", 0, 60_000)
+    print(f"batch downsample: {written}")
+    # recovery parity: a fresh shard recovers the same sample count
+    ms2 = TimeSeriesMemStore()
+    shard2 = ms2.setup("batch", GAUGE, 0, cfg, sink=FileColumnStore(root))
+    shard2.recover()
+    recovered = int(np.asarray(shard2.store.n_host[:shard2.num_series]).sum())
+    assert recovered == total, (recovered, total)
+    print(f"OK: recovery parity ({recovered:,} samples)")
+    return 0
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:]]
+    sys.exit(main(*args))

@@ -1,0 +1,79 @@
+"""Streaming stress: sustained concurrent ingest AND query against one live
+server — the ingest path races query dispatch on the same shard lock, which is
+exactly the donation discipline under load.
+
+Reference: stress/src/main/scala/filodb.stress/StreamingStress.scala
+(continuous ingest + queries with correctness checking).
+Run: python stress/streaming_stress.py [duration_s] [n_series]
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.query.engine import QueryEngine
+
+
+def main(duration_s=20, n_series=5_000):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=1 << 14, samples_per_series=512,
+                      flush_batch_size=1 << 18)
+    shard = ms.setup("stream", GAUGE, 0, cfg)
+    eng = QueryEngine(ms, "stream")
+    base = 1_700_000_000_000
+    stop = time.time() + duration_s
+    errors: list[str] = []
+    counts = {"ingested": 0, "queries": 0}
+
+    def ingester():
+        t = 0
+        while time.time() < stop:
+            b = RecordBuilder(GAUGE)
+            for i in range(n_series):
+                # strictly increasing counters: rate must always be >= 0
+                b.add({"_metric_": "req", "inst": f"i{i}"},
+                      base + t * 10_000, float(t * (1 + i % 3)))
+            shard.ingest(b.build())
+            shard.flush()
+            counts["ingested"] += n_series
+            t += 1
+
+    def querier():
+        while time.time() < stop:
+            try:
+                r = eng.query_range("sum(rate(req[2m]))", base + 120_000,
+                                    base + 600_000, 60_000)
+                for _k, _t, v in r.matrix.iter_series():
+                    if (np.asarray(v) < 0).any():
+                        errors.append(f"negative rate: {v}")
+                counts["queries"] += 1
+            except Exception as e:  # noqa: BLE001 - stress records failures
+                if "retry the query" not in str(e):
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=ingester)] + \
+        [threading.Thread(target=querier) for _ in range(2)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    print(f"{dt:.1f}s: ingested {counts['ingested']:,} samples, "
+          f"ran {counts['queries']} concurrent queries, "
+          f"lock contentions={shard.lock.contentions}")
+    if errors:
+        print(f"FAILED: {len(errors)} errors; first: {errors[0]}")
+        return 1
+    print("OK: no errors, no negative rates under concurrent ingest")
+    return 0
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:]]
+    sys.exit(main(*args))

@@ -9,7 +9,9 @@ from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
 from filodb_tpu.core.record import RecordBuilder
 from filodb_tpu.core.schemas import GAUGE
 from filodb_tpu.core.store import FileColumnStore
-from filodb_tpu.jobs.batch_downsampler import load_downsampled, run_batch_downsample
+from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
+                                               run_batch_downsample,
+                                               run_cascade_downsample)
 
 BASE = 1_700_000_000_000
 IV = 10_000
@@ -104,3 +106,64 @@ def test_batch_downsample_job_and_query(tmp_path):
     avgs = np.array([raw_v[buckets == b].mean() for b in np.unique(buckets)])
     want0 = avgs[ends <= BASE + RES][-1]
     np.testing.assert_allclose(vals[0], want0)
+
+
+def test_ttime_and_cascade_downsample(tmp_path):
+    """tTime records the last real sample timestamp per bucket, and the 1m->1h
+    cascade (dAvgAc weighted average + distributive reductions) matches a
+    direct raw->1h downsample exactly (ref: ChunkDownsampler dAvgAc/tTime)."""
+    from filodb_tpu.core.downsample import downsample_records
+    rng = np.random.default_rng(4)
+    HOUR = 3_600_000
+    n = 720                                         # 2h of 10s samples
+    ts = BASE + np.arange(n) * IV
+    vals = rng.normal(50, 10, n)
+    pids = np.zeros(n, np.int32)
+
+    # tTime: last sample ts per 1m bucket
+    rec = downsample_records(pids, ts, vals, RES)
+    _p, _t, tl = rec["tTime"]
+    buckets = ts // RES
+    want = np.array([ts[buckets == b][-1] for b in np.unique(buckets)], float)
+    np.testing.assert_array_equal(tl, want)
+
+    # first level: raw -> 1m persisted
+    sink = FileColumnStore(str(tmp_path))
+    from filodb_tpu.core.store import ChunkSetRecord
+    sink.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts, vals)])
+    sink.write_part_keys("ds", 0, [(0, {"_metric_": "m"}, int(ts[0]))])
+    run_batch_downsample(sink, "ds", 0, RES)
+    # cascade: 1m -> 1h
+    written = run_cascade_downsample(sink, "ds", 0, RES, HOUR)
+    assert set(written) >= {"dMin", "dMax", "dSum", "dCount", "dAvg"}
+    # golden: direct raw -> 1h
+    direct = downsample_records(pids, ts, vals, HOUR)
+    got = {}
+    for agg in ("dMin", "dMax", "dSum", "dCount", "dAvg"):
+        recs = [r for _g, rs in sink.read_chunksets(f"ds:ds_60m:{agg}", 0)
+                for r in rs]
+        got[agg] = np.concatenate([np.asarray(r.values) for r in recs])
+        _dp, dts, dv = direct[agg]
+        np.testing.assert_allclose(got[agg], dv, rtol=1e-12,
+                                   err_msg=agg)
+
+
+def test_cascade_avg_ac_fallback(tmp_path):
+    """Without a first-level dSum dataset the cascade's average falls back to
+    the (avg, count) pair — still count-weighted exact (ref: dAvgAc)."""
+    from filodb_tpu.core.downsample import downsample_records
+    from filodb_tpu.core.store import ChunkSetRecord
+    rng = np.random.default_rng(6)
+    HOUR = 3_600_000
+    ts = BASE + np.arange(720) * IV
+    vals = rng.normal(10, 3, 720)
+    sink = FileColumnStore(str(tmp_path))
+    sink.write_chunkset("ds", 0, 0, [ChunkSetRecord(0, ts, vals)])
+    sink.write_part_keys("ds", 0, [(0, {"_metric_": "m"}, int(ts[0]))])
+    run_batch_downsample(sink, "ds", 0, RES, aggs=("dAvg", "dCount"))
+    written = run_cascade_downsample(sink, "ds", 0, RES, HOUR)
+    assert "dAvg" in written
+    direct = downsample_records(np.zeros(720, np.int32), ts, vals, HOUR)
+    recs = [r for _g, rs in sink.read_chunksets("ds:ds_60m:dAvg", 0) for r in rs]
+    got = np.concatenate([np.asarray(r.values) for r in recs])
+    np.testing.assert_allclose(got, direct["dAvg"][2], rtol=1e-12)
