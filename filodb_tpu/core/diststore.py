@@ -319,38 +319,40 @@ class ReplicatedColumnStore(ChunkSink):
 
     def read_chunksets(self, dataset, shard, start_ms: int = 0,
                        end_ms: int = 1 << 62):
-        # best-replica: the longest chunk log wins (a replica that missed
-        # appends during an outage has a shorter log; its partial answer
-        # must not mask a complete sibling). The cheap size probe keeps this
-        # streaming — materializing every replica to count samples would
-        # defeat the ranged reader underneath.
+        """Best-replica read: a replica that missed appends during an outage
+        must not mask a complete sibling.
+
+        Range-bounded reads (queries, scan splits) materialize every
+        reachable replica's overlapping records and serve the one with the
+        most samples IN RANGE — exact, and bounded by the window. Unbounded
+        reads (recovery scans the whole log) pick by a cheap size probe and
+        stream, trying every replica in descending-size order; a failed stat
+        only demotes a replica to the end of the order, never excludes it."""
+        bounded = start_ms > 0 or end_ms < 1 << 62
+        if bounded:
+            results = self._read_all(dataset, shard, "read_chunksets",
+                                     start_ms, end_ms)
+            def total(res):
+                return sum(len(r.ts) for _g, recs in res for r in recs)
+            return max((res for _b, res in results), key=total)
         probed = []
-        last_err = None
         for b in self._replicas(dataset, shard):
+            size = None
+            if hasattr(b, "chunk_log_size"):
+                try:
+                    size = b.chunk_log_size(dataset, shard)
+                except Exception as e:  # noqa: BLE001 - stat only demotes
+                    log.warning("replica stat failed on %r: %s", b, e)
+            probed.append((b, size))
+        order = sorted(probed, key=lambda p: -(p[1] if p[1] is not None else -1))
+        last_err = None
+        for b, _size in order:
             try:
-                size = (b.chunk_log_size(dataset, shard)
-                        if hasattr(b, "chunk_log_size") else None)
-                probed.append((b, size))
+                return list(b.read_chunksets(dataset, shard, start_ms, end_ms))
             except Exception as e:  # noqa: BLE001 - fail over
                 last_err = e
-                log.warning("replica stat failed on %r: %s", b, e)
-        if not probed:
-            raise IOError("all replicas failed") from last_err
-        if all(size is not None for _b, size in probed):
-            order = sorted(probed, key=lambda p: -p[1])
-            for b, _size in order:
-                try:
-                    return list(b.read_chunksets(dataset, shard, start_ms, end_ms))
-                except Exception as e:  # noqa: BLE001 - fail over
-                    last_err = e
-                    log.warning("replica read failed on %r: %s", b, e)
-            raise IOError("all replicas failed") from last_err
-        # backends without a size probe (local stores in tests): materialize
-        results = self._read_all(dataset, shard, "read_chunksets",
-                                 start_ms, end_ms)
-        def total(res):
-            return sum(len(r.ts) for _g, recs in res for r in recs)
-        return max((res for _b, res in results), key=total)
+                log.warning("replica read failed on %r: %s", b, e)
+        raise IOError("all replicas failed") from last_err
 
     def read_part_keys(self, dataset, shard):
         results = self._read_all(dataset, shard, "read_part_keys")

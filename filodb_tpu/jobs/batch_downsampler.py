@@ -124,35 +124,38 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
             return None
         return (np.concatenate(pids), np.concatenate(ts), np.concatenate(vals))
 
-    def write(agg, rec_tuple):
+    def write(agg, rec_tuple, keys_from):
         opids, ots, ovals = rec_tuple
         return _write_split_records(store, f"{dst}:{agg}", shard,
                                     opids, ots, ovals,
-                                    src_keys_from=f"{src}:{agg}")
+                                    src_keys_from=f"{src}:{keys_from}")
 
     written: dict[str, int] = {}
+    loaded_cache: dict[str, object] = {}
     # distributive aggregates reduce over their own first-level dataset
     for agg, op in (("dMin", "dMin"), ("dMax", "dMax"), ("dSum", "dSum"),
                     ("dCount", "dSum"), ("dLast", "dLast"), ("tTime", "dMax")):
-        loaded = load(agg)
+        loaded = loaded_cache.setdefault(agg, load(agg))
         if loaded is None:
             continue
         pids, ts, vals = loaded
         out = downsample_records(pids, ts, vals, to_res_ms, aggs=(op,))
-        written[agg] = write(agg, out[op])
+        written[agg] = write(agg, out[op], keys_from=agg)
     # the average cascades through (sum, count) when possible, else (avg, count)
-    cn = load("dCount")
-    sm = load("dSum")
+    cn = loaded_cache.get("dCount") or load("dCount")
+    sm = loaded_cache.get("dSum")
     if cn is not None and sm is not None:
         pids, ts, svals, cvals = _join_by_pid_ts(sm, cn)
         out = downsample_avg_sc(pids, ts, svals, cvals, to_res_ms)
-        written["dAvg"] = write("dAvg", out["dAvg"])
+        # part keys mirror from dSum — this branch runs exactly when the
+        # first level has it (a dAvg source dataset may not exist)
+        written["dAvg"] = write("dAvg", out["dAvg"], keys_from="dSum")
     elif cn is not None:
         av = load("dAvg")
         if av is not None:
             pids, ts, avals, cvals = _join_by_pid_ts(av, cn)
             out = downsample_avg_ac(pids, ts, avals, cvals, to_res_ms)
-            written["dAvg"] = write("dAvg", out["dAvg"])
+            written["dAvg"] = write("dAvg", out["dAvg"], keys_from="dAvg")
     return written
 
 

@@ -422,9 +422,18 @@ def _raw(vs: VectorSelector, p: QueryParams, lookback_ms: int) -> L.RawSeries:
     # __name__ matcher is an alias for the metric column (ref ast/Vectors.scala)
     filters = [Equals(p.metric_column, f.value) if isinstance(f, Equals) and f.label == "__name__"
                else f for f in filters]
+    # __col__ selects the value column (ref ast/Vectors.scala __col__; here a
+    # downsample family's aggregate dataset, e.g. {__col__="dAvg"})
+    col_matchers = [f for f in filters if getattr(f, "label", "") == "__col__"]
+    if any(not isinstance(f, Equals) for f in col_matchers):
+        raise ParseError("__col__ only supports equality matching")
+    columns = tuple(dict.fromkeys(f.value for f in col_matchers))
+    if len(columns) > 1:
+        raise ParseError(f"conflicting __col__ selectors: {columns}")
+    filters = [f for f in filters if getattr(f, "label", "") != "__col__"]
     start = p.start_ms - vs.offset_ms - lookback_ms
     end = p.end_ms - vs.offset_ms
-    return L.RawSeries(L.IntervalSelector(start, end), tuple(filters))
+    return L.RawSeries(L.IntervalSelector(start, end), tuple(filters), columns)
 
 
 def _lower_vector(vs: VectorSelector, p: QueryParams) -> L.PeriodicSeries:

@@ -932,12 +932,26 @@ class SelectRawPartitionsExec(ExecPlan):
     filters: tuple = ()
     start_ms: int = 0
     end_ms: int = 0
+    # __col__ value-column selector: targets an aggregate dataset of a
+    # downsample family, e.g. column "dAvg" of family "ds:ds_1m" reads the
+    # dataset "ds:ds_1m:dAvg" (ref: the reference's multi-column downsample
+    # datasets select with __col__; here each aggregate is its own dataset)
+    column: str = ""
+
+    def _shard_of(self, ctx):
+        ds = f"{ctx.dataset}:{self.column}" if self.column else ctx.dataset
+        try:
+            return ctx.memstore.shard(ds, self.shard)
+        except KeyError:
+            raise QueryError(
+                f"unknown {'column ' + self.column + ' of ' if self.column else ''}"
+                f"dataset {ds}") from None
 
     def execute(self, ctx: QueryContext):
         # hold the shard lock across array capture AND the transformer chain's
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
-        shard = ctx.memstore.shard(ctx.dataset, self.shard)
+        shard = self._shard_of(ctx)
         try:
             with shard.lock:
                 result = super().execute(ctx)
@@ -1023,7 +1037,7 @@ class SelectRawPartitionsExec(ExecPlan):
         return merged
 
     def do_execute(self, ctx) -> SeriesSelection:
-        shard = ctx.memstore.shard(ctx.dataset, self.shard)
+        shard = self._shard_of(ctx)
         if shard.store is None:   # histogram shard with no data yet
             z = jnp.zeros((8, 8), jnp.float32)
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
@@ -1186,7 +1200,11 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
 # Binary joins and set operators
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=1 << 18)
 def _join_key(k: RangeVectorKey, on, ignoring) -> RangeVectorKey:
+    """Join key of a series under on/ignoring. Memoized: RangeVectorKey
+    objects are per-shard singletons (rv_key_of cache), so repeated joins and
+    set ops skip the per-series label rebuilds that dominate wide joins."""
     k = k.without(("_metric_",))
     if on:
         return k.only(on)

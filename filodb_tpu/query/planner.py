@@ -50,7 +50,8 @@ class QueryPlanner:
         return [
             SelectRawPartitionsExec(
                 transformers=[psm], shard=s, filters=tuple(raw.filters),
-                start_ms=raw.range_selector.from_ms, end_ms=raw.range_selector.to_ms)
+                start_ms=raw.range_selector.from_ms, end_ms=raw.range_selector.to_ms,
+                column=raw.columns[0] if raw.columns else "")
             for s in shards
         ]
 

@@ -167,3 +167,32 @@ def test_cascade_avg_ac_fallback(tmp_path):
     recs = [r for _g, rs in sink.read_chunksets("ds:ds_60m:dAvg", 0) for r in rs]
     got = np.concatenate([np.asarray(r.values) for r in recs])
     np.testing.assert_allclose(got, direct["dAvg"][2], rtol=1e-12)
+
+
+def test_col_selector_targets_downsample_aggregate(tmp_path):
+    """PromQL __col__ parity: a downsample family engine serves
+    m{__col__="dAvg"} / {__col__="dMax"} from the per-aggregate datasets
+    (ref: the reference's multi-column downsample datasets + __col__)."""
+    sink = FileColumnStore(str(tmp_path))
+    ms, shard = _ingest_shard(sink)
+    shard.flush_all_groups()
+    run_batch_downsample(sink, "prometheus", 0, RES)
+    ms2 = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    for agg in ("dAvg", "dMax"):
+        load_downsampled(sink, "prometheus", 0, RES, agg, ms2, cfg)
+    from filodb_tpu.query.engine import QueryEngine
+    eng = QueryEngine(ms2, "prometheus:ds_1m")
+    got = {}
+    for agg in ("dAvg", "dMax"):
+        r = eng.query_range('m{host="h1",__col__="%s"}' % agg,
+                            BASE + RES, BASE + 5 * RES, RES)
+        (_k, _t, vals), = list(r.matrix.iter_series())
+        got[agg] = np.asarray(vals)
+    assert (got["dMax"] >= got["dAvg"]).all()
+    # unknown column errors cleanly
+    import pytest
+    from filodb_tpu.query.rangevector import QueryError
+    with pytest.raises(QueryError, match="unknown column"):
+        eng.query_range('m{__col__="nope"}', BASE + RES, BASE + 2 * RES, RES)
