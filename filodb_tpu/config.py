@@ -44,9 +44,13 @@ DEFAULTS: dict[str, Any] = {
         "queue_size": 64,
         "timeout": "60s",
     },
+    # inline downsampling at flush into durable per-aggregate datasets
+    # ({ds}:ds_{res}:{agg}); additional resolutions cascade periodically from
+    # the previous one (ref: ShardDownsampler inline + DownsamplerMain 6h cron)
     "downsample": {
         "enabled": False,
         "resolutions": ["1m"],
+        "cascade_interval": "6h",
     },
     "http": {"host": "127.0.0.1", "port": 8080},
     "data_dir": None,            # enables the durable FileColumnStore when set

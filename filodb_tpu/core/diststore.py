@@ -328,13 +328,6 @@ class ReplicatedColumnStore(ChunkSink):
         reads (recovery scans the whole log) pick by a cheap size probe and
         stream, trying every replica in descending-size order; a failed stat
         only demotes a replica to the end of the order, never excludes it."""
-        bounded = start_ms > 0 or end_ms < 1 << 62
-        if bounded:
-            results = self._read_all(dataset, shard, "read_chunksets",
-                                     start_ms, end_ms)
-            def total(res):
-                return sum(len(r.ts) for _g, recs in res for r in recs)
-            return max((res for _b, res in results), key=total)
         probed = []
         for b in self._replicas(dataset, shard):
             size = None
@@ -344,6 +337,19 @@ class ReplicatedColumnStore(ChunkSink):
                 except Exception as e:  # noqa: BLE001 - stat only demotes
                     log.warning("replica stat failed on %r: %s", b, e)
             probed.append((b, size))
+        sizes = [s for _b, s in probed if s is not None]
+        bounded = start_ms > 0 or end_ms < 1 << 62
+        diverged = len(set(sizes)) != 1 or len(sizes) != len(probed)
+        if bounded and diverged:
+            # replicas disagree: materialize the window from each reachable
+            # one and serve the most complete — exact, bounded by the window
+            results = self._read_all(dataset, shard, "read_chunksets",
+                                     start_ms, end_ms)
+            def total(res):
+                return sum(len(r.ts) for _g, recs in res for r in recs)
+            return max((res for _b, res in results), key=total)
+        # replicas agree (or the read is an unbounded recovery scan): stream
+        # from one, in descending-size order with failover
         order = sorted(probed, key=lambda p: -(p[1] if p[1] is not None else -1))
         last_err = None
         for b, _size in order:

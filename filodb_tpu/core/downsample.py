@@ -25,6 +25,136 @@ import numpy as np
 DOWNSAMPLERS = ("dMin", "dMax", "dSum", "dCount", "dAvg", "dLast", "tTime")
 
 
+def ds_family(dataset: str, resolution_ms: int) -> str:
+    """Canonical downsample family name for a resolution (shared by inline,
+    batch, cascade, and load paths; sub-minute resolutions use a seconds
+    suffix so they never collide)."""
+    if resolution_ms % 60_000 == 0:
+        return f"{dataset}:ds_{resolution_ms // 60_000}m"
+    return f"{dataset}:ds_{resolution_ms // 1000}s"
+
+
+class InlineDownsampler:
+    """Streaming per-flush downsampler emitting only COMPLETE buckets.
+
+    The reference's ShardDownsampler downsamples whole flushed chunks, which
+    are much longer than a resolution bucket; here flushes can be sub-second
+    (poll-driven), so per-flush emission would produce partial duplicate
+    bucket records. Instead, partial aggregates accumulate per (series,
+    bucket) and a bucket is emitted once its series' ingestion time passes
+    the bucket end — in-order-per-series ingestion (out-of-order samples are
+    dropped upstream) guarantees no more samples can arrive for it.
+    Emission state is dropped only after the publish callback SUCCEEDS, so a
+    transient sink failure retries at the next flush."""
+
+    def __init__(self, resolution_ms: int, publish, floor_ms: int = -1):
+        self.resolution_ms = resolution_ms
+        self.publish = publish           # publish(shard, {agg: (pids, ts, vals)})
+        # buckets ending at or before this are already durably published
+        # (restart resume floor); their samples are ignored
+        self.floor_ms = floor_ms
+        # (pid, bucket) -> [sum, count, min, max, last_v, last_t]
+        self._acc: dict[tuple[int, int], list] = {}
+
+    def drop_pids(self, pids) -> None:
+        """Partition release (purge/eviction): open buckets of these pids
+        must never be emitted — the slot may be reused by a new series whose
+        labels would then be attributed the dead series' data."""
+        gone = set(int(p) for p in pids)
+        for k in [k for k in self._acc if k[0] in gone]:
+            del self._acc[k]
+
+    def seed_from_store(self, shard) -> None:
+        """Post-recovery rebuild of open buckets, called AFTER the sink's
+        chunks loaded but BEFORE bus replay: replay skips rows below the
+        durable chunk watermark, so a bucket straddling the restart would
+        otherwise re-publish with only its post-restart samples. Per-pid
+        seed floors make later replayed duplicates of already-seeded samples
+        no-ops in add()."""
+        st = shard.store
+        if st is None:
+            return
+        self._seeded_last = np.full(st.S, -(1 << 62), np.int64)
+        for pid in range(st.S):
+            if st.n_host[pid] == 0:
+                continue
+            t, v = st.series_snapshot(pid)
+            sel = t > self.floor_ms
+            if sel.any():
+                self._ingest(shard, np.full(int(sel.sum()), pid, np.int32),
+                             t[sel], np.asarray(v[sel], np.float64))
+            if len(t):
+                self._seeded_last[pid] = int(t[-1])
+
+    _seeded_last = None
+
+    def add(self, shard, pids, ts, vals) -> None:
+        pids = np.asarray(pids)
+        ts = np.asarray(ts)
+        vals = np.asarray(vals)
+        if self._seeded_last is not None:
+            # recovery replay can re-deliver rows the seed already counted
+            keep = ts > self._seeded_last[pids]
+            if not keep.all():
+                pids, ts, vals = pids[keep], ts[keep], vals[keep]
+        self._ingest(shard, pids, ts, vals)
+
+    def _ingest(self, shard, pids, ts, vals) -> None:
+        res = self.resolution_ms
+        if self.floor_ms >= 0 and len(ts):
+            keep = (ts // res + 1) * res - 1 > self.floor_ms
+            if not keep.all():
+                pids, ts, vals = pids[keep], ts[keep], vals[keep]
+        if len(pids) == 0:
+            return
+        v, t, gidx, ngroups, gp, gts = _group_by_series_bucket(pids, ts, vals, res)
+        sums = np.bincount(gidx, weights=v, minlength=ngroups)
+        cnts = np.bincount(gidx, minlength=ngroups)
+        mins = np.full(ngroups, np.inf); np.minimum.at(mins, gidx, v)
+        maxs = np.full(ngroups, -np.inf); np.maximum.at(maxs, gidx, v)
+        lastv = np.zeros(ngroups); lastv[gidx] = v
+        lastt = np.zeros(ngroups, np.int64); lastt[gidx] = t
+        for i in range(ngroups):
+            key = (int(gp[i]), int(gts[i]) // res)
+            a = self._acc.get(key)
+            if a is None:
+                self._acc[key] = [sums[i], cnts[i], mins[i], maxs[i],
+                                  lastv[i], lastt[i]]
+            else:
+                a[0] += sums[i]; a[1] += cnts[i]
+                a[2] = min(a[2], mins[i]); a[3] = max(a[3], maxs[i])
+                if lastt[i] >= a[5]:
+                    a[4], a[5] = lastv[i], lastt[i]
+        self._emit_complete(shard)
+
+    def _emit_complete(self, shard, force: bool = False) -> None:
+        res = self.resolution_ms
+        last_ts = shard.store.last_ts
+        done = [k for k in self._acc
+                if force or last_ts[k[0]] >= (k[1] + 1) * res]
+        if not done:
+            return
+        pids = np.array([k[0] for k in done], np.int32)
+        bts = np.array([(k[1] + 1) * res - 1 for k in done], np.int64)
+        rows = np.array([self._acc[k] for k in done], np.float64)
+        recs = {
+            "dSum": (pids, bts, rows[:, 0]),
+            "dCount": (pids, bts, rows[:, 1]),
+            "dMin": (pids, bts, rows[:, 2]),
+            "dMax": (pids, bts, rows[:, 3]),
+            "dAvg": (pids, bts, rows[:, 0] / np.maximum(rows[:, 1], 1)),
+            "dLast": (pids, bts, rows[:, 4]),
+            "tTime": (pids, bts, rows[:, 5]),
+        }
+        self.publish(shard, recs)        # raises on failure: state retained
+        for k in done:
+            del self._acc[k]
+
+    def flush_remaining(self, shard) -> None:
+        """Emit every open bucket (shutdown / final drain)."""
+        self._emit_complete(shard, force=True)
+
+
 @dataclass
 class DownsampledBlock:
     """One aggregate's downsampled series block."""

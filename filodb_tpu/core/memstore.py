@@ -19,10 +19,13 @@ TPU-native shape of the same responsibilities:
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from .chunkstore import SeriesStore
 from .eviction import BloomFilter, CapacityEvictionPolicy, EvictionPolicy
@@ -203,6 +206,10 @@ class TimeSeriesShard:
         for pid in pid_list:
             self._rv_keys.pop(pid, None)
         self._free_pids.extend(pid_list)
+        # open downsample buckets of released partitions must never emit: the
+        # slot's next owner would be attributed the dead series' data
+        if self.downsample is not None and hasattr(self.downsample[1], "drop_pids"):
+            self.downsample[1].drop_pids(pid_list)
         if self.sink is not None:
             # unpersisted samples of a released partition must never reach the
             # sink: a later flush_group would write them under a pid whose slot
@@ -401,12 +408,19 @@ class TimeSeriesShard:
             # the sink reader (WAL semantics).
             self._requeue_pending(group, pending, pend_epochs)
             raise
-        # inline downsample publishes only after the chunks are durably
-        # written: a requeued retry must not double-publish the same buckets
+        # inline downsample runs after the chunks are durably written; a
+        # failure here must not kill the ingest thread — the streaming
+        # downsampler retains its accumulators and retries next flush
         if self.downsample is not None and vals.ndim == 1:
-            from .downsample import downsample_records
-            res_ms, publish = self.downsample
-            publish(self, downsample_records(pids, ts, vals, res_ms))
+            res_ms, target = self.downsample
+            try:
+                if hasattr(target, "add"):        # streaming InlineDownsampler
+                    target.add(self, pids, ts, vals)
+                else:                             # plain callback (tests)
+                    from .downsample import downsample_records
+                    target(self, downsample_records(pids, ts, vals, res_ms))
+            except Exception:
+                log.exception("inline downsample publish failed; will retry")
         off = int(self._pending_group_offset[group])
         if off >= 0:
             # a checkpoint failure does NOT requeue: the chunks are durable,
@@ -434,7 +448,8 @@ class TimeSeriesShard:
         for g in range(self.config.groups_per_shard):
             self.flush_group(g)
 
-    def recover(self, bus=None, schemas: Schemas | None = None) -> int:
+    def recover(self, bus=None, schemas: Schemas | None = None,
+                on_chunks_loaded=None) -> int:
         """Restore shard state from the sink + replay the bus from the minimum
         checkpointed offset (ref: TimeSeriesShard.recoverIndex :483 +
         TimeSeriesMemStore.recoverStream :148). Returns rows replayed."""
@@ -500,6 +515,11 @@ class TimeSeriesShard:
             if len(pids):
                 with self.lock:   # append donates the store buffers
                     self.store.append(pids, ts, vals)
+        # between chunk load and replay: replayed rows flow through the
+        # normal flush pipeline, so state seeded here (e.g. the streaming
+        # downsampler's open buckets) sees each sample exactly once
+        if on_chunks_loaded is not None:
+            on_chunks_loaded()
         # 3. checkpoints -> watermarks; replay the bus past them
         cps = self.sink.read_checkpoints(self.dataset, self.shard_num)
         for g, off in cps.items():

@@ -18,7 +18,7 @@ from collections import defaultdict
 import numpy as np
 
 from ..core.downsample import (DOWNSAMPLERS, downsample_records,
-                               downsample_records_hist)
+                               downsample_records_hist, ds_family)
 from ..core.store import ChunkSetRecord, FileColumnStore
 
 
@@ -49,7 +49,7 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
         meta = None
     written = {}
     for agg, (opids, ots, ovals) in dsrec.items():
-        ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
+        ds_name = f"{ds_family(dataset, resolution_ms)}:{agg}"
         # per-series record split + part-key mirror (shared with the cascade)
         written[agg] = _write_split_records(store, ds_name, shard,
                                             opids, ots, ovals,
@@ -57,6 +57,54 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
         if meta and hasattr(store, "write_meta"):
             store.write_meta(ds_name, shard, meta)   # bucket scheme rides along
     return written
+
+
+def make_inline_publisher(sink, dataset: str, resolution_ms: int):
+    """Publish callback for the streaming InlineDownsampler: durable
+    per-aggregate datasets (ref: ShardDownsampler -> DownsamplePublisher; the
+    Kafka hop is replaced by a direct sink write). Each series' part keys are
+    mirrored the first time IT appears — a pod starting long after the shard
+    is still queryable in the downsample datasets. ``publish.published_max``
+    tracks, per shard, the latest bucket timestamp durably written: the
+    cascade scheduler advances its window from this, never from in-memory
+    ingest state."""
+    mirrored: dict[int, set] = {}
+    family = ds_family(dataset, resolution_ms)
+
+    def publish(shard, recs):
+        done = mirrored.setdefault(shard.shard_num, set())
+        new_pids = sorted({int(p) for _a, (pids, _t, _v) in recs.items()
+                           for p in pids} - done)
+        if new_pids:
+            entries = [(pid, shard.index.labels_of(pid),
+                        shard.index.start_time(pid)) for pid in new_pids]
+            for agg in recs:
+                sink.write_part_keys(f"{family}:{agg}", shard.shard_num, entries)
+        hi = 0
+        for agg, (pids, ts, vals) in recs.items():
+            _write_split_records(sink, f"{family}:{agg}", shard.shard_num,
+                                 pids, ts, vals)
+            if len(ts):
+                hi = max(hi, int(np.max(ts)))
+        # state advances only after every write succeeded. A mid-batch
+        # failure retries the WHOLE batch next flush; aggregates already
+        # written get duplicate records, which every reader dedups
+        # (load_downsampled's out-of-order drop, the cascade's keep-first).
+        done.update(new_pids)
+        if hi:
+            cur = publish.published_max.get(shard.shard_num, 0)
+            hi = max(cur, hi)
+            publish.published_max[shard.shard_num] = hi
+            if hasattr(sink, "write_meta"):
+                # durable publish floor: restart resumes (and re-seeds open
+                # buckets) from here instead of re-emitting partial buckets
+                sink.write_meta(family, shard.shard_num,
+                                {"published_through": hi})
+
+    publish.published_max = {}
+    publish.family = family
+    publish.sink = sink
+    return publish
 
 
 def _write_split_records(store, ds_name: str, shard: int, pids, ts, vals,
@@ -107,8 +155,8 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
     from ..core.downsample import (downsample_avg_ac, downsample_avg_sc,
                                    downsample_records)
 
-    src = f"{dataset}:ds_{from_res_ms // 60000}m"
-    dst = f"{dataset}:ds_{to_res_ms // 60000}m"
+    src = ds_family(dataset, from_res_ms)
+    dst = ds_family(dataset, to_res_ms)
 
     def load(agg):
         pids, ts, vals = [], [], []
@@ -122,7 +170,14 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
                     vals.append(np.asarray(r.values, np.float64)[sel])
         if not pids:
             return None
-        return (np.concatenate(pids), np.concatenate(ts), np.concatenate(vals))
+        p, t, v = (np.concatenate(pids), np.concatenate(ts),
+                   np.concatenate(vals))
+        # keep-first dedup on (pid, bucket): publish retries after partial
+        # failures append duplicate identical records
+        k = p.astype(np.int64) << 42 | t.astype(np.int64) % (1 << 42)
+        _u, idx = np.unique(k, return_index=True)
+        idx.sort()
+        return p[idx], t[idx], v[idx]
 
     def write(agg, rec_tuple, keys_from):
         opids, ots, ovals = rec_tuple
@@ -166,7 +221,7 @@ def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
     from ..core.memstore import StoreConfig
     from ..core.record import RecordBuilder
     from ..core.schemas import GAUGE, PROM_HISTOGRAM
-    ds_name = f"{dataset}:ds_{resolution_ms // 60000}m:{agg}"
+    ds_name = f"{ds_family(dataset, resolution_ms)}:{agg}"
     meta = store.read_meta(ds_name, shard) if hasattr(store, "read_meta") else {}
     les = np.asarray(meta["bucket_les"]) if meta.get("bucket_les") else None
     schema = PROM_HISTOGRAM if les is not None else GAUGE

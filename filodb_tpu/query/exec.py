@@ -1200,17 +1200,23 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
 # Binary joins and set operators
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=1 << 18)
-def _join_key(k: RangeVectorKey, on, ignoring) -> RangeVectorKey:
-    """Join key of a series under on/ignoring. Memoized: RangeVectorKey
-    objects are per-shard singletons (rv_key_of cache), so repeated joins and
-    set ops skip the per-series label rebuilds that dominate wide joins."""
-    k = k.without(("_metric_",))
+def _join_key(k: RangeVectorKey, on, ignoring,
+              memo: dict | None = None) -> RangeVectorKey:
+    """Join key of a series under on/ignoring. ``memo`` is a per-execution
+    dict (both sides of a join share on/ignoring): wide joins reuse keys
+    intra-query without retaining label tuples for the process lifetime."""
+    if memo is not None:
+        jk = memo.get(k)
+        if jk is not None:
+            return jk
+    out = k.without(("_metric_",))
     if on:
-        return k.only(on)
-    if ignoring:
-        return k.without(ignoring)
-    return k
+        out = out.only(on)
+    elif ignoring:
+        out = out.without(ignoring)
+    if memo is not None:
+        memo[k] = out
+    return out
 
 
 @dataclass
@@ -1230,9 +1236,10 @@ class BinaryJoinExec(ExecPlan):
         rm = _as_matrix(self.rhs.execute(ctx)).to_host()
         swap = self.cardinality == "OneToMany"   # treat as ManyToOne with sides swapped
         many, one = (rm, lm) if swap else (lm, rm)
+        memo: dict = {}           # per-query join-key cache (both sides)
         one_by_key: dict[RangeVectorKey, int] = {}
         for i, k in enumerate(one.keys):
-            jk = _join_key(k, self.on, self.ignoring)
+            jk = _join_key(k, self.on, self.ignoring, memo)
             if jk in one_by_key:
                 raise QueryError(f"duplicate series on 'one' side of join for {jk}")
             one_by_key[jk] = i
@@ -1241,7 +1248,7 @@ class BinaryJoinExec(ExecPlan):
                      and not self.operator.endswith("_bool"))
         seen: set[RangeVectorKey] = set()
         for i, k in enumerate(many.keys):
-            jk = _join_key(k, self.on, self.ignoring)
+            jk = _join_key(k, self.on, self.ignoring, memo)
             j = one_by_key.get(jk)
             if j is None:
                 continue
@@ -1265,7 +1272,7 @@ class BinaryJoinExec(ExecPlan):
                             d.pop(lbl, None)
                     out = RangeVectorKey.of(d)
                 elif self.on and self.cardinality == "OneToOne":
-                    out = _join_key(k, self.on, self.ignoring)
+                    out = _join_key(k, self.on, self.ignoring, memo)
                 keys.append(out)
         if not rows_many:
             return ResultMatrix(lm.out_ts, np.zeros((0, len(lm.out_ts))), [])
@@ -1289,12 +1296,13 @@ class SetOperatorExec(ExecPlan):
         lm = _as_matrix(self.lhs.execute(ctx)).to_host()
         rm = _as_matrix(self.rhs.execute(ctx)).to_host()
         lvals, rvals = np.asarray(lm.values), np.asarray(rm.values)
+        memo: dict = {}           # per-query join-key cache (both sides)
         T = len(lm.out_ts)
         # presence of each join key at each step on the rhs / lhs
         def presence(mat, keys):
             pres: dict[RangeVectorKey, np.ndarray] = {}
             for i, k in enumerate(keys):
-                jk = _join_key(k, self.on, self.ignoring)
+                jk = _join_key(k, self.on, self.ignoring, memo)
                 cur = pres.get(jk)
                 here = ~np.isnan(np.asarray(mat)[i])
                 pres[jk] = here if cur is None else (cur | here)
@@ -1303,7 +1311,7 @@ class SetOperatorExec(ExecPlan):
             rp = presence(rvals, rm.keys)
             out = []
             for i, k in enumerate(lm.keys):
-                jk = _join_key(k, self.on, self.ignoring)
+                jk = _join_key(k, self.on, self.ignoring, memo)
                 mask = rp.get(jk, np.zeros(T, bool))
                 out.append(np.where(mask, lvals[i], np.nan))
             vals = np.stack(out) if out else np.zeros((0, T))
@@ -1312,7 +1320,7 @@ class SetOperatorExec(ExecPlan):
             rp = presence(rvals, rm.keys)
             out = []
             for i, k in enumerate(lm.keys):
-                jk = _join_key(k, self.on, self.ignoring)
+                jk = _join_key(k, self.on, self.ignoring, memo)
                 mask = rp.get(jk, np.zeros(T, bool))
                 out.append(np.where(mask, np.nan, lvals[i]))
             vals = np.stack(out) if out else np.zeros((0, T))
@@ -1322,7 +1330,7 @@ class SetOperatorExec(ExecPlan):
             rows = [lvals[i] for i in range(len(lm.keys))]
             keys = list(lm.keys)
             for i, k in enumerate(rm.keys):
-                jk = _join_key(k, self.on, self.ignoring)
+                jk = _join_key(k, self.on, self.ignoring, memo)
                 lmask = lp.get(jk, np.zeros(T, bool))
                 rows.append(np.where(lmask, np.nan, rvals[i]))
                 keys.append(k)

@@ -44,6 +44,28 @@ class IngestionConsumer(threading.Thread):
         self._stop_ev = threading.Event()
         self._offset = 0
 
+    def _seed_downsampler(self, sh) -> None:
+        """Resume the streaming downsampler after recovery: the durable
+        publish floor comes from the fine family's meta, and buckets left
+        open across the restart rebuild from the recovered store (replay
+        alone would re-emit them with only post-watermark samples)."""
+        if sh.downsample is None:
+            return
+        target = sh.downsample[1]
+        if not hasattr(target, "seed_from_store"):
+            return
+        pub = target.publish
+        floor = -1
+        sink = getattr(pub, "sink", None)
+        fam = getattr(pub, "family", None)
+        if sink is not None and fam and hasattr(sink, "read_meta"):
+            floor = int(sink.read_meta(fam, sh.shard_num)
+                        .get("published_through", -1))
+        target.floor_ms = floor
+        if floor >= 0 and hasattr(pub, "published_max"):
+            pub.published_max[sh.shard_num] = floor
+        target.seed_from_store(sh)
+
     def run(self):
         sh = self.shard
         try:
@@ -55,7 +77,8 @@ class IngestionConsumer(threading.Thread):
                     if sh.sink is not None:
                         self.manager.set_status(self.dataset, sh.shard_num,
                                                 ShardStatus.RECOVERY)
-                        sh.recover(self.bus, self.schemas)
+                        sh.recover(self.bus, self.schemas,
+                                   on_chunks_loaded=lambda: self._seed_downsampler(sh))
                         self._offset = int(self.bus.end_offset)
                     break
                 except (ConnectionError, OSError):
@@ -136,6 +159,10 @@ class FiloServer:
         self._shards_lock = threading.Lock()
         self._sink = None
         self._store_cfg = None
+        self._ds_publish = None
+        self._ds_res: list[int] = []
+        self._cascade_stop = None
+        self._cascade_wm: dict[int, int] = {}
 
     def _start_shard(self, dataset: str, shard_num: int) -> None:
         """Bring up one owned shard: store + (optionally) its bus consumer
@@ -165,6 +192,11 @@ class FiloServer:
         except ValueError:
             # a retried start after a partial failure: the store exists
             shard = self.memstore.shard(dataset, shard_num)
+        if self._ds_publish is not None and not shard.schema.is_histogram:
+            from .core.downsample import InlineDownsampler
+            shard.downsample = (self._ds_res[0],
+                                InlineDownsampler(self._ds_res[0],
+                                                  self._ds_publish))
         if cfg.get("bus_addr") or cfg.get("bus_dir"):
             if cfg.get("bus_addr"):
                 # remote broker: shard N == broker partition N (ref: Kafka
@@ -285,6 +317,20 @@ class FiloServer:
         self._store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
+        # inline downsampling publisher (ref: ShardDownsampler at flush); the
+        # first resolution publishes at every group flush, coarser ones
+        # cascade periodically below
+        if cfg.get("downsample.enabled") and self._sink is not None:
+            from .jobs.batch_downsampler import make_inline_publisher
+            self._ds_res = [parse_duration_ms(r)
+                            for r in cfg["downsample.resolutions"]]
+            for fine, coarse in zip(self._ds_res, self._ds_res[1:]):
+                if coarse <= fine or coarse % fine:
+                    raise ValueError(
+                        "downsample.resolutions must ascend and each must be "
+                        f"a multiple of the previous; got {cfg['downsample.resolutions']}")
+            self._ds_publish = make_inline_publisher(self._sink, dataset,
+                                                     self._ds_res[0])
         for shard_num in self.manager.shards_of_node(dataset, self.node):
             self._start_shard(dataset, shard_num)
         self.manager.subscribe(self._on_shard_event)
@@ -332,6 +378,56 @@ class FiloServer:
                 for ds in list(self.engines)}
             self.membership.poll_once()
             self.membership.start()
+        if self._ds_publish is not None and len(self._ds_res) > 1:
+            # periodic cascade to coarser resolutions (ref: DownsamplerMain's
+            # 6-hourly batch job). Windows advance to the last COMPLETE coarse
+            # bucket of the DURABLY PUBLISHED finer data (never in-memory
+            # ingest state), and watermarks persist in the sink's meta so a
+            # restart or shard takeover resumes instead of re-appending.
+            self._cascade_stop = threading.Event()
+            interval_s = parse_duration_ms(cfg["downsample.cascade_interval"]) / 1000.0
+
+            def cascade_loop(_ds=dataset):
+                from .core.downsample import ds_family
+                from .jobs.batch_downsampler import run_cascade_downsample
+                while not self._cascade_stop.wait(interval_s):
+                    try:
+                        with self._shards_lock:
+                            owned = sorted(self._running)
+                        for sh_num in owned:
+                            pub_max = self._ds_publish.published_max.get(sh_num)
+                            if pub_max is None:
+                                continue
+                            for i in range(1, len(self._ds_res)):
+                                coarse = self._ds_res[i]
+                                fam = ds_family(_ds, coarse)
+                                # one-coarse-bucket lateness margin: series
+                                # whose fine buckets publish a little behind
+                                # the shard's fastest are still included
+                                # (the reference's late-data widening analog)
+                                hi = ((pub_max - coarse) // coarse) * coarse - 1
+                                key = (sh_num, i)
+                                lo = self._cascade_wm.get(key)
+                                if lo is None:   # durable watermark survives
+                                    meta = self._sink.read_meta(fam, sh_num) \
+                                        if hasattr(self._sink, "read_meta") else {}
+                                    lo = int(meta.get("cascade_wm", -1))
+                                if hi <= lo:
+                                    self._cascade_wm[key] = lo
+                                    continue
+                                run_cascade_downsample(
+                                    self._sink, _ds, sh_num,
+                                    self._ds_res[i - 1], coarse,
+                                    start_ms=lo + 1, end_ms=hi)
+                                self._cascade_wm[key] = hi
+                                if hasattr(self._sink, "write_meta"):
+                                    self._sink.write_meta(fam, sh_num,
+                                                          {"cascade_wm": hi})
+                    except Exception:
+                        log.exception("cascade downsample pass failed")
+
+            threading.Thread(target=cascade_loop, daemon=True,
+                             name="cascade-downsampler").start()
         if cfg.get("profiler.enabled"):
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(
@@ -342,6 +438,8 @@ class FiloServer:
         return self
 
     def shutdown(self) -> None:
+        if self._cascade_stop is not None:
+            self._cascade_stop.set()
         for c in self.consumers:
             c.stop()
         for c in self.consumers:

@@ -214,3 +214,75 @@ def test_wide_on_demand_paging_batches(tmp_path, monkeypatch):
     r = eng.query_range("topk(3, sum_over_time(m[1m]))",
                         BASE + 60_000, BASE + 90_000, 30_000)
     assert r.matrix.num_series <= 3
+
+
+def test_server_inline_downsample_and_cascade(tmp_path):
+    """downsample.enabled wires the inline flush publisher (durable 1m
+    datasets) and the periodic cascade produces the coarser family."""
+    cfg = {
+        "num_shards": 1,
+        "data_dir": str(tmp_path / "data"),
+        "bus_dir": str(tmp_path / "bus"),
+        "http": {"port": 0},
+        "downsample": {"enabled": True, "resolutions": ["1m", "5m"],
+                       "cascade_interval": "300ms"},
+        "store": {"max_series_per_shard": 8, "samples_per_series": 720,
+                  "flush_batch_size": 10**9, "groups_per_shard": 1,
+                  "dtype": "float64"},
+    }
+    bus = FileBus(str(tmp_path / "bus" / "shard0.log"))
+    # two separate bus batches -> two poll-driven ingest/flush cycles: the
+    # streaming downsampler must still emit each 1m bucket exactly once,
+    # with the mid-bucket split invisible in the output
+    b = RecordBuilder(GAUGE)
+    for t in range(63):
+        b.add({"_metric_": "m", "host": "h0"}, BASE + t * IV, float(t))
+    bus.publish(b.build())
+    server = FiloServer(Config(cfg)).start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            sh = server.memstore.shard("prometheus", 0)
+            if sh.stats.rows_ingested >= 63:
+                break
+            time.sleep(0.1)
+        b = RecordBuilder(GAUGE)
+        for t in range(63, 120):   # 20 minutes of 10s data in total
+            b.add({"_metric_": "m", "host": "h0"}, BASE + t * IV, float(t))
+        bus.publish(b.build())
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if sh.stats.rows_ingested >= 120:
+                break
+            time.sleep(0.1)
+        sh.flush_all_groups()       # inline publisher fires at group flush
+        sink = FileColumnStore(str(tmp_path / "data"))
+        one_m = [r for _g, recs in sink.read_chunksets("prometheus:ds_1m:dAvg", 0)
+                 for r in recs]
+        assert one_m, "inline 1m downsample not published"
+        ts_1m = np.concatenate([r.ts for r in one_m])
+        assert len(ts_1m) == len(np.unique(ts_1m)), "duplicate 1m buckets"
+        v_1m = np.concatenate([np.asarray(r.values) for r in one_m])
+        for bts, bv in zip(ts_1m, v_1m):
+            sel = (BASE + np.arange(120) * IV) // 60_000 == bts // 60_000
+            np.testing.assert_allclose(bv, np.arange(120.0)[sel].mean())
+        keys = list(sink.read_part_keys("prometheus:ds_1m:dAvg", 0))
+        assert keys and keys[0][1].get("host") == "h0"
+        deadline = time.time() + 15
+        five_m = []
+        while time.time() < deadline and not five_m:
+            five_m = [r for _g, recs in
+                      sink.read_chunksets("prometheus:ds_5m:dAvg", 0)
+                      for r in recs]
+            time.sleep(0.2)
+        assert five_m, "cascade 5m downsample never ran"
+        # weighted 5m averages match a direct computation over complete buckets
+        ts_all = np.concatenate([r.ts for r in five_m])
+        v_all = np.concatenate([np.asarray(r.values) for r in five_m])
+        raw_ts = BASE + np.arange(120) * IV
+        raw_v = np.arange(120.0)
+        for bts, bv in zip(ts_all, v_all):
+            sel = raw_ts // 300_000 == bts // 300_000
+            np.testing.assert_allclose(bv, raw_v[sel].mean())
+    finally:
+        server.shutdown()
