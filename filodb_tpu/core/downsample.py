@@ -18,6 +18,7 @@ with standard PromQL (multi-column stores are a planned follow-up).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -55,14 +56,19 @@ class InlineDownsampler:
         self.floor_ms = floor_ms
         # (pid, bucket) -> [sum, count, min, max, last_v, last_t]
         self._acc: dict[tuple[int, int], list] = {}
+        # flush_group runs from several threads (ingest consumer poll, test/
+        # operator flush_all_groups): accumulate/emit must be atomic or two
+        # racing emitters would publish the same closed bucket twice
+        self._lock = threading.Lock()
 
     def drop_pids(self, pids) -> None:
         """Partition release (purge/eviction): open buckets of these pids
         must never be emitted — the slot may be reused by a new series whose
         labels would then be attributed the dead series' data."""
         gone = set(int(p) for p in pids)
-        for k in [k for k in self._acc if k[0] in gone]:
-            del self._acc[k]
+        with self._lock:
+            for k in [k for k in self._acc if k[0] in gone]:
+                del self._acc[k]
 
     def seed_from_store(self, shard) -> None:
         """Post-recovery rebuild of open buckets, called AFTER the sink's
@@ -107,6 +113,12 @@ class InlineDownsampler:
                 pids, ts, vals = pids[keep], ts[keep], vals[keep]
         if len(pids) == 0:
             return
+        with self._lock:
+            self._ingest_locked(shard, pids, ts, vals)
+        self._emit_complete(shard)
+
+    def _ingest_locked(self, shard, pids, ts, vals) -> None:
+        res = self.resolution_ms
         v, t, gidx, ngroups, gp, gts = _group_by_series_bucket(pids, ts, vals, res)
         sums = np.bincount(gidx, weights=v, minlength=ngroups)
         cnts = np.bincount(gidx, minlength=ngroups)
@@ -125,18 +137,38 @@ class InlineDownsampler:
                 a[2] = min(a[2], mins[i]); a[3] = max(a[3], maxs[i])
                 if lastt[i] >= a[5]:
                     a[4], a[5] = lastv[i], lastt[i]
-        self._emit_complete(shard)
 
     def _emit_complete(self, shard, force: bool = False) -> None:
         res = self.resolution_ms
         last_ts = shard.store.last_ts
-        done = [k for k in self._acc
-                if force or last_ts[k[0]] >= (k[1] + 1) * res]
-        if not done:
-            return
+        with self._lock:
+            done = [k for k in self._acc
+                    if force or last_ts[k[0]] >= (k[1] + 1) * res]
+            if not done:
+                return
+            # claim atomically: a racing emitter must not publish these too
+            claimed = {k: self._acc.pop(k) for k in done}
+        try:
+            self._publish_claimed(shard, claimed)
+        except Exception:
+            with self._lock:     # publish failed: restore for retry
+                for k, a in claimed.items():
+                    cur = self._acc.get(k)
+                    if cur is None:
+                        self._acc[k] = a
+                    else:
+                        cur[0] += a[0]; cur[1] += a[1]
+                        cur[2] = min(cur[2], a[2]); cur[3] = max(cur[3], a[3])
+                        if a[5] >= cur[5]:
+                            cur[4], cur[5] = a[4], a[5]
+            raise
+
+    def _publish_claimed(self, shard, claimed) -> None:
+        done = list(claimed)
+        res = self.resolution_ms
         pids = np.array([k[0] for k in done], np.int32)
         bts = np.array([(k[1] + 1) * res - 1 for k in done], np.int64)
-        rows = np.array([self._acc[k] for k in done], np.float64)
+        rows = np.array([claimed[k] for k in done], np.float64)
         recs = {
             "dSum": (pids, bts, rows[:, 0]),
             "dCount": (pids, bts, rows[:, 1]),
@@ -146,9 +178,7 @@ class InlineDownsampler:
             "dLast": (pids, bts, rows[:, 4]),
             "tTime": (pids, bts, rows[:, 5]),
         }
-        self.publish(shard, recs)        # raises on failure: state retained
-        for k in done:
-            del self._acc[k]
+        self.publish(shard, recs)
 
     def flush_remaining(self, shard) -> None:
         """Emit every open bucket (shutdown / final drain)."""

@@ -23,6 +23,23 @@ from ..query.rangevector import QueryError
 from ..query.scheduler import Priority, SchedulerBusy
 
 
+def _fmt(v: float) -> str:
+    """Prometheus sample-value string: full float64 round-trip precision
+    (Go's strconv.FormatFloat with shortest round-trip digits — "%g" would
+    truncate to 6 significant digits, corrupting large values like
+    epoch-second arithmetic). Integral values render without a decimal
+    point; non-finite values use Prometheus' spellings."""
+    import math
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e17:
+        return str(int(v))
+    return repr(v)
+
+
 def matrix_to_prom_json(result) -> dict:
     """QueryResult -> Prometheus /api/v1 response data (ref: PrometheusModel
     convertSampl... matrix/vector conversion; values are [sec, "str"] pairs)."""
@@ -34,10 +51,10 @@ def matrix_to_prom_json(result) -> dict:
             metric["__name__"] = metric.pop("_metric_")
         if vector:
             out.append({"metric": metric,
-                        "value": [ts[-1] / 1000.0, "%g" % vals[-1]]})
+                        "value": [ts[-1] / 1000.0, _fmt(vals[-1])]})
         else:
             out.append({"metric": metric,
-                        "values": [[t / 1000.0, "%g" % v] for t, v in zip(ts, vals)]})
+                        "values": [[t / 1000.0, _fmt(v)] for t, v in zip(ts, vals)]})
     return {"resultType": "vector" if vector else "matrix", "result": out}
 
 

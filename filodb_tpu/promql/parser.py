@@ -49,6 +49,7 @@ INSTANT_FNS_ARGS = {
 }
 MISC_FNS = {"label_replace", "label_join", "timestamp"}
 SORT_FNS = {"sort", "sort_desc"}
+SCALAR_FNS = {"time", "scalar", "vector"}   # ref: ast/Functions.scala allows vector/time
 AGG_OPS = {
     "sum", "avg", "count", "min", "max", "stddev", "stdvar", "topk", "bottomk",
     "count_values", "quantile",
@@ -171,6 +172,7 @@ _PRECEDENCE = {
     "^": 6,
 }
 _SET_OPS = {"and", "or", "unless"}
+_CMP_OPS = {"==", "!=", ">", "<", ">=", "<="}
 _COMPARISON_OPS = {"==", "!=", "<=", "<", ">=", ">"}
 
 
@@ -299,6 +301,7 @@ class Parser:
             if self.peek().text == "(" and (
                 name in RANGE_FNS or name in RANGE_FNS_ARGS or name in INSTANT_FNS
                 or name in INSTANT_FNS_ARGS or name in MISC_FNS or name in SORT_FNS
+                or name in SCALAR_FNS
             ):
                 return Call(name, self._call_args())
             if name in KEYWORDS:
@@ -475,8 +478,29 @@ def _lower(e: Expr, p: QueryParams) -> L.LogicalPlan:
     raise ParseError(f"cannot lower {e!r}")
 
 
+_SCALAR_PLANS = (L.ScalarPlan, L.TimeScalarPlan, L.ScalarOfVector)
+
+
 def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
     name = e.func
+    if name == "time":
+        if e.args:
+            raise ParseError("time() takes no arguments")
+        return L.TimeScalarPlan(p.start_ms, p.step_ms, p.end_ms)
+    if name == "scalar":
+        if len(e.args) != 1:
+            raise ParseError("scalar() expects one instant vector")
+        inner = _lower(e.args[0], p)
+        if isinstance(inner, _SCALAR_PLANS):
+            raise ParseError("scalar() expects an instant vector")
+        return L.ScalarOfVector(inner)
+    if name == "vector":
+        if len(e.args) != 1:
+            raise ParseError("vector() expects one scalar")
+        inner = _lower(e.args[0], p)
+        if not isinstance(inner, _SCALAR_PLANS):
+            raise ParseError("vector() expects a scalar expression")
+        return L.VectorOfScalar(inner)
     if name in RANGE_FNS or name in RANGE_FNS_ARGS:
         if name in RANGE_FNS_ARGS:
             scal_pos, vec_pos = RANGE_FNS_ARGS[name]
@@ -514,19 +538,34 @@ def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
 def _lower_binary(e: BinaryExpr, p: QueryParams) -> L.LogicalPlan:
     lhs = _lower(e.lhs, p)
     rhs = _lower(e.rhs, p)
-    lhs_scalar = isinstance(lhs, L.ScalarPlan)
-    rhs_scalar = isinstance(rhs, L.ScalarPlan)
+    lhs_scalar = isinstance(lhs, _SCALAR_PLANS)
+    rhs_scalar = isinstance(rhs, _SCALAR_PLANS)
     op = e.op + ("_bool" if e.bool_modifier else "")
-    if lhs_scalar and rhs_scalar:
+    if (lhs_scalar and rhs_scalar
+            and isinstance(lhs, L.ScalarPlan) and isinstance(rhs, L.ScalarPlan)):
+        if e.op in _CMP_OPS and not e.bool_modifier:
+            raise ParseError("comparisons between scalars must use BOOL modifier")
         from ..ops.binop import scalar_binop
         return L.ScalarPlan(scalar_binop(e.op, lhs.value, rhs.value, e.bool_modifier),
                             p.start_ms, p.step_ms, p.end_ms)
     if lhs_scalar or rhs_scalar:
         if e.op in _SET_OPS:
             raise ParseError(f"set operator {e.op} not allowed with scalar")
-        scalar = lhs.value if lhs_scalar else rhs.value
-        vector = rhs if lhs_scalar else lhs
-        return L.ScalarVectorBinaryOperation(op, scalar, vector, scalar_is_lhs=lhs_scalar)
+        if lhs_scalar and rhs_scalar:
+            if e.op in _CMP_OPS and not e.bool_modifier:
+                raise ParseError(
+                    "comparisons between scalars must use BOOL modifier")
+            # step-varying scalar on at least one side: evaluate as a
+            # 1-series vector op; the result is scalar-typed again
+            svbo = L.ScalarVectorBinaryOperation(
+                op, lhs.value if isinstance(lhs, L.ScalarPlan) else lhs,
+                L.VectorOfScalar(rhs), scalar_is_lhs=True)
+            return L.ScalarOfVector(svbo)
+        sp = lhs if lhs_scalar else rhs
+        vec = rhs if lhs_scalar else lhs
+        scalar = sp.value if isinstance(sp, L.ScalarPlan) else sp
+        return L.ScalarVectorBinaryOperation(op, scalar, vec,
+                                             scalar_is_lhs=lhs_scalar)
     card = "OneToOne" if not (e.group_left or e.group_right) else (
         "ManyToOne" if e.group_left else "OneToMany")
     if e.op in _SET_OPS:

@@ -271,9 +271,24 @@ class ScalarOperationMapper(Transformer):
     scalar: float
     scalar_is_lhs: bool = False
 
+    _resolved = None
+
+    def prepare(self, ctx) -> None:
+        """Resolve a step-varying scalar subplan (time(), scalar(v)) ONCE per
+        query, called by the leaf BEFORE it takes its shard lock: executing
+        the subplan inside the lock would nest shard locks across queries
+        (ABBA deadlock) and re-run it per ODP batch."""
+        if isinstance(self.scalar, ExecPlan) and self._resolved is None:
+            sm = _as_matrix(self.scalar.execute(ctx)).to_host()
+            self._resolved = np.asarray(sm.values, np.float64)[0]
+
     def apply(self, data, ctx):
         m = _as_matrix(data)
-        vals = binop.apply_scalar_op(self.operator, self.scalar, m.values,
+        s = self.scalar
+        if isinstance(s, ExecPlan):
+            self.prepare(ctx)     # non-leaf chains have no lock to avoid
+            s = self._resolved    # [T] array broadcasts against [P, T]
+        vals = binop.apply_scalar_op(self.operator, s, m.values,
                                      self.scalar_is_lhs)
         keys = m.keys
         op = self.operator.removesuffix("_bool")
@@ -952,6 +967,12 @@ class SelectRawPartitionsExec(ExecPlan):
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
         shard = self._shard_of(ctx)
+        # step-varying scalar operands resolve BEFORE the lock: their
+        # subplans take other shards' locks (nested acquisition would ABBA-
+        # deadlock two concurrent mirror-image queries)
+        for t in self.transformers:
+            if isinstance(t, ScalarOperationMapper):
+                t.prepare(ctx)
         try:
             with shard.lock:
                 result = super().execute(ctx)
@@ -1352,3 +1373,35 @@ class ScalarExec(ExecPlan):
                            dtype=np.int64)
         vals = np.full((1, len(out_ts)), self.value)
         return ResultMatrix(out_ts, vals, [RangeVectorKey(())])
+
+
+@dataclass
+class TimeScalarExec(ExecPlan):
+    """PromQL ``time()``: evaluation timestamp in seconds per step."""
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+
+    def do_execute(self, ctx):
+        out_ts = np.arange(self.start_ms, self.end_ms + 1, max(self.step_ms, 1),
+                           dtype=np.int64)
+        vals = (out_ts / 1000.0)[None, :]
+        return ResultMatrix(out_ts, vals, [RangeVectorKey(())])
+
+
+@dataclass
+class ScalarOfVectorExec(ExecPlan):
+    """PromQL ``scalar(v)``: the single series' values, NaN at steps where
+    the vector doesn't have exactly one sample."""
+    child: ExecPlan = None
+
+    def do_execute(self, ctx):
+        m = _as_matrix(self.child.execute(ctx)).to_host()
+        T = len(m.out_ts)
+        vals = np.asarray(m.values, np.float64)
+        present = (~np.isnan(vals)).sum(axis=0) if m.num_series else \
+            np.zeros(T)
+        col = np.where(present == 1,
+                       np.nansum(np.where(np.isnan(vals), 0, vals), axis=0)
+                       if m.num_series else np.nan, np.nan)
+        return ResultMatrix(m.out_ts, col[None, :], [RangeVectorKey(())])

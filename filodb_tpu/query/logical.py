@@ -129,6 +129,27 @@ class ScalarPlan(PeriodicSeriesPlan):
     end_ms: int = 0
 
 
+@dataclass(frozen=True)
+class TimeScalarPlan(PeriodicSeriesPlan):
+    """PromQL ``time()``: the evaluation timestamp (seconds) at each step."""
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+
+
+@dataclass(frozen=True)
+class ScalarOfVector(PeriodicSeriesPlan):
+    """PromQL ``scalar(v)``: the single series' value per step, NaN unless
+    the vector has exactly one series."""
+    vectors: LogicalPlan = None
+
+
+@dataclass(frozen=True)
+class VectorOfScalar(PeriodicSeriesPlan):
+    """PromQL ``vector(s)``: a one-series instant vector from a scalar."""
+    scalar: LogicalPlan = None
+
+
 # ---- metadata plans ---------------------------------------------------------
 
 @dataclass(frozen=True)

@@ -17,7 +17,8 @@ from . import logical as L
 from .exec import (AggregateMapReduce, AggregatePresenter, BinaryJoinExec,
                    DistConcatExec, ExecPlan, InstantVectorFunctionMapper,
                    MiscellaneousFunctionMapper, PeriodicSamplesMapper, ScalarExec,
-                   ScalarOperationMapper, SelectRawPartitionsExec,
+                   ScalarOfVectorExec, ScalarOperationMapper,
+                   SelectRawPartitionsExec, TimeScalarExec,
                    SetOperatorExec, SortFunctionMapper)
 from .rangevector import QueryError
 
@@ -82,8 +83,13 @@ class QueryPlanner:
                                   ignoring=p.ignoring, include=p.include)
         if isinstance(p, L.ScalarVectorBinaryOperation):
             child = self._walk(p.vector)
+            scalar = p.scalar
+            if isinstance(scalar, L.LogicalPlan):
+                # step-varying scalar (time(), scalar(v)): materialize its
+                # exec; the mapper evaluates it to a [T] array at query time
+                scalar = self._walk(scalar)
             child.transformers = child.transformers + [
-                ScalarOperationMapper(p.operator, p.scalar, p.scalar_is_lhs)]
+                ScalarOperationMapper(p.operator, scalar, p.scalar_is_lhs)]
             return child
         if isinstance(p, L.ApplyInstantFunction):
             child = self._walk(p.vectors)
@@ -100,7 +106,16 @@ class QueryPlanner:
             child.transformers = child.transformers + [SortFunctionMapper(p.function)]
             return child
         if isinstance(p, L.ScalarPlan):
-            return ScalarExec(value=p.value)
+            return ScalarExec(value=p.value, start_ms=p.start_ms,
+                              step_ms=p.step_ms, end_ms=p.end_ms)
+        if isinstance(p, L.TimeScalarPlan):
+            return TimeScalarExec(start_ms=p.start_ms, step_ms=p.step_ms,
+                                  end_ms=p.end_ms)
+        if isinstance(p, L.ScalarOfVector):
+            return ScalarOfVectorExec(child=self._walk(p.vectors))
+        if isinstance(p, L.VectorOfScalar):
+            # a scalar exec already yields a one-series matrix
+            return self._walk(p.scalar)
         raise QueryError(f"cannot materialize {type(p).__name__}")
 
     def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:

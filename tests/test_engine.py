@@ -241,3 +241,29 @@ def test_aggregate_over_padded_narrow_gather():
     finite_rows = np.isfinite(vals).any(axis=1)
     winners = {r.matrix.keys[i].as_dict()["inst"] for i in np.nonzero(finite_rows)[0]}
     assert winners == {"i96", "i95"}
+
+
+def test_time_vector_scalar_functions(engine):
+    # time(): evaluation timestamp in seconds at each step
+    res = engine.query_range("time()", START + 600_000, START + 660_000, 30_000)
+    (_k, ts, vals), = list(res.matrix.iter_series())
+    np.testing.assert_allclose(vals, ts / 1000.0)
+    # vector(s): a one-series instant vector
+    res = engine.query_range("vector(7)", START + 600_000, START + 630_000, 30_000)
+    (_k, _t, vals), = list(res.matrix.iter_series())
+    np.testing.assert_allclose(vals, 7.0)
+    # step-varying scalar in a binop: series minus time()
+    r1 = q(engine, 'heap_usage{host="h0"} - time()')
+    r2 = q(engine, 'heap_usage{host="h0"}')
+    ((_, (t1, v1)),) = r1.items()
+    ((_, (_t2, v2)),) = r2.items()
+    np.testing.assert_allclose(v1, v2 - t1 / 1000.0)
+    # scalar(v): single-series value usable as a scalar operand
+    r3 = q(engine, 'heap_usage{host="h1"} * 0 + scalar(heap_usage{host="h0"})')
+    ((_, (_t3, v3)),) = r3.items()
+    np.testing.assert_allclose(v3, v2)
+    # scalar() of a multi-series vector is NaN -> empty result series
+    res = engine.query_range("vector(scalar(heap_usage))",
+                             START + 600_000, START + 630_000, 30_000)
+    assert res.matrix.num_series == 0 or np.isnan(
+        np.asarray(res.matrix.values)).all()

@@ -240,7 +240,7 @@ def test_server_inline_downsample_and_cascade(tmp_path):
     bus.publish(b.build())
     server = FiloServer(Config(cfg)).start()
     try:
-        deadline = time.time() + 15
+        deadline = time.time() + 40
         while time.time() < deadline:
             sh = server.memstore.shard("prometheus", 0)
             if sh.stats.rows_ingested >= 63:
@@ -250,7 +250,7 @@ def test_server_inline_downsample_and_cascade(tmp_path):
         for t in range(63, 120):   # 20 minutes of 10s data in total
             b.add({"_metric_": "m", "host": "h0"}, BASE + t * IV, float(t))
         bus.publish(b.build())
-        deadline = time.time() + 15
+        deadline = time.time() + 40
         while time.time() < deadline:
             if sh.stats.rows_ingested >= 120:
                 break
@@ -268,7 +268,7 @@ def test_server_inline_downsample_and_cascade(tmp_path):
             np.testing.assert_allclose(bv, np.arange(120.0)[sel].mean())
         keys = list(sink.read_part_keys("prometheus:ds_1m:dAvg", 0))
         assert keys and keys[0][1].get("host") == "h0"
-        deadline = time.time() + 15
+        deadline = time.time() + 40
         five_m = []
         while time.time() < deadline and not five_m:
             five_m = [r for _g, recs in
