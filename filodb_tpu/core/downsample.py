@@ -60,6 +60,10 @@ class InlineDownsampler:
         # operator flush_all_groups): accumulate/emit must be atomic or two
         # racing emitters would publish the same closed bucket twice
         self._lock = threading.Lock()
+        # pids released while their buckets were claimed mid-publish: the
+        # publish filter and the failure-restore path both consult this, and
+        # a pid leaves the set when its (reused) slot ingests new data
+        self._dropped: set[int] = set()
 
     def drop_pids(self, pids) -> None:
         """Partition release (purge/eviction): open buckets of these pids
@@ -67,6 +71,7 @@ class InlineDownsampler:
         labels would then be attributed the dead series' data."""
         gone = set(int(p) for p in pids)
         with self._lock:
+            self._dropped |= gone
             for k in [k for k in self._acc if k[0] in gone]:
                 del self._acc[k]
 
@@ -128,6 +133,7 @@ class InlineDownsampler:
         lastt = np.zeros(ngroups, np.int64); lastt[gidx] = t
         for i in range(ngroups):
             key = (int(gp[i]), int(gts[i]) // res)
+            self._dropped.discard(key[0])   # new data => slot's (new) owner
             a = self._acc.get(key)
             if a is None:
                 self._acc[key] = [sums[i], cnts[i], mins[i], maxs[i],
@@ -153,6 +159,8 @@ class InlineDownsampler:
         except Exception:
             with self._lock:     # publish failed: restore for retry
                 for k, a in claimed.items():
+                    if k[0] in self._dropped:   # released mid-publish: stays dead
+                        continue
                     cur = self._acc.get(k)
                     if cur is None:
                         self._acc[k] = a
@@ -164,6 +172,12 @@ class InlineDownsampler:
             raise
 
     def _publish_claimed(self, shard, claimed) -> None:
+        with self._lock:
+            # a release can race the claim window: its buckets must not emit
+            claimed = {k: a for k, a in claimed.items()
+                       if k[0] not in self._dropped}
+        if not claimed:
+            return
         done = list(claimed)
         res = self.resolution_ms
         pids = np.array([k[0] for k in done], np.int32)

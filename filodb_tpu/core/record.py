@@ -121,10 +121,12 @@ class RecordBuilder:
         self._ph: list[int] = []
         self._sh: list[int] = []
         self._pidx: list[int] = []
+        self._batches: list[tuple] = []   # add_batch array groups
         self._labels: list[dict[str, str]] = []
         self._label_key_to_idx: dict[tuple, int] = {}
 
-    def add(self, labels: dict[str, str], ts_ms: int, value) -> None:
+    def _intern(self, labels: dict[str, str]):
+        """Shared hash-memo + label interning: ((part_hash, shard_hash), idx)."""
         key = tuple(sorted(labels.items()))
         cached = self._hash_cache.get(key)
         if cached is None:
@@ -138,23 +140,48 @@ class RecordBuilder:
             idx = len(self._labels)
             self._labels.append(dict(labels))
             self._label_key_to_idx[key] = idx
+        return cached, idx
+
+    def add(self, labels: dict[str, str], ts_ms: int, value) -> None:
+        cached, idx = self._intern(labels)
         self._ts.append(ts_ms)
         self._vals.append(value)
         self._ph.append(cached[0])
         self._sh.append(cached[1])
         self._pidx.append(idx)
 
+    def add_batch(self, labels: dict[str, str], ts_ms, values) -> None:
+        """Bulk samples for ONE series: hashing/label interning happens once
+        and the arrays ride through build() without per-sample Python work —
+        the path for backfills, CSV imports, and synthetic generators."""
+        cached, idx = self._intern(labels)
+        ts_ms = np.asarray(ts_ms, np.int64)
+        n = len(ts_ms)
+        values = np.asarray(values)
+        if len(values) != n:
+            raise ValueError(
+                f"add_batch length mismatch: {n} timestamps vs "
+                f"{len(values)} values for {labels}")
+        self._batches.append((
+            ts_ms, values,
+            np.full(n, cached[0], np.uint64),
+            np.full(n, cached[1], np.uint32),
+            np.full(n, idx, np.int32)))
+
     def build(self) -> RecordContainer:
+        ts = np.asarray(self._ts, dtype=np.int64)
         vals = np.asarray(self._vals, dtype=np.float64)
-        rc = RecordContainer(
-            self.schema,
-            np.asarray(self._ts, dtype=np.int64),
-            vals,
-            np.asarray(self._ph, dtype=np.uint64),
-            np.asarray(self._sh, dtype=np.uint32),
-            np.asarray(self._pidx, dtype=np.int32),
-            self._labels,
-            self.bucket_les,
-        )
+        ph = np.asarray(self._ph, dtype=np.uint64)
+        sh = np.asarray(self._sh, dtype=np.uint32)
+        pidx = np.asarray(self._pidx, dtype=np.int32)
+        if self._batches:
+            ts = np.concatenate([ts] + [b[0] for b in self._batches])
+            vals = np.concatenate([vals] + [np.asarray(b[1], np.float64)
+                                            for b in self._batches])
+            ph = np.concatenate([ph] + [b[2] for b in self._batches])
+            sh = np.concatenate([sh] + [b[3] for b in self._batches])
+            pidx = np.concatenate([pidx] + [b[4] for b in self._batches])
+        rc = RecordContainer(self.schema, ts, vals, ph, sh, pidx,
+                             self._labels, self.bucket_les)
         self.reset()
         return rc

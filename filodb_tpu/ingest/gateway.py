@@ -47,7 +47,35 @@ def parse_influx_line(line: str) -> tuple[str, dict[str, str], dict[str, float],
     line = line.strip()
     if not line or line.startswith("#"):
         raise InfluxParseError("empty/comment line")
-    # split into (measurement+tags, fields, optional ts) on unescaped spaces
+    if "\\" not in line and '"' not in line:
+        # fast path (the overwhelmingly common shape): no escapes, no string
+        # fields — C-speed str.split instead of the per-character scanner
+        segs = line.split(" ")
+        if len(segs) < 2 or len(segs) > 3 or not segs[1]:
+            raise InfluxParseError(f"bad line: {line!r}")
+        head = segs[0].split(",")
+        measurement = head[0]
+        tags = {}
+        for t in head[1:]:
+            k, eq, v = t.partition("=")
+            if not eq:
+                raise InfluxParseError(f"bad tag {t!r}")
+            tags[k] = v
+        fields = {}
+        for fkv in segs[1].split(","):
+            k, eq, v = fkv.partition("=")
+            if not eq:
+                raise InfluxParseError(f"bad field {fkv!r}")
+            try:
+                fields[k] = float(v)
+            except ValueError:
+                try:
+                    fields[k] = float(v.rstrip("iu"))
+                except ValueError:
+                    raise InfluxParseError(f"bad field value {v!r}") from None
+        ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+        return measurement, tags, fields, ts_ns
+    # escaped/quoted general path
     segs = []
     cur, i = [], 0
     while i < len(line):

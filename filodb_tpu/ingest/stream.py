@@ -8,7 +8,6 @@ sources/CsvStream.scala (sample CSV source), gateway/.../TestTimeseriesProducer
 from __future__ import annotations
 
 import csv
-import math
 from typing import Iterator
 
 import numpy as np
@@ -85,18 +84,23 @@ class SyntheticStream(IngestionStream):
                 "dc": f"DC{i % 2}"}
 
     def __iter__(self):
-        counters = np.zeros(self.n_series)
+        counter_base = np.zeros(self.n_series)
         t_idx = 0
+        idx = np.arange(self.n_series)[:, None]
         for batch in range(self.n_batches):
             b = RecordBuilder(self.schema)
-            for _ in range(self.samples_per_batch):
-                ts = self.start_ms + t_idx * self.interval_ms
-                for i in range(self.n_series):
-                    if self.kind == "counter":
-                        counters[i] += abs(math.sin(t_idx / 10 + i)) * 10
-                        v = counters[i]
-                    else:
-                        v = 15.0 * (i + 1) + 8 * math.sin(t_idx / 10 + i)
-                    b.add(self.labels(i), ts, v)
-                t_idx += 1
+            k = self.samples_per_batch
+            steps = t_idx + np.arange(k)[None, :]
+            ts = self.start_ms + steps[0] * self.interval_ms
+            if self.kind == "counter":
+                incs = np.abs(np.sin(steps / 10 + idx)) * 10     # [S, k]
+                vals = counter_base[:, None] + np.cumsum(incs, axis=1)
+                counter_base = vals[:, -1].copy()
+            else:
+                vals = 15.0 * (idx + 1) + 8 * np.sin(steps / 10 + idx)
+            # one bulk append per series per batch (samples stay time-ordered
+            # per series; cross-series interleaving is irrelevant downstream)
+            for i in range(self.n_series):
+                b.add_batch(self.labels(i), ts, vals[i])
+            t_idx += k
             yield batch, b.build()

@@ -172,7 +172,6 @@ _PRECEDENCE = {
     "^": 6,
 }
 _SET_OPS = {"and", "or", "unless"}
-_CMP_OPS = {"==", "!=", ">", "<", ">=", "<="}
 _COMPARISON_OPS = {"==", "!=", "<=", "<", ">=", ">"}
 
 
@@ -543,7 +542,7 @@ def _lower_binary(e: BinaryExpr, p: QueryParams) -> L.LogicalPlan:
     op = e.op + ("_bool" if e.bool_modifier else "")
     if (lhs_scalar and rhs_scalar
             and isinstance(lhs, L.ScalarPlan) and isinstance(rhs, L.ScalarPlan)):
-        if e.op in _CMP_OPS and not e.bool_modifier:
+        if e.op in _COMPARISON_OPS and not e.bool_modifier:
             raise ParseError("comparisons between scalars must use BOOL modifier")
         from ..ops.binop import scalar_binop
         return L.ScalarPlan(scalar_binop(e.op, lhs.value, rhs.value, e.bool_modifier),
@@ -552,7 +551,7 @@ def _lower_binary(e: BinaryExpr, p: QueryParams) -> L.LogicalPlan:
         if e.op in _SET_OPS:
             raise ParseError(f"set operator {e.op} not allowed with scalar")
         if lhs_scalar and rhs_scalar:
-            if e.op in _CMP_OPS and not e.bool_modifier:
+            if e.op in _COMPARISON_OPS and not e.bool_modifier:
                 raise ParseError(
                     "comparisons between scalars must use BOOL modifier")
             # step-varying scalar on at least one side: evaluate as a

@@ -1398,10 +1398,8 @@ class ScalarOfVectorExec(ExecPlan):
     def do_execute(self, ctx):
         m = _as_matrix(self.child.execute(ctx)).to_host()
         T = len(m.out_ts)
-        vals = np.asarray(m.values, np.float64)
-        present = (~np.isnan(vals)).sum(axis=0) if m.num_series else \
-            np.zeros(T)
-        col = np.where(present == 1,
-                       np.nansum(np.where(np.isnan(vals), 0, vals), axis=0)
-                       if m.num_series else np.nan, np.nan)
+        vals = np.asarray(m.values, np.float64).reshape(-1, T)
+        present = (~np.isnan(vals)).sum(axis=0)
+        with np.errstate(invalid="ignore"):
+            col = np.where(present == 1, np.nansum(vals, axis=0), np.nan)
         return ResultMatrix(m.out_ts, col[None, :], [RangeVectorKey(())])

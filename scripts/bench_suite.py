@@ -97,6 +97,19 @@ def bench_ingestion(full: bool) -> None:
     build_s = time.perf_counter() - t0
     n_records = n_series * n_samples
     emit("ingestion", "record_build_throughput", n_records / build_s, "records/s")
+    # bulk path: one add_batch per series (backfills/CSV/generators)
+    from filodb_tpu.core.record import RecordBuilder
+    import numpy as np
+    ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+    t0 = time.perf_counter()
+    b = RecordBuilder(GAUGE)
+    for s in range(n_series):
+        b.add_batch({"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app",
+                     "host": f"h{s}", "job": f"App-{s % 8}"},
+                    ts_arr, np.full(n_samples, float(s)))
+    b.build()
+    emit("ingestion", "record_build_batch_throughput",
+         n_records / (time.perf_counter() - t0), "records/s")
 
     cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=n_samples + 8,
                       flush_batch_size=10**9, dtype="float32")
