@@ -60,10 +60,12 @@ class InlineDownsampler:
         # operator flush_all_groups): accumulate/emit must be atomic or two
         # racing emitters would publish the same closed bucket twice
         self._lock = threading.Lock()
-        # pids released while their buckets were claimed mid-publish: the
-        # publish filter and the failure-restore path both consult this, and
-        # a pid leaves the set when its (reused) slot ingests new data
-        self._dropped: set[int] = set()
+        # generation-tagged drops: a claim snapshots the drop counter, and a
+        # pid poisons that claim iff it was dropped AFTER the snapshot —
+        # state accumulated by a reused slot's NEW owner (later generations)
+        # is never confused with the in-flight claim of the dead series
+        self._drop_counter = 0
+        self._drop_gen_of: dict[int, int] = {}
 
     def drop_pids(self, pids) -> None:
         """Partition release (purge/eviction): open buckets of these pids
@@ -71,7 +73,9 @@ class InlineDownsampler:
         labels would then be attributed the dead series' data."""
         gone = set(int(p) for p in pids)
         with self._lock:
-            self._dropped |= gone
+            self._drop_counter += 1
+            for p in gone:
+                self._drop_gen_of[p] = self._drop_counter
             for k in [k for k in self._acc if k[0] in gone]:
                 del self._acc[k]
 
@@ -133,7 +137,6 @@ class InlineDownsampler:
         lastt = np.zeros(ngroups, np.int64); lastt[gidx] = t
         for i in range(ngroups):
             key = (int(gp[i]), int(gts[i]) // res)
-            self._dropped.discard(key[0])   # new data => slot's (new) owner
             a = self._acc.get(key)
             if a is None:
                 self._acc[key] = [sums[i], cnts[i], mins[i], maxs[i],
@@ -154,13 +157,14 @@ class InlineDownsampler:
                 return
             # claim atomically: a racing emitter must not publish these too
             claimed = {k: self._acc.pop(k) for k in done}
+            claim_gen = self._drop_counter
         try:
-            self._publish_claimed(shard, claimed)
+            self._publish_claimed(shard, claimed, claim_gen)
         except Exception:
             with self._lock:     # publish failed: restore for retry
                 for k, a in claimed.items():
-                    if k[0] in self._dropped:   # released mid-publish: stays dead
-                        continue
+                    if self._drop_gen_of.get(k[0], 0) > claim_gen:
+                        continue       # released after the claim: stays dead
                     cur = self._acc.get(k)
                     if cur is None:
                         self._acc[k] = a
@@ -171,11 +175,13 @@ class InlineDownsampler:
                             cur[4], cur[5] = a[4], a[5]
             raise
 
-    def _publish_claimed(self, shard, claimed) -> None:
+    def _publish_claimed(self, shard, claimed, claim_gen: int) -> None:
         with self._lock:
-            # a release can race the claim window: its buckets must not emit
+            # a release racing the claim window poisons exactly the claims
+            # taken before it (generation comparison): new-owner state from a
+            # later reuse is untouched
             claimed = {k: a for k, a in claimed.items()
-                       if k[0] not in self._dropped}
+                       if self._drop_gen_of.get(k[0], 0) <= claim_gen}
         if not claimed:
             return
         done = list(claimed)

@@ -175,12 +175,19 @@ class RecordBuilder:
         sh = np.asarray(self._sh, dtype=np.uint32)
         pidx = np.asarray(self._pidx, dtype=np.int32)
         if self._batches:
-            ts = np.concatenate([ts] + [b[0] for b in self._batches])
-            vals = np.concatenate([vals] + [np.asarray(b[1], np.float64)
-                                            for b in self._batches])
-            ph = np.concatenate([ph] + [b[2] for b in self._batches])
-            sh = np.concatenate([sh] + [b[3] for b in self._batches])
-            pidx = np.concatenate([pidx] + [b[4] for b in self._batches])
+            # a 1-D empty scalar head cannot concatenate with 2-D histogram
+            # batch values: include the per-sample parts only when present
+            vhead = [vals] if len(self._vals) else []
+            head = [ts] if len(self._ts) else []
+            ts = np.concatenate(head + [b[0] for b in self._batches])
+            vals = np.concatenate(vhead + [np.asarray(b[1], np.float64)
+                                           for b in self._batches])
+            ph = np.concatenate(([ph] if len(self._ph) else [])
+                                + [b[2] for b in self._batches])
+            sh = np.concatenate(([sh] if len(self._sh) else [])
+                                + [b[3] for b in self._batches])
+            pidx = np.concatenate(([pidx] if len(self._pidx) else [])
+                                  + [b[4] for b in self._batches])
         rc = RecordContainer(self.schema, ts, vals, ph, sh, pidx,
                              self._labels, self.bucket_les)
         self.reset()

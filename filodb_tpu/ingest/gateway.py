@@ -73,7 +73,10 @@ def parse_influx_line(line: str) -> tuple[str, dict[str, str], dict[str, float],
                     fields[k] = float(v.rstrip("iu"))
                 except ValueError:
                     raise InfluxParseError(f"bad field value {v!r}") from None
-        ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+        try:
+            ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+        except ValueError:
+            raise InfluxParseError(f"bad timestamp {segs[2]!r}") from None
         return measurement, tags, fields, ts_ns
     # escaped/quoted general path
     segs = []
@@ -107,7 +110,10 @@ def parse_influx_line(line: str) -> tuple[str, dict[str, str], dict[str, float],
         if v.startswith('"'):
             continue  # string fields are not time series samples
         fields[k] = float(v)
-    ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+    try:
+        ts_ns = int(segs[2]) if len(segs) > 2 and segs[2] else 0
+    except ValueError:
+        raise InfluxParseError(f"bad timestamp {segs[2]!r}") from None
     return measurement, tags, fields, ts_ns
 
 

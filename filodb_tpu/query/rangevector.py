@@ -60,8 +60,24 @@ class ResultMatrix:
 
     def iter_series(self) -> Iterator[tuple[RangeVectorKey, np.ndarray, np.ndarray]]:
         """Yield (key, ts, values) per series with NaN points dropped; series with
-        no points are skipped entirely (Prometheus empty-series semantics)."""
+        no points are skipped entirely (Prometheus empty-series semantics).
+
+        Histogram-valued matrices expand into the classic Prometheus form:
+        one ``le``-labeled series per bucket (cumulative counts), so raw
+        histogram results (e.g. ``rate(hist[5m])``) serialize over the API
+        like a scraped classic histogram."""
         vals = np.asarray(self.values)
+        if self.bucket_les is not None and vals.ndim == 3:
+            for p, key in enumerate(self.keys):
+                base = key.as_dict()
+                for b, le in enumerate(self.bucket_les):
+                    col = vals[p, :, b]
+                    present = ~np.isnan(col)
+                    if present.any():
+                        le_s = "+Inf" if np.isinf(le) else ("%g" % le)
+                        bkey = RangeVectorKey.of(dict(base, le=le_s))
+                        yield bkey, self.out_ts[present], col[present]
+            return
         for p, key in enumerate(self.keys):
             present = ~np.isnan(vals[p])
             if present.any():

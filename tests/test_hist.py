@@ -278,3 +278,23 @@ def test_hist_persistence_roundtrip(tmp_path):
     np.testing.assert_allclose(shard2.bucket_les, les)
     ts0, v0 = shard2.store.series_snapshot(0)
     assert len(ts0) == 20
+
+
+def test_raw_hist_result_expands_to_le_series(hist_engine):
+    """rate(hist[2m]) without a quantile mapper serializes as classic
+    Prometheus le-labeled bucket series."""
+    eng, les, data = hist_engine
+    r = eng.query_range("rate(req_latency[2m])",
+                        BASE + 600_000, BASE + 660_000, 30_000)
+    series = list(r.matrix.iter_series())
+    # 3 pods x 6 buckets
+    assert len(series) == 18
+    les_seen = {k.as_dict()["le"] for k, _, _ in series}
+    assert les_seen == {"1", "2", "4", "8", "16", "+Inf"}
+    # cumulative within a pod at each step: monotone in le
+    pod0 = {k.as_dict()["le"]: np.asarray(v) for k, _, v in series
+            if k.as_dict()["pod"] == "p0"}
+    np.testing.assert_array_equal(
+        np.maximum(pod0["1"], pod0["2"]), pod0["2"])
+    np.testing.assert_array_equal(
+        np.maximum(pod0["16"], pod0["+Inf"]), pod0["+Inf"])
