@@ -66,6 +66,7 @@ class InlineDownsampler:
         # is never confused with the in-flight claim of the dead series
         self._drop_counter = 0
         self._drop_gen_of: dict[int, int] = {}
+        self._claims_in_flight: list[int] = []   # claim gens not yet settled
 
     def drop_pids(self, pids) -> None:
         """Partition release (purge/eviction): open buckets of these pids
@@ -158,6 +159,7 @@ class InlineDownsampler:
             # claim atomically: a racing emitter must not publish these too
             claimed = {k: self._acc.pop(k) for k in done}
             claim_gen = self._drop_counter
+            self._claims_in_flight.append(claim_gen)
         try:
             self._publish_claimed(shard, claimed, claim_gen)
         except Exception:
@@ -174,6 +176,17 @@ class InlineDownsampler:
                         if a[5] >= cur[5]:
                             cur[4], cur[5] = a[4], a[5]
             raise
+        finally:
+            with self._lock:
+                self._claims_in_flight.remove(claim_gen)
+                # drop generations older than every outstanding claim can no
+                # longer poison anything: prune (bounds churn-driven growth)
+                floor = min(self._claims_in_flight,
+                            default=self._drop_counter)
+                if self._drop_gen_of:
+                    self._drop_gen_of = {p: g for p, g in
+                                         self._drop_gen_of.items()
+                                         if g > floor}
 
     def _publish_claimed(self, shard, claimed, claim_gen: int) -> None:
         with self._lock:

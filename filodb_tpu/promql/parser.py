@@ -50,6 +50,7 @@ INSTANT_FNS_ARGS = {
 MISC_FNS = {"label_replace", "label_join", "timestamp"}
 SORT_FNS = {"sort", "sort_desc"}
 SCALAR_FNS = {"time", "scalar", "vector"}   # ref: ast/Functions.scala allows vector/time
+FILO_FNS = {"_filodb_chunkmeta_all"}        # ref: FiloFunctionId.ChunkMetaAll
 AGG_OPS = {
     "sum", "avg", "count", "min", "max", "stddev", "stdvar", "topk", "bottomk",
     "count_values", "quantile",
@@ -300,7 +301,7 @@ class Parser:
             if self.peek().text == "(" and (
                 name in RANGE_FNS or name in RANGE_FNS_ARGS or name in INSTANT_FNS
                 or name in INSTANT_FNS_ARGS or name in MISC_FNS or name in SORT_FNS
-                or name in SCALAR_FNS
+                or name in SCALAR_FNS or name in FILO_FNS
             ):
                 return Call(name, self._call_args())
             if name in KEYWORDS:
@@ -482,6 +483,15 @@ _SCALAR_PLANS = (L.ScalarPlan, L.TimeScalarPlan, L.ScalarOfVector)
 
 def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
     name = e.func
+    if name == "_filodb_chunkmeta_all":
+        # chunk-metadata debug plan (ref: FiloFunctionId.ChunkMetaAll ->
+        # RawChunkMeta, Functions.scala:48; no lookback — metadata only)
+        if len(e.args) != 1 or not isinstance(e.args[0], VectorSelector):
+            raise ParseError(f"{name} expects one vector selector")
+        vs = e.args[0]
+        raw = _raw(vs, p, 0)
+        return L.RawChunkMeta(raw.range_selector, raw.filters,
+                              raw.columns[0] if raw.columns else "")
     if name == "time":
         if e.args:
             raise ParseError("time() takes no arguments")

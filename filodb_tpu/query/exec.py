@@ -954,13 +954,7 @@ class SelectRawPartitionsExec(ExecPlan):
     column: str = ""
 
     def _shard_of(self, ctx):
-        ds = f"{ctx.dataset}:{self.column}" if self.column else ctx.dataset
-        try:
-            return ctx.memstore.shard(ds, self.shard)
-        except KeyError:
-            raise QueryError(
-                f"unknown {'column ' + self.column + ' of ' if self.column else ''}"
-                f"dataset {ds}") from None
+        return _shard_of_ctx(ctx, self.shard, self.column)
 
     def execute(self, ctx: QueryContext):
         # hold the shard lock across array capture AND the transformer chain's
@@ -1387,6 +1381,71 @@ class TimeScalarExec(ExecPlan):
                            dtype=np.int64)
         vals = (out_ts / 1000.0)[None, :]
         return ResultMatrix(out_ts, vals, [RangeVectorKey(())])
+
+
+def _shard_of_ctx(ctx, shard_num: int, column: str = ""):
+    """Resolve a shard, honoring a __col__ value-column selector (targets an
+    aggregate dataset of a downsample family) with a clean QueryError."""
+    ds = f"{ctx.dataset}:{column}" if column else ctx.dataset
+    try:
+        return ctx.memstore.shard(ds, shard_num)
+    except KeyError:
+        raise QueryError(
+            f"unknown {'column ' + column + ' of ' if column else ''}"
+            f"dataset {ds}") from None
+
+
+@dataclass
+class SelectChunkInfosExec(ExecPlan):
+    """Chunk-metadata debug leaf (ref: SelectChunkInfosExec.scala — id,
+    numRows, startTime, endTime, numBytes, readerKlazz per chunk). This
+    design keeps ONE resident row per series (no chunk lists), so the row's
+    stats come back as labels on a synthetic series, plus the count of
+    persisted chunk frames when a sink exists."""
+    shard: int = 0
+    filters: tuple = ()
+    start_ms: int = 0
+    end_ms: int = 0
+    column: str = ""
+
+    MAX_PARTS = 1000    # debug surface: bound the output
+
+    def do_execute(self, ctx):
+        shard = _shard_of_ctx(ctx, self.shard, self.column)
+        out_ts = np.array([self.end_ms], np.int64)
+        if shard.store is None:
+            return ResultMatrix(out_ts, np.zeros((0, 1)), [])
+        pids = shard.part_ids_from_filters(list(self.filters), self.start_ms,
+                                           self.end_ms, limit=self.MAX_PARTS)
+        sink_chunks: dict[int, int] = {}
+        if shard.sink is not None and hasattr(shard.sink, "read_chunksets"):
+            for _g, recs in shard.sink.read_chunksets(
+                    shard.dataset, self.shard, self.start_ms, self.end_ms) or ():
+                for r in recs:
+                    sink_chunks[r.part_id] = sink_chunks.get(r.part_id, 0) + 1
+        st = shard.store
+        keys, vals = [], []
+        with shard.lock:
+            for p in pids:
+                p = int(p)
+                labels = dict(shard.index.labels_of(p))
+                n = int(st.n_host[p])
+                per_sample = 8 + (st.val.dtype.itemsize
+                                  * max(st.nbuckets, 1))
+                labels.update({
+                    "_id_": str(p),
+                    "_numRows_": str(n),
+                    "_startTime_": str(int(st.first_ts[p])),
+                    "_endTime_": str(int(st.last_ts[p])) if n else "-1",
+                    "_numBytes_": str(n * per_sample),
+                    "_readerKlazz_": "SeriesStoreRow",
+                    "_sinkChunks_": str(sink_chunks.get(p, 0)),
+                })
+                keys.append(RangeVectorKey.of(labels))
+                vals.append([float(n)])
+        if not keys:
+            return ResultMatrix(out_ts, np.zeros((0, 1)), [])
+        return ResultMatrix(out_ts, np.asarray(vals), keys)
 
 
 @dataclass

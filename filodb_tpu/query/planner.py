@@ -18,7 +18,7 @@ from .exec import (AggregateMapReduce, AggregatePresenter, BinaryJoinExec,
                    DistConcatExec, ExecPlan, InstantVectorFunctionMapper,
                    MiscellaneousFunctionMapper, PeriodicSamplesMapper, ScalarExec,
                    ScalarOfVectorExec, ScalarOperationMapper,
-                   SelectRawPartitionsExec, TimeScalarExec,
+                   SelectChunkInfosExec, SelectRawPartitionsExec, TimeScalarExec,
                    SetOperatorExec, SortFunctionMapper)
 from .rangevector import QueryError
 
@@ -116,6 +116,13 @@ class QueryPlanner:
         if isinstance(p, L.VectorOfScalar):
             # a scalar exec already yields a one-series matrix
             return self._walk(p.scalar)
+        if isinstance(p, L.RawChunkMeta):
+            shards = self.shards_for_filters(list(p.filters))
+            children = [SelectChunkInfosExec(
+                shard=s, filters=tuple(p.filters),
+                start_ms=p.range_selector.from_ms,
+                end_ms=p.range_selector.to_ms, column=p.column) for s in shards]
+            return self._fan_in(children)
         raise QueryError(f"cannot materialize {type(p).__name__}")
 
     def _materialize_aggregate(self, p: L.Aggregate) -> ExecPlan:

@@ -74,7 +74,14 @@ class ResultMatrix:
                     col = vals[p, :, b]
                     present = ~np.isnan(col)
                     if present.any():
-                        le_s = "+Inf" if np.isinf(le) else ("%g" % le)
+                        # full round-trip precision: "%g" would collide
+                        # near-equal custom bounds into duplicate le labels
+                        if np.isinf(le):
+                            le_s = "+Inf"
+                        elif float(le) == int(le):
+                            le_s = str(int(le))
+                        else:
+                            le_s = repr(float(le))
                         bkey = RangeVectorKey.of(dict(base, le=le_s))
                         yield bkey, self.out_ts[present], col[present]
             return

@@ -267,3 +267,18 @@ def test_time_vector_scalar_functions(engine):
                              START + 600_000, START + 630_000, 30_000)
     assert res.matrix.num_series == 0 or np.isnan(
         np.asarray(res.matrix.values)).all()
+
+
+def test_chunkmeta_debug_function(engine):
+    """_filodb_chunkmeta_all(m{...}) returns per-series store metadata as
+    labels (ref: FiloFunctionId.ChunkMetaAll -> SelectChunkInfosExec)."""
+    r = engine.query_range('_filodb_chunkmeta_all(heap_usage{host="h2"})',
+                           START, START + NSAMPLES * INTERVAL, 30_000)
+    (k, ts, vals), = list(r.matrix.iter_series())
+    d = k.as_dict()
+    assert d["host"] == "h2"
+    assert int(d["_numRows_"]) == NSAMPLES
+    assert int(d["_startTime_"]) == START
+    assert int(d["_endTime_"]) == START + (NSAMPLES - 1) * INTERVAL
+    assert d["_readerKlazz_"] == "SeriesStoreRow"
+    assert vals[0] == NSAMPLES
