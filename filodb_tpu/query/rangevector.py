@@ -105,30 +105,45 @@ class QueryError(Exception):
 
 # ---- wire serialization (SerializableRangeVector equivalent) ----------------
 
-_MAGIC = 0x46545256  # 'FTRV'
+_MAGIC = 0x46545257  # 'FTRW' — v2 header carries the histogram bucket count
 
 
 def serialize_matrix(m: ResultMatrix) -> bytes:
     """Compact wire form for cross-node result transfer (ref: RangeVector.scala
     SerializableRangeVector materializes into RecordContainers; here: one header
-    + columnar f64 block + label blob)."""
+    + columnar f64 block + label blob). Histogram-valued matrices ([P, T, B])
+    carry the bucket count + bucket bounds after the value block."""
     import json
     host = m.to_host()
     P, T = len(host.keys), len(host.out_ts)
+    vals = np.asarray(host.values, "<f8")
+    # B comes from the bucket bounds; shape disagreement is a caller bug and
+    # must fail here, not as a corrupt blob at the receiver
+    B = len(host.bucket_les) if host.bucket_les is not None else 0
+    if (vals.ndim == 3) != (B > 0) or (B and vals.shape[2] != B):
+        raise ValueError(
+            f"histogram matrix shape {vals.shape} inconsistent with "
+            f"{B} bucket bounds")
     blob = json.dumps([k.labels for k in host.keys], separators=(",", ":")).encode()
-    head = struct.pack("<IIII", _MAGIC, P, T, len(blob))
+    head = struct.pack("<IIIII", _MAGIC, P, T, len(blob), B)
+    les = (np.asarray(host.bucket_les, "<f8").tobytes() if B else b"")
     return (head + host.out_ts.astype("<i8").tobytes()
-            + np.asarray(host.values, "<f8").tobytes() + blob)
+            + vals.tobytes() + les + blob)
 
 
 def deserialize_matrix(buf: bytes) -> ResultMatrix:
     import json
-    magic, P, T, blob_len = struct.unpack_from("<IIII", buf, 0)
+    magic, P, T, blob_len, B = struct.unpack_from("<IIIII", buf, 0)
     if magic != _MAGIC:
         raise ValueError("bad result matrix magic")
-    off = 16
+    off = 20
     out_ts = np.frombuffer(buf, "<i8", T, off).copy(); off += 8 * T
-    values = np.frombuffer(buf, "<f8", P * T, off).reshape(P, T).copy(); off += 8 * P * T
+    n_vals = P * T * (B or 1)
+    values = np.frombuffer(buf, "<f8", n_vals, off).copy(); off += 8 * n_vals
+    values = values.reshape((P, T, B) if B else (P, T))
+    les = None
+    if B:
+        les = np.frombuffer(buf, "<f8", B, off).copy(); off += 8 * B
     keys = [RangeVectorKey(tuple(tuple(kv) for kv in k))
             for k in json.loads(buf[off:off + blob_len])]
-    return ResultMatrix(out_ts, values, keys)
+    return ResultMatrix(out_ts, values, keys, les)

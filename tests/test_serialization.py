@@ -23,3 +23,16 @@ def test_empty_matrix_roundtrip():
     m = ResultMatrix(np.zeros(0, np.int64), np.zeros((0, 0)), [])
     back = deserialize_matrix(serialize_matrix(m))
     assert back.num_series == 0
+
+
+def test_histogram_matrix_roundtrip():
+    les = np.array([1.0, 4.0, np.inf])
+    m = ResultMatrix(np.arange(3, dtype=np.int64) * 1000,
+                     np.arange(2 * 3 * 3, dtype=np.float64).reshape(2, 3, 3),
+                     [RangeVectorKey((("pod", "p0"),)),
+                      RangeVectorKey((("pod", "p1"),))],
+                     bucket_les=les)
+    back = deserialize_matrix(serialize_matrix(m))
+    np.testing.assert_array_equal(back.values, m.values)
+    np.testing.assert_array_equal(back.bucket_les, les)
+    assert back.keys == m.keys
