@@ -14,9 +14,12 @@ framework's own parts:
     shared), so local and remote stores interoperate.
   - ``ReplicatedColumnStore``: fans writes out to ``replication`` replicas
     chosen on a ring keyed by (dataset, shard); reads fail over to the first
-    healthy replica. Write succeeds if at least one replica accepted
-    (lagging replicas self-heal on the next append of the same log — logs
-    are idempotent to re-reads via recovery's dedup).
+    healthy replica. Write succeeds if at least one replica accepted. A
+    replica that misses a write stays divergent for those frames (effective
+    RF degrades until the log is re-replicated operationally); reads defend
+    against divergence by picking the replica with the most distinct
+    in-range samples (see ``read_chunksets``), and recovery's replay dedups
+    duplicate frames from retried flushes.
   - ``get_scan_splits``: time-range splits (the token-range analog), aligned
     to a resolution so batch downsampling over splits never splits a bucket.
 """
@@ -31,6 +34,8 @@ import socketserver
 import struct
 import threading
 import zlib
+
+import numpy as np
 
 from ..utils.netio import recv_exact as _recv_exact
 from .store import ChunkSink, encode_chunkset, iter_chunksets
@@ -345,8 +350,18 @@ class ReplicatedColumnStore(ChunkSink):
             # one and serve the most complete — exact, bounded by the window
             results = self._read_all(dataset, shard, "read_chunksets",
                                      start_ms, end_ms)
+
             def total(res):
-                return sum(len(r.ts) for _g, recs in res for r in recs)
+                # count DISTINCT (pid, ts) samples: retried flushes can leave
+                # duplicate frames, and raw lengths would let a
+                # duplicate-inflated replica outrank a sibling holding more
+                # distinct data
+                per_pid: dict[int, list] = {}
+                for _g, recs in res:
+                    for r in recs:
+                        per_pid.setdefault(r.part_id, []).append(r.ts)
+                return sum(len(np.unique(np.concatenate(v)))
+                           for v in per_pid.values())
             return max((res for _b, res in results), key=total)
         # replicas agree (or the read is an unbounded recovery scan): stream
         # from one, in descending-size order with failover

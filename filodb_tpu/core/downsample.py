@@ -79,6 +79,11 @@ class InlineDownsampler:
                 self._drop_gen_of[p] = self._drop_counter
             for k in [k for k in self._acc if k[0] in gone]:
                 del self._acc[k]
+            if self._seeded_last is not None:
+                # the seed floor is per-SLOT: a reused slot's new owner must
+                # not have its samples filtered by the dead series' floor
+                for p in gone:
+                    self._seeded_last[p] = -(1 << 62)
 
     def seed_from_store(self, shard) -> None:
         """Post-recovery rebuild of open buckets, called AFTER the sink's

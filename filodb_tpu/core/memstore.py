@@ -116,6 +116,22 @@ class TimeSeriesShard:
         G = config.groups_per_shard
         self._pending_chunks: list[list] = [[] for _ in range(G)]   # per group (pids, ts, vals)
         self._pending_group_offset = np.full(G, -1, np.int64)
+        # pids of chunk snapshots currently being written by a flush_group
+        # call (token -> unique pids). While a snapshot is outside
+        # _pending_chunks its pids are invisible to the release-time scrubs,
+        # so eviction/purge must not release them: a release during the sink
+        # write would persist a dead pid's samples after its tombstone and,
+        # after slot reuse, attribute them to the slot's next owner on
+        # recovery. Protected here; scrub-on-requeue stays as defense.
+        self._inflight_flush: dict[object, np.ndarray] = {}
+        # one flush at a time per group (ref: createFlushTask — a group's
+        # flush task is singular). Beyond exactly-once, this gives callers a
+        # happens-after guarantee: when flush_group(g) returns, any in-flight
+        # flush of g that had already snapshotted the pending chunks has
+        # finished its sink write AND its inline-downsample publish — without
+        # it, a caller could see an empty pending list, return immediately,
+        # and read the sink before the concurrent flusher published
+        self._group_flush_locks = [threading.Lock() for _ in range(G)]
         # ordered part-key event log awaiting durable persist: creations
         # (pid, labels, start) and release tombstones (pid, {}, -1) in event
         # order, so recovery's last-entry-wins resolves slot reuse correctly
@@ -176,6 +192,11 @@ class TimeSeriesShard:
         if protected:
             occupied = occupied[~np.isin(
                 occupied, np.fromiter(protected, np.int64, count=len(protected)))]
+        if self._inflight_flush:
+            # snapshots mid-write (see _inflight_flush): releasing these pids
+            # would persist dead samples after their tombstone
+            inflight = np.unique(np.concatenate(list(self._inflight_flush.values())))
+            occupied = occupied[~np.isin(occupied, inflight)]
         if occupied.size == 0:
             return False
         # amortize: evict a small batch, least-recently-active first
@@ -360,10 +381,16 @@ class TimeSeriesShard:
     def flush_group(self, group: int) -> int:
         """Encode and persist one flush group's pending samples, then commit its
         checkpoint atomically after the write (ref: :989 writeChunks ->
-        :1048 commitCheckpoint). Returns chunkset record count."""
+        :1048 commitCheckpoint). Serialized per group — see
+        ``_group_flush_locks``. Returns chunkset record count."""
         if self.sink is None:
             return 0
+        with self._group_flush_locks[group]:
+            return self._flush_group_serialized(group)
+
+    def _flush_group_serialized(self, group: int) -> int:
         self.flush()                      # device state first
+        token = object()
         with self.lock:
             pending = self._pending_chunks[group]
             self._pending_chunks[group] = []
@@ -371,6 +398,9 @@ class TimeSeriesShard:
             # release ran meanwhile, the requeue scrubs exactly the released
             # (possibly reused) slots' samples
             pend_epochs = [self.slot_epoch[p].copy() for (p, _, _) in pending]
+            if pending:
+                self._inflight_flush[token] = np.unique(
+                    np.concatenate([p for (p, _, _) in pending]))
         try:
             # part-key events (creations + tombstones, in order) land before
             # the chunks that reference them. Order matters: the chunk
@@ -405,22 +435,34 @@ class TimeSeriesShard:
             # for the next flush attempt. A fully-written duplicate frame from
             # a partially-completed attempt is deduped at recovery replay by
             # the store's out-of-order drop; a torn tail frame is skipped by
-            # the sink reader (WAL semantics).
-            self._requeue_pending(group, pending, pend_epochs)
+            # the sink reader (WAL semantics). The requeue puts the pids back
+            # in _pending_chunks where the release-time scrubs see them, so
+            # the inflight token can be dropped with the snapshot re-queued.
+            with self.lock:
+                self._requeue_pending_locked(group, pending, pend_epochs)
+                self._inflight_flush.pop(token, None)
             raise
-        # inline downsample runs after the chunks are durably written; a
-        # failure here must not kill the ingest thread — the streaming
-        # downsampler retains its accumulators and retries next flush
-        if self.downsample is not None and vals.ndim == 1:
-            res_ms, target = self.downsample
-            try:
-                if hasattr(target, "add"):        # streaming InlineDownsampler
-                    target.add(self, pids, ts, vals)
-                else:                             # plain callback (tests)
-                    from .downsample import downsample_records
-                    target(self, downsample_records(pids, ts, vals, res_ms))
-            except Exception:
-                log.exception("inline downsample publish failed; will retry")
+        try:
+            # inline downsample runs after the chunks are durably written; a
+            # failure here must not kill the ingest thread — the streaming
+            # downsampler retains its accumulators and retries next flush.
+            # Still under the inflight token: a release between the sink
+            # write and this add would otherwise let the dead pid's samples
+            # rebuild an open bucket AFTER drop_pids scrubbed it, and the
+            # claim-generation check cannot poison a claim taken later
+            if self.downsample is not None and vals.ndim == 1:
+                res_ms, target = self.downsample
+                try:
+                    if hasattr(target, "add"):    # streaming InlineDownsampler
+                        target.add(self, pids, ts, vals)
+                    else:                         # plain callback (tests)
+                        from .downsample import downsample_records
+                        target(self, downsample_records(pids, ts, vals, res_ms))
+                except Exception:
+                    log.exception("inline downsample publish failed; will retry")
+        finally:
+            with self.lock:
+                self._inflight_flush.pop(token, None)
         off = int(self._pending_group_offset[group])
         if off >= 0:
             # a checkpoint failure does NOT requeue: the chunks are durable,
@@ -429,20 +471,19 @@ class TimeSeriesShard:
             self.group_watermarks[group] = off
         return len(records)
 
-    def _requeue_pending(self, group, pending, pend_epochs) -> None:
+    def _requeue_pending_locked(self, group, pending, pend_epochs) -> None:
         """Return a failed flush's chunk snapshot to the pending queue (at the
         front, preserving order), scrubbing samples whose partition was
         released while the snapshot was outside ``_pending_chunks`` — the
-        release-time scrub could not see them there."""
-        with self.lock:
-            kept = []
-            for (pids_, ts_, vals_), eps in zip(pending, pend_epochs):
-                m = self.slot_epoch[pids_] == eps
-                if m.all():
-                    kept.append((pids_, ts_, vals_))
-                elif m.any():
-                    kept.append((pids_[m], ts_[m], vals_[m]))
-            self._pending_chunks[group] = kept + self._pending_chunks[group]
+        release-time scrub could not see them there. Caller holds the lock."""
+        kept = []
+        for (pids_, ts_, vals_), eps in zip(pending, pend_epochs):
+            m = self.slot_epoch[pids_] == eps
+            if m.all():
+                kept.append((pids_, ts_, vals_))
+            elif m.any():
+                kept.append((pids_[m], ts_[m], vals_[m]))
+        self._pending_chunks[group] = kept + self._pending_chunks[group]
 
     def flush_all_groups(self) -> None:
         for g in range(self.config.groups_per_shard):
@@ -557,10 +598,12 @@ class TimeSeriesShard:
                 if self.index.is_live(pid):
                     self.index.update_end_time(pid, int(last[pid]))
             purged = self.index.part_ids_ended_before(cutoff_ms)
-            # never purge series with data still staged for a pending flush group
+            # never purge series with data still staged for a pending flush
+            # group, nor pids of a snapshot currently being written
             if len(purged) and self.sink is not None:
                 staged = [pids for chunks in self._pending_chunks
                           for (pids, _, _) in chunks]
+                staged.extend(self._inflight_flush.values())
                 if staged:
                     pending = np.unique(np.concatenate(staged))
                     purged = np.setdiff1d(purged, pending).astype(np.int32)
@@ -614,7 +657,11 @@ class TimeSeriesShard:
             if cold_ts[p]:
                 ct = np.concatenate(cold_ts[p])
                 cv = np.concatenate(cold_val[p])
-                sel = ct < boundary            # dedupe vs resident data
+                # same slot-reuse rule as recovery (recover() step 2): sink
+                # chunks older than the CURRENT owner's start time belong to
+                # a released predecessor of the slot, not this series
+                own_start = self.index.start_time(p)
+                sel = (ct < boundary) & (ct >= own_start)
                 order = np.argsort(ct[sel], kind="stable")
                 rows_ts.append(np.concatenate([ct[sel][order], hot_t]))
                 rows_val.append(np.concatenate([cv[sel][order], hot_v]))

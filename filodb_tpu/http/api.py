@@ -23,21 +23,7 @@ from ..query.rangevector import QueryError
 from ..query.scheduler import Priority, SchedulerBusy
 
 
-def _fmt(v: float) -> str:
-    """Prometheus sample-value string: full float64 round-trip precision
-    (Go's strconv.FormatFloat with shortest round-trip digits — "%g" would
-    truncate to 6 significant digits, corrupting large values like
-    epoch-second arithmetic). Integral values render without a decimal
-    point; non-finite values use Prometheus' spellings."""
-    import math
-    v = float(v)
-    if math.isnan(v):
-        return "NaN"
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    if v == int(v) and abs(v) < 1e17:
-        return str(int(v))
-    return repr(v)
+from ..query.rangevector import fmt_value as _fmt  # shared full-precision renderer
 
 
 def matrix_to_prom_json(result) -> dict:

@@ -29,7 +29,8 @@ import numpy as np
 
 from ..core.filters import Filter
 from ..ops import aggregators, binop, instantfns, rangefns
-from .rangevector import QueryError, QueryResult, RangeVectorKey, ResultMatrix
+from .rangevector import (QueryError, QueryResult, RangeVectorKey,
+                          ResultMatrix, fmt_value)
 
 DEFAULT_SAMPLE_LIMIT = 1_000_000
 GATHER_THRESHOLD = 8192      # selections narrower than this gather rows up front
@@ -511,8 +512,8 @@ def _order_stat_map(m: MatrixView, op, params, by, without, cap=None):
     entries: dict = {}
     for i, pr in enumerate(upairs):
         gi, vi = divmod(int(pr), max(len(uvals), 1))
-        key = (gi, "%g" % uvals[vi])
-        # distinct floats can share a "%g" rendering: counts accumulate
+        key = (gi, fmt_value(uvals[vi]))
+        # distinct floats could share a truncated rendering: counts accumulate
         if key in entries:
             entries[key] = entries[key] + counts[i]
         else:
@@ -824,7 +825,7 @@ def _count_values(m: ResultMatrix, gkeys, label: str) -> ResultMatrix:
             v = vals[p, t]
             if np.isnan(v):
                 continue
-            vstr = ("%g" % v)
+            vstr = fmt_value(v)
             key = RangeVectorKey(tuple(sorted(dict(gk.labels, **{label: vstr}).items())))
             row = out.setdefault(key, np.full(T, np.nan))
             row[t] = (0 if np.isnan(row[t]) else row[t]) + 1

@@ -13,7 +13,26 @@ import struct
 from dataclasses import dataclass, field
 from typing import Iterator
 
+import math
+
 import numpy as np
+
+
+def fmt_value(v: float) -> str:
+    """Prometheus sample-value string: full float64 round-trip precision
+    (Go's strconv.FormatFloat with shortest round-trip digits — "%g" would
+    truncate to 6 significant digits, truncating large values like
+    epoch-second arithmetic and colliding distinct count_values labels).
+    Integral values render without a decimal point; non-finite values use
+    Prometheus' spellings."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e17:
+        return str(int(v))
+    return repr(v)
 
 
 @dataclass(frozen=True)
@@ -76,13 +95,7 @@ class ResultMatrix:
                     if present.any():
                         # full round-trip precision: "%g" would collide
                         # near-equal custom bounds into duplicate le labels
-                        if np.isinf(le):
-                            le_s = "+Inf"
-                        elif float(le) == int(le):
-                            le_s = str(int(le))
-                        else:
-                            le_s = repr(float(le))
-                        bkey = RangeVectorKey.of(dict(base, le=le_s))
+                        bkey = RangeVectorKey.of(dict(base, le=fmt_value(le)))
                         yield bkey, self.out_ts[present], col[present]
             return
         for p, key in enumerate(self.keys):

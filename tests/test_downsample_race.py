@@ -1,0 +1,95 @@
+"""Concurrent flush_group vs inline-downsample publish: exactly-once and
+happens-after guarantees under thread contention.
+
+Targets the round-2 driver-visible flake (test_server_inline_downsample_and
+_cascade, "inline 1m downsample not published"): the ingest-consumer poll
+thread and an operator flush_all_groups both call flush_group; before
+flush_group was serialized per group, the second caller could observe an
+empty pending queue and return while the first was still mid-publish — a
+reader consulting the sink right after the second call saw nothing.
+
+Reference parity: TimeSeriesShard.createFlushTask schedules ONE flush task
+per group (TimeSeriesShard.scala:771-814); checkpoints/chunks commit
+exactly once per flushed window (:1048).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from filodb_tpu.core.downsample import InlineDownsampler
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import FileColumnStore
+
+BASE = 1_700_000_000_000
+IV = 10_000
+RES = 60_000
+
+
+def test_concurrent_flush_publish_exactly_once(tmp_path):
+    ms = TimeSeriesMemStore()
+    sink = FileColumnStore(str(tmp_path))
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=1024,
+                      flush_batch_size=10**9, groups_per_shard=1)
+    shard = ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+
+    published: dict[tuple[int, int], int] = {}   # (pid, bucket_ts) -> count
+    pub_lock = threading.Lock()
+
+    def publish(sh, recs):
+        pids, bts, vals = recs["dAvg"]
+        time.sleep(0.002)   # widen the publish window the flake lived in
+        with pub_lock:
+            for p, t in zip(pids.tolist(), bts.tolist()):
+                published[(p, t)] = published.get((p, t), 0) + 1
+
+    shard.downsample = (RES, InlineDownsampler(RES, publish))
+
+    NSERIES, NSAMP = 4, 360          # 1h of 10s data -> 60 one-minute buckets
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                shard.flush_group(0)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for chunk in range(0, NSAMP, 30):
+            b = RecordBuilder(GAUGE)
+            for s in range(NSERIES):
+                for k in range(chunk, min(chunk + 30, NSAMP)):
+                    b.add({"_metric_": "m", "host": f"h{s}"},
+                          BASE + k * IV, float(k))
+            shard.ingest(b.build())
+            shard.flush_group(0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+
+    # happens-after: flush_group has returned on this thread, so every closed
+    # bucket of everything ingested above must already be published. Buckets
+    # live on the ABSOLUTE grid (ts // RES); bucket b is closed once the
+    # series' last ingested ts reaches the next bucket's start
+    last_ts = BASE + (NSAMP - 1) * IV
+    closed = [b for b in range(BASE // RES, last_ts // RES + 1)
+              if last_ts >= (b + 1) * RES]
+    expect = {(pid, (b + 1) * RES - 1)
+              for pid in range(NSERIES) for b in closed}
+    got = set(published)
+    missing = {e for e in expect if e not in got}
+    assert not missing, f"{len(missing)} closed buckets never published"
+    # exactly-once: no bucket published twice despite 4 racing flushers
+    dups = {k: c for k, c in published.items() if c != 1}
+    assert not dups, f"buckets published more than once: {dups}"
