@@ -126,8 +126,13 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool):
+def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
+                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool):
+    """The raw (traceable) fused-kernel pallas_call — also invoked inside
+    ``shard_map`` by the mesh executor (parallel/distributed.py), where each
+    shard runs this same map phase on its resident block and the partial
+    state crosses the ICI collective (ref: AggrOverRangeVectors.scala:62 —
+    the identical map phase runs on every data node)."""
     body = functools.partial(_kernel_body, fn, needs_sumsq, window_ms,
                              interval_ms, Sb, C, Tp, G)
     n_out = 3 if needs_sumsq else 2
@@ -136,7 +141,7 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     acc_spec = pl.BlockSpec((G, Tp), lambda i: (0, 0), memory_space=pltpu.VMEM)
     const = functools.partial(pl.BlockSpec, index_map=lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
-    call = pl.pallas_call(
+    return pl.pallas_call(
         body,
         grid=(S // Sb,),
         in_specs=[
@@ -151,6 +156,13 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
         interpret=interpret,
     )
 
+
+@functools.lru_cache(maxsize=64)
+def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
+                S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool):
+    call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                        S, Sb, C, Tp, G, interpret)
+
     # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
     # inside the jit — on a tunneled device every extra dispatch is a
     # round-trip (~0.1s measured), dwarfing the kernel itself
@@ -162,13 +174,11 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     return jax.jit(wrapped)
 
 
-@functools.lru_cache(maxsize=32)
-def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
-                     base_ts: int, interval_ms: int):
-    """Band/one-hot/edge operands on device, cached per query shape — the
-    upload matters: repeated host->device transfers of the [C, Tp] bands per
-    row-batch would dominate over a tunneled device link."""
-    out_ts = np.frombuffer(out_ts_key, np.int64)
+def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
+                  base_ts: int, interval_ms: int):
+    """Band/one-hot/edge operands as host arrays (band[C,Tp], ohlo[C,Tp],
+    lo[1,Tp], hi[1,Tp], rel[1,Tp]) — shared by the single-chip upload cache
+    below and the mesh path (which replicates them across shard devices)."""
     T = len(out_ts)
     lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
     rel = out_ts - base_ts
@@ -180,9 +190,19 @@ def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
     band[:, :T] = gridfns.band_matrix(C, lo, hi, True, np.float32)
     ohlo = np.zeros((C, Tp), np.float32)
     ohlo[:, :T] = gridfns.onehot_matrix(C, np.maximum(lo, 0), np.float32)
-    return (jnp.asarray(band), jnp.asarray(ohlo),
-            jnp.asarray(lo_p).reshape(1, Tp), jnp.asarray(hi_p).reshape(1, Tp),
-            jnp.asarray(rel_p).reshape(1, Tp))
+    return (band, ohlo, lo_p.reshape(1, Tp), hi_p.reshape(1, Tp),
+            rel_p.reshape(1, Tp))
+
+
+@functools.lru_cache(maxsize=32)
+def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
+                     base_ts: int, interval_ms: int):
+    """Band/one-hot/edge operands on device, cached per query shape — the
+    upload matters: repeated host->device transfers of the [C, Tp] bands per
+    row-batch would dominate over a tunneled device link."""
+    out_ts = np.frombuffer(out_ts_key, np.int64)
+    return tuple(jnp.asarray(a) for a in
+                 host_operands(C, Tp, out_ts, window_ms, base_ts, interval_ms))
 
 
 # conservative VMEM-driven caps for the fused path; beyond them callers must

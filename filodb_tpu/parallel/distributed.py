@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import aggregators, rangefns
+from ..ops import aggregators, fusedgrid, rangefns
 
 
 def make_mesh(devices=None, axis: str = "shard") -> Mesh:
@@ -87,12 +87,78 @@ def dist_aggregate(ts_g, val_g, n_g, gids_g, out_ts, window_ms, a0, a1,
     )(ts_g, val_g, n_g, gids_g)
 
 
+@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
+                                             "window_ms", "interval_ms",
+                                             "S", "C", "Tp"))
+def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
+                         fn: str, op: str, num_groups: int, mesh: Mesh,
+                         window_ms: int, interval_ms: int,
+                         S: int, C: int, Tp: int):
+    """Fused single-pass map phase on every shard + psum of its partial-state
+    layout over the shard axis — the multi-chip twin of
+    ``fusedgrid.fused_grid_aggregate`` (ref: AggrOverRangeVectors.scala:62 —
+    the same AggregateMapReduce map phase runs identically on every data
+    node; the psum IS the reduce node). Band/edge operands are replicated;
+    each shard streams only its resident [S, C] block."""
+    needs_sumsq = op in ("stddev", "stdvar")
+    Sb = 512 if S % 512 == 0 else S
+    call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                                  S, Sb, C, Tp, num_groups,
+                                  jax.default_backend() != "tpu")
+
+    def per_shard(val, n, gids, band, ohlo, lo, hi, rel):
+        outs = call(val[0].astype(jnp.float32),
+                    n[0].astype(jnp.int32).reshape(S, 1),
+                    gids[0].astype(jnp.int32).reshape(S, 1),
+                    band, ohlo, lo, hi, rel)
+        parts = ({"count": jax.lax.psum(outs[1], "shard")}
+                 if op in ("count", "group") else
+                 {k: jax.lax.psum(v, "shard")
+                  for k, v in zip(("sum", "count", "sumsq"), outs)})
+        return aggregators.present_partials(op, parts)[None]
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P(), P(), P(), P(), P()),
+        out_specs=P("shard"),
+        # pallas_call emits ShapeDtypeStructs without varying-mesh-axis
+        # annotations; the kernel is per-shard-local so vma checking adds
+        # nothing here
+        check_vma=False,
+    )(val_g, n_g, gids_g, band, ohlo, lo, hi, rel)
+
+
 class MeshQueryExecutor:
     """Runs aggregation queries over a DistributedStore (used by the engine when
-    a mesh is configured; falls back to in-process scatter-gather otherwise)."""
+    a mesh is configured; falls back to in-process scatter-gather otherwise).
+
+    Routing: when the query is fusable (rate/increase/delta into
+    sum/avg/count/group/stddev/stdvar), every shard store is f32,
+    grid-aligned to one common (base, interval) with a single uniform start
+    cohort, and the shapes fit the fused kernel's VMEM gate, the per-shard
+    map phase runs the single-pass fused Pallas kernel; otherwise the
+    general two-step kernels. ``last_path`` records the route taken."""
 
     def __init__(self, dstore: DistributedStore):
         self.dstore = dstore
+        self.last_path: str | None = None
+
+    def _fused_grid(self):
+        """Common (base_ts, interval_ms) when every shard qualifies for the
+        fused map phase, else None."""
+        grids = set()
+        for sh in self.dstore.shards:
+            st = sh.store
+            if st is None or st.dtype != jnp.float32:
+                return None
+            gi = st.grid_info()
+            if gi is None:
+                return None
+            kind, off = st.grid_cohorts()
+            if kind != "uniform" or off != 0:
+                return None
+            grids.add(gi)
+        return grids.pop() if len(grids) == 1 else None
 
     def aggregate(self, fn: str, op: str, out_ts: np.ndarray, window_ms: int,
                   group_ids_per_shard: list[np.ndarray], num_groups: int,
@@ -103,9 +169,29 @@ class MeshQueryExecutor:
             [jax.device_put(jnp.asarray(g, jnp.int32), d)
              for g, d in zip(group_ids_per_shard, devs)], (self.dstore.S,), jnp.int32)
         G = _pow2(num_groups)
+        S, C, T = self.dstore.S, self.dstore.C, len(out_ts)
+        grid = (self._fused_grid()
+                if fn in fusedgrid.FUSED_FNS and op in fusedgrid.FUSED_OPS
+                and fusedgrid.fusable(S, C, T, G) else None)
+        if grid is not None:
+            base_ts, interval_ms = grid
+            Tp = (max(T, 1) + 127) // 128 * 128
+            # cached per query shape — repeated [C, Tp] band uploads would
+            # dominate on a tunneled device link (same cache as single-chip)
+            band, ohlo, lo, hi, rel = fusedgrid._device_operands(
+                C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
+                int(window_ms), base_ts, int(interval_ms))
+            with jax.enable_x64(False):
+                out = dist_fused_aggregate(
+                    val_g, n_g, gids, band, ohlo, lo, hi, rel,
+                    fn, op, G, self.dstore.mesh, int(window_ms),
+                    int(interval_ms), S, C, Tp)
+            self.last_path = "fused"
+            return np.asarray(out.addressable_shards[0].data[0])[:num_groups, :T]
         out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_ts),
                              jnp.int64(window_ms), jnp.float64(args[0]),
                              jnp.float64(args[1]), fn, op, G, self.dstore.mesh)
+        self.last_path = "twostep"
         # all shards hold identical presented results; take shard 0's block
         return np.asarray(out.addressable_shards[0].data[0])[:num_groups]
 

@@ -85,6 +85,50 @@ def test_mesh_grouped_avg_and_max():
         np.testing.assert_allclose(got[g], np.max(per, axis=0), rtol=1e-12)
 
 
+def test_mesh_fused_rate_path_matches_twostep():
+    """f32 grid-aligned shards route sum(rate)/avg(rate) through the fused
+    single-pass map phase inside shard_map (asserted via last_path), and the
+    psum-reduced result matches the general two-step mesh path."""
+    mesh = make_mesh()
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    shards = [ms.setup("prometheus", GAUGE, i, cfg, device=dev)
+              for i, dev in enumerate(mesh.devices.ravel())]
+    rng = np.random.default_rng(5)
+    for i in range(24):
+        b = RecordBuilder(GAUGE)
+        vals = np.cumsum(rng.exponential(5.0, N))
+        labels = {"_metric_": "m", "host": f"h{i}", "grp": f"g{i % 4}"}
+        for t in range(N):
+            b.add(labels, START + t * INTERVAL, float(vals[t]))
+        ms.ingest("prometheus", i % 8, b.build())
+    ms.flush_all()
+    dstore = DistributedStore(mesh, shards)
+    ex = MeshQueryExecutor(dstore)
+    out_ts = np.arange(START + 300_000, START + 500_001, 20_000, dtype=np.int64)
+    gids = [np.zeros(16, np.int32) for _ in range(8)]
+
+    fused = ex.aggregate("rate", "sum", out_ts, 60_000, gids, 1)
+    assert ex.last_path == "fused"
+    # force the general path by (temporarily) demoting one shard's grid
+    shards[0].store.grid_ok = False
+    general = ex.aggregate("rate", "sum", out_ts, 60_000, gids, 1)
+    assert ex.last_path == "twostep"
+    shards[0].store.grid_ok = True
+    np.testing.assert_allclose(fused[0], general[0], rtol=2e-4, atol=1e-4)
+
+    # grouped avg through the fused partial layout
+    gids4 = [np.arange(16, dtype=np.int32) % 4 for _ in range(8)]
+    fused4 = ex.aggregate("rate", "avg", out_ts, 60_000, gids4, 4)
+    assert ex.last_path == "fused"
+    shards[0].store.grid_ok = False
+    general4 = ex.aggregate("rate", "avg", out_ts, 60_000, gids4, 4)
+    shards[0].store.grid_ok = True
+    np.testing.assert_allclose(fused4, general4, rtol=2e-4, atol=1e-4,
+                               equal_nan=True)
+
+
 def test_store_blocks_stay_on_their_devices():
     mesh, ms, shards, _ = build_store()
     devs = list(mesh.devices.ravel())
