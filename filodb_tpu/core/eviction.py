@@ -80,4 +80,6 @@ class BloomFilter:
         self.count += 1
 
     def __contains__(self, key: bytes) -> bool:
+        if not self.count:      # nothing ever evicted: registration hot path
+            return False
         return all(self._bits[p >> 3] & (1 << (p & 7)) for p in self._positions(key))

@@ -72,6 +72,15 @@ class TimeSeriesShard:
         self.index = PartKeyIndex()
         self._part_key_to_id: dict[bytes, int] = {}
         self._part_key_of_id: dict[int, bytes] = {}
+        # native open-addressing part-key table (ref: PartitionSet.scala) —
+        # batch-probed once per container on the ingest hot path; the dicts
+        # above remain the source of truth (and the fallback when no
+        # toolchain). Mirrored on create/release/recover.
+        from . import native as _native
+        self._native_ps = (_native.NativePartSet(config.max_series_per_shard)
+                           if _native.available() else None)
+        # bumped on every partition release: invalidates batch-resolved pids
+        self._release_epoch = 0
         # purged slots available for reuse + membership filter of evicted keys
         # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
         self._free_pids: list[int] = []
@@ -154,29 +163,70 @@ class TimeSeriesShard:
         set resolved: when a new slot is needed but every eviction candidate is
         a series resolved earlier in this same container (its samples not yet
         staged), resolution stops there so the caller can stage the prefix —
-        which makes those series evictable — and re-enter."""
+        which makes those series evictable — and re-enter.
+
+        Hot path: the whole container probes the native part-key table in ONE
+        call (ref: PartitionSet zero-alloc probes under
+        getOrAddPartitionAndIngest, TimeSeriesShard.scala:1183); only misses
+        (new series) take the per-set creation path. A release during the
+        loop (eviction making room) invalidates the batch snapshot, so the
+        remaining tail re-probes."""
         S = self.config.max_series_per_shard
+        n_sets = len(container.label_sets)
+        keys, hashes = container.resolved_keys()
         protected: set[int] = set()
-        for i in range(start, len(container.label_sets)):
-            labels = container.label_sets[i]
-            pk = part_key_of(labels, self.schema.options)
-            pid = self._part_key_to_id.get(pk)
-            if pid is None:
-                if not self._free_pids and len(self.index) >= S:
-                    if not self._ensure_free_space_locked(protected):
-                        return i   # blocked on this container's own series
-                if pk in self._evicted_keys:
-                    self.stats.evicted_part_key_reingests += 1
-                pid = self._free_pids.pop() if self._free_pids else len(self.index)
-                self._part_key_to_id[pk] = pid
-                self._part_key_of_id[pid] = pk
-                self.index.add_part_key(pid, labels, start_time=first_ts)
-                if self.sink is not None:
-                    self._partkey_log.append((pid, labels, first_ts))
-                self.stats.series_created += 1
-            mapping[i] = pid
-            protected.add(pid)
-        return len(container.label_sets)
+        i = start
+        while i < n_sets:
+            if self._native_ps is not None:
+                pids = self._native_ps.resolve_batch(hashes[i:], keys[i:])
+            else:
+                g = self._part_key_to_id.get
+                pids = np.fromiter((g(k, -1) for k in keys[i:]), np.int32,
+                                   count=n_sets - i)
+            epoch0 = self._release_epoch
+            seg = i
+            for j in range(seg, n_sets):
+                pid = int(pids[j - seg])
+                if pid < 0:
+                    pid = self._create_series_locked(
+                        container.label_sets[j], keys[j], int(hashes[j]),
+                        first_ts, protected)
+                    if pid is None:
+                        return j   # blocked on this container's own series
+                mapping[j] = pid
+                protected.add(pid)
+                i = j + 1
+                if self._release_epoch != epoch0 and i < n_sets:
+                    break          # eviction ran: re-probe the tail
+        return n_sets
+
+    def _create_series_locked(self, labels, pk: bytes, ph: int, first_ts,
+                              protected) -> int | None:
+        """Admit a new series: assign a slot (evicting under pressure), index
+        it, and mirror the key into the native table. None when every
+        eviction candidate is protected (caller stages its prefix first)."""
+        S = self.config.max_series_per_shard
+        # distinct label sets can share one part key (ignore_shard_key_tags):
+        # an earlier creation in this same batch snapshot must win, not be
+        # double-created (the batch probe predates it)
+        pid = self._part_key_to_id.get(pk)
+        if pid is not None:
+            return pid
+        if not self._free_pids and len(self.index) >= S:
+            if not self._ensure_free_space_locked(protected):
+                return None
+        if pk in self._evicted_keys:
+            self.stats.evicted_part_key_reingests += 1
+        pid = self._free_pids.pop() if self._free_pids else len(self.index)
+        self._part_key_to_id[pk] = pid
+        self._part_key_of_id[pid] = pk
+        if self._native_ps is not None:
+            self._native_ps.insert(ph, pk, pid)
+        self.index.add_part_key(pid, labels, start_time=first_ts)
+        if self.sink is not None:
+            self._partkey_log.append((pid, labels, first_ts))
+        self.stats.series_created += 1
+        return pid
 
     def _ensure_free_space_locked(self, protected: set[int]) -> bool:
         """Evict the least-recently-active partitions so a new series can be
@@ -217,11 +267,18 @@ class TimeSeriesShard:
         later owner of the reused slot."""
         pid_list = pids.tolist()
         self.slot_epoch[pids] += 1
+        self._release_epoch += 1
+        released_keys = []
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
                 del self._part_key_to_id[pk]
                 self._evicted_keys.add(pk)
+                released_keys.append(pk)
+        if self._native_ps is not None and released_keys:
+            from .native import fnv1a64_batch
+            for pk, h in zip(released_keys, fnv1a64_batch(released_keys)):
+                self._native_ps.remove(int(h), pk)
         self.index.remove_part_keys(pids)
         self.store.free_rows(pids)
         for pid in pid_list:
@@ -534,6 +591,9 @@ class TimeSeriesShard:
                 pk = part_key_of(labels, self.schema.options)
                 self._part_key_to_id[pk] = pid
                 self._part_key_of_id[pid] = pk
+                if self._native_ps is not None:
+                    from .record import fnv1a64
+                    self._native_ps.insert(fnv1a64(pk), pk, pid)
                 self.index.add_part_key(pid, labels, start)
         # 2. chunks -> device store (batched appends, flush order == time order).
         #    Chunks of purged partitions are skipped; for a reused slot, samples

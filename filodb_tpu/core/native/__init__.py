@@ -1,0 +1,111 @@
+"""ctypes binding for the native partition-set library (C++).
+
+``NativePartSet`` is the ingest hot-path part-key table (ref:
+core/.../memstore/PartitionSet.scala — zero-alloc open-addressing probes
+against ingest records, under getOrAddPartitionAndIngest,
+TimeSeriesShard.scala:1183). The shard keeps a Python-dict fallback when the
+toolchain is unavailable (``available()`` False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_DIR, "libfilodb_partset.so")
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["sh", os.path.join(_DIR, "build.sh")], check=True,
+                           capture_output=True)
+        except Exception:
+            _load_failed = True   # no toolchain: don't re-fork per build()
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.ps_new.restype = ctypes.c_void_p
+    lib.ps_new.argtypes = [ctypes.c_uint64]
+    lib.ps_free.argtypes = [ctypes.c_void_p]
+    lib.ps_size.restype = ctypes.c_uint64
+    lib.ps_size.argtypes = [ctypes.c_void_p]
+    lib.ps_insert.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+                              ctypes.c_uint32, ctypes.c_int32]
+    lib.ps_remove.restype = ctypes.c_int32
+    lib.ps_remove.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+                              ctypes.c_uint32]
+    lib.ps_resolve_batch.restype = ctypes.c_int64
+    lib.ps_resolve_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int64, ctypes.c_void_p]
+    lib.fnv1a64_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_int64, ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _concat_keys(keys: list[bytes]):
+    offs = np.zeros(len(keys) + 1, np.uint64)
+    np.cumsum([len(k) for k in keys], out=offs[1:])
+    return b"".join(keys), offs
+
+
+def fnv1a64_batch(keys: list[bytes]) -> np.ndarray:
+    """Vectorized wire-stable FNV-1a64 of each key (matches record.fnv1a64)."""
+    lib = _load()
+    blob, offs = _concat_keys(keys)
+    out = np.empty(len(keys), np.uint64)
+    lib.fnv1a64_batch(blob, offs.ctypes.data, len(keys), out.ctypes.data)
+    return out
+
+
+class NativePartSet:
+    """Open-addressing part-key -> pid table with exact-bytes verification."""
+
+    def __init__(self, cap_hint: int = 1024):
+        self._lib = _load()
+        assert self._lib is not None, "native partset unavailable"
+        self._h = self._lib.ps_new(cap_hint)
+
+    def __len__(self) -> int:
+        return int(self._lib.ps_size(self._h))
+
+    def insert(self, hash_: int, key: bytes, pid: int) -> None:
+        self._lib.ps_insert(self._h, hash_, key, len(key), pid)
+
+    def remove(self, hash_: int, key: bytes) -> bool:
+        return bool(self._lib.ps_remove(self._h, hash_, key, len(key)))
+
+    def resolve_batch(self, hashes: np.ndarray, keys: list[bytes]) -> np.ndarray:
+        """pids[i] for each key (or -1 on miss) in one native call."""
+        blob, offs = _concat_keys(keys)
+        out = np.empty(len(keys), np.int32)
+        h = np.ascontiguousarray(hashes, np.uint64)
+        self._lib.ps_resolve_batch(self._h, h.ctypes.data, blob,
+                                   offs.ctypes.data, len(keys),
+                                   out.ctypes.data)
+        return out
+
+    def __del__(self):
+        try:
+            self._lib.ps_free(self._h)
+        except Exception:
+            pass

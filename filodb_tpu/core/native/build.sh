@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native partition-set library. Called automatically on first import
+# of filodb_tpu.core.native (and from CI); idempotent.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -o libfilodb_partset.so partset.cpp
+echo "built $(pwd)/libfilodb_partset.so"

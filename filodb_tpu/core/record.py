@@ -12,8 +12,13 @@ versioned, little-endian. Layout:
 
     u32 magic 'FTRC' | u16 version | u16 schema_id | u32 n | u32 nlabels_blob_len
     i64 ts[n] | f64 value[n]  (or hist: u16 nbuckets + f64 buckets[n*nbuckets])
-    u64 part_hash[n] | u32 part_idx[n]   (index into label blob entries)
+    u64 part_hash[n] | u32 shard_hash[n] | i32 part_idx[n]
     label blob: json-encoded list of label dicts (only distinct series in batch)
+    v2 trailer (version >= 2): u32 n_sets | u32 key_len[n_sets]
+                               | u64 set_hash[n_sets] | key bytes concatenated
+    (canonical part-key bytes + fnv1a64 per label set, so consumers resolve
+    partitions by hash-table probe without re-sorting/re-encoding labels;
+    v1 frames are still readable — keys are recomputed lazily)
 """
 
 from __future__ import annotations
@@ -43,7 +48,14 @@ def fnv1a64(data: bytes) -> int:
 
 @dataclass
 class RecordContainer:
-    """One columnar ingest batch for a single schema."""
+    """One columnar ingest batch for a single schema.
+
+    Like the reference's BinaryRecord2 ingest records — which carry their
+    partition-key region so the shard's PartitionSet can probe without
+    allocating (binaryrecord2/RecordContainer.scala, PartitionSet.scala) —
+    a container carries the canonical part-key BYTES and 64-bit hash per
+    label set, so shard resolution is a pure hash-table probe with no
+    re-sorting/re-encoding of labels."""
     schema: Schema
     ts: np.ndarray            # int64 [n] epoch millis
     values: np.ndarray        # f64 [n] or [n, nbuckets] for histograms
@@ -52,15 +64,29 @@ class RecordContainer:
     part_idx: np.ndarray      # int32 [n] -> index into label_sets
     label_sets: list[dict[str, str]]
     bucket_les: np.ndarray | None = None   # f64 [nbuckets] histogram bucket tops
+    part_keys: list[bytes] | None = None   # canonical key bytes per label set
+    set_hashes: np.ndarray | None = None   # uint64 [n_sets] fnv1a64(part_keys)
 
     def __len__(self) -> int:
         return len(self.ts)
+
+    def resolved_keys(self):
+        """(part_keys, set_hashes), computing them when absent (v1 wire
+        frames, hand-built containers)."""
+        if self.part_keys is None:
+            opts = self.schema.options
+            self.part_keys = [part_key_of(ls, opts) for ls in self.label_sets]
+        if self.set_hashes is None:
+            self.set_hashes = np.fromiter(
+                (fnv1a64(k) for k in self.part_keys), np.uint64,
+                count=len(self.part_keys))
+        return self.part_keys, self.set_hashes
 
     def to_bytes(self) -> bytes:
         blob = json.dumps(self.label_sets, separators=(",", ":")).encode()
         n = len(self.ts)
         parts = [
-            _HDR.pack(_MAGIC, 1, self.schema.schema_id, n, len(blob)),
+            _HDR.pack(_MAGIC, 2, self.schema.schema_id, n, len(blob)),
             self.ts.astype("<i8").tobytes(),
         ]
         if self.values.ndim == 2:
@@ -76,6 +102,16 @@ class RecordContainer:
             self.shard_hash.astype("<u4").tobytes(),
             self.part_idx.astype("<i4").tobytes(),
             blob,
+        ]
+        # v2 trailer: canonical part-key bytes + per-set hashes, so consumers
+        # resolve partitions by hash probe without re-encoding labels
+        keys, hashes = self.resolved_keys()
+        lens = np.fromiter((len(k) for k in keys), np.uint32, count=len(keys))
+        parts += [
+            struct.pack("<I", len(keys)),
+            lens.astype("<u4").tobytes(),
+            hashes.astype("<u8").tobytes(),
+            b"".join(keys),
         ]
         return b"".join(parts)
 
@@ -97,8 +133,17 @@ class RecordContainer:
         part_hash = np.frombuffer(buf, "<u8", n, off); off += 8 * n
         shard_hash = np.frombuffer(buf, "<u4", n, off); off += 4 * n
         part_idx = np.frombuffer(buf, "<i4", n, off); off += 4 * n
-        label_sets = json.loads(buf[off : off + blob_len])
-        return cls(schema, ts, values, part_hash, shard_hash, part_idx, label_sets, bucket_les)
+        label_sets = json.loads(buf[off : off + blob_len]); off += blob_len
+        part_keys = set_hashes = None
+        if ver >= 2:
+            (nk,) = struct.unpack_from("<I", buf, off); off += 4
+            lens = np.frombuffer(buf, "<u4", nk, off); off += 4 * nk
+            set_hashes = np.frombuffer(buf, "<u8", nk, off); off += 8 * nk
+            part_keys = []
+            for ln in lens.tolist():
+                part_keys.append(buf[off:off + ln]); off += ln
+        return cls(schema, ts, values, part_hash, shard_hash, part_idx,
+                   label_sets, bucket_les, part_keys, set_hashes)
 
 
 class RecordBuilder:
@@ -118,43 +163,52 @@ class RecordBuilder:
     def reset(self) -> None:
         self._ts: list[int] = []
         self._vals: list = []
-        self._ph: list[int] = []
-        self._sh: list[int] = []
         self._pidx: list[int] = []
         self._batches: list[tuple] = []   # add_batch array groups
         self._labels: list[dict[str, str]] = []
+        self._part_keys: list[bytes] = []   # canonical key bytes per label set
+        self._shard_keys: list[bytes] = []  # shard-key bytes per label set
+        self._set_entries: list[list] = []  # _hash_cache rows per label set
         self._label_key_to_idx: dict[tuple, int] = {}
 
-    def _intern(self, labels: dict[str, str]):
-        """Shared hash-memo + label interning: ((part_hash, shard_hash), idx)."""
+    def _intern(self, labels: dict[str, str]) -> int:
+        """Label interning: canonical part/shard key BYTES are computed once
+        per unique label set (memoized across builds); the 64-bit hashes are
+        computed in one batched pass at build() time — per-record hashes are
+        a fancy-index of the per-set hashes, so add() does no hashing at all
+        (ref: BinaryRecords carry their part-key region; RecordBuilder
+        sortAndComputeHashes batches the hash work)."""
         key = tuple(sorted(labels.items()))
-        cached = self._hash_cache.get(key)
-        if cached is None:
-            opts = self.schema.options
-            ph = fnv1a64(part_key_of(labels, opts))
-            sh = fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF
-            cached = (ph, sh)
-            self._hash_cache[key] = cached
         idx = self._label_key_to_idx.get(key)
         if idx is None:
+            cached = self._hash_cache.get(key)
+            if cached is None:
+                opts = self.schema.options
+                # [pk, sk, part_hash?, shard_hash?] — hashes filled in by the
+                # first build() and reused across builds (long-lived gateway
+                # builders must not re-hash stable series every flush)
+                cached = [part_key_of(labels, opts),
+                          shard_key_of(labels, opts), None, None]
+                self._hash_cache[key] = cached
             idx = len(self._labels)
             self._labels.append(dict(labels))
+            self._part_keys.append(cached[0])
+            self._shard_keys.append(cached[1])
+            self._set_entries.append(cached)
             self._label_key_to_idx[key] = idx
-        return cached, idx
+        return idx
 
     def add(self, labels: dict[str, str], ts_ms: int, value) -> None:
-        cached, idx = self._intern(labels)
+        idx = self._intern(labels)
         self._ts.append(ts_ms)
         self._vals.append(value)
-        self._ph.append(cached[0])
-        self._sh.append(cached[1])
         self._pidx.append(idx)
 
     def add_batch(self, labels: dict[str, str], ts_ms, values) -> None:
         """Bulk samples for ONE series: hashing/label interning happens once
         and the arrays ride through build() without per-sample Python work —
         the path for backfills, CSV imports, and synthetic generators."""
-        cached, idx = self._intern(labels)
+        idx = self._intern(labels)
         ts_ms = np.asarray(ts_ms, np.int64)
         n = len(ts_ms)
         values = np.asarray(values)
@@ -162,17 +216,19 @@ class RecordBuilder:
             raise ValueError(
                 f"add_batch length mismatch: {n} timestamps vs "
                 f"{len(values)} values for {labels}")
-        self._batches.append((
-            ts_ms, values,
-            np.full(n, cached[0], np.uint64),
-            np.full(n, cached[1], np.uint32),
-            np.full(n, idx, np.int32)))
+        self._batches.append((ts_ms, values, np.full(n, idx, np.int32)))
+
+    @staticmethod
+    def _hash_keys(keys: list[bytes]) -> np.ndarray:
+        from .native import available as _native_ok, fnv1a64_batch
+        if keys and _native_ok():
+            return fnv1a64_batch(keys)
+        return np.fromiter((fnv1a64(k) for k in keys), np.uint64,
+                           count=len(keys))
 
     def build(self) -> RecordContainer:
         ts = np.asarray(self._ts, dtype=np.int64)
         vals = np.asarray(self._vals, dtype=np.float64)
-        ph = np.asarray(self._ph, dtype=np.uint64)
-        sh = np.asarray(self._sh, dtype=np.uint32)
         pidx = np.asarray(self._pidx, dtype=np.int32)
         if self._batches:
             # a 1-D empty scalar head cannot concatenate with 2-D histogram
@@ -182,13 +238,26 @@ class RecordBuilder:
             ts = np.concatenate(head + [b[0] for b in self._batches])
             vals = np.concatenate(vhead + [np.asarray(b[1], np.float64)
                                            for b in self._batches])
-            ph = np.concatenate(([ph] if len(self._ph) else [])
-                                + [b[2] for b in self._batches])
-            sh = np.concatenate(([sh] if len(self._sh) else [])
-                                + [b[3] for b in self._batches])
             pidx = np.concatenate(([pidx] if len(self._pidx) else [])
-                                  + [b[4] for b in self._batches])
+                                  + [b[2] for b in self._batches])
+        # hash only sets whose memo rows lack hashes (first sighting); stable
+        # series across builds reuse their memoized hashes
+        need = [i for i, e in enumerate(self._set_entries) if e[2] is None]
+        if need:
+            phs = self._hash_keys([self._part_keys[i] for i in need])
+            shs = (self._hash_keys([self._shard_keys[i] for i in need])
+                   & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            for j, i in enumerate(need):
+                self._set_entries[i][2] = int(phs[j])
+                self._set_entries[i][3] = int(shs[j])
+        set_hashes = np.fromiter((e[2] for e in self._set_entries), np.uint64,
+                                 count=len(self._set_entries))
+        set_shard = np.fromiter((e[3] for e in self._set_entries), np.uint32,
+                                count=len(self._set_entries))
+        ph = set_hashes[pidx] if len(pidx) else np.zeros(0, np.uint64)
+        sh = set_shard[pidx] if len(pidx) else np.zeros(0, np.uint32)
         rc = RecordContainer(self.schema, ts, vals, ph, sh, pidx,
-                             self._labels, self.bucket_les)
+                             self._labels, self.bucket_les,
+                             self._part_keys, set_hashes)
         self.reset()
         return rc
