@@ -79,6 +79,12 @@ class TimeSeriesShard:
         from . import native as _native
         self._native_ps = (_native.NativePartSet(config.max_series_per_shard)
                            if _native.available() else None)
+        # hash each pid was INSERTED under (container-supplied for ingest):
+        # removal must use the same value — recomputing could diverge from a
+        # frame whose trailer hash mismatches its key bytes, stranding a
+        # stale native entry that resolves to a freed slot
+        self._pid_hash = (np.zeros(config.max_series_per_shard, np.uint64)
+                          if self._native_ps is not None else None)
         # bumped on every partition release: invalidates batch-resolved pids
         self._release_epoch = 0
         # purged slots available for reuse + membership filter of evicted keys
@@ -171,7 +177,6 @@ class TimeSeriesShard:
         (new series) take the per-set creation path. A release during the
         loop (eviction making room) invalidates the batch snapshot, so the
         remaining tail re-probes."""
-        S = self.config.max_series_per_shard
         n_sets = len(container.label_sets)
         keys, hashes = container.resolved_keys()
         protected: set[int] = set()
@@ -222,6 +227,7 @@ class TimeSeriesShard:
         self._part_key_of_id[pid] = pk
         if self._native_ps is not None:
             self._native_ps.insert(ph, pk, pid)
+            self._pid_hash[pid] = ph
         self.index.add_part_key(pid, labels, start_time=first_ts)
         if self.sink is not None:
             self._partkey_log.append((pid, labels, first_ts))
@@ -268,17 +274,15 @@ class TimeSeriesShard:
         pid_list = pids.tolist()
         self.slot_epoch[pids] += 1
         self._release_epoch += 1
-        released_keys = []
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
                 del self._part_key_to_id[pk]
                 self._evicted_keys.add(pk)
-                released_keys.append(pk)
-        if self._native_ps is not None and released_keys:
-            from .native import fnv1a64_batch
-            for pk, h in zip(released_keys, fnv1a64_batch(released_keys)):
-                self._native_ps.remove(int(h), pk)
+                if self._native_ps is not None:
+                    # remove under the hash it was INSERTED with (see
+                    # _pid_hash) — never a recomputed one
+                    self._native_ps.remove(int(self._pid_hash[pid]), pk)
         self.index.remove_part_keys(pids)
         self.store.free_rows(pids)
         for pid in pid_list:
@@ -576,6 +580,7 @@ class TimeSeriesShard:
         # store.append would donate (delete) array buffers a concurrent query
         # has already captured
         with self.lock:
+            recovered_keys: list[tuple[int, bytes]] = []
             for pid in sorted(latest):
                 while len(self.index) < pid:   # gap: entry lost; free hole
                     hole = len(self.index)
@@ -591,10 +596,15 @@ class TimeSeriesShard:
                 pk = part_key_of(labels, self.schema.options)
                 self._part_key_to_id[pk] = pid
                 self._part_key_of_id[pid] = pk
-                if self._native_ps is not None:
-                    from .record import fnv1a64
-                    self._native_ps.insert(fnv1a64(pk), pk, pid)
+                recovered_keys.append((pid, pk))
                 self.index.add_part_key(pid, labels, start)
+            if self._native_ps is not None and recovered_keys:
+                # one native batch hash instead of a per-key Python FNV loop
+                from .native import fnv1a64_batch
+                hashes = fnv1a64_batch([pk for _pid, pk in recovered_keys])
+                for (pid, pk), h in zip(recovered_keys, hashes):
+                    self._native_ps.insert(int(h), pk, pid)
+                    self._pid_hash[pid] = h
         # 2. chunks -> device store (batched appends, flush order == time order).
         #    Chunks of purged partitions are skipped; for a reused slot, samples
         #    older than the current owner's start time belong to the purged

@@ -26,7 +26,10 @@ def _load():
     global _lib, _load_failed
     if _lib is not None or _load_failed:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    src = os.path.join(_DIR, "partset.cpp")
+    stale = (not os.path.exists(_LIB_PATH)
+             or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+    if stale:   # built per host (-march=native): never ship binaries
         try:
             subprocess.run(["sh", os.path.join(_DIR, "build.sh")], check=True,
                            capture_output=True)

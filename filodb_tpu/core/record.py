@@ -157,7 +157,9 @@ class RecordBuilder:
     def __init__(self, schema: Schema, bucket_les: np.ndarray | None = None):
         self.schema = schema
         self.bucket_les = bucket_les
-        self._hash_cache: dict[tuple, tuple[int, int, int]] = {}
+        # sorted-labels tuple -> [pk_bytes, sk_bytes, part_hash?, shard_hash?]
+        # (hashes lazily filled by the first build(); persists across resets)
+        self._hash_cache: dict[tuple, list] = {}
         self.reset()
 
     def reset(self) -> None:

@@ -47,12 +47,14 @@ class CustomBuckets:
 _HDR = struct.Struct("<HH")   # n_hists, n_buckets
 
 
-def encode_hist_series(counts: np.ndarray) -> bytes:
+def encode_hist_series_py(counts: np.ndarray) -> bytes:
     """counts [n, B] cumulative bucket counts (int64able) -> compressed bytes.
 
     Layout: header | per-histogram NibblePack'ed *increasing* delta arrays,
     where hist 0 packs its own bucket deltas and hist t>0 packs the 2D-delta
     (bucket-delta array minus previous histogram's bucket-delta array, zigzag).
+    numpy spec implementation; the native twin (memory/native hist_encode)
+    is bit-identical and handles the whole series in one call.
     """
     c = np.asarray(counts, dtype=np.int64)
     n, B = c.shape
@@ -70,7 +72,7 @@ def encode_hist_series(counts: np.ndarray) -> bytes:
     return b"".join(out)
 
 
-def decode_hist_series(buf: bytes) -> np.ndarray:
+def decode_hist_series_py(buf: bytes) -> np.ndarray:
     n, B = _HDR.unpack_from(buf, 0)
     off = _HDR.size
     out = np.zeros((n, B), np.int64)
@@ -84,6 +86,29 @@ def decode_hist_series(buf: bytes) -> np.ndarray:
         out[i] = np.cumsum(deltas)
         prev_deltas = deltas
     return out
+
+
+def _encode_native(counts: np.ndarray) -> bytes:
+    from . import native
+    c = np.asarray(counts, dtype=np.int64)
+    n, B = c.shape
+    return _HDR.pack(n, B) + native.hist_encode(c)
+
+
+def _decode_native(buf) -> np.ndarray:
+    from . import native
+    n, B = _HDR.unpack_from(buf, 0)
+    return native.hist_decode(buf[_HDR.size:], n, B)
+
+
+def _bind():
+    from . import native
+    if native.available():
+        return _encode_native, _decode_native
+    return encode_hist_series_py, decode_hist_series_py
+
+
+encode_hist_series, decode_hist_series = _bind()
 
 
 def _zigzag(v: np.ndarray) -> np.ndarray:

@@ -11,6 +11,7 @@
 // Build: memory/native/build.sh -> libfilodb_codecs.so
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 namespace {
@@ -140,6 +141,62 @@ void dd_restore(const uint64_t* zz, size_t n, int64_t first, int64_t slope,
         int64_t r = (int64_t)(zz[i] >> 1) ^ -(int64_t)(zz[i] & 1);
         out[i] = first + slope * (int64_t)i + r;
     }
+}
+
+// 2D-delta histogram series codec (ref: HistogramVector.scala sectioned
+// vectors, doc/compression.md "2D Delta Compression"): row 0 packs its own
+// bucket deltas; row t>0 packs zigzag(deltas_t - deltas_{t-1}). Wire-equal
+// to the numpy spec in memory/hist.py (whole series in ONE call — the
+// per-row Python loop was the flush/recovery bottleneck).
+size_t hist_encode(const int64_t* c, size_t n, size_t B, uint8_t* out) {
+    int64_t* prev = (int64_t*)std::malloc(B * sizeof(int64_t));
+    int64_t* cur = (int64_t*)std::malloc(B * sizeof(int64_t));
+    uint64_t* zz = (uint64_t*)std::malloc(((B + 7) & ~(size_t)7) * sizeof(uint64_t));
+    size_t pos = 0;
+    for (size_t i = 0; i < n; i++) {
+        const int64_t* row = c + i * B;
+        for (size_t j = 0; j < B; j++)
+            cur[j] = row[j] - (j ? row[j - 1] : 0);
+        if (i == 0) {
+            for (size_t j = 0; j < B; j++) zz[j] = (uint64_t)cur[j];
+        } else {
+            for (size_t j = 0; j < B; j++) {
+                int64_t d = cur[j] - prev[j];
+                zz[j] = (uint64_t)((d << 1) ^ (d >> 63));
+            }
+        }
+        pos += np_pack_u64(zz, B, out + pos);
+        int64_t* t = prev; prev = cur; cur = t;
+    }
+    std::free(prev); std::free(cur); std::free(zz);
+    return pos;
+}
+
+// Decodes n rows of B cumulative buckets; returns bytes consumed.
+size_t hist_decode(const uint8_t* in, size_t n, size_t B, int64_t* out) {
+    size_t Bpad = (B + 7) & ~(size_t)7;
+    uint64_t* words = (uint64_t*)std::malloc(Bpad * sizeof(uint64_t));
+    int64_t* deltas = (int64_t*)std::malloc(B * sizeof(int64_t));
+    size_t pos = 0;
+    for (size_t i = 0; i < n; i++) {
+        pos += np_unpack_u64(in + pos, B, words);
+        if (i == 0) {
+            for (size_t j = 0; j < B; j++) deltas[j] = (int64_t)words[j];
+        } else {
+            for (size_t j = 0; j < B; j++) {
+                int64_t d = (int64_t)(words[j] >> 1) ^ -(int64_t)(words[j] & 1);
+                deltas[j] += d;
+            }
+        }
+        int64_t acc = 0;
+        int64_t* row = out + i * B;
+        for (size_t j = 0; j < B; j++) {
+            acc += deltas[j];
+            row[j] = acc;
+        }
+    }
+    std::free(words); std::free(deltas);
+    return pos;
 }
 
 // sub-byte bit-packing for the IntBinaryVector family (bits in {1, 2, 4}):

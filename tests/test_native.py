@@ -49,3 +49,33 @@ def test_native_faster_than_numpy_decode(rng):
     nibblepack.unpack_u64(buf, len(vals))
     t_numpy = time.perf_counter() - t0
     assert t_native < t_numpy, (t_native, t_numpy)
+
+
+def test_native_deltadelta_bit_identical_and_fast():
+    from filodb_tpu.memory import deltadelta as dd
+    rng = np.random.default_rng(9)
+    for vals in (
+        np.arange(0, 7200_000, 10_000, dtype=np.int64) + 1_700_000_000_000,
+        np.cumsum(rng.integers(9_000, 11_000, 5000)).astype(np.int64),
+        np.array([], np.int64),
+        np.array([42], np.int64),
+        rng.integers(-(1 << 40), 1 << 40, 999).astype(np.int64),
+    ):
+        enc_py = dd.encode_py(vals)
+        enc_nat = dd._encode_native(vals)
+        assert enc_py == enc_nat
+        np.testing.assert_array_equal(dd._decode_native(enc_py), vals)
+        np.testing.assert_array_equal(dd.decode_py(enc_nat), vals)
+
+
+def test_native_hist_series_bit_identical():
+    from filodb_tpu.memory import hist as hc
+    rng = np.random.default_rng(10)
+    for n, B in ((1, 8), (50, 64), (33, 13), (200, 3)):
+        inc = rng.integers(0, 50, (n, B))
+        counts = np.cumsum(np.cumsum(inc, axis=1), axis=0)
+        enc_py = hc.encode_hist_series_py(counts)
+        enc_nat = hc._encode_native(counts)
+        assert enc_py == enc_nat, (n, B)
+        np.testing.assert_array_equal(hc._decode_native(enc_py), counts)
+        np.testing.assert_array_equal(hc.decode_hist_series_py(enc_nat), counts)
