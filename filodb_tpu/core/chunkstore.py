@@ -42,6 +42,20 @@ def _scatter_append(ts, val, n, rows, cols, new_ts, new_val, counts_add):
     return ts, val, n
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _scatter_append_multi(ts, val, extra, n, rows, cols, new_ts, new_val,
+                          new_extra, counts_add):
+    """Multi-value-column append: the default column plus named scalar
+    columns scatter in ONE dispatch (extra/new_extra are dicts — pytree
+    donation covers every leaf)."""
+    ts = ts.at[rows, cols].set(new_ts, mode="drop")
+    val = val.at[rows, cols].set(new_val, mode="drop")
+    extra = {k: v.at[rows, cols].set(new_extra[k], mode="drop")
+             for k, v in extra.items()}
+    n = n + counts_add
+    return ts, val, extra, n
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def _compact(ts, val, n, cutoff):
     """Drop samples with ts < cutoff by shifting each series row left (one gather)."""
@@ -61,6 +75,29 @@ def _compact(ts, val, n, cutoff):
     pos = jnp.arange(C)[None, :]
     new_ts = jnp.where(pos < new_n[:, None], new_ts, TS_PAD)
     return new_ts, new_val, new_n
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _compact_multi(ts, val, extra, n, cutoff):
+    """Multi-column twin of ``_compact``: one gather per column, shared
+    shift indices."""
+    S, C = ts.shape
+    k = jax.vmap(lambda row: jnp.searchsorted(row, cutoff, side="left"))(ts)
+    idx = jnp.arange(C)[None, :] + k[:, None]
+    valid = idx < C
+    idx = jnp.where(valid, idx, C - 1)
+    new_ts = jnp.where(valid, jnp.take_along_axis(ts, idx, axis=1), TS_PAD)
+    if val.ndim == 3:
+        new_val = jnp.where(valid[:, :, None],
+                            jnp.take_along_axis(val, idx[:, :, None], axis=1), 0)
+    else:
+        new_val = jnp.where(valid, jnp.take_along_axis(val, idx, axis=1), 0)
+    new_extra = {kk: jnp.where(valid, jnp.take_along_axis(vv, idx, axis=1), 0)
+                 for kk, vv in extra.items()}
+    new_n = jnp.maximum(n - k.astype(n.dtype), 0)
+    pos = jnp.arange(C)[None, :]
+    new_ts = jnp.where(pos < new_n[:, None], new_ts, TS_PAD)
+    return new_ts, new_val, new_extra, new_n
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -90,17 +127,39 @@ class SeriesStore:
     """One shard's device store for a non-histogram schema value column."""
 
     def __init__(self, max_series: int, capacity: int, dtype=jnp.float32,
-                 device=None, nbuckets: int = 0):
+                 device=None, nbuckets: int = 0, layout=None,
+                 default_col: str | None = None):
+        """``layout`` (from Schema.col_layout) declares multi-value-column
+        storage: the schema's DEFAULT column lives in ``self.val`` and every
+        other data column gets its own named [S, C] array in ``self.extra``
+        — one ts/n pair serves all columns (ref: multi-column datasets,
+        Schemas.scala / filodb-defaults.conf:17-106; a column is selected at
+        query time via __col__)."""
         self.S = max_series
         self.C = capacity
         self.dtype = dtype
         self.nbuckets = nbuckets   # 0 = scalar values; >0 = histogram [S, C, B]
+        self.layout = layout       # [(name, offset, width, is_hist)] or None
+        self.default_col = None
         # local_devices, not devices: under multi-host jax.distributed the
         # global list leads with rank 0's (non-addressable) device
         dev = device or jax.local_devices()[0]
         vshape = (max_series, capacity) if not nbuckets else (max_series, capacity, nbuckets)
         self.ts = jax.device_put(jnp.full((max_series, capacity), TS_PAD, jnp.int64), dev)
         self.val = jax.device_put(jnp.zeros(vshape, dtype), dev)
+        self.extra: dict[str, jax.Array] = {}
+        if layout is not None:
+            # default col = the schema's value_column (else the histogram
+            # col / last col); every other column is a named scalar array
+            hist = [nm for nm, _o, _w, ih in layout if ih]
+            names = [nm for nm, _o, _w, _ih in layout]
+            self.default_col = (default_col if default_col in names
+                                else hist[0] if hist else layout[-1][0])
+            for nm, _off, _w, is_h in layout:
+                if nm != self.default_col:
+                    assert not is_h, "only one histogram column per schema"
+                    self.extra[nm] = jax.device_put(
+                        jnp.zeros((max_series, capacity), dtype), dev)
         self.n = jax.device_put(jnp.zeros(max_series, jnp.int32), dev)
         # host mirrors: ingest-path bookkeeping without device->host syncs
         self.n_host = np.zeros(max_series, np.int32)
@@ -195,11 +254,32 @@ class SeriesStore:
         rp = np.full(P, self.S, np.int32); rp[:m] = r
         cp = np.zeros(P, np.int32); cp[:m] = cols
         tp = np.zeros(P, np.int64); tp[:m] = t
-        vp = np.zeros((P,) + v.shape[1:], v.dtype); vp[:m] = v
-        self.ts, self.val, self.n = _scatter_append(
-            self.ts, self.val, self.n,
-            jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),
-            jnp.asarray(vp).astype(self.dtype), jnp.asarray(counts))
+        if self.layout is None:
+            vp = np.zeros((P,) + v.shape[1:], v.dtype); vp[:m] = v
+            self.ts, self.val, self.n = _scatter_append(
+                self.ts, self.val, self.n,
+                jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),
+                jnp.asarray(vp).astype(self.dtype), jnp.asarray(counts))
+        else:
+            # split the flat [m, W] ingest row by the schema layout: default
+            # column (scalar or histogram span) + named scalar columns
+            dv = None
+            ev = {}
+            for nm, off, w, _is_h in self.layout:
+                colv = v[:, off] if w == 1 else v[:, off:off + w]
+                if nm == self.default_col:
+                    dv = colv
+                else:
+                    ev[nm] = colv
+            vp = np.zeros((P,) + dv.shape[1:], dv.dtype); vp[:m] = dv
+            evp = {}
+            for k, a in ev.items():
+                ap = np.zeros(P, a.dtype); ap[:m] = a
+                evp[k] = jnp.asarray(ap).astype(self.dtype)
+            self.ts, self.val, self.extra, self.n = _scatter_append_multi(
+                self.ts, self.val, self.extra, self.n,
+                jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),
+                jnp.asarray(vp).astype(self.dtype), evp, jnp.asarray(counts))
         self.stats.samples_appended += m
         return m
 
@@ -294,8 +374,12 @@ class SeriesStore:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
         by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
         self._pre_donate("SeriesStore.compact")
-        self.ts, self.val, self.n = _compact(self.ts, self.val, self.n,
-                                             jnp.int64(cutoff_ts))
+        if self.extra:
+            self.ts, self.val, self.extra, self.n = _compact_multi(
+                self.ts, self.val, self.extra, self.n, jnp.int64(cutoff_ts))
+        else:
+            self.ts, self.val, self.n = _compact(self.ts, self.val, self.n,
+                                                 jnp.int64(cutoff_ts))
         self.n_host = np.array(self.n)  # fresh writable host copy
         new_first = np.array(self.ts[:, 0])
         self.first_ts = np.where(self.n_host > 0, new_first, -1)
@@ -324,11 +408,21 @@ class SeriesStore:
 
     # -- query access -------------------------------------------------------
 
-    def arrays(self):
-        """(ts[S,C], val[S,C], n[S]) device arrays for query kernels."""
-        return self.ts, self.val, self.n
+    def arrays(self, column: str | None = None):
+        """(ts[S,C], val, n[S]) device arrays for query kernels; ``column``
+        selects a named value column of a multi-column store (None = the
+        schema's default column)."""
+        return self.ts, self.column_array(column), self.n
 
-    def series_snapshot(self, part_id: int):
-        """Host copy of one series (tests/debug)."""
+    def column_array(self, column: str | None = None):
+        if column is None or column == self.default_col:
+            return self.val
+        if column in self.extra:
+            return self.extra[column]
+        raise KeyError(f"unknown value column {column!r}")
+
+    def series_snapshot(self, part_id: int, column: str | None = None):
+        """Host copy of one series (tests/debug/ODP)."""
         cnt = int(self.n_host[part_id])
-        return (np.asarray(self.ts[part_id, :cnt]), np.asarray(self.val[part_id, :cnt]))
+        v = self.column_array(column)
+        return (np.asarray(self.ts[part_id, :cnt]), np.asarray(v[part_id, :cnt]))

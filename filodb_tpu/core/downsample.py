@@ -10,10 +10,13 @@ fixed-size cell ranges, so the whole shard downsamples with ``lax.reduce_window`
 (sum/min/max/count) and strided slices (last) — one fused pass per aggregate.
 Irregular shards use the general window kernels with bucket-end step times.
 
-Output model: one downsampled series store per aggregate. The reference packs all
-aggregates as extra columns of a downsample dataset and selects with ``__col__``;
-here each aggregate lands in its own dataset ``{name}:ds_{res}:{agg}`` queryable
-with standard PromQL (multi-column stores are a planned follow-up).
+Output model (matches the reference): ONE downsample dataset per resolution,
+``{name}:ds_{res}``, carrying every aggregate as a named value column
+(dMin/dMax/dSum/dCount/dAvg/dLast/tTime) selected at query time with
+``metric::dAvg`` / ``{__col__="dAvg"}`` — exactly how the reference's
+multi-column downsample datasets work (filodb-defaults.conf downsample
+schemas + ast/Vectors.scala __col__). Readers keep a fallback to the
+pre-multi-column per-aggregate datasets ``{name}:ds_{res}:{agg}``.
 """
 
 from __future__ import annotations
@@ -24,6 +27,22 @@ from dataclasses import dataclass
 import numpy as np
 
 DOWNSAMPLERS = ("dMin", "dMax", "dSum", "dCount", "dAvg", "dLast", "tTime")
+
+
+# canonical wire/column order of downsample aggregates — BY DEFINITION the
+# downsampler list (one constant: column order can never desynchronize from it)
+DS_AGG_ORDER = DOWNSAMPLERS
+
+
+def ds_schema(aggs: tuple[str, ...] = DS_AGG_ORDER):
+    """Multi-value-column schema of a downsample dataset: one DOUBLE column
+    per aggregate (ref: the reference's downsample datasets pack all
+    aggregates as data columns, selected via __col__)."""
+    from .schemas import Column, ColumnType, Schema
+    cols = (Column("timestamp", ColumnType.TIMESTAMP),) + tuple(
+        Column(a, ColumnType.DOUBLE) for a in aggs)
+    default = "dAvg" if "dAvg" in aggs else aggs[-1]
+    return Schema("ds-gauge", cols, value_column=default)
 
 
 def ds_family(dataset: str, resolution_ms: int) -> str:

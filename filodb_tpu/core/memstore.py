@@ -114,9 +114,7 @@ class TimeSeriesShard:
             # the first container (ref: BinaryHistogram carries its bucket scheme)
             self.store = None
         else:
-            self.store = SeriesStore(config.max_series_per_shard,
-                                     config.samples_per_series,
-                                     dtype=self._dtype, device=device)
+            self.store = self._make_store()
             self.store.owner_lock = self.lock
         # staging buffers (host)
         self._stage_pid: list[np.ndarray] = []
@@ -339,6 +337,22 @@ class TimeSeriesShard:
 
     # -- ingest -------------------------------------------------------------
 
+    def _make_store(self, width_hint: int = 0) -> SeriesStore:
+        """Device store shaped by the schema: multi-value-column schemas get
+        one array per data column sharing ts/n (Schema.col_layout); legacy
+        single-column schemas keep the flat scalar/histogram layout
+        (``width_hint``: bucket count of a les-less 2-D container)."""
+        nb = len(self.bucket_les) if self.bucket_les is not None else 0
+        if not nb and not self.schema.is_multi_column:
+            nb = width_hint
+        layout = (self.schema.col_layout(nb)
+                  if self.schema.is_multi_column else None)
+        return SeriesStore(self.config.max_series_per_shard,
+                           self.config.samples_per_series,
+                           dtype=self._dtype, device=self._device,
+                           nbuckets=nb, layout=layout,
+                           default_col=self.schema.value_column)
+
     def ingest(self, container: RecordContainer, offset: int = -1,
                recovery_watermarks: np.ndarray | None = None) -> None:
         """Ingest one container. During recovery replay, rows whose flush group
@@ -348,13 +362,11 @@ class TimeSeriesShard:
             self.stats.unknown_schema_dropped += len(container)
             return
         if self.store is None:
-            nb = container.values.shape[1] if container.values.ndim == 2 else 0
             self.bucket_les = (np.asarray(container.bucket_les)
                                if container.bucket_les is not None else None)
-            self.store = SeriesStore(self.config.max_series_per_shard,
-                                     self.config.samples_per_series,
-                                     dtype=self._dtype, device=self._device,
-                                     nbuckets=nb)
+            width = (container.values.shape[1]
+                     if container.values.ndim == 2 else 0)
+            self.store = self._make_store(width_hint=width)
             self.store.owner_lock = self.lock
         n_sets = len(container.label_sets)
         if n_sets == 0 or len(container) == 0:
@@ -480,9 +492,13 @@ class TimeSeriesShard:
             pids, ts, vals = pids[order], ts[order], vals[order]
             bounds = np.concatenate([[0], np.nonzero(np.diff(pids))[0] + 1,
                                      [len(pids)]])
+            layout = None
+            if self.schema.is_multi_column:
+                nb = len(self.bucket_les) if self.bucket_les is not None else 0
+                layout = tuple(self.schema.col_layout(nb))
             records = [
                 ChunkSetRecord(int(pids[bounds[i]]), ts[bounds[i]:bounds[i + 1]],
-                               vals[bounds[i]:bounds[i + 1]])
+                               vals[bounds[i]:bounds[i + 1]], layout)
                 for i in range(len(bounds) - 1)
             ]
             if self.bucket_les is not None and not self._meta_written:
@@ -556,15 +572,18 @@ class TimeSeriesShard:
         checkpointed offset (ref: TimeSeriesShard.recoverIndex :483 +
         TimeSeriesMemStore.recoverStream :148). Returns rows replayed."""
         assert self.sink is not None and len(self.index) == 0
-        if self.schema.is_histogram and self.store is None:
+        if self.store is None and (self.schema.is_histogram
+                                   or self.schema.is_multi_column):
             meta = self.sink.read_meta(self.dataset, self.shard_num) \
                 if hasattr(self.sink, "read_meta") else {}
-            if meta.get("bucket_les"):
-                self.bucket_les = np.asarray(meta["bucket_les"])
-                self.store = SeriesStore(self.config.max_series_per_shard,
-                                         self.config.samples_per_series,
-                                         dtype=self._dtype, device=self._device,
-                                         nbuckets=len(self.bucket_les))
+            # create early only when the bucket count is knowable: a
+            # histogram schema without persisted les (crash before first
+            # flush) must stay None so bus replay recreates it with the
+            # bucket scheme its first container carries
+            if meta.get("bucket_les") or not self.schema.is_histogram:
+                self.bucket_les = (np.asarray(meta["bucket_les"])
+                                   if meta.get("bucket_les") else None)
+                self.store = self._make_store()
                 self.store.owner_lock = self.lock
         # 1. part keys -> index (ids dense in creation order; a purged slot may
         #    have been re-persisted under a new series — the last entry wins)
@@ -711,22 +730,35 @@ class TimeSeriesShard:
         return cold_ts, cold_val
 
     def read_with_paging(self, pids: np.ndarray, start_ms: int, end_ms: int,
-                         cold=None):
+                         cold=None, column=None):
         """Merged (ts [P, C'], val [P, C'], n [P]) host arrays combining paged
         cold chunks (from the sink) with resident device data, deduped on the
         per-series resident first-timestamp boundary. ``cold`` accepts a
-        pre-fetched read_cold_for result (gathered outside the shard lock)."""
+        pre-fetched read_cold_for result (gathered outside the shard lock);
+        ``column`` selects one scalar column of a multi-column store (cold
+        multi-column records are sliced by the schema layout)."""
         from .chunkstore import TS_PAD
         cold_ts, cold_val = cold if cold is not None else \
             self.read_cold_for(pids, start_ms, end_ms)
+        col_off = None
+        if self.schema.is_multi_column:
+            nb = len(self.bucket_les) if self.bucket_les is not None else 0
+            name = column or self.store.default_col
+            for nm, off, w, _ih in self.schema.col_layout(nb):
+                if nm == name:
+                    assert w == 1, "histogram columns do not page on demand"
+                    col_off = off
+                    break
         rows_ts, rows_val = [], []
         for p in pids:
             p = int(p)
-            hot_t, hot_v = self.store.series_snapshot(p)
+            hot_t, hot_v = self.store.series_snapshot(p, column)
             boundary = hot_t[0] if len(hot_t) else (1 << 62)
             if cold_ts[p]:
                 ct = np.concatenate(cold_ts[p])
                 cv = np.concatenate(cold_val[p])
+                if col_off is not None and cv.ndim == 2:
+                    cv = cv[:, col_off]
                 # same slot-reuse rule as recovery (recover() step 2): sink
                 # chunks older than the CURRENT owner's start time belong to
                 # a released predecessor of the slot, not this series

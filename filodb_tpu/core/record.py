@@ -86,17 +86,18 @@ class RecordContainer:
         blob = json.dumps(self.label_sets, separators=(",", ":")).encode()
         n = len(self.ts)
         parts = [
-            _HDR.pack(_MAGIC, 2, self.schema.schema_id, n, len(blob)),
+            _HDR.pack(_MAGIC, 3, self.schema.schema_id, n, len(blob)),
             self.ts.astype("<i8").tobytes(),
         ]
-        if self.values.ndim == 2:
-            nb = self.values.shape[1]
-            parts.append(struct.pack("<H", nb))
+        # v3 values section: bucket-count and row width are independent
+        # (multi-column rows are wider than the histogram span)
+        nb = len(self.bucket_les) if self.bucket_les is not None else 0
+        W = self.values.shape[1] if self.values.ndim == 2 else 0
+        parts.append(struct.pack("<H", nb))
+        if nb:
             parts.append(self.bucket_les.astype("<f8").tobytes())
-            parts.append(self.values.astype("<f8").tobytes())
-        else:
-            parts.append(struct.pack("<H", 0))
-            parts.append(self.values.astype("<f8").tobytes())
+        parts.append(struct.pack("<H", W))
+        parts.append(self.values.astype("<f8").tobytes())
         parts += [
             self.part_hash.astype("<u8").tobytes(),
             self.shard_hash.astype("<u4").tobytes(),
@@ -125,7 +126,16 @@ class RecordContainer:
         ts = np.frombuffer(buf, "<i8", n, off); off += 8 * n
         (nb,) = struct.unpack_from("<H", buf, off); off += 2
         bucket_les = None
-        if nb:
+        if ver >= 3:
+            if nb:
+                bucket_les = np.frombuffer(buf, "<f8", nb, off); off += 8 * nb
+            (W,) = struct.unpack_from("<H", buf, off); off += 2
+            if W:
+                values = np.frombuffer(buf, "<f8", n * W, off).reshape(n, W)
+                off += 8 * n * W
+            else:
+                values = np.frombuffer(buf, "<f8", n, off); off += 8 * n
+        elif nb:
             bucket_les = np.frombuffer(buf, "<f8", nb, off); off += 8 * nb
             values = np.frombuffer(buf, "<f8", n * nb, off).reshape(n, nb); off += 8 * n * nb
         else:
@@ -200,9 +210,34 @@ class RecordBuilder:
             self._label_key_to_idx[key] = idx
         return idx
 
+    def _flatten_value(self, value):
+        """Multi-column flat row [W]: ``value`` may be a dict {col: scalar or
+        buckets}, or a bare bucket array (legacy histogram callers — sum is
+        unknowable, count = top bucket)."""
+        nb = len(self.bucket_les) if self.bucket_les is not None else 0
+        layout = self.schema.col_layout(nb)
+        row = np.full(self.schema.flat_width(nb), np.nan)
+        if not isinstance(value, dict):
+            arr = np.asarray(value, np.float64)
+            hist_col = next((nm for nm, _o, _w, ih in layout if ih), None)
+            value = {hist_col: arr}
+            if any(nm == "count" for nm, _o, _w, _ih in layout) and len(arr):
+                value["count"] = float(arr[-1])
+        for nm, off, w, _is_h in layout:
+            v = value.get(nm)
+            if v is None:
+                continue
+            if w == 1:
+                row[off] = float(v)
+            else:
+                row[off:off + w] = np.asarray(v, np.float64)
+        return row
+
     def add(self, labels: dict[str, str], ts_ms: int, value) -> None:
         idx = self._intern(labels)
         self._ts.append(ts_ms)
+        if self.schema.is_multi_column:
+            value = self._flatten_value(value)
         self._vals.append(value)
         self._pidx.append(idx)
 

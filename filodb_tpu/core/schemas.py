@@ -71,6 +71,36 @@ class Schema:
     def is_histogram(self) -> bool:
         return self.value_col.ctype == ColumnType.HISTOGRAM
 
+    # -- multi-value-column layout (ref: the reference's schemas carry several
+    #    data columns per dataset — prom-histogram is timestamp+sum+count+h,
+    #    filodb-defaults.conf:17-106 — selected at query time via __col__) ----
+
+    @property
+    def data_columns(self) -> tuple[Column, ...]:
+        """All value-bearing columns (everything after the timestamp)."""
+        return self.columns[1:]
+
+    @property
+    def is_multi_column(self) -> bool:
+        return len(self.data_columns) > 1
+
+    def column_named(self, name: str) -> Column | None:
+        return next((c for c in self.data_columns if c.name == name), None)
+
+    def col_layout(self, nbuckets: int) -> list[tuple[str, int, int, bool]]:
+        """Flat ingest-row layout: [(name, offset, width, is_hist)] over a
+        [n, W] values matrix; histogram columns span ``nbuckets`` slots."""
+        out = []
+        off = 0
+        for c in self.data_columns:
+            w = nbuckets if c.ctype == ColumnType.HISTOGRAM else 1
+            out.append((c.name, off, w, c.ctype == ColumnType.HISTOGRAM))
+            off += w
+        return out
+
+    def flat_width(self, nbuckets: int) -> int:
+        return sum(w for _n, _o, w, _h in self.col_layout(nbuckets))
+
 
 # The stock schemas shipped in the reference's filodb-defaults.conf:17-106.
 GAUGE = Schema(

@@ -28,6 +28,7 @@ from ..memory import native as _native
 # nb-field flag marking a bit-packed integer value chunk (high bit: real
 # histogram bucket counts never approach it)
 _INTPACK_FLAG = 0x80000000
+_MULTICOL_FLAG = 0x40000000
 
 # persistence hot path prefers the C++ codecs (bit-identical; tests/test_native.py)
 if _native.available():
@@ -40,10 +41,13 @@ else:  # pragma: no cover - toolchain-less fallback
 
 @dataclass
 class ChunkSetRecord:
-    """One series' slice of a flushed chunkset."""
+    """One series' slice of a flushed chunkset. ``layout`` (from
+    Schema.col_layout) marks multi-value-column rows: values is [n, W] with
+    each named column encoded separately on the wire."""
     part_id: int
     ts: np.ndarray
     values: np.ndarray
+    layout: tuple | None = None
 
 
 class ChunkSink:
@@ -94,7 +98,23 @@ def encode_chunkset(group: int, records) -> bytes:
     for r in records:
         ts_enc = deltadelta.encode(r.ts)
         vals = np.asarray(r.values)
-        if vals.ndim == 2:     # histogram: 2D-delta + NibblePack codec
+        if r.layout is not None:   # multi-value-column row: per-column codecs
+            nb = _MULTICOL_FLAG
+            cols = [struct.pack("<H", len(r.layout))]
+            for _nm, off, w, is_h in r.layout:
+                cv = vals[:, off:off + w]
+                if is_h:
+                    enc = histcodec.encode_hist_series(cv)
+                    kind = 2
+                elif len(cv) and intpack.is_integral(cv[:, 0]):
+                    enc = intpack.pack_ints(cv[:, 0].astype(np.int64))
+                    kind = 1
+                else:
+                    enc = _pack_doubles(cv[:, 0].astype(np.float64))
+                    kind = 0
+                cols.append(struct.pack("<BHI", kind, w, len(enc)) + enc)
+            val_enc = b"".join(cols)
+        elif vals.ndim == 2:   # histogram: 2D-delta + NibblePack codec
             nb = vals.shape[1]
             val_enc = histcodec.encode_hist_series(vals)
         elif len(vals) and intpack.is_integral(vals):
@@ -111,6 +131,29 @@ def encode_chunkset(group: int, records) -> bytes:
     payload = b"".join(frames)
     return (_CHUNK_HDR.pack(group, len(records), 0)
             + struct.pack("<I", len(payload)) + payload)
+
+
+def _decode_multicol(buf: bytes, n: int):
+    """Inverse of the multi-column encoding: [n, W] f64 + wire layout
+    (names are not on the wire; offsets/widths/kinds suffice — the consumer
+    splits by its schema's layout, which recovery validates by width)."""
+    (ncols,) = struct.unpack_from("<H", buf, 0)
+    off = 2
+    cols = []
+    layout = []
+    at = 0
+    for _ in range(ncols):
+        kind, w, plen = struct.unpack_from("<BHI", buf, off); off += 7
+        p = buf[off:off + plen]; off += plen
+        if kind == 2:
+            cols.append(histcodec.decode_hist_series(p).astype(np.float64))
+        elif kind == 1:
+            cols.append(intpack.unpack_ints(p).astype(np.float64)[:, None])
+        else:
+            cols.append(_unpack_doubles(p, n)[:, None])
+        layout.append((f"c{len(layout)}", at, w, kind == 2))
+        at += w
+    return np.concatenate(cols, axis=1), tuple(layout)
 
 
 def iter_chunksets(f, start_ms: int = 0, end_ms: int = 1 << 62):
@@ -137,9 +180,12 @@ def iter_chunksets(f, start_ms: int = 0, end_ms: int = 1 << 62):
                 pid, n, nb, tlen, vlen = struct.unpack_from("<IIIII", payload, off)
                 off += 20
                 ts = deltadelta.decode(payload[off:off + tlen]); off += tlen
+                layout = None
                 if nb == _INTPACK_FLAG:
                     vals = intpack.unpack_ints(
                         payload[off:off + vlen]).astype(np.float64)
+                elif nb == _MULTICOL_FLAG:
+                    vals, layout = _decode_multicol(payload[off:off + vlen], n)
                 elif nb:
                     vals = histcodec.decode_hist_series(
                         payload[off:off + vlen]).astype(np.float64)
@@ -147,7 +193,7 @@ def iter_chunksets(f, start_ms: int = 0, end_ms: int = 1 << 62):
                     vals = _unpack_doubles(payload[off:off + vlen], n)
                 off += vlen
                 if len(ts) and ts[-1] >= start_ms and ts[0] <= end_ms:
-                    records.append(ChunkSetRecord(pid, ts, vals))
+                    records.append(ChunkSetRecord(pid, ts, vals, layout))
         except (struct.error, ValueError, IndexError):
             return                # corrupt tail frame: stop at last good one
         if records:

@@ -32,8 +32,19 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
         for r in records:
             sel = (r.ts >= start_ms) & (r.ts <= end_ms)
             if sel.any():
+                vals = np.asarray(r.values)
+                if r.layout is not None:
+                    # multi-column record (e.g. prom-histogram sum+count+h):
+                    # downsample the HISTOGRAM column (hSum); the scalar
+                    # columns are derivable from it (count = top bucket)
+                    hist = [(off, w) for _nm, off, w, ih in r.layout if ih]
+                    if hist:
+                        off, w = hist[0]
+                        vals = vals[:, off:off + w]
+                    else:
+                        vals = vals[:, 0]
                 per_series_ts[r.part_id].append(r.ts[sel])
-                per_series_val[r.part_id].append(np.asarray(r.values)[sel])
+                per_series_val[r.part_id].append(vals[sel])
     if not per_series_ts:
         return {}
     pids = np.concatenate([np.full(sum(map(len, per_series_ts[p])), p, np.int32)
@@ -41,30 +52,34 @@ def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
     ts = np.concatenate([t for p in per_series_ts for t in per_series_ts[p]])
     vals = np.concatenate([v for p in per_series_val for v in per_series_val[p]])
     if vals.ndim == 2:
-        # native histogram dataset: hSum downsampling (per-bucket sums)
+        # native histogram dataset: hSum downsampling (per-bucket sums) —
+        # the histogram aggregate keeps its own dataset (one hist column)
         dsrec = downsample_records_hist(pids, ts, vals, resolution_ms)
         meta = store.read_meta(dataset, shard) if hasattr(store, "read_meta") else {}
-    else:
-        dsrec = downsample_records(pids, ts, vals, resolution_ms, aggs)
-        meta = None
-    written = {}
-    for agg, (opids, ots, ovals) in dsrec.items():
-        ds_name = f"{ds_family(dataset, resolution_ms)}:{agg}"
-        # per-series record split + part-key mirror (shared with the cascade)
-        written[agg] = _write_split_records(store, ds_name, shard,
-                                            opids, ots, ovals,
-                                            src_keys_from=dataset)
-        if meta and hasattr(store, "write_meta"):
-            store.write_meta(ds_name, shard, meta)   # bucket scheme rides along
-    return written
+        written = {}
+        for agg, (opids, ots, ovals) in dsrec.items():
+            ds_name = f"{ds_family(dataset, resolution_ms)}:{agg}"
+            written[agg] = _write_split_records(store, ds_name, shard,
+                                                opids, ots, ovals,
+                                                src_keys_from=dataset)
+            if meta and hasattr(store, "write_meta"):
+                store.write_meta(ds_name, shard, meta)  # bucket scheme rides
+        return written
+    # scalar dataset: ONE multi-column family, one column per aggregate
+    dsrec = downsample_records(pids, ts, vals, resolution_ms, aggs)
+    return _write_family(store, ds_family(dataset, resolution_ms), shard,
+                         dsrec, src_keys_from=dataset)
 
 
 def make_inline_publisher(sink, dataset: str, resolution_ms: int):
-    """Publish callback for the streaming InlineDownsampler: durable
-    per-aggregate datasets (ref: ShardDownsampler -> DownsamplePublisher; the
-    Kafka hop is replaced by a direct sink write). Each series' part keys are
-    mirrored the first time IT appears — a pod starting long after the shard
-    is still queryable in the downsample datasets. ``publish.published_max``
+    """Publish callback for the streaming InlineDownsampler: ONE durable
+    multi-column dataset per resolution — every aggregate is a value column
+    of ``{dataset}:ds_{res}``, selected at query time via ``::dAvg`` /
+    ``{__col__="dAvg"}`` (ref: ShardDownsampler -> DownsamplePublisher into
+    the reference's multi-column downsample datasets; the Kafka hop is
+    replaced by a direct sink write). Each series' part keys are mirrored
+    the first time IT appears — a pod starting long after the shard is
+    still queryable in the downsample dataset. ``publish.published_max``
     tracks, per shard, the latest bucket timestamp durably written: the
     cascade scheduler advances its window from this, never from in-memory
     ingest state."""
@@ -78,14 +93,13 @@ def make_inline_publisher(sink, dataset: str, resolution_ms: int):
         if new_pids:
             entries = [(pid, shard.index.labels_of(pid),
                         shard.index.start_time(pid)) for pid in new_pids]
-            for agg in recs:
-                sink.write_part_keys(f"{family}:{agg}", shard.shard_num, entries)
+            sink.write_part_keys(family, shard.shard_num, entries)
         hi = 0
-        for agg, (pids, ts, vals) in recs.items():
-            _write_split_records(sink, f"{family}:{agg}", shard.shard_num,
-                                 pids, ts, vals)
+        written = _write_family(sink, family, shard.shard_num, recs)
+        if written:
+            _p, ts, _v = recs[next(iter(written))]
             if len(ts):
-                hi = max(hi, int(np.max(ts)))
+                hi = int(np.max(ts))
         # state advances only after every write succeeded. A mid-batch
         # failure retries the WHOLE batch next flush; aggregates already
         # written get duplicate records, which every reader dedups
@@ -98,8 +112,12 @@ def make_inline_publisher(sink, dataset: str, resolution_ms: int):
             if hasattr(sink, "write_meta"):
                 # durable publish floor: restart resumes (and re-seeds open
                 # buckets) from here instead of re-emitting partial buckets
-                sink.write_meta(family, shard.shard_num,
-                                {"published_through": hi})
+                # (merged — _write_family keeps the column order in the same
+                # meta)
+                m = (sink.read_meta(family, shard.shard_num) or {}
+                     if hasattr(sink, "read_meta") else {})
+                m["published_through"] = hi
+                sink.write_meta(family, shard.shard_num, m)
 
     publish.published_max = {}
     publish.family = family
@@ -108,15 +126,16 @@ def make_inline_publisher(sink, dataset: str, resolution_ms: int):
 
 
 def _write_split_records(store, ds_name: str, shard: int, pids, ts, vals,
-                         src_keys_from=None) -> int:
+                         src_keys_from=None, layout=None) -> int:
     """Split (pids, ts, vals) into per-series ChunkSetRecords and persist them
     (shared by the first-level and cascade batch jobs); optionally mirror the
-    part keys from a source dataset so the output stays queryable."""
+    part keys from a source dataset so the output stays queryable.
+    ``layout`` marks multi-column rows (one column per aggregate)."""
     order = np.argsort(pids, kind="stable")
     op, ot, ov = pids[order], ts[order], vals[order]
     bounds = np.concatenate([[0], np.nonzero(np.diff(op))[0] + 1, [len(op)]])
     recs = [ChunkSetRecord(int(op[bounds[i]]), ot[bounds[i]:bounds[i + 1]],
-                           ov[bounds[i]:bounds[i + 1]])
+                           ov[bounds[i]:bounds[i + 1]], layout)
             for i in range(len(bounds) - 1)]
     store.write_chunkset(ds_name, shard, 0, recs)
     if src_keys_from is not None:
@@ -124,6 +143,68 @@ def _write_split_records(store, ds_name: str, shard: int, pids, ts, vals,
         if entries:
             store.write_part_keys(ds_name, shard, entries)
     return len(recs)
+
+
+def _dedup_keep_first(p, t, v):
+    """keep-first dedup on (pid, bucket): publish retries after partial
+    failures append duplicate identical records."""
+    k = p.astype(np.int64) << 42 | t.astype(np.int64) % (1 << 42)
+    _u, idx = np.unique(k, return_index=True)
+    idx.sort()
+    return p[idx], t[idx], v[idx]
+
+
+def _write_family(store, family: str, shard: int, dsrec: dict,
+                  src_keys_from=None) -> dict[str, int]:
+    """Persist one multi-column downsample batch: stack the aggregates (all
+    sharing (pids, ts)) in canonical DS_AGG_ORDER, write the records with
+    their layout, and record the column-name order in the family meta
+    (merged — the wire carries offsets/widths only). The single writer for
+    the batch job, the inline publisher, and the cascade."""
+    from ..core.downsample import DS_AGG_ORDER
+    order = tuple(a for a in DS_AGG_ORDER if a in dsrec)
+    if not order:
+        return {}
+    opids, ots, _ = dsrec[order[0]]
+    ovals = np.stack([dsrec[a][2] for a in order], axis=1)
+    layout = tuple((a, i, 1, False) for i, a in enumerate(order))
+    n = _write_split_records(store, family, shard, opids, ots, ovals,
+                             src_keys_from=src_keys_from, layout=layout)
+    if hasattr(store, "write_meta"):
+        meta = (store.read_meta(family, shard) or {}
+                if hasattr(store, "read_meta") else {})
+        meta["columns"] = list(order)
+        store.write_meta(family, shard, meta)
+    return {a: n for a in order}
+
+
+def _load_family(store, family: str, shard: int, start_ms: int, end_ms: int):
+    """Read a multi-column downsample family: (pids, ts, {agg: vals}) with
+    keep-first dedup on (pid, bucket), or None when the family has no
+    multi-column records (legacy per-aggregate layout). Column names come
+    from the family meta (the wire carries offsets/widths only)."""
+    meta = store.read_meta(family, shard) if hasattr(store, "read_meta") else {}
+    names = meta.get("columns")
+    pids, ts, vals = [], [], []
+    for _g, recs in store.read_chunksets(family, shard, start_ms, end_ms) or ():
+        for r in recs:
+            if r.layout is None:
+                continue
+            sel = (r.ts >= start_ms) & (r.ts <= end_ms)
+            if sel.any():
+                pids.append(np.full(int(sel.sum()), r.part_id, np.int32))
+                ts.append(r.ts[sel])
+                vals.append(np.asarray(r.values, np.float64)[sel])
+    if not pids:
+        return None
+    p = np.concatenate(pids)
+    t = np.concatenate(ts)
+    v = np.concatenate(vals)
+    if not names or len(names) != v.shape[1]:
+        from ..core.downsample import DS_AGG_ORDER
+        names = list(DS_AGG_ORDER[:v.shape[1]])
+    p, t, v = _dedup_keep_first(p, t, v)
+    return p, t, {nm: v[:, i] for i, nm in enumerate(names)}
 
 
 def _join_by_pid_ts(a, b):
@@ -158,6 +239,28 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
     src = ds_family(dataset, from_res_ms)
     dst = ds_family(dataset, to_res_ms)
 
+    # primary path: the multi-column family dataset (one record stream, all
+    # aggregates as columns; names from the family meta)
+    fam = _load_family(store, src, shard, start_ms, end_ms)
+    if fam is not None:
+        pids, ts, cols = fam
+        out_cols = {}
+        for agg, op in (("dMin", "dMin"), ("dMax", "dMax"), ("dSum", "dSum"),
+                        ("dCount", "dSum"), ("dLast", "dLast"),
+                        ("tTime", "dMax")):
+            if agg in cols:
+                out_cols[agg] = downsample_records(pids, ts, cols[agg],
+                                                   to_res_ms, aggs=(op,))[op]
+        # the average cascades count-weighted through (sum, count) when
+        # present (ref AvgScDownsampler dAvgSc), else (avg, count) (dAvgAc)
+        if "dSum" in cols and "dCount" in cols:
+            out_cols["dAvg"] = downsample_avg_sc(pids, ts, cols["dSum"],
+                                                 cols["dCount"], to_res_ms)["dAvg"]
+        elif "dAvg" in cols and "dCount" in cols:
+            out_cols["dAvg"] = downsample_avg_ac(pids, ts, cols["dAvg"],
+                                                 cols["dCount"], to_res_ms)["dAvg"]
+        return _write_family(store, dst, shard, out_cols, src_keys_from=src)
+
     def load(agg):
         pids, ts, vals = [], [], []
         for _g, recs in store.read_chunksets(f"{src}:{agg}", shard,
@@ -172,12 +275,7 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
             return None
         p, t, v = (np.concatenate(pids), np.concatenate(ts),
                    np.concatenate(vals))
-        # keep-first dedup on (pid, bucket): publish retries after partial
-        # failures append duplicate identical records
-        k = p.astype(np.int64) << 42 | t.astype(np.int64) % (1 << 42)
-        _u, idx = np.unique(k, return_index=True)
-        idx.sort()
-        return p[idx], t[idx], v[idx]
+        return _dedup_keep_first(p, t, v)
 
     def write(agg, rec_tuple, keys_from):
         opids, ots, ovals = rec_tuple
@@ -216,12 +314,45 @@ def run_cascade_downsample(store: FileColumnStore, dataset: str, shard: int,
 
 def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
                      resolution_ms: int, agg: str, memstore, config=None):
-    """Load a batch-downsampled dataset into a memstore for querying
-    (histogram datasets rebuild with their bucket scheme from the meta)."""
+    """Load a downsampled dataset into a memstore for querying.
+
+    Multi-column families load as ONE dataset named ``{ds}:ds_{res}`` whose
+    store carries every aggregate column — query with ``metric::dAvg`` or
+    ``{__col__="dAvg"}``. Histogram aggregates (and legacy per-aggregate
+    layouts) load as the ``{ds}:ds_{res}:{agg}`` dataset."""
+    from ..core.downsample import ds_schema
     from ..core.memstore import StoreConfig
     from ..core.record import RecordBuilder
     from ..core.schemas import GAUGE, PROM_HISTOGRAM
-    ds_name = f"{ds_family(dataset, resolution_ms)}:{agg}"
+
+    family = ds_family(dataset, resolution_ms)
+    try:
+        # already loaded (e.g. a second aggregate of the same family): the
+        # multi-column store serves every column
+        existing = memstore.shard(family, shard)
+        if existing.schema.column_named(agg) is not None:
+            return existing
+    except KeyError:
+        pass
+    fam = _load_family(store, family, shard, 0, 1 << 62)
+    if fam is not None and agg in fam[2]:
+        pids, ts, cols = fam
+        names = tuple(cols)
+        schema = ds_schema(names)
+        shard_obj = memstore.setup(family, schema, shard,
+                                   config or StoreConfig())
+        labels_by_pid = {pid: labels for pid, labels, _ in
+                         (store.read_part_keys(family, shard) or ())}
+        order = np.lexsort((ts, pids))
+        b = RecordBuilder(schema)
+        for i in order.tolist():
+            labels = labels_by_pid.get(int(pids[i]), {"_metric_": "unknown"})
+            b.add(labels, int(ts[i]), {nm: cols[nm][i] for nm in names})
+        shard_obj.ingest(b.build())
+        shard_obj.flush()
+        return shard_obj
+
+    ds_name = f"{family}:{agg}"
     meta = store.read_meta(ds_name, shard) if hasattr(store, "read_meta") else {}
     les = np.asarray(meta["bucket_les"]) if meta.get("bucket_les") else None
     schema = PROM_HISTOGRAM if les is not None else GAUGE

@@ -420,8 +420,17 @@ def query_to_logical_plan(text: str, start_ms: int, end_ms: int,
 
 def _raw(vs: VectorSelector, p: QueryParams, lookback_ms: int) -> L.RawSeries:
     filters = list(vs.matchers)
-    if vs.metric:
-        filters.append(Equals(p.metric_column, vs.metric))
+    metric = vs.metric
+    name_col = ()
+    if metric and "::" in metric:
+        # value-column suffix: ``metric::col`` selects a data column of a
+        # multi-column schema (ref: ast/Vectors.scala metric name "::" split)
+        metric, _, suffix = metric.partition("::")
+        if not suffix or not metric:
+            raise ParseError(f"malformed ::column selector in {vs.metric!r}")
+        name_col = (suffix,)
+    if metric:
+        filters.append(Equals(p.metric_column, metric))
     # __name__ matcher is an alias for the metric column (ref ast/Vectors.scala)
     filters = [Equals(p.metric_column, f.value) if isinstance(f, Equals) and f.label == "__name__"
                else f for f in filters]
@@ -430,7 +439,8 @@ def _raw(vs: VectorSelector, p: QueryParams, lookback_ms: int) -> L.RawSeries:
     col_matchers = [f for f in filters if getattr(f, "label", "") == "__col__"]
     if any(not isinstance(f, Equals) for f in col_matchers):
         raise ParseError("__col__ only supports equality matching")
-    columns = tuple(dict.fromkeys(f.value for f in col_matchers))
+    columns = tuple(dict.fromkeys(
+        name_col + tuple(f.value for f in col_matchers)))
     if len(columns) > 1:
         raise ParseError(f"conflicting __col__ selectors: {columns}")
     filters = [f for f in filters if getattr(f, "label", "") != "__col__"]

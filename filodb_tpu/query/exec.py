@@ -961,7 +961,7 @@ class SelectRawPartitionsExec(ExecPlan):
         # hold the shard lock across array capture AND the transformer chain's
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
-        shard = self._shard_of(ctx)
+        shard, _col = self._shard_of(ctx)
         # step-varying scalar operands resolve BEFORE the lock: their
         # subplans take other shards' locks (nested acquisition would ABBA-
         # deadlock two concurrent mirror-image queries)
@@ -986,12 +986,14 @@ class SelectRawPartitionsExec(ExecPlan):
             # batched paging runs OUTSIDE the long-held lock: each batch
             # re-locks only around its store snapshot, so ingest is not
             # stalled for the duration of a wide historical scan
-            return self._paged_batches(ctx, shard, result.pids)
+            return self._paged_batches(ctx, shard, result.pids, _col)
         return result
 
-    def _paged_selection(self, shard, pids, keys, cold=None) -> SeriesSelection:
+    def _paged_selection(self, shard, pids, keys, cold=None,
+                         column=None) -> SeriesSelection:
         ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms,
-                                                  self.end_ms, cold=cold)
+                                                  self.end_ms, cold=cold,
+                                                  column=column)
         return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
                                jnp.asarray(n_h), keys, None, None)
 
@@ -1007,7 +1009,7 @@ class SelectRawPartitionsExec(ExecPlan):
             return t.function != "absent"
         return False
 
-    def _paged_batches(self, ctx, shard, pids):
+    def _paged_batches(self, ctx, shard, pids, column=None):
         """Wide on-demand paging: bounded memory via pid batches — each batch
         pages its cold chunks, runs the (distributive prefix of the)
         transformer chain, and the per-batch results merge exactly like shard
@@ -1027,7 +1029,8 @@ class SelectRawPartitionsExec(ExecPlan):
             cold = shard.read_cold_for(sub, self.start_ms, self.end_ms)
             with shard.lock:
                 keys = [shard.rv_key_of(int(p)) for p in sub]
-                data = self._paged_selection(shard, sub, keys, cold=cold)
+                data = self._paged_selection(shard, sub, keys, cold=cold,
+                                             column=column)
             for t in prefix:
                 data = t.apply(data, ctx)
             if isinstance(data, FusedWindowData):
@@ -1053,28 +1056,36 @@ class SelectRawPartitionsExec(ExecPlan):
         return merged
 
     def do_execute(self, ctx) -> SeriesSelection:
-        shard = self._shard_of(ctx)
+        shard, col = self._shard_of(ctx)
         if shard.store is None:   # histogram shard with no data yet
             z = jnp.zeros((8, 8), jnp.float32)
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
                                    jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
         store = shard.store
+        # bucket boundaries ride only when the SELECTED column is the
+        # histogram one (``{__col__="sum"}`` on prom-histogram is scalar)
         les = getattr(shard, "bucket_les", None)
+        if col is not None:
+            colobj = shard.schema.column_named(col)
+            from ..core.schemas import ColumnType
+            if colobj is None or colobj.ctype != ColumnType.HISTOGRAM:
+                les = None
         # on-demand paging: query reaches behind resident data -> merge cold
         # chunks from the sink (ref: OnDemandPagingShard.scanPartitions)
         if les is None and shard.needs_paging(pids, self.start_ms):
             if len(pids) > ODP_BATCH:
                 return _WideODP(pids)
             return self._paged_selection(
-                shard, pids, [shard.rv_key_of(int(p)) for p in pids])
+                shard, pids, [shard.rv_key_of(int(p)) for p in pids],
+                column=col)
         if len(pids) > GATHER_THRESHOLD:
             # wide selection: defer key materialization (global aggregates
             # never read them; per-series outputs pay the cost on iteration)
             keys = LazyKeys(shard, pids)
         else:
             keys = [shard.rv_key_of(int(p)) for p in pids]
-        ts, val, n = store.arrays()
+        ts, val, n = store.arrays(col)
         total = len(shard.index)
         grid = store.grid_info()
         if len(pids) == 0:
@@ -1385,11 +1396,27 @@ class TimeScalarExec(ExecPlan):
 
 
 def _shard_of_ctx(ctx, shard_num: int, column: str = ""):
-    """Resolve a shard, honoring a __col__ value-column selector (targets an
-    aggregate dataset of a downsample family) with a clean QueryError."""
+    """Resolve (shard, store_column) honoring a __col__ value-column selector.
+
+    A column NAMED BY THE SCHEMA selects that column of the dataset's own
+    multi-column device store (ref: __col__ in ast/Vectors.scala picking a
+    data column — e.g. ``{__col__="sum"}`` on prom-histogram); otherwise the
+    selector targets a per-aggregate dataset of a downsample family
+    (``ds:ds_1m:dAvg``), the pre-multi-column layout."""
+    if column:
+        try:
+            sh = ctx.memstore.shard(ctx.dataset, shard_num)
+        except KeyError:
+            sh = None
+        if sh is not None and sh.schema.column_named(column) is not None:
+            if not sh.schema.is_multi_column:
+                # single-column schema: naming its one value column is the
+                # default selection (m::value on gauge)
+                return sh, None
+            return sh, column
     ds = f"{ctx.dataset}:{column}" if column else ctx.dataset
     try:
-        return ctx.memstore.shard(ds, shard_num)
+        return ctx.memstore.shard(ds, shard_num), None
     except KeyError:
         raise QueryError(
             f"unknown {'column ' + column + ' of ' if column else ''}"
@@ -1412,7 +1439,7 @@ class SelectChunkInfosExec(ExecPlan):
     MAX_PARTS = 1000    # debug surface: bound the output
 
     def do_execute(self, ctx):
-        shard = _shard_of_ctx(ctx, self.shard, self.column)
+        shard, _col = _shard_of_ctx(ctx, self.shard, self.column)
         out_ts = np.array([self.end_ms], np.int64)
         if shard.store is None:
             return ResultMatrix(out_ts, np.zeros((0, 1)), [])

@@ -421,8 +421,11 @@ class FiloServer:
                                     start_ms=lo + 1, end_ms=hi)
                                 self._cascade_wm[key] = hi
                                 if hasattr(self._sink, "write_meta"):
-                                    self._sink.write_meta(fam, sh_num,
-                                                          {"cascade_wm": hi})
+                                    # merge: the cascade job records the
+                                    # family's column order in the same meta
+                                    m = self._sink.read_meta(fam, sh_num) or {}
+                                    m["cascade_wm"] = hi
+                                    self._sink.write_meta(fam, sh_num, m)
                     except Exception:
                         log.exception("cascade downsample pass failed")
 

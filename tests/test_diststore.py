@@ -153,16 +153,19 @@ def test_batch_downsample_over_splits_matches_single_pass(tmp_path):
     run_batch_downsample(store, "ds", 0, RES)
     for lo, hi in get_scan_splits(store2, "ds", 0, 3, align_ms=RES):
         run_batch_downsample(store2, "ds", 0, RES, start_ms=lo, end_ms=hi)
+    cols = store.read_meta("ds:ds_1m", 0)["columns"]
+    ci = cols.index("dAvg")
     one = {r.part_id: r for _g, recs in
-           store.read_chunksets("ds:ds_1m:dAvg", 0) for r in recs}
+           store.read_chunksets("ds:ds_1m", 0) for r in recs}
     # split runs append multiple chunksets; merge by time
     split_ts, split_v = [], []
-    for _g, recs in store2.read_chunksets("ds:ds_1m:dAvg", 0):
+    for _g, recs in store2.read_chunksets("ds:ds_1m", 0):
         for r in recs:
             split_ts.append(r.ts)
-            split_v.append(np.asarray(r.values))
+            split_v.append(np.asarray(r.values)[:, ci])
     st_all = np.concatenate(split_ts)
     sv_all = np.concatenate(split_v)
     order = np.argsort(st_all)
     np.testing.assert_array_equal(st_all[order], one[0].ts)
-    np.testing.assert_allclose(sv_all[order], one[0].values)
+    np.testing.assert_allclose(sv_all[order],
+                               np.asarray(one[0].values)[:, ci])

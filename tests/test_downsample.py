@@ -71,6 +71,15 @@ def _ingest_shard(sink=None, n_series=3, n_samples=60):
     return ms, shard
 
 
+def _read_family_col(sink, family, shard, agg):
+    """Column ``agg`` of a multi-column downsample family, concatenated
+    across records (column order from the family meta)."""
+    cols = sink.read_meta(family, shard)["columns"]
+    i = cols.index(agg)
+    recs = [r for _g, rs in sink.read_chunksets(family, shard) for r in rs]
+    return np.concatenate([np.asarray(r.values)[:, i] for r in recs])
+
+
 def test_inline_downsample_publisher(tmp_path):
     sink = FileColumnStore(str(tmp_path))
     ms, shard = _ingest_shard(sink)
@@ -94,8 +103,9 @@ def test_batch_downsample_job_and_query(tmp_path):
                       flush_batch_size=10**9, dtype="float64")
     load_downsampled(sink, "prometheus", 0, RES, "dAvg", ms2, cfg)
     from filodb_tpu.query.engine import QueryEngine
-    eng = QueryEngine(ms2, "prometheus:ds_1m:dAvg")
-    r = eng.query_range('m{host="h1"}', BASE + RES, BASE + 5 * RES, RES)
+    # ONE multi-column dataset per resolution; ::dAvg selects the column
+    eng = QueryEngine(ms2, "prometheus:ds_1m")
+    r = eng.query_range('m::dAvg{host="h1"}', BASE + RES, BASE + 5 * RES, RES)
     (key, ts, vals), = list(r.matrix.iter_series())
     # recompute expected dAvg per epoch-aligned bucket; first query point sees
     # the last bucket whose end timestamp <= BASE + RES
@@ -140,9 +150,7 @@ def test_ttime_and_cascade_downsample(tmp_path):
     direct = downsample_records(pids, ts, vals, HOUR)
     got = {}
     for agg in ("dMin", "dMax", "dSum", "dCount", "dAvg"):
-        recs = [r for _g, rs in sink.read_chunksets(f"ds:ds_60m:{agg}", 0)
-                for r in rs]
-        got[agg] = np.concatenate([np.asarray(r.values) for r in recs])
+        got[agg] = _read_family_col(sink, "ds:ds_60m", 0, agg)
         _dp, dts, dv = direct[agg]
         np.testing.assert_allclose(got[agg], dv, rtol=1e-12,
                                    err_msg=agg)
@@ -164,8 +172,7 @@ def test_cascade_avg_ac_fallback(tmp_path):
     written = run_cascade_downsample(sink, "ds", 0, RES, HOUR)
     assert "dAvg" in written
     direct = downsample_records(np.zeros(720, np.int32), ts, vals, HOUR)
-    recs = [r for _g, rs in sink.read_chunksets("ds:ds_60m:dAvg", 0) for r in rs]
-    got = np.concatenate([np.asarray(r.values) for r in recs])
+    got = _read_family_col(sink, "ds:ds_60m", 0, "dAvg")
     np.testing.assert_allclose(got, direct["dAvg"][2], rtol=1e-12)
 
 
@@ -180,8 +187,7 @@ def test_col_selector_targets_downsample_aggregate(tmp_path):
     ms2 = TimeSeriesMemStore()
     cfg = StoreConfig(max_series_per_shard=8, samples_per_series=64,
                       flush_batch_size=10**9, dtype="float64")
-    for agg in ("dAvg", "dMax"):
-        load_downsampled(sink, "prometheus", 0, RES, agg, ms2, cfg)
+    load_downsampled(sink, "prometheus", 0, RES, "dAvg", ms2, cfg)
     from filodb_tpu.query.engine import QueryEngine
     eng = QueryEngine(ms2, "prometheus:ds_1m")
     got = {}

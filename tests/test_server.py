@@ -257,28 +257,33 @@ def test_server_inline_downsample_and_cascade(tmp_path):
             time.sleep(0.1)
         sh.flush_all_groups()       # inline publisher fires at group flush
         sink = FileColumnStore(str(tmp_path / "data"))
-        one_m = [r for _g, recs in sink.read_chunksets("prometheus:ds_1m:dAvg", 0)
+        # ONE multi-column family dataset per resolution: dAvg is a column
+        cols_1m = sink.read_meta("prometheus:ds_1m", 0)["columns"]
+        one_m = [r for _g, recs in sink.read_chunksets("prometheus:ds_1m", 0)
                  for r in recs]
         assert one_m, "inline 1m downsample not published"
         ts_1m = np.concatenate([r.ts for r in one_m])
         assert len(ts_1m) == len(np.unique(ts_1m)), "duplicate 1m buckets"
-        v_1m = np.concatenate([np.asarray(r.values) for r in one_m])
+        v_1m = np.concatenate([np.asarray(r.values)[:, cols_1m.index("dAvg")]
+                               for r in one_m])
         for bts, bv in zip(ts_1m, v_1m):
             sel = (BASE + np.arange(120) * IV) // 60_000 == bts // 60_000
             np.testing.assert_allclose(bv, np.arange(120.0)[sel].mean())
-        keys = list(sink.read_part_keys("prometheus:ds_1m:dAvg", 0))
+        keys = list(sink.read_part_keys("prometheus:ds_1m", 0))
         assert keys and keys[0][1].get("host") == "h0"
         deadline = time.time() + 40
         five_m = []
         while time.time() < deadline and not five_m:
             five_m = [r for _g, recs in
-                      sink.read_chunksets("prometheus:ds_5m:dAvg", 0)
+                      sink.read_chunksets("prometheus:ds_5m", 0)
                       for r in recs]
             time.sleep(0.2)
         assert five_m, "cascade 5m downsample never ran"
+        cols_5m = sink.read_meta("prometheus:ds_5m", 0)["columns"]
         # weighted 5m averages match a direct computation over complete buckets
         ts_all = np.concatenate([r.ts for r in five_m])
-        v_all = np.concatenate([np.asarray(r.values) for r in five_m])
+        v_all = np.concatenate([np.asarray(r.values)[:, cols_5m.index("dAvg")]
+                                for r in five_m])
         raw_ts = BASE + np.arange(120) * IV
         raw_v = np.arange(120.0)
         for bts, bv in zip(ts_all, v_all):
