@@ -135,7 +135,12 @@ class SeriesStore:
         — one ts/n pair serves all columns (ref: multi-column datasets,
         Schemas.scala / filodb-defaults.conf:17-106; a column is selected at
         query time via __col__)."""
-        self.S = max_series
+        # round the row dimension up to a fused-kernel-friendly shape (mult
+        # of 8 up to 512, mult of 512 beyond): wide selections then always
+        # qualify for the single-pass Pallas path, at a cost of <= 511 empty
+        # rows; the logical slot budget stays config.max_series_per_shard
+        m = 8 if max_series <= 512 else 512
+        self.S = (max_series + m - 1) // m * m
         self.C = capacity
         self.dtype = dtype
         self.nbuckets = nbuckets   # 0 = scalar values; >0 = histogram [S, C, B]
@@ -144,8 +149,9 @@ class SeriesStore:
         # local_devices, not devices: under multi-host jax.distributed the
         # global list leads with rank 0's (non-addressable) device
         dev = device or jax.local_devices()[0]
-        vshape = (max_series, capacity) if not nbuckets else (max_series, capacity, nbuckets)
-        self.ts = jax.device_put(jnp.full((max_series, capacity), TS_PAD, jnp.int64), dev)
+        S = self.S
+        vshape = (S, capacity) if not nbuckets else (S, capacity, nbuckets)
+        self.ts = jax.device_put(jnp.full((S, capacity), TS_PAD, jnp.int64), dev)
         self.val = jax.device_put(jnp.zeros(vshape, dtype), dev)
         self.extra: dict[str, jax.Array] = {}
         if layout is not None:
@@ -159,12 +165,12 @@ class SeriesStore:
                 if nm != self.default_col:
                     assert not is_h, "only one histogram column per schema"
                     self.extra[nm] = jax.device_put(
-                        jnp.zeros((max_series, capacity), dtype), dev)
-        self.n = jax.device_put(jnp.zeros(max_series, jnp.int32), dev)
+                        jnp.zeros((S, capacity), dtype), dev)
+        self.n = jax.device_put(jnp.zeros(S, jnp.int32), dev)
         # host mirrors: ingest-path bookkeeping without device->host syncs
-        self.n_host = np.zeros(max_series, np.int32)
-        self.last_ts = np.full(max_series, -(1 << 62), np.int64)
-        self.first_ts = np.full(max_series, -1, np.int64)
+        self.n_host = np.zeros(S, np.int32)
+        self.last_ts = np.full(S, -(1 << 62), np.int64)
+        self.first_ts = np.full(S, -1, np.int64)
         # scrape-grid tracking: when every series stays aligned to a common
         # (base, interval) grid with contiguous samples, queries take the MXU
         # band-matmul fast path (ops/gridfns.py) instead of per-row searches
@@ -180,6 +186,14 @@ class SeriesStore:
         self.owner_lock = None
         self.detective = diagnostics.DonationDetective()
         self.stats = SeriesStoreStats()
+        # backpressure: device mutations are dispatched asynchronously; an
+        # unthrottled ingest loop would queue scatters faster than the device
+        # (or a tunneled link) retires them, building an unbounded backlog
+        # that every query fetch then waits behind — and eventually blocking
+        # the dispatcher itself INSIDE the shard lock. Callers drain via
+        # throttle() after releasing the lock.
+        self._appends_since_sync = 0
+        self.max_inflight = 8
 
     def _pre_donate(self, what: str) -> None:
         """Every buffer-donating mutation funnels through here: assert the
@@ -281,7 +295,28 @@ class SeriesStore:
                 jnp.asarray(rp), jnp.asarray(cp), jnp.asarray(tp),
                 jnp.asarray(vp).astype(self.dtype), evp, jnp.asarray(counts))
         self.stats.samples_appended += m
+        self._appends_since_sync += 1
         return m
+
+    def throttle(self) -> None:
+        """Bound the in-flight device mutations (call OUTSIDE the shard
+        lock): after ``max_inflight`` un-synced appends, block until the
+        LATEST scatter retires, so a hot ingest loop runs at the device's
+        retirement rate instead of growing a backlog that starves concurrent
+        query fetches. Blocks on the current ``n`` output (a queued older
+        handle would already be donated/deleted by a newer append); if a
+        concurrent append donates it mid-wait, retry on the replacement."""
+        if self._appends_since_sync <= self.max_inflight:
+            return
+        for _ in range(4):
+            arr = self.n
+            try:
+                arr.block_until_ready()
+                break
+            except Exception:  # noqa: BLE001 - donated by a racing append
+                if arr is self.n:
+                    break
+        self._appends_since_sync = 0
 
     def _track_grid(self, r, t, uniq, first_pos) -> None:
         """Maintain the shard scrape-grid invariant on each append batch:

@@ -433,11 +433,15 @@ class TimeSeriesShard:
         return self.store.append(pids, ts, vals)
 
     def flush(self) -> int:
-        """Push staged samples to the device store; advance group watermarks."""
+        """Push staged samples to the device store; advance group watermarks.
+        Applies device backpressure OUTSIDE the lock (SeriesStore.throttle):
+        a hot ingest loop must run at the device's retirement rate, or its
+        dispatch backlog starves concurrent query fetches."""
         with self.lock:
             if not self._staged:
                 return 0
             written = self._flush_staged_locked()
+        self.store.throttle()
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset

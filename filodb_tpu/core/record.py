@@ -170,6 +170,13 @@ class RecordBuilder:
         # sorted-labels tuple -> [pk_bytes, sk_bytes, part_hash?, shard_hash?]
         # (hashes lazily filled by the first build(); persists across resets)
         self._hash_cache: dict[tuple, list] = {}
+        # fixed for the builder's lifetime: (layout, flat width, hist col) —
+        # per-add recomputation would dominate the multi-column hot path
+        nb = len(bucket_les) if bucket_les is not None else 0
+        layout = schema.col_layout(nb)
+        self._layout_cache = (
+            layout, schema.flat_width(nb),
+            next((nm for nm, _o, _w, ih in layout if ih), None))
         self.reset()
 
     def reset(self) -> None:
@@ -214,12 +221,15 @@ class RecordBuilder:
         """Multi-column flat row [W]: ``value`` may be a dict {col: scalar or
         buckets}, or a bare bucket array (legacy histogram callers — sum is
         unknowable, count = top bucket)."""
-        nb = len(self.bucket_les) if self.bucket_les is not None else 0
-        layout = self.schema.col_layout(nb)
-        row = np.full(self.schema.flat_width(nb), np.nan)
+        layout, width, hist_col = self._layout_cache
+        row = np.full(width, np.nan)
         if not isinstance(value, dict):
+            if hist_col is None:
+                raise TypeError(
+                    f"schema {self.schema.name} has several value columns "
+                    f"and no histogram column: pass a dict {{col: value}}, "
+                    f"got {type(value).__name__}")
             arr = np.asarray(value, np.float64)
-            hist_col = next((nm for nm, _o, _w, ih in layout if ih), None)
             value = {hist_col: arr}
             if any(nm == "count" for nm, _o, _w, _ih in layout) and len(arr):
                 value["count"] = float(arr[-1])

@@ -13,9 +13,12 @@ fallback otherwise), and writes downsample chunksets back under
 
 from __future__ import annotations
 
+import logging
 from collections import defaultdict
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from ..core.downsample import (DOWNSAMPLERS, downsample_records,
                                downsample_records_hist, ds_family)
@@ -185,24 +188,33 @@ def _load_family(store, family: str, shard: int, start_ms: int, end_ms: int):
     from the family meta (the wire carries offsets/widths only)."""
     meta = store.read_meta(family, shard) if hasattr(store, "read_meta") else {}
     names = meta.get("columns")
+    if not names:
+        # no durable column map: refusing to guess (mislabeled aggregates
+        # would silently downsample sums as mins); callers fall back to the
+        # legacy per-aggregate layout
+        return None
     pids, ts, vals = [], [], []
+    skipped = 0
     for _g, recs in store.read_chunksets(family, shard, start_ms, end_ms) or ():
         for r in recs:
             if r.layout is None:
+                continue
+            if np.asarray(r.values).shape[1] != len(names):
+                skipped += 1   # written under a different column set
                 continue
             sel = (r.ts >= start_ms) & (r.ts <= end_ms)
             if sel.any():
                 pids.append(np.full(int(sel.sum()), r.part_id, np.int32))
                 ts.append(r.ts[sel])
                 vals.append(np.asarray(r.values, np.float64)[sel])
+    if skipped:
+        log.warning("family %s shard %d: %d records skipped (column-width "
+                    "mismatch vs meta %s)", family, shard, skipped, names)
     if not pids:
         return None
     p = np.concatenate(pids)
     t = np.concatenate(ts)
     v = np.concatenate(vals)
-    if not names or len(names) != v.shape[1]:
-        from ..core.downsample import DS_AGG_ORDER
-        names = list(DS_AGG_ORDER[:v.shape[1]])
     p, t, v = _dedup_keep_first(p, t, v)
     return p, t, {nm: v[:, i] for i, nm in enumerate(names)}
 

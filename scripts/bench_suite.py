@@ -306,36 +306,98 @@ def bench_query_hicard(full: bool) -> None:
 
 
 def bench_query_ingest(full: bool) -> None:
-    """Ref QueryAndIngestBenchmark: queries while ingest keeps running."""
+    """Ref QueryAndIngestBenchmark: an ingest thread keeps streaming
+    containers (with per-batch flushes) while concurrent query threads run —
+    the reference likewise measures queries DURING ingestion (the shard's
+    single ingest thread + concurrent query scheduler model,
+    TimeSeriesShard.scala:258-260 + FiloSchedulers)."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
     from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
     from filodb_tpu.core.schemas import GAUGE
     from filodb_tpu.query.engine import QueryEngine
 
     n_series, n_samples = (1000, 100) if full else (400, 60)
     containers = _gauge_containers(n_series, n_samples)
-    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=2 * n_samples + 8,
+    # capacity 1024 keeps the fused single-pass path (its VMEM row-tile cap);
+    # longer retention would compact, as in production
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=1024,
                       flush_batch_size=10**9, dtype="float32")
     ms = TimeSeriesMemStore()
     ms.setup("bench", GAUGE, 0, cfg)
+    sh = ms.shard("bench", 0)
     for c in containers[: len(containers) // 2]:
         ms.ingest("bench", 0, c)
     ms.flush_all()
     eng = QueryEngine(ms, "bench")
     start = BASE + 120_000
     end = BASE + (n_samples // 2 - 1) * IV
-    t0 = time.perf_counter()
-    n_q = 0
-    rest = containers[len(containers) // 2:]
-    for i, c in enumerate(rest):
-        ms.ingest("bench", 0, c)
-        if i % 4 == 0:
-            eng.query_range('sum(rate(heap_usage[1m]))', start, end, 30_000)
-            n_q += 1
-    ms.flush_all()
-    dt = time.perf_counter() - t0
-    n_rec = sum(len(c.ts) for c in rest)
-    emit("query_ingest", "mixed_ingest_throughput", n_rec / dt, "records/s")
+
+    def run_query(_=None):
+        eng.query_range('sum(rate(heap_usage[1m]))', start, end, 30_000)
+
+    run_query()   # compile
+    # idle baseline: concurrent queries, no ingest (8 in flight)
+    with ThreadPoolExecutor(8) as ex:
+        list(ex.map(run_query, range(8)))   # thread warm
+        t0 = time.perf_counter()
+        list(ex.map(run_query, range(32)))
+        idle_qps = 32 / (time.perf_counter() - t0)
+    emit("query_ingest", "idle_query_throughput", idle_qps, "queries/s")
+
+    stop = threading.Event()
+    ingested = [0]
+
+    def ingest_loop():
+        # a live scrape stream: one template container per tick (1 sample per
+        # series, timestamps shifted per tick — container building is the
+        # producer/gateway's job, measured by its own suites), ~20 ticks
+        # staged per device flush; SeriesStore.throttle applies backpressure
+        # so the dispatch backlog stays bounded
+        import numpy as np
+
+        from filodb_tpu.core.record import RecordBuilder, RecordContainer
+        b = RecordBuilder(GAUGE)
+        for s in range(n_series):
+            b.add({"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app",
+                   "host": f"h{s}", "job": f"App-{s % 8}"}, 0, float(s))
+        tpl = b.build()
+        k = 0
+        base = BASE + (n_samples // 2) * IV   # contiguous with the preload
+        while not stop.is_set():
+            for _ in range(20):
+                ts = np.full(len(tpl.ts), base + k * IV, np.int64)
+                c = RecordContainer(tpl.schema, ts, tpl.values, tpl.part_hash,
+                                    tpl.shard_hash, tpl.part_idx,
+                                    tpl.label_sets, tpl.bucket_les,
+                                    tpl.part_keys, tpl.set_hashes)
+                ms.ingest("bench", 0, c)
+                ingested[0] += n_series
+                k += 1
+                if stop.is_set():
+                    break
+                time.sleep(0.001)   # yield: scrape streams are paced, not spins
+            sh.flush()
+
+    t = threading.Thread(target=ingest_loop, daemon=True)
+    t.start()
+    n_q = 64
+    with ThreadPoolExecutor(8) as ex:
+        t0 = time.perf_counter()
+        list(ex.map(run_query, range(n_q)))
+        dt = time.perf_counter() - t0
+    stop.set()
+    t.join(timeout=10)
+    emit("query_ingest", "mixed_ingest_throughput", ingested[0] / dt, "records/s")
     emit("query_ingest", "mixed_query_throughput", n_q / dt, "queries/s")
+    # NOTE on this rig: every blocking query costs one ~100ms tunnel sync
+    # and ingest flush/throttle syncs share the same single link, while one
+    # host core runs both workloads — the ratio below reflects that shared
+    # budget, not shard-lock serialization (measured lock wait under load is
+    # ~3ms; the lock is released before every device fetch)
+    emit("query_ingest", "mixed_vs_idle_query_ratio",
+         (n_q / dt) / idle_qps, "x")
 
 
 def bench_gateway(full: bool) -> None:
