@@ -313,9 +313,10 @@ class SeriesStore:
             try:
                 arr.block_until_ready()
                 break
-            except Exception:  # noqa: BLE001 - donated by a racing append
+            except Exception:
                 if arr is self.n:
-                    break
+                    raise   # a REAL device failure, not a racing donation
+                continue    # donated by a racing append: retry on the new n
         self._appends_since_sync = 0
 
     def _track_grid(self, r, t, uniq, first_pos) -> None:

@@ -251,6 +251,36 @@ class RecordBuilder:
         self._vals.append(value)
         self._pidx.append(idx)
 
+    def _flatten_batch(self, values, n: int) -> np.ndarray:
+        """Vectorized multi-column flat rows [n, W]: ``values`` may be a dict
+        {col: [n] or [n, B]} or a bare [n, B] bucket matrix (legacy histogram
+        callers — count column derives from the top bucket)."""
+        layout, width, hist_col = self._layout_cache
+        rows = np.full((n, width), np.nan)
+        if not isinstance(values, dict):
+            if hist_col is None:
+                raise TypeError(
+                    f"schema {self.schema.name} has several value columns "
+                    f"and no histogram column: pass a dict {{col: values}}")
+            arr = np.asarray(values, np.float64)
+            values = {hist_col: arr}
+            if any(nm == "count" for nm, _o, _w, _ih in layout) and arr.size:
+                values["count"] = arr[:, -1]
+        for nm, off, w, _is_h in layout:
+            v = values.get(nm)
+            if v is None:
+                continue
+            v = np.asarray(v, np.float64)
+            if len(v) != n:
+                raise ValueError(
+                    f"add_batch length mismatch: column {nm!r} has {len(v)} "
+                    f"values for {n} timestamps")
+            if w == 1:
+                rows[:, off] = v
+            else:
+                rows[:, off:off + w] = v
+        return rows
+
     def add_batch(self, labels: dict[str, str], ts_ms, values) -> None:
         """Bulk samples for ONE series: hashing/label interning happens once
         and the arrays ride through build() without per-sample Python work —
@@ -258,7 +288,10 @@ class RecordBuilder:
         idx = self._intern(labels)
         ts_ms = np.asarray(ts_ms, np.int64)
         n = len(ts_ms)
-        values = np.asarray(values)
+        if self.schema.is_multi_column:
+            values = self._flatten_batch(values, n)
+        else:
+            values = np.asarray(values)
         if len(values) != n:
             raise ValueError(
                 f"add_batch length mismatch: {n} timestamps vs "

@@ -176,6 +176,14 @@ def _write_family(store, family: str, shard: int, dsrec: dict,
     if hasattr(store, "write_meta"):
         meta = (store.read_meta(family, shard) or {}
                 if hasattr(store, "read_meta") else {})
+        existing = meta.get("columns")
+        if existing and existing != list(order):
+            # one family = one column set: silently rebinding names to a
+            # same-width record stream would downsample one aggregate as
+            # another on the next read
+            raise ValueError(
+                f"downsample family {family} already has columns {existing}; "
+                f"refusing to write {list(order)}")
         meta["columns"] = list(order)
         store.write_meta(family, shard, meta)
     return {a: n for a in order}

@@ -232,8 +232,9 @@ class PaddedPartials:
         self._ng = num_groups
         self._T = T
 
-    def resolve(self) -> dict:
-        outs = jax.device_get(self._outs)
+    def parts_of(self, outs) -> dict:
+        """Partial dict from ALREADY-FETCHED outputs (callers batching many
+        bundles into one device_get use this instead of resolve())."""
         s, c = outs[0][:self._ng, :self._T], outs[1][:self._ng, :self._T]
         if self._op in ("count", "group"):
             return {"count": c}
@@ -241,6 +242,9 @@ class PaddedPartials:
         if len(outs) > 2:
             parts["sumsq"] = outs[2][:self._ng, :self._T]
         return parts
+
+    def resolve(self) -> dict:
+        return self.parts_of(jax.device_get(self._outs))
 
 
 def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,

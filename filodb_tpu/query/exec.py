@@ -1126,7 +1126,9 @@ class SelectRawPartitionsExec(ExecPlan):
             return SeriesSelection(sel_ts, sel_val, sel_n, keys, sel_rows, grid, les,
                                    g_min)
         # wide selection: no gather — disable non-selected rows via n = 0
-        if len(pids) == store.S or len(pids) == total:
+        # (store.S is the PHYSICAL padded row count; the full-selection test
+        # is against the logical series count)
+        if len(pids) == total:
             n_eff = n
         else:
             mask = np.zeros(store.S, bool)
@@ -1188,6 +1190,12 @@ class ReduceAggregateExec(ExecPlan):
 
 def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
     """Align group keys across shards, then combine partial state."""
+    if len(partials) == 1:
+        # single shard: nothing to align — stay lazy/on-device; the one
+        # host fetch happens at matrix materialization (each early fetch
+        # of the tiny partial arrays costs a full round trip on a
+        # tunneled device link)
+        return partials[0]
     all_keys: dict[RangeVectorKey, int] = {}
     for p in partials:
         for k in p.group_keys:
@@ -1197,11 +1205,19 @@ def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:
     out_ts = partials[0].out_ts
     les = partials[0].bucket_les
     T = len(out_ts) * (len(les) if les is not None else 1)
+    # ONE batched host fetch for every shard's (tiny) partial arrays; lazy
+    # device bundles (PaddedPartials) contribute their raw outputs to the
+    # same fetch — calling their resolve() here would round-trip per shard
+    raw = [p.parts for p in partials]
+    fetched = jax.device_get([r._outs if hasattr(r, "parts_of") else r
+                              for r in raw])
+    resolved = [r.parts_of(f) if hasattr(r, "parts_of") else f
+                for r, f in zip(raw, fetched)]
     merged: dict[str, object] = {}
-    for p in partials:
+    for p, rparts in zip(partials, resolved):
         # scatter this shard's groups into the global group space
         idx = np.array([all_keys[k] for k in p.group_keys], np.int32)
-        for name, arr in aggregators.resolve_partials(p.parts).items():
+        for name, arr in rparts.items():
             arr = np.asarray(arr)[: p.num_groups]
             if name == "min":
                 base = np.full((Gpad, T), np.inf)

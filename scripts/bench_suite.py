@@ -215,19 +215,36 @@ def bench_hist_ingest(full: bool) -> None:
               .astype(np.float64) for _ in range(n_series)]
     cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=n_samples + 8,
                       flush_batch_size=10**9, dtype="float64")
-    ms = TimeSeriesMemStore()
-    ms.setup("bench", PROM_HISTOGRAM, 0, cfg)
+    ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+
+    def ingest_all():
+        ms = TimeSeriesMemStore()
+        ms.setup("bench", PROM_HISTOGRAM, 0, cfg)
+        for s in range(n_series):
+            b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+            # the reference benchmark ships pre-built containers into the
+            # shard; add_batch is the equivalent bulk build path
+            b.add_batch({"_metric_": "req_latency", "host": f"h{s}"},
+                        ts_arr, counts[s])
+            ms.ingest("bench", 0, b.build())
+        ms.flush_all()
+        return ms
+
+    ingest_all()                      # warm the jit caches (jmh warmup)
     t0 = time.perf_counter()
-    for s in range(n_series):
-        b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
-        for t in range(n_samples):
-            b.add({"_metric_": "req_latency", "host": f"h{s}"},
-                  BASE + t * IV, counts[s][t])
-        ms.ingest("bench", 0, b.build())
-    ms.flush_all()
+    ms = ingest_all()
     total = n_series * n_samples
     emit("hist_ingest", "ingest_throughput",
          total / (time.perf_counter() - t0), "hist_records/s")
+    # per-record build path (one b.add per sample, 64-bucket rows)
+    t0 = time.perf_counter()
+    b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+    for t in range(n_samples):
+        b.add({"_metric_": "req_latency", "host": "h0"}, BASE + t * IV,
+              counts[0][t])
+    b.build()
+    emit("hist_ingest", "record_build_throughput",
+         n_samples / (time.perf_counter() - t0), "hist_records/s")
 
     one = counts[0]
     dt, it = timed(lambda: H.encode_hist_series(one))
@@ -260,13 +277,21 @@ def bench_hist_query(full: bool) -> None:
     eng = QueryEngine(ms, "bench")
     start, end = BASE + 600_000, BASE + (n_samples - 10) * IV
 
-    def q():
+    def q(_=None):
         eng.query_range('histogram_quantile(0.9, sum(rate(req_latency[5m])))',
                         start, end, 60_000)
 
     dt, it = timed(q, max_iters=30)
     emit("hist_query", "quantile_of_sum_rate", it / dt, "queries/s")
     emit("hist_query", "quantile_of_sum_rate_p50", dt / it * 1000, "ms")
+    # concurrent throughput (the jmh methodology: queries in flight)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(8) as ex:
+        list(ex.map(q, range(8)))
+        t0 = time.perf_counter()
+        list(ex.map(q, range(32)))
+        emit("hist_query", "quantile_of_sum_rate_concurrent",
+             32 / (time.perf_counter() - t0), "queries/s")
 
 
 def bench_query_hicard(full: bool) -> None:
