@@ -31,6 +31,19 @@ from .filters import Equals, EqualsRegex, Filter, In, NotEquals, NotEqualsRegex
 _EMPTY = np.empty(0, dtype=np.int32)
 
 
+def _intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Intersection of two SORTED-unique id arrays by binary search of the
+    smaller into the larger — no re-sort of the big side."""
+    if len(a) > len(b):
+        a, b = b, a
+    if len(a) == 0:
+        return a
+    pos = np.searchsorted(b, a)
+    ok = pos < len(b)
+    ok[ok] = b[pos[ok]] == a[ok]
+    return a[ok]
+
+
 class _Postings:
     """Append-friendly posting list with lazy sorted-array compaction."""
 
@@ -115,6 +128,21 @@ class PartKeyIndex:
         # (two O(S) gathers per query) is provably a no-op
         self._max_start = -(1 << 62)
         self._num_ended = 0
+        # regex fast path (ref: PartKeyLuceneIndex automata over TERMS, :34):
+        # matchers evaluate against each label's DISTINCT value pool, not per
+        # key. The pool is scanned as one newline-joined blob with a single
+        # compiled (?m)^(...)$ pass (C-speed), and matches are cached per
+        # (label, pattern) keyed by the pool version — pools only grow on
+        # NEW distinct values, so dashboards re-running the same matcher hit
+        # the cache even while postings churn.
+        self._pool_version: list[int] = []     # name_id -> bumped per new value
+        self._pool_blob: dict[int, tuple[int, str, np.ndarray, bool]] = {}
+        self._regex_cache: dict[tuple[str, str], tuple[int, list[str]]] = {}
+        # name_id -> bumped whenever any posting of that label changes; keys
+        # the cached regex UNION (the matcher's expanded pid set)
+        self._postings_epoch: list[int] = []
+        self._regex_union_cache: dict[tuple[str, str],
+                                      tuple[int, int, np.ndarray]] = {}
 
     LIVE_END = np.iinfo(np.int64).max
 
@@ -128,6 +156,8 @@ class PartKeyIndex:
             self._name_pool.append(name)
             self._val_pool.append([])
             self._vid_of.append({})
+            self._pool_version.append(0)
+            self._postings_epoch.append(0)
         vals = self._inv[name]
         p = vals.get(value)
         if p is None:
@@ -136,6 +166,7 @@ class PartKeyIndex:
                 pool = self._val_pool[nid]
                 vid = self._vid_of[nid][value] = len(pool)
                 pool.append(value)
+                self._pool_version[nid] += 1
             # reuse the pooled (canonical) string instance as the _inv key
             p = vals[self._val_pool[nid][vid]] = _Postings(vid)
         return nid, p.vid, p
@@ -158,6 +189,7 @@ class PartKeyIndex:
                 self._arena.append(nid)
                 self._arena.append(vid)
                 p.add(part_id)
+                self._postings_epoch[nid] += 1
         else:
             # reuse of a purged slot (ref: TimeSeriesShard partId free list);
             # new pairs append to the arena, the old region is dead space until
@@ -173,6 +205,7 @@ class PartKeyIndex:
                 self._arena.append(nid)
                 self._arena.append(vid)
                 p.add(part_id)
+                self._postings_epoch[nid] += 1
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
         was_live = self._end[part_id] == self.LIVE_END
@@ -221,10 +254,23 @@ class PartKeyIndex:
         if isinstance(f, In):
             arrs = [vals[v].array() for v in f.values if v in vals]
         elif isinstance(f, (EqualsRegex, NotEqualsRegex)):
-            # applied per distinct value; NotEqualsRegex handled by caller via complement
-            import re
-            pat = re.compile(f.pattern)
-            arrs = [p.array() for v, p in vals.items() if pat.fullmatch(v)]
+            # applied per distinct value; NotEqualsRegex handled by caller via
+            # complement. The expanded union is cached until the label's pool
+            # or postings change (stable between series churn events)
+            nid = self._name_id.get(f.label)
+            ckey = (f.label, f.pattern)
+            cur = (self._pool_version[nid], self._postings_epoch[nid])
+            hit = self._regex_union_cache.get(ckey)
+            if hit is not None and hit[:2] == cur:
+                return hit[2]
+            matched = self._regex_values(f.label, f.pattern)
+            arrs = [vals[v].array() for v in matched if v in vals]
+            u = (np.unique(np.concatenate(arrs)) if len(arrs) > 1
+                 else (arrs[0] if arrs else _EMPTY))
+            if len(self._regex_union_cache) > 1024:
+                self._regex_union_cache.clear()
+            self._regex_union_cache[ckey] = cur + (u,)
+            return u
         elif isinstance(f, NotEquals):
             arrs = [p.array() for v, p in vals.items() if v != f.value]
         else:  # pragma: no cover
@@ -233,20 +279,87 @@ class PartKeyIndex:
             return _EMPTY
         return np.unique(np.concatenate(arrs)) if len(arrs) > 1 else arrs[0]
 
+    def _regex_values(self, label: str, pattern: str) -> list[str]:
+        """Distinct pool values fullmatching ``pattern`` — one compiled
+        multiline scan over the newline-joined pool, cached per (label,
+        pattern) until a NEW distinct value extends the pool."""
+        import re
+        nid = self._name_id.get(label)
+        if nid is None:
+            return []
+        version = self._pool_version[nid]
+        key = (label, pattern)
+        hit = self._regex_cache.get(key)
+        if hit is not None and hit[0] == version:
+            return hit[1]
+        pool = self._val_pool[nid]
+        blob = self._pool_blob.get(nid)
+        if blob is None or blob[0] != version:
+            text = "\n".join(pool)
+            starts = np.zeros(len(pool), np.int64)
+            lens = np.fromiter((len(v) for v in pool), np.int64,
+                               count=len(pool))
+            if len(pool) > 1:
+                np.cumsum(lens[:-1] + 1, out=starts[1:])
+            multiline_safe = not any("\n" in v for v in pool)
+            blob = (version, text, starts, multiline_safe)
+            self._pool_blob[nid] = blob
+        _v, text, starts, safe = blob
+        matched = None
+        if safe:
+            try:
+                pat = re.compile(r"(?m)^(?:%s)$" % pattern)
+            except re.error:
+                # e.g. a global inline flag "(?i)..." cannot be embedded
+                # mid-expression: per-value fullmatch still supports it
+                pat = None
+                safe = False
+        if safe:
+            out: list[str] = []
+            for m in pat.finditer(text):
+                i = int(np.searchsorted(starts, m.start()))
+                # a pattern atom that can consume '\n' (e.g. \s*) could span
+                # pool lines — any span that isn't exactly one whole value
+                # disqualifies the scan for this pattern
+                if (i >= len(pool) or starts[i] != m.start()
+                        or m.end() - m.start() != len(pool[i])):
+                    out = None
+                    break
+                out.append(pool[i])
+            matched = out
+        if matched is None:   # newline-y pool or cross-line-capable pattern
+            pat = re.compile(pattern)
+            matched = [v for v in pool if pat.fullmatch(v)]
+        if len(self._regex_cache) > 4096:
+            self._regex_cache.clear()
+        self._regex_cache[key] = (version, matched)
+        return matched
+
     def part_ids_from_filters(self, filters: list[Filter], start_time: int,
                               end_time: int, limit: int | None = None) -> np.ndarray:
         """Part ids matching all filters and alive in [start_time, end_time]."""
-        result: np.ndarray | None = None
         negations: list[Filter] = []
+        pos: list[np.ndarray] = []
         for f in filters:
             if isinstance(f, (NotEquals, NotEqualsRegex)):
                 negations.append(f)
                 continue
             p = self._postings_for(f)
-            result = p if result is None else np.intersect1d(result, p, assume_unique=True)
-            if result is not None and len(result) == 0:
+            if len(p) == 0:
                 return _EMPTY
-        if result is None:
+            pos.append(p)
+        if pos:
+            # postings are sorted-unique (see _Postings.array): intersect by
+            # binary search from the smallest list outward — intersect1d
+            # would re-SORT the largest postings (e.g. a metric matching 1M
+            # series) on every query
+            pos.sort(key=len)
+            result = pos[0]
+            for p in pos[1:]:
+                result = _intersect_sorted(result, p)
+                if len(result) == 0:
+                    return _EMPTY
+        else:
             result = np.arange(len(self._off), dtype=np.int32)
         for f in negations:
             # series *lacking* the label entirely also match a negative filter
@@ -288,6 +401,9 @@ class PartKeyIndex:
                 self._num_ended += 1     # disables the all-live fast path
             self._end[pid] = -1          # matches no [start, end] overlap query
         for name, values in touched.items():
+            nid = self._name_id.get(name)
+            if nid is not None:
+                self._postings_epoch[nid] += 1   # invalidate cached unions
             for value in values:
                 p = self._inv[name].get(value)
                 if p is not None:
@@ -336,6 +452,15 @@ class PartKeyIndex:
         self._val_pool = new_pools
         self._vid_of = new_vid_of
         self._dead_pairs = 0
+        # pools rebuilt: every cached blob/match/union is stale (decoding a
+        # stale blob's line offsets against the new pool would return the
+        # WRONG values' postings)
+        for nid in range(len(self._pool_version)):
+            self._pool_version[nid] += 1
+            self._postings_epoch[nid] += 1
+        self._pool_blob.clear()
+        self._regex_cache.clear()
+        self._regex_union_cache.clear()
         return True
 
     def label_values(self, label: str, filters: list[Filter] | None = None,

@@ -211,3 +211,35 @@ def test_partkey_index_unique_value_churn_pools_bounded():
     np.testing.assert_array_equal(got, [3])
     assert idx.labels_of(3) == {"pod": "pod-final-3", "app": "web"}
     assert idx.label_values("pod", top_k=3)
+
+
+def test_regex_cache_survives_churn_and_inline_flags():
+    """Regex fast-path caches must invalidate on slot reuse and arena
+    compaction, and global inline flags fall back to per-value matching."""
+    import numpy as np
+
+    from filodb_tpu.core import filters as F
+    from filodb_tpu.core.partkey_index import PartKeyIndex
+
+    idx = PartKeyIndex()
+    for i in range(8):
+        idx.add_part_key(i, {"_metric_": "m", "job": f"api-{i}"}, 1000)
+    got = idx.part_ids_from_filters([F.EqualsRegex("job", "api-.*")], 0, 1 << 62)
+    assert len(got) == 8
+    # purge half, reuse a slot under an EXISTING pool value: the cached
+    # union must include the reused pid
+    idx.remove_part_keys(np.arange(4, dtype=np.int32))
+    got = idx.part_ids_from_filters([F.EqualsRegex("job", "api-.*")], 0, 1 << 62)
+    assert sorted(got) == [4, 5, 6, 7]
+    idx.add_part_key(0, {"_metric_": "m", "job": "api-7"}, 2000)
+    got = idx.part_ids_from_filters([F.EqualsRegex("job", "api-.*")], 0, 1 << 62)
+    assert sorted(got) == [0, 4, 5, 6, 7]
+    # arena compaction renumbers vids/pools: stale blobs must not be decoded
+    # (remove_part_keys may auto-compact; force one more pass regardless)
+    idx.remove_part_keys(np.array([4, 5], np.int32))
+    idx.maybe_compact_arena(min_dead_ratio=0.0)
+    got = idx.part_ids_from_filters([F.EqualsRegex("job", "api-7")], 0, 1 << 62)
+    assert sorted(got) == [0, 7]
+    # global inline flag: falls back to per-value fullmatch, no crash
+    got = idx.part_ids_from_filters([F.EqualsRegex("job", "(?i)API-6")], 0, 1 << 62)
+    assert sorted(got) == [6]
