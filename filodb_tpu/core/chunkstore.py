@@ -194,6 +194,10 @@ class SeriesStore:
         # throttle() after releasing the lock.
         self._appends_since_sync = 0
         self.max_inflight = 8
+        # lazily-built u16 quantized mirror of the default value column
+        # (ops/narrow.py); the query leaf consults it when enabled
+        from ..ops.narrow import NarrowMirror
+        self.narrow = NarrowMirror()
 
     def _pre_donate(self, what: str) -> None:
         """Every buffer-donating mutation funnels through here: assert the

@@ -46,6 +46,14 @@ class StoreConfig:
     groups_per_shard: int = 16
     retention_ms: int = 3 * 3600 * 1000
     dtype: str = "float32"
+    # maintain an i16 quantized mirror of f32 value columns (ops/narrow.py):
+    # halves the HBM bytes the fused query path streams (bit-exact for
+    # integer-valued series; raw-f32 fallback per row otherwise). OFF by
+    # default: on this TPU generation the fused kernel is MXU-bound (band
+    # matmuls), so fewer HBM bytes measured ~1.5ms/query SLOWER at 1M
+    # series — enable on deployments where the value stream, not the MXU,
+    # is the measured bottleneck
+    narrow_mirror: bool = False
 
 
 @dataclass
@@ -442,6 +450,10 @@ class TimeSeriesShard:
                 return 0
             written = self._flush_staged_locked()
         self.store.throttle()
+        if self.config.narrow_mirror:
+            # flush-time rebuild, outside the lock: the build streams the
+            # whole store and fetches the ok flags — queries only CONSULT
+            self.store.narrow.refresh(self.store)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
             self.group_watermarks[:] = self._pending_offset

@@ -46,14 +46,32 @@ def _roundup(x: int, m: int) -> int:
 
 
 def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                 Sb: int, C: int, Tp: int, G: int,
-                 val_ref, n_ref, gid_ref, band_ref, ohlo_ref, lo_ref, hi_ref,
-                 rel_ref, sum_ref, cnt_ref, *maybe_sumsq):
+                 Sb: int, C: int, Tp: int, G: int, narrow: bool,
+                 *refs):
+    if narrow:
+        (val_ref, vmin_ref, scl_ref, n_ref, gid_ref, band_ref, ohlo_ref,
+         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
+    else:
+        (val_ref, n_ref, gid_ref, band_ref, ohlo_ref,
+         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
     i = pl.program_id(0)
     is_counter = fn != "delta"
     f32 = jnp.float32
 
-    v = val_ref[:]                                            # [Sb, C]
+    if narrow:
+        # u16 mirror decode in VMEM (ops/narrow.py): q * 2^e is exact
+        # (q < 2^16, power-of-two scale) and vmin + d reproduces the f32
+        # value bit-exactly for rows the encoder verified — HALF the HBM
+        # bytes of the raw f32 store stream (ref: the reference decompresses
+        # NibblePack chunks on access for the same bandwidth reason)
+        # biased i16 mirror: stored x = q - 32768 for q = round((v-vmin)/2^e)
+        # in [0, 65535]; decode recovers q = x + 32768 (integers <= 65535 are
+        # exact in f32), then vmin + q * 2^e reproduces v bit-exactly for
+        # rows the encoder verified
+        v = (vmin_ref[:]
+             + (val_ref[:].astype(f32) + 32768.0) * scl_ref[:])  # [Sb, C]
+    else:
+        v = val_ref[:]                                        # [Sb, C]
     n = n_ref[:]                                              # [Sb, 1] i32
     col = jax.lax.broadcasted_iota(jnp.int32, (Sb, C), 1)
     valid = col < n
@@ -127,30 +145,36 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 
 @functools.lru_cache(maxsize=64)
 def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool):
+                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
+                 narrow: bool = False):
     """The raw (traceable) fused-kernel pallas_call — also invoked inside
     ``shard_map`` by the mesh executor (parallel/distributed.py), where each
     shard runs this same map phase on its resident block and the partial
     state crosses the ICI collective (ref: AggrOverRangeVectors.scala:62 —
-    the identical map phase runs on every data node)."""
+    the identical map phase runs on every data node). With ``narrow`` the
+    value operand is the u16 quantized mirror plus per-row (vmin, scale)."""
     body = functools.partial(_kernel_body, fn, needs_sumsq, window_ms,
-                             interval_ms, Sb, C, Tp, G)
+                             interval_ms, Sb, C, Tp, G, narrow)
     n_out = 3 if needs_sumsq else 2
     out_shape = tuple(jax.ShapeDtypeStruct((G, Tp), jnp.float32)
                       for _ in range(n_out))
     acc_spec = pl.BlockSpec((G, Tp), lambda i: (0, 0), memory_space=pltpu.VMEM)
     const = functools.partial(pl.BlockSpec, index_map=lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
+    row = lambda shape: pl.BlockSpec(shape, lambda i: (i, 0),  # noqa: E731
+                                     memory_space=pltpu.VMEM)
+    in_specs = [row((Sb, C))]
+    if narrow:
+        in_specs += [row((Sb, 1)), row((Sb, 1))]   # vmin, scale
+    in_specs += [
+        row((Sb, 1)), row((Sb, 1)),
+        const((C, Tp)), const((C, Tp)),
+        const((1, Tp)), const((1, Tp)), const((1, Tp)),
+    ]
     return pl.pallas_call(
         body,
         grid=(S // Sb,),
-        in_specs=[
-            pl.BlockSpec((Sb, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((Sb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((Sb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            const((C, Tp)), const((C, Tp)),
-            const((1, Tp)), const((1, Tp)), const((1, Tp)),
-        ],
+        in_specs=in_specs,
         out_specs=tuple(acc_spec for _ in range(n_out)),
         out_shape=out_shape,
         interpret=interpret,
@@ -159,17 +183,24 @@ def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 
 @functools.lru_cache(maxsize=64)
 def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool):
+                S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
+                narrow: bool = False):
     call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                        S, Sb, C, Tp, G, interpret)
+                        S, Sb, C, Tp, G, interpret, narrow)
 
     # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
     # inside the jit — on a tunneled device every extra dispatch is a
     # round-trip (~0.1s measured), dwarfing the kernel itself
-    def wrapped(val, n, gids, *ops):
-        return call(val.astype(jnp.float32),
-                    n.astype(jnp.int32).reshape(S, 1),
-                    gids.astype(jnp.int32).reshape(S, 1), *ops)
+    if narrow:
+        def wrapped(val, vmin, scl, n, gids, *ops):
+            return call(val, vmin.reshape(S, 1), scl.reshape(S, 1),
+                        n.astype(jnp.int32).reshape(S, 1),
+                        gids.astype(jnp.int32).reshape(S, 1), *ops)
+    else:
+        def wrapped(val, n, gids, *ops):
+            return call(val.astype(jnp.float32),
+                        n.astype(jnp.int32).reshape(S, 1),
+                        gids.astype(jnp.int32).reshape(S, 1), *ops)
 
     return jax.jit(wrapped)
 
@@ -249,7 +280,8 @@ class PaddedPartials:
 
 def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
                          out_ts: np.ndarray, window_ms: int,
-                         base_ts: int, interval_ms: int, fetch: bool = True):
+                         base_ts: int, interval_ms: int, fetch: bool = True,
+                         narrow=None):
     """One-pass ``op(fn(metric[window]))`` partials over a grid-aligned block.
 
     val [S, C] f32 (S a multiple of 512 or a power of two), n [S] i32 valid
@@ -257,7 +289,10 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     partial-state dict as ``aggregators.partial_aggregate(op, ...)`` with
     [num_groups, T] arrays, combinable via ``combine_partials`` / psum.
     With ``fetch=False`` returns a :class:`PaddedPartials` whose ``resolve()``
-    does the (blocking) host fetch later.
+    does the (blocking) host fetch later. ``narrow=(q, vmin, scale)`` streams
+    the u16 quantized mirror (ops/narrow.py) instead of ``val`` — half the
+    HBM bytes; the caller must already have zeroed ``n`` for rows whose
+    mirror is not bit-exact.
     """
     assert fn in FUSED_FNS and op in FUSED_OPS
     S, C = val.shape
@@ -274,13 +309,18 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     needs_sumsq = op in ("stddev", "stdvar")
     interpret = jax.default_backend() != "tpu"
     call = _build_call(fn, needs_sumsq, int(window_ms), int(interval_ms),
-                       S, Sb, C, Tp, G, interpret)
+                       S, Sb, C, Tp, G, interpret, narrow is not None)
     # the framework runs with x64 on (int64 timestamps); Mosaic rejects the
     # i64 scalars x64 tracing injects (grid index maps, roll shifts), and the
     # kernel itself is pure f32/i32 — so trace the call with x64 off
     with jax.enable_x64(False):
-        outs = call(val, jnp.asarray(n), jnp.asarray(gids),
-                    band, ohlo, lo_d, hi_d, rel_d)
+        if narrow is not None:
+            q, vmin, scale = narrow
+            outs = call(q, vmin, scale, jnp.asarray(n), jnp.asarray(gids),
+                        band, ohlo, lo_d, hi_d, rel_d)
+        else:
+            outs = call(val, jnp.asarray(n), jnp.asarray(gids),
+                        band, ohlo, lo_d, hi_d, rel_d)
     # partial state is tiny ([G, Tp]): ONE host fetch finishes the query — the
     # slice/present/combine chain as device ops would cost a round-trip each
     padded = PaddedPartials(outs, op, num_groups, T)

@@ -69,6 +69,11 @@ class SeriesSelection:
     # the majority cohort grid/base_ts was shifted to (churn): the grid kernel
     # result is wrong for exactly these rows; PSM recomputes them generally
     grid_minority: np.ndarray | None = None
+    # u16 quantized mirror (q, vmin, scale) of the FULL store value column
+    # (ops/narrow.py): the fused kernel streams it instead of val — half the
+    # HBM bytes. Rows whose mirror is not bit-exact are already folded into
+    # grid_minority by the leaf. Wide selections only.
+    narrow: tuple | None = None
 
 
 @dataclass
@@ -430,6 +435,16 @@ class AggregateMapReduce(Transformer):
         base_ts, interval_ms = sel.grid
         n_eff = sel.n
         minority = sel.grid_minority
+        narrow = None
+        if sel.narrow is not None:
+            # u16 mirror: rows that don't round-trip bit-exactly join the
+            # minority set — excluded from the kernel and recomputed via the
+            # general path below, exactly like churned cohorts
+            q, vmin, scale, bad = sel.narrow
+            narrow = (q, vmin, scale)
+            if len(bad):
+                minority = (bad if minority is None or not len(minority)
+                            else np.union1d(np.asarray(minority), bad))
         has_minority = minority is not None and len(minority)
         if has_minority:
             n_eff = n_eff.at[jnp.asarray(np.asarray(minority))].set(0)
@@ -441,7 +456,8 @@ class AggregateMapReduce(Transformer):
         # the blocking host fetch happens at present/merge time, outside it
         parts = fusedgrid.fused_grid_aggregate(
             self.operator, data.fn, sel.val, n_eff, gids_dev, Gp,
-            data.out_ts, data.window, base_ts, interval_ms, fetch=False)
+            data.out_ts, data.window, base_ts, interval_ms, fetch=False,
+            narrow=narrow)
         if has_minority:
             rows = np.asarray(minority, np.int32)
             sub_ts, sub_val, sub_n, P = _gather_rows_padded(sel.ts, sel.val,
@@ -1136,8 +1152,19 @@ class SelectRawPartitionsExec(ExecPlan):
             n_eff = jnp.where(jnp.asarray(mask), n, 0)
         g_min = (pids[minority_sel].astype(np.int32)
                  if minority_sel is not None else None)
+        narrow = None
+        if (grid is not None and col is None and les is None
+                and shard.config.narrow_mirror and store.S % 512 == 0
+                and val.ndim == 2):
+            nd = store.narrow.get(store)
+            if nd is not None:
+                q, vmin, scale, ok_host = nd
+                bad = pids[~ok_host[pids]].astype(np.int32)
+                # mostly-inexact data: raw f32 is cheaper than correcting
+                if len(bad) <= 0.25 * max(len(pids), 1):
+                    narrow = (q, vmin, scale, bad)
         return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les,
-                               g_min)
+                               g_min, narrow)
 
 
 @dataclass

@@ -1,0 +1,106 @@
+"""Narrow (u16 quantized) on-device mirror: bit-exact fast path for
+integer-valued series, raw-f32 fallback for incompressible rows
+(ops/narrow.py; ref: the reference's compressed chunk read path,
+NibblePack.scala / doc/compression.md — bytes-per-sample as the bandwidth
+lever)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.query.engine import QueryEngine
+
+BASE = 1_700_000_000_000
+IV = 10_000
+NSERIES = 520          # store pads to S=1024 (>=512: narrow-eligible)
+NSAMP = 64
+
+
+def _build(narrow: bool, values_of):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=1024, samples_per_series=NSAMP + 8,
+                      flush_batch_size=10**9, dtype="float32",
+                      narrow_mirror=narrow)
+    shard = ms.setup("prometheus", GAUGE, 0, cfg)
+    ts = BASE + np.arange(NSAMP, dtype=np.int64) * IV
+    b = RecordBuilder(GAUGE)
+    for s in range(NSERIES):
+        b.add_batch({"_metric_": "m", "host": f"h{s}", "grp": f"g{s % 4}"},
+                    ts, values_of(s))
+    shard.ingest(b.build())
+    shard.flush()
+    return ms, shard
+
+
+def _query(ms, q="sum(rate(m[2m]))"):
+    eng = QueryEngine(ms, "prometheus")
+    r = eng.query_range(q, BASE + 200_000, BASE + (NSAMP - 1) * IV, 30_000)
+    return {k: np.asarray(v) for k, _t, v in r.matrix.iter_series()}
+
+
+def test_integer_counters_use_narrow_mirror_bit_exactly():
+    rng = np.random.default_rng(7)
+
+    def vals(s):
+        return np.cumsum(rng.integers(0, 50, NSAMP)).astype(np.float64)
+
+    rng2 = np.random.default_rng(7)
+
+    def vals2(s):
+        return np.cumsum(rng2.integers(0, 50, NSAMP)).astype(np.float64)
+
+    ms_n, shard_n = _build(True, vals)
+    ms_r, _ = _build(False, vals2)
+    got_n = _query(ms_n)
+    # the mirror was built and every live row round-trips exactly
+    nd = shard_n.store.narrow._data
+    assert nd is not None, "narrow mirror never built"
+    assert np.asarray(nd[3])[:NSERIES].all(), "integer counters must encode exactly"
+    got_r = _query(ms_r)
+    for k in got_r:
+        np.testing.assert_array_equal(got_n[k], got_r[k])
+
+
+def test_incompressible_floats_fall_back_to_raw():
+    rng = np.random.default_rng(8)
+
+    def vals(s):
+        return np.cumsum(rng.exponential(5.0, NSAMP))
+
+    ms_n, shard_n = _build(True, vals)
+    got = _query(ms_n)
+    (v,) = got.values()
+    assert np.isfinite(v).all()
+    nd = shard_n.store.narrow._data
+    # mirror built once, found inexact, query fell back (narrow not passed)
+    assert nd is not None and not np.asarray(nd[3])[:NSERIES].any()
+
+
+def test_mixed_rows_correct_inexact_minority():
+    rng = np.random.default_rng(9)
+
+    def vals(s):
+        if s % 10 == 0:       # 10% of rows are incompressible
+            return np.cumsum(rng.exponential(5.0, NSAMP))
+        return np.cumsum(rng.integers(0, 50, NSAMP)).astype(np.float64)
+
+    rng2 = np.random.default_rng(9)
+
+    def vals2(s):
+        if s % 10 == 0:
+            return np.cumsum(rng2.exponential(5.0, NSAMP))
+        return np.cumsum(rng2.integers(0, 50, NSAMP)).astype(np.float64)
+
+    ms_n, shard_n = _build(True, vals)
+    ms_r, _ = _build(False, vals2)
+    got_n = _query(ms_n, "sum by (grp) (rate(m[2m]))")
+    got_r = _query(ms_r, "sum by (grp) (rate(m[2m]))")
+    nd = shard_n.store.narrow._data
+    ok = np.asarray(nd[3])[:NSERIES]
+    assert 0 < (~ok).sum() <= NSERIES // 8
+    assert set(got_n) == set(got_r)
+    for k in got_r:
+        # inexact rows ride the general kernel: tolerance, not bit equality
+        np.testing.assert_allclose(got_n[k], got_r[k], rtol=2e-4, atol=1e-4)
