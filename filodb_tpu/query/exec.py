@@ -117,6 +117,17 @@ def _gather_rows_padded(ts, val, n, rows: np.ndarray):
     return ts_g, jnp.take(val, rid, axis=0), n_g.astype(jnp.int32), P
 
 
+def _pad_steps(out_ts: np.ndarray) -> tuple[np.ndarray, int]:
+    """(padded out_ts to a multiple of 32 by repeating the last step, true T).
+    Window kernels jit-compile per output shape; padding buckets the compile
+    space for ad-hoc query shapes (duplicate steps are sliced off after)."""
+    T = len(out_ts)
+    Tpad = -(-T // 32) * 32 if T else 0
+    if Tpad == T:
+        return out_ts, T
+    return np.concatenate([out_ts, np.full(Tpad - T, out_ts[-1], np.int64)]), T
+
+
 @dataclass
 class FusedWindowData:
     """Lazy PeriodicSamplesMapper output on a grid-aligned f32 selection: the
@@ -133,13 +144,18 @@ class FusedWindowData:
     def materialize(self) -> MatrixView:
         from ..ops import gridfns
         base_ts, interval_ms = self.sel.grid
+        # same T-bucketing as PSM.apply: this fallback otherwise re-opens the
+        # per-dashboard-shape compile cost on the hot f32 path
+        out_eval, T = _pad_steps(self.out_ts)
         vals = gridfns.periodic_samples_grid(
-            self.sel.val, self.sel.n, self.out_ts, self.window, self.fn,
+            self.sel.val, self.sel.n, out_eval, self.window, self.fn,
             base_ts, interval_ms, stale_ms=self.stale_ms)
         minority = self.sel.grid_minority
         if minority is not None and len(minority):
-            vals = _correct_minority_cohort(self.sel, vals, self.out_ts,
+            vals = _correct_minority_cohort(self.sel, vals, out_eval,
                                             self.window, self.fn, 0.0, 0.0)
+        if vals.shape[1] != T:
+            vals = vals[:, :T]
         return MatrixView(self.out_ts, vals, self.sel.keys, self.sel.rows)
 
 
@@ -187,6 +203,16 @@ class PeriodicSamplesMapper(Transformer):
     def apply(self, data, ctx: QueryContext):
         assert isinstance(data, SeriesSelection), "PSM must sit directly on a leaf"
         out_ts = self.out_ts(ctx)
+        if len(out_ts) == 0:
+            return MatrixView(out_ts, np.zeros((len(data.keys), 0)),
+                              data.keys, data.rows, data.bucket_les)
+        # bucket the step count: the window kernels jit-compile per output
+        # shape, and ad-hoc dashboards produce a fresh T per query — pad the
+        # evaluation grid to a multiple of 32 (repeating the last step, whose
+        # duplicate results are sliced off) so compiles amortize across query
+        # shapes (the fused path pads to 128 internally already)
+        out_eval, T = _pad_steps(out_ts)
+        Tpad = len(out_eval)
         fn = self.function or "last_sample"
         if fn == "last_sample":
             window = ctx.stale_ms
@@ -208,16 +234,18 @@ class PeriodicSamplesMapper(Transformer):
             if grid_usable and fn in gridfns.HIST_GRID_FNS:
                 base_ts, interval_ms = data.grid
                 vals = gridfns.periodic_samples_grid_hist(
-                    data.val, data.n, out_ts, window, fn, base_ts, interval_ms,
+                    data.val, data.n, out_eval, window, fn, base_ts, interval_ms,
                     stale_ms=ctx.stale_ms)
                 if minority is not None and len(minority):
-                    vals = _correct_minority_cohort(data, vals, out_ts, window,
+                    vals = _correct_minority_cohort(data, vals, out_eval, window,
                                                     fn, a0, a1, hist=True)
             else:
                 # off-grid shard: general searchsorted hist path (ref:
                 # HistogramVector read through chunked range functions)
                 vals = rangefns.periodic_samples_hist(data.ts, data.val, data.n,
-                                                      out_ts, window, fn, a0)
+                                                      out_eval, window, fn, a0)
+            if Tpad != T:
+                vals = vals[:, :T]
             return MatrixView(out_ts, vals, data.keys, data.rows, data.bucket_les)
         if grid_usable and fn in gridfns.GRID_FNS:
             from ..ops import fusedgrid
@@ -228,15 +256,17 @@ class PeriodicSamplesMapper(Transformer):
                 # function with the aggregation in one HBM pass
                 return FusedWindowData(data, out_ts, window, fn, ctx.stale_ms)
             base_ts, interval_ms = data.grid
-            vals = gridfns.periodic_samples_grid(data.val, data.n, out_ts, window,
+            vals = gridfns.periodic_samples_grid(data.val, data.n, out_eval, window,
                                                  fn, base_ts, interval_ms,
                                                  stale_ms=ctx.stale_ms)
             if minority is not None and len(minority):
-                vals = _correct_minority_cohort(data, vals, out_ts, window,
+                vals = _correct_minority_cohort(data, vals, out_eval, window,
                                                 fn, a0, a1)
         else:
-            vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_ts,
+            vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_eval,
                                              window, fn, a0, a1)
+        if Tpad != T:
+            vals = vals[:, :T]
         return MatrixView(out_ts, vals, data.keys, data.rows)
 
 
