@@ -609,9 +609,10 @@ def _map_topk(m: MatrixView, gids, uniq, G: int, k: int, bottom: bool):
         sv = -gv if bottom else gv
         _, top_i = jax.lax.top_k(sv.T, kk)                       # [T, kk]
         top_ok = jnp.take_along_axis(presence.T, top_i, axis=1)  # exact mask
-        top_v = np.asarray(jnp.take_along_axis(vals.T, top_i, axis=1))
-        top_i = np.asarray(top_i)
-        ok = np.asarray(top_ok)
+        # ONE host fetch for all three small arrays (each separate fetch is
+        # a full round trip on a tunneled device link)
+        top_v, top_i, ok = jax.device_get(
+            (jnp.take_along_axis(vals.T, top_i, axis=1), top_i, top_ok))
         for t, s in zip(*np.nonzero(ok)):
             row = int(top_i[t, s])
             slot = row_slot.get(row)
