@@ -242,31 +242,49 @@ def periodic_samples_grid_hist(val, n, out_ts: np.ndarray, window_ms: int, fn: s
                              ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
 
 
-@jax.jit
-def histogram_quantile(q, les, counts):
-    """Prometheus histogram_quantile, vectorized: les [B], counts [..., B]
-    cumulative -> [...] (ref: Histogram.scala quantile :288; device mirror of
-    memory/hist.py host reference)."""
+def _hist_quantile(q, les, counts, xp):
+    """One shared body for the device (xp=jnp) and host (xp=np) entry points
+    below: the classic-le and native-histogram paths answer identically by
+    construction, not by keeping two copies in sync."""
+    import contextlib
+    guard = (np.errstate(invalid="ignore", divide="ignore")
+             if xp is np else contextlib.nullcontext())
     B = les.shape[0]
     total = counts[..., -1]
     rank = q * total
     # first bucket with cumulative >= rank
     b = (counts < rank[..., None]).sum(axis=-1)
-    b = jnp.clip(b, 0, B - 1)
-    lo_le = jnp.where(b > 0, les[jnp.maximum(b - 1, 0)], 0.0)
+    b = xp.clip(b, 0, B - 1)
+    lo_le = xp.where(b > 0, les[xp.maximum(b - 1, 0)], 0.0)
     hi_le = les[b]
-    lo_cnt = jnp.where(b > 0, jnp.take_along_axis(
-        counts, jnp.maximum(b - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
-    hi_cnt = jnp.take_along_axis(counts, b[..., None], axis=-1)[..., 0]
-    frac = jnp.where(hi_cnt > lo_cnt, (rank - lo_cnt) / (hi_cnt - lo_cnt), 1.0)
-    res = lo_le + (hi_le - lo_le) * frac
+    lo_cnt = xp.where(b > 0, xp.take_along_axis(
+        counts, xp.maximum(b - 1, 0)[..., None], axis=-1)[..., 0], 0.0)
+    hi_cnt = xp.take_along_axis(counts, b[..., None], axis=-1)[..., 0]
+    with guard:
+        frac = xp.where(hi_cnt > lo_cnt, (rank - lo_cnt) / (hi_cnt - lo_cnt), 1.0)
+        res = lo_le + (hi_le - lo_le) * frac
     # +Inf top bucket: clamp to the highest finite bound
-    res = jnp.where(jnp.isinf(hi_le),
-                    jnp.where(b > 0, les[jnp.maximum(b - 1, 0)], jnp.nan), res)
-    res = jnp.where((total > 0) & ~jnp.isnan(total), res, jnp.nan)
-    res = jnp.where(q < 0, -jnp.inf, res)
-    res = jnp.where(q > 1, jnp.inf, res)
+    res = xp.where(xp.isinf(hi_le),
+                   xp.where(b > 0, les[xp.maximum(b - 1, 0)], xp.nan), res)
+    res = xp.where((total > 0) & ~xp.isnan(total), res, xp.nan)
+    res = xp.where(q < 0, -xp.inf, res)
+    res = xp.where(q > 1, xp.inf, res)
     return res
+
+
+@jax.jit
+def histogram_quantile(q, les, counts):
+    """Prometheus histogram_quantile, vectorized: les [B], counts [..., B]
+    cumulative -> [...] (ref: Histogram.scala quantile :288; device mirror of
+    memory/hist.py host reference)."""
+    return _hist_quantile(q, les, counts, jnp)
+
+
+def histogram_quantile_np(q, les, counts):
+    """Host-numpy evaluation of the identical algebra — the classic
+    le-labeled path (query/exec.py _classic_le_quantile) finishes tiny
+    ragged per-group matrices here without a device round trip."""
+    return _hist_quantile(q, les, counts, np)
 
 
 def periodic_samples_grid(val, n, out_ts: np.ndarray, window_ms: int, fn: str,

@@ -128,6 +128,23 @@ def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
     )(val_g, n_g, gids_g, band, ohlo, lo, hi, rel)
 
 
+class LazyMeshResult:
+    """Device-resident distributed result; ``resolve()`` does the blocking
+    host fetch. The engine dispatches under the shard locks but fetches
+    outside them (same contract as the in-process leaf: a slow collective
+    must not stall ingest on every shard for its full wall time)."""
+
+    def __init__(self, out, num_groups: int, T: int | None):
+        self._out = out
+        self._ng = num_groups
+        self._T = T
+
+    def resolve(self) -> np.ndarray:
+        # all shards hold identical presented results; take shard 0's block
+        r = np.asarray(self._out.addressable_shards[0].data[0])[:self._ng]
+        return r[:, :self._T] if self._T is not None else r
+
+
 class MeshQueryExecutor:
     """Runs aggregation queries over a DistributedStore (used by the engine when
     a mesh is configured; falls back to in-process scatter-gather otherwise).
@@ -162,7 +179,7 @@ class MeshQueryExecutor:
 
     def aggregate(self, fn: str, op: str, out_ts: np.ndarray, window_ms: int,
                   group_ids_per_shard: list[np.ndarray], num_groups: int,
-                  args=(0.0, 0.0)):
+                  args=(0.0, 0.0), fetch: bool = True):
         ts_g, val_g, n_g = self.dstore.arrays()
         devs = list(self.dstore.mesh.devices.ravel())
         gids = self.dstore._global(
@@ -187,13 +204,22 @@ class MeshQueryExecutor:
                     fn, op, G, self.dstore.mesh, int(window_ms),
                     int(interval_ms), S, C, Tp)
             self.last_path = "fused"
-            return np.asarray(out.addressable_shards[0].data[0])[:num_groups, :T]
-        out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_ts),
+            res = LazyMeshResult(out, num_groups, T)
+            return res.resolve() if fetch else res
+        # bucket the step count (pad to a multiple of 32, repeating the last
+        # step): dist_aggregate jit-compiles per output shape and ad-hoc
+        # dashboards would otherwise recompile per query — the same compile-
+        # space bucketing as the in-process path (query/exec.py _pad_steps)
+        T = len(out_ts)
+        Tpad = -(-max(T, 1) // 32) * 32
+        out_eval = (out_ts if Tpad == T else np.concatenate(
+            [out_ts, np.full(Tpad - T, out_ts[-1], np.int64)]))
+        out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_eval),
                              jnp.int64(window_ms), jnp.float64(args[0]),
                              jnp.float64(args[1]), fn, op, G, self.dstore.mesh)
         self.last_path = "twostep"
-        # all shards hold identical presented results; take shard 0's block
-        return np.asarray(out.addressable_shards[0].data[0])[:num_groups]
+        res = LazyMeshResult(out, num_groups, T)
+        return res.resolve() if fetch else res
 
 
 def _pow2(n: int, floor: int = 8) -> int:

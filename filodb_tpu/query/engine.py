@@ -2,20 +2,36 @@
 
 Reference: coordinator/.../QueryActor.scala (processLogicalPlan2Query) +
 queryengine2/QueryEngine.materialize — minus the actor layer: dispatch here is a
-direct call; the mesh executor (parallel/) plugs in underneath the same API.
+direct call. When a device mesh is configured, fusable aggregate plans route
+through the shard_map/psum executor (parallel/distributed.py) the way the
+reference's planner routes every query to per-shard dispatchers
+(queryengine2/QueryEngine.scala:59-67,369); anything else falls back to the
+in-process scatter-gather ExecPlan tree.
 """
 
 from __future__ import annotations
 
+import contextlib
+
 from dataclasses import dataclass
+
+import numpy as np
 
 from ..core.memstore import TimeSeriesMemStore
 from ..parallel.shardmapper import ShardMapper
 from ..promql import parser as promql
 from . import logical as L
-from .exec import QueryContext
+from .exec import QueryContext, group_keys_of
 from .planner import QueryPlanner
-from .rangevector import QueryResult
+from .rangevector import QueryResult, RangeVectorKey, ResultMatrix
+
+# aggregation operators whose partial state crosses the mesh collective
+# (psum/pmin/pmax — ops/aggregators.py partial layout)
+MESH_OPS = frozenset({"sum", "avg", "count", "group", "stddev", "stdvar",
+                      "min", "max"})
+# rows outside the selection: a group id no kernel's one-hot/segment scatter
+# ever matches (OOB scatter updates drop; one-hot comparisons never equal it)
+_EXCLUDED_GID = 1 << 30
 
 
 @dataclass
@@ -28,7 +44,7 @@ class QueryConfig:
 class QueryEngine:
     def __init__(self, memstore: TimeSeriesMemStore, dataset: str,
                  shard_mapper: ShardMapper | None = None,
-                 config: QueryConfig = QueryConfig()):
+                 config: QueryConfig = QueryConfig(), mesh=None):
         self.memstore = memstore
         self.dataset = dataset
         num_shards = max(len(memstore.shards_of(dataset)), 1)
@@ -37,6 +53,12 @@ class QueryEngine:
             pow2 *= 2
         self.mapper = shard_mapper or ShardMapper(pow2)
         self.config = config
+        # jax.sharding.Mesh with one device per shard: aggregate queries
+        # execute via shard_map + psum instead of the host scatter-gather
+        self.mesh = mesh
+        # route taken by the last query:
+        # "mesh-fused" | "mesh-twostep" | "mesh-empty" | "local"
+        self.last_exec_path: str | None = None
         schema = memstore._dataset_schema.get(dataset)
         opts = schema.options if schema else None
         self.planner = QueryPlanner(self.mapper, opts) if opts else QueryPlanner(self.mapper)
@@ -58,8 +80,115 @@ class QueryEngine:
         return res
 
     def exec_logical(self, plan: L.LogicalPlan) -> QueryResult:
+        if self.mesh is not None:
+            res = self._try_mesh(plan)
+            if res is not None:
+                return res
+        self.last_exec_path = "local"
         exec_plan = self.planner.materialize(plan)
         return exec_plan.run(self._ctx())
+
+    # -- mesh dispatch (ref: queryengine2/QueryEngine.scala:59-67 — the
+    # planner routes every query through per-shard dispatchers; here the
+    # per-shard dispatch IS the shard_map and the reduce IS the psum) --------
+
+    def _mesh_executor(self, shards):
+        """A MeshQueryExecutor when every shard's store lives on its mesh
+        device with one common [S, C] shape, else None (host fallback)."""
+        from ..parallel.distributed import DistributedStore, MeshQueryExecutor
+        if self.mesh is None or self.mesh.devices.size != len(shards):
+            return None
+        devs = list(self.mesh.devices.ravel())
+        s0 = shards[0].store
+        if s0 is None:
+            return None
+        for sh, dev in zip(shards, devs):
+            st = sh.store
+            if (st is None or getattr(sh, "bucket_les", None) is not None
+                    or st.val.ndim != 2 or (st.S, st.C) != (s0.S, s0.C)
+                    or list(st.ts.devices())[0] != dev):
+                return None
+        return MeshQueryExecutor(DistributedStore(self.mesh, shards))
+
+    def _try_mesh(self, plan: L.LogicalPlan) -> QueryResult | None:
+        """Execute ``op(fn(selector[w]))`` via shard_map/psum when the plan
+        shape, operator, and store layout allow; None => caller falls back."""
+        if not isinstance(plan, L.Aggregate) or plan.operator not in MESH_OPS:
+            return None
+        if plan.params:
+            return None
+        inner = plan.vectors
+        if isinstance(inner, L.PeriodicSeriesWithWindowing):
+            raw, fn, window = inner.series, inner.function, inner.window_ms
+            args = tuple(float(a) for a in (inner.function_args or ()))
+        elif isinstance(inner, L.PeriodicSeries):
+            raw, fn = inner.raw_series, "last_sample"
+            window = self.config.stale_sample_after_ms
+            args = (float(window),)
+        else:
+            return None
+        if raw.columns or fn is None:
+            return None
+        shards = self.memstore.shards_of(self.dataset)
+        if len(shards) < 2:
+            return None
+        ex = self._mesh_executor(shards)
+        if ex is None:
+            return None
+        step = max(inner.step_ms, 1)
+        out_ts = np.arange(inner.start_ms, inner.end_ms + 1, step,
+                           dtype=np.int64)
+        if len(out_ts) == 0:
+            return None
+        filters = list(raw.filters)
+        from_ms = raw.range_selector.from_ms
+        to_ms = raw.range_selector.to_ms
+        uniq: dict[RangeVectorKey, int] = {}
+        gids_list: list[np.ndarray] = []
+        # all shard locks held across gid construction AND kernel dispatch:
+        # a concurrent ingest flush donates (invalidates) any shard's store
+        # buffers mid-stream otherwise (same rule as the in-process leaf)
+        with contextlib.ExitStack() as stack:
+            for sh in shards:
+                stack.enter_context(sh.lock)
+            for sh in shards:
+                pids = sh.part_ids_from_filters(filters, from_ms, to_ms)
+                if sh.needs_paging(pids, from_ms):
+                    return None          # cold data: host ODP path handles it
+                g = np.full(sh.store.S, _EXCLUDED_GID, np.int32)
+                if len(pids):
+                    if not plan.by and not plan.without:
+                        g[pids] = 0
+                        uniq.setdefault(RangeVectorKey(()), 0)
+                    else:
+                        keys = [sh.rv_key_of(int(p)) for p in pids]
+                        for p, gk in zip(pids, group_keys_of(keys, plan.by,
+                                                             plan.without)):
+                            g[p] = uniq.setdefault(gk, len(uniq))
+                gids_list.append(g)
+            if not uniq:
+                self.last_exec_path = "mesh-empty"
+                return QueryResult(ResultMatrix(
+                    out_ts, np.zeros((0, len(out_ts))), []))
+            G = len(uniq)
+            a0 = args[0] if len(args) > 0 else 0.0
+            a1 = args[1] if len(args) > 1 else 0.0
+            # dispatch under the locks; the blocking host fetch happens after
+            # they release (same rule as the in-process leaf) so a slow
+            # collective never stalls ingest across every shard. The FIRST
+            # query of a new (fn, op, G-bucket, T-bucket) shape still traces
+            # and compiles here — step-count bucketing inside the executor
+            # bounds that compile space exactly like the in-process path
+            lazy = ex.aggregate(fn, plan.operator, out_ts, window, gids_list,
+                                G, args=(a0, a1), fetch=False)
+        self.last_exec_path = f"mesh-{ex.last_path}"
+        m = ResultMatrix(out_ts, lazy.resolve(), list(uniq))
+        if m.num_series * len(out_ts) > self.config.sample_limit:
+            from .rangevector import QueryError
+            raise QueryError(
+                f"result too large: {m.num_series} series x {len(out_ts)} "
+                f"steps > sample limit {self.config.sample_limit}")
+        return QueryResult(m)
 
     # -- metadata queries (ref: QueryActor label-values / series paths) -------
 

@@ -281,6 +281,12 @@ class InstantVectorFunctionMapper(Transformer):
                              "histogram_max_quantile"):
             from ..ops import gridfns
             if m.bucket_les is None:
+                if self.function == "histogram_quantile":
+                    # classic le-labeled bucket series (what remote-write and
+                    # the Influx gateway ingest): group by labels minus le,
+                    # sort buckets, fix monotonicity, same quantile algebra
+                    # (ref: HistogramQuantileMapper.scala:23-90)
+                    return _classic_le_quantile(m, float(self.args[0]))
                 raise QueryError(f"{self.function} requires native histogram series")
             les = np.asarray(m.bucket_les, np.float64)
             if self.function == "histogram_bucket":
@@ -299,6 +305,56 @@ class InstantVectorFunctionMapper(Transformer):
             return ResultMatrix(m.out_ts, out, [RangeVectorKey(())])
         return ResultMatrix(m.out_ts, instantfns.apply(self.function, m.values, self.args),
                             m.keys)
+
+
+def _classic_le_quantile(m, q: float) -> ResultMatrix:
+    """histogram_quantile over classic ``le``-labeled scalar bucket series
+    (ref: HistogramQuantileMapper.scala:23-90 + Histogram.scala:288).
+
+    Groups input series by labels minus ``le``, sorts each group's buckets by
+    ascending le, repairs monotonicity (NaN or decreasing bucket rates take
+    the running max — scrapes are not atomic across buckets), and computes
+    the Prometheus quantile with the SAME algebra as the native-histogram
+    device path (ops/gridfns.histogram_quantile), so both ingestion forms
+    answer identically. Host numpy: group counts are dashboard-sized and the
+    ragged per-group bucket layouts don't batch."""
+    if not len(m.keys):
+        return ResultMatrix(m.out_ts, np.zeros((0, len(m.out_ts))), [])
+    vals = np.asarray(m.values, np.float64)               # [R, T]
+    groups: dict[RangeVectorKey, list[tuple[float, int]]] = {}
+    for i, k in enumerate(m.keys):
+        d = k.as_dict()
+        le_s = d.get("le")
+        if le_s is None:
+            raise QueryError(
+                "cannot calculate histogram quantile: 'le' tag is absent in "
+                f"time series {d}")
+        try:
+            le = np.inf if le_s == "+Inf" else float(le_s)
+        except ValueError:
+            raise QueryError(
+                f"cannot calculate histogram quantile: unparseable le tag "
+                f"{le_s!r} in time series {d}") from None
+        groups.setdefault(k.without(("le",)), []).append((le, i))
+    T = len(m.out_ts)
+    out = np.full((len(groups), T), np.nan)
+    keys = list(groups)
+    for g, gk in enumerate(keys):
+        buckets = sorted(groups[gk], key=lambda p: p[0])
+        les = np.array([b[0] for b in buckets])
+        if not np.isinf(les[-1]):
+            continue              # no +Inf bucket: quantile undefined (NaN)
+        counts = vals[[b[1] for b in buckets]].T           # [T, B] cumulative
+        # makeMonotonic: running max along the bucket axis, floor 0 — NaN and
+        # regressions (bucket churn, non-atomic scrapes) take the prior max
+        counts = np.maximum.accumulate(
+            np.where(np.isnan(counts), -np.inf, counts), axis=1)
+        counts = np.maximum(counts, 0.0)
+        # the SAME quantile algebra as the native-histogram device path,
+        # evaluated host-side: parity by construction, not discipline
+        from ..ops import gridfns
+        out[g] = gridfns.histogram_quantile_np(q, les, counts)
+    return ResultMatrix(m.out_ts, out, keys)
 
 
 @dataclass

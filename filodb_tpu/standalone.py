@@ -184,11 +184,23 @@ class FiloServer:
                 self._running.discard(shard_num)
             raise
 
+    def _shard_device(self, shard_num: int):
+        """Mesh placement: with multiple local devices, shard stores go
+        round-robin so aggregate queries can execute via shard_map/psum
+        (the reference's per-shard data nodes; here devices ARE the nodes)."""
+        try:
+            import jax
+            devs = jax.devices()
+        except Exception:
+            return None
+        return devs[shard_num % len(devs)] if len(devs) > 1 else None
+
     def _start_shard_claimed(self, dataset: str, shard_num: int) -> None:
         cfg = self.config
         try:
             shard = self.memstore.setup(dataset, cfg["schema"], shard_num,
-                                        self._store_cfg, sink=self._sink)
+                                        self._store_cfg, sink=self._sink,
+                                        device=self._shard_device(shard_num))
         except ValueError:
             # a retried start after a partial failure: the store exists
             shard = self.memstore.shard(dataset, shard_num)
@@ -335,8 +347,20 @@ class FiloServer:
             self._start_shard(dataset, shard_num)
         self.manager.subscribe(self._on_shard_event)
         mapper = ShardMapper(num_shards, spread=cfg["spread"])
+        # one device per owned shard => PromQL aggregates run on the mesh
+        # (query/engine.py _try_mesh); any other topology stays in-process
+        mesh = None
+        try:
+            import jax
+            devs = jax.devices()
+            owned = self.manager.shards_of_node(dataset, self.node)
+            if 1 < num_shards == len(owned) == len(devs):
+                from .parallel.distributed import make_mesh
+                mesh = make_mesh(devs)
+        except Exception:
+            mesh = None
         self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
-                                            cfg.query_config())
+                                            cfg.query_config(), mesh=mesh)
 
         # remote-write sink: durable bus publish when configured, else direct
         # ingest. The whole batch is validated against owned shards BEFORE

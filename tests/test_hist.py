@@ -298,3 +298,107 @@ def test_raw_hist_result_expands_to_le_series(hist_engine):
         np.maximum(pod0["1"], pod0["2"]), pod0["2"])
     np.testing.assert_array_equal(
         np.maximum(pod0["16"], pod0["+Inf"]), pod0["+Inf"])
+
+
+# ---- classic le-labeled histogram_quantile (HistogramQuantileMapper parity) --
+
+def _classic_gauge_engine(les, data):
+    """The same bucket counters ingested as classic scalar ``_bucket`` series
+    with le labels (what remote-write / the Influx gateway produce)."""
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.rangevector import fmt_value
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=32, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float64")
+    shard = ms.setup("prometheus", GAUGE, 0, cfg)
+    for s, counts in data.items():
+        for bi, le in enumerate(les):
+            le_s = "+Inf" if np.isinf(le) else fmt_value(le)
+            b = RecordBuilder(GAUGE)
+            for t in range(counts.shape[0]):
+                b.add({"_metric_": "req_latency_bucket", "pod": f"p{s}",
+                       "le": le_s}, BASE + t * IV, float(counts[t, bi]))
+            shard.ingest(b.build())
+    shard.flush()
+    return QueryEngine(ms, "prometheus")
+
+
+def test_classic_le_quantile_matches_native(hist_engine):
+    """Golden parity (ref: HistogramQuantileMapper.scala:23-90): the same
+    histogram ingested natively and as classic le-labeled bucket series
+    answers histogram_quantile identically, per-histogram and summed."""
+    eng, les, data = hist_engine
+    ceng = _classic_gauge_engine(les, data)
+    start, end, step = BASE + 600_000, BASE + 900_000, 60_000
+
+    rn = eng.query_range("histogram_quantile(0.9, rate(req_latency[2m]))",
+                         start, end, step)
+    rc = ceng.query_range(
+        "histogram_quantile(0.9, rate(req_latency_bucket[2m]))",
+        start, end, step)
+    native = {k.without(("_metric_",)): np.asarray(v)
+              for k, _t, v in rn.matrix.iter_series()}
+    classic = {k.without(("_metric_",)): np.asarray(v)
+               for k, _t, v in rc.matrix.iter_series()}
+    assert set(native) == set(classic) and len(native) == 3
+    for k in native:
+        np.testing.assert_allclose(classic[k], native[k], rtol=1e-9)
+
+    # the canonical dashboard form: quantile of sum-of-rates
+    rn2 = eng.query_range(
+        "histogram_quantile(0.9, sum(rate(req_latency[2m])))",
+        start, end, step)
+    rc2 = ceng.query_range(
+        "histogram_quantile(0.9, sum by (le) (rate(req_latency_bucket[2m])))",
+        start, end, step)
+    (_k, _t, vn), = list(rn2.matrix.iter_series())
+    (_k, _t, vc), = list(rc2.matrix.iter_series())
+    np.testing.assert_allclose(vc, vn, rtol=1e-9)
+
+
+def test_classic_le_quantile_semantics():
+    """Unit semantics (ref: HistogramQuantileMapper.makeMonotonic +
+    histogramQuantile): monotonic repair, missing +Inf bucket, missing le
+    label, and out-of-range q."""
+    from filodb_tpu.query.exec import (InstantVectorFunctionMapper,
+                                       _classic_le_quantile)
+    from filodb_tpu.query.rangevector import QueryError, RangeVectorKey, \
+        ResultMatrix
+    out_ts = np.array([0, 1000], np.int64)
+
+    def mat(rows):
+        keys = [RangeVectorKey.of(d) for d, _ in rows]
+        vals = np.array([v for _, v in rows], np.float64)
+        return ResultMatrix(out_ts, vals, keys)
+
+    # NaN and regressing bucket rates take the running max before quantile
+    m = mat([({"le": "1"}, [10.0, 10.0]),
+             ({"le": "2"}, [np.nan, 8.0]),        # NaN -> repaired to 10
+             ({"le": "4"}, [30.0, 30.0]),
+             ({"le": "+Inf"}, [40.0, 40.0])])
+    r = _classic_le_quantile(m, 0.5)
+    # rank 20: first step interpolates in (2,4]: 2 + 2*(20-10)/(30-10) = 3
+    np.testing.assert_allclose(np.asarray(r.values)[0], [3.0, 3.0])
+
+    # without a +Inf bucket the quantile is undefined
+    m = mat([({"le": "1"}, [10.0, 10.0]), ({"le": "4"}, [30.0, 30.0])])
+    assert np.isnan(np.asarray(_classic_le_quantile(m, 0.5).values)).all()
+
+    # q outside [0, 1]
+    m = mat([({"le": "1"}, [10.0, 10.0]), ({"le": "+Inf"}, [30.0, 30.0])])
+    assert np.isposinf(np.asarray(_classic_le_quantile(m, 1.5).values)).all()
+    assert np.isneginf(np.asarray(_classic_le_quantile(m, -0.5).values)).all()
+
+    # a series without an le tag is an error (reference throws)
+    m = mat([({"le": "1"}, [10.0, 10.0]), ({"pod": "p0"}, [30.0, 30.0])])
+    try:
+        _classic_le_quantile(m, 0.5)
+        assert False, "expected QueryError"
+    except QueryError:
+        pass
+
+    # the mapper routes scalar (non-native-histogram) input to the classic path
+    out = InstantVectorFunctionMapper("histogram_quantile", (0.9,)).apply(
+        mat([({"le": "1"}, [10.0, 10.0]), ({"le": "+Inf"}, [10.0, 10.0])]),
+        None)
+    assert np.asarray(out.values).shape == (1, 2)
