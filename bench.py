@@ -290,6 +290,30 @@ def main():
         marg.append((time.perf_counter() - t0) * 1000)
     device_marginal = (marg[1] - marg[0]) / 15.0
 
+    # sub-range marginal: a "last 30m" dashboard panel over the 2h retention
+    # — the active-column kernel streams/matmuls only the panel's store
+    # tiles. Ranges cycle (shifted by one cell) for the same reason the main
+    # marginal cycles variants: identical repeats could be deduped
+    sub_ts_vars = [np.arange(end - 1_800_000 - k * INTERVAL_MS,
+                             end - k * INTERVAL_MS + 1, STEP_MS,
+                             dtype=np.int64) for k in range(8)]
+
+    def submit_sub(i):
+        return fusedgrid.fused_grid_aggregate(
+            "sum", "rate", shard.store.val, shard.store.n, gids, 8,
+            sub_ts_vars[i % len(sub_ts_vars)], WINDOW_MS, BASE_TS,
+            INTERVAL_MS, fetch=False)
+
+    for i in range(len(sub_ts_vars)):
+        submit_sub(i).resolve()
+    marg = []
+    for K in (1, 16):
+        t0 = time.perf_counter()
+        ps = [submit_sub(i) for i in range(K)]
+        jax.device_get([p._outs for p in ps])
+        marg.append((time.perf_counter() - t0) * 1000)
+    device_marginal_sub = (marg[1] - marg[0]) / 15.0
+
     floor_ms = session_floor_ms()
     roofline_ms = stream_probe(shard.store.val)
     baseline_ms, baseline_how = measure_baseline_proxy()
@@ -318,6 +342,7 @@ def main():
             "session_rt_floor_ms": round(floor_ms, 2),
             "single_query_minus_floor_ms": round(single_p50 - floor_ms, 2),
             "device_marginal_ms_per_query": round(device_marginal, 2),
+            "device_marginal_ms_subrange_30m": round(device_marginal_sub, 2),
             "hbm_stream_pass_ms": round(roofline_ms, 2),
             "baseline_p50_ms": round(baseline_ms, 2),
             "baseline_method": baseline_how,

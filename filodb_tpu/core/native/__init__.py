@@ -57,6 +57,10 @@ def _load():
                                      ctypes.c_int64, ctypes.c_void_p]
     lib.fnv1a64_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_int64, ctypes.c_void_p]
+    lib.sorted_intersect_i32.restype = ctypes.c_int64
+    lib.sorted_intersect_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -78,6 +82,20 @@ def fnv1a64_batch(keys: list[bytes]) -> np.ndarray:
     out = np.empty(len(keys), np.uint64)
     lib.fnv1a64_batch(blob, offs.ctypes.data, len(keys), out.ctypes.data)
     return out
+
+
+def sorted_intersect(a: np.ndarray, b: np.ndarray) -> np.ndarray | None:
+    """Intersection of two sorted-unique int32 arrays in native code
+    (galloping for skewed sizes); None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, np.int32)
+    b = np.ascontiguousarray(b, np.int32)
+    out = np.empty(min(len(a), len(b)), np.int32)
+    k = lib.sorted_intersect_i32(a.ctypes.data, len(a), b.ctypes.data, len(b),
+                                 out.ctypes.data)
+    return out[:k]
 
 
 class NativePartSet:

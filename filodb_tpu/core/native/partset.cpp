@@ -207,6 +207,44 @@ int64_t ps_resolve_batch(void* h, const uint64_t* hashes, const uint8_t* keys,
     return misses;
 }
 
+// Sorted-unique int32 intersection. Small-vs-large pairs gallop (binary
+// search of each small element into the large side, advancing the base);
+// similar sizes linear-merge. out needs room for min(n_a, n_b) entries.
+// Serves the part-key index's filter intersection (PartKeyLuceneIndex's
+// postings intersection analog) where numpy's per-call overhead dominates
+// 10k x 10k lookups.
+int64_t sorted_intersect_i32(const int32_t* a, int64_t n_a,
+                             const int32_t* b, int64_t n_b, int32_t* out) {
+    if (n_a > n_b) { const int32_t* t = a; a = b; b = t;
+                     int64_t tn = n_a; n_a = n_b; n_b = tn; }
+    int64_t k = 0;
+    if (n_a == 0) return 0;
+    if (n_b / (n_a + 1) >= 8) {
+        int64_t lo = 0;
+        for (int64_t i = 0; i < n_a; i++) {
+            int32_t x = a[i];
+            // gallop forward from the last match position
+            int64_t step = 1, hi = lo;
+            while (hi < n_b && b[hi] < x) { lo = hi; hi += step; step <<= 1; }
+            if (hi > n_b) hi = n_b;
+            while (lo < hi) {           // binary search in (lo, hi]
+                int64_t mid = (lo + hi) >> 1;
+                if (b[mid] < x) lo = mid + 1; else hi = mid;
+            }
+            if (lo < n_b && b[lo] == x) out[k++] = x;
+        }
+        return k;
+    }
+    int64_t i = 0, j = 0;
+    while (i < n_a && j < n_b) {
+        int32_t x = a[i], y = b[j];
+        if (x < y) i++;
+        else if (y < x) j++;
+        else { out[k++] = x; i++; j++; }
+    }
+    return k;
+}
+
 // FNV-1a 64 over concatenated keys (offs[n+1]); wire-stable with
 // record.fnv1a64 (the Python per-byte loop costs ~5us per 50-byte key).
 void fnv1a64_batch(const uint8_t* keys, const uint64_t* offs, int64_t n,

@@ -46,8 +46,11 @@ def _roundup(x: int, m: int) -> int:
 
 
 def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                 Sb: int, C: int, Tp: int, G: int, narrow: bool,
+                 Sb: int, Ca: int, Tp: int, G: int, narrow: bool, c0: int,
                  *refs):
+    """``Ca`` is the streamed column width and ``c0`` its global offset into
+    the store: a sub-range query streams (and matmuls) only its active
+    columns (see active_columns); full-range queries have c0=0, Ca=C."""
     if narrow:
         (val_ref, vmin_ref, scl_ref, n_ref, gid_ref, band_ref, ohlo_ref,
          lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
@@ -69,21 +72,28 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
         # exact in f32), then vmin + q * 2^e reproduces v bit-exactly for
         # rows the encoder verified
         v = (vmin_ref[:]
-             + (val_ref[:].astype(f32) + 32768.0) * scl_ref[:])  # [Sb, C]
+             + (val_ref[:].astype(f32) + 32768.0) * scl_ref[:])  # [Sb, Ca]
     else:
-        v = val_ref[:]                                        # [Sb, C]
+        v = val_ref[:]                                        # [Sb, Ca]
     n = n_ref[:]                                              # [Sb, 1] i32
-    col = jax.lax.broadcasted_iota(jnp.int32, (Sb, C), 1)
+    lcol = jax.lax.broadcasted_iota(jnp.int32, (Sb, Ca), 1)
+    col = lcol + c0                                           # global cell
     valid = col < n
     v = jnp.where(valid, v, 0.0)
 
     # increments: valid cells are a prefix of each row, so cell c has a valid
     # predecessor exactly when c > 0 and c is valid; roll's column-0 wraparound
-    # is masked out by that same condition
+    # is masked out by that same condition. With a column offset the local
+    # column 0 wraps to the slice's LAST column — its increment is garbage but
+    # never consumed (band rows at/below the first window edge are zero);
+    # zero it anyway so no value-dependent surprise can leak
     prev = pltpu.roll(v, jnp.int32(1), 1)   # i32 shift: x64 mode would lower an i64 operand, which tpu.dynamic_rotate rejects
     raw = v - prev
     inc = jnp.maximum(raw, 0.0) if is_counter else raw
-    inc = jnp.where(valid & (col > 0), inc, 0.0)
+    mask = valid & (col > 0)
+    if c0:
+        mask &= lcol > 0
+    inc = jnp.where(mask, inc, 0.0)
 
     delta = jnp.dot(inc, band_ref[:], preferred_element_type=f32)   # [Sb, Tp]
     f_v = jnp.dot(v, ohlo_ref[:], preferred_element_type=f32)
@@ -146,29 +156,38 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 @functools.lru_cache(maxsize=64)
 def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                  S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
-                 narrow: bool = False):
+                 narrow: bool = False, c0: int = 0, Ck: int = 0):
     """The raw (traceable) fused-kernel pallas_call — also invoked inside
     ``shard_map`` by the mesh executor (parallel/distributed.py), where each
     shard runs this same map phase on its resident block and the partial
     state crosses the ICI collective (ref: AggrOverRangeVectors.scala:62 —
     the identical map phase runs on every data node). With ``narrow`` the
-    value operand is the u16 quantized mirror plus per-row (vmin, scale)."""
-    body = functools.partial(_kernel_body, fn, needs_sumsq, window_ms,
-                             interval_ms, Sb, C, Tp, G, narrow)
+    value operand is the u16 quantized mirror plus per-row (vmin, scale).
+
+    ``(c0, Ca)`` describe the active column range (see active_columns): when
+    it covers less than the full store, the kernel's value block starts at
+    column ``c0`` and spans only ``Ca`` columns — HBM bytes and MXU MACs
+    scale with the query's range, not the store's retention — and the band
+    operands arrive pre-sliced to [Ca, Tp]."""
     n_out = 3 if needs_sumsq else 2
+    Ca = Ck if Ck else C
     out_shape = tuple(jax.ShapeDtypeStruct((G, Tp), jnp.float32)
                       for _ in range(n_out))
+    body = functools.partial(_kernel_body, fn, needs_sumsq, window_ms,
+                             interval_ms, Sb, Ca, Tp, G, narrow, c0)
     acc_spec = pl.BlockSpec((G, Tp), lambda i: (0, 0), memory_space=pltpu.VMEM)
     const = functools.partial(pl.BlockSpec, index_map=lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
     row = lambda shape: pl.BlockSpec(shape, lambda i: (i, 0),  # noqa: E731
                                      memory_space=pltpu.VMEM)
-    in_specs = [row((Sb, C))]
+    kcol = c0 // Ca                       # active_columns guarantees c0 % Ca == 0
+    in_specs = [pl.BlockSpec((Sb, Ca), lambda i: (i, kcol),
+                             memory_space=pltpu.VMEM)]
     if narrow:
         in_specs += [row((Sb, 1)), row((Sb, 1))]   # vmin, scale
     in_specs += [
         row((Sb, 1)), row((Sb, 1)),
-        const((C, Tp)), const((C, Tp)),
+        const((Ca, Tp)), const((Ca, Tp)),
         const((1, Tp)), const((1, Tp)), const((1, Tp)),
     ]
     return pl.pallas_call(
@@ -181,12 +200,42 @@ def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     )
 
 
+def active_columns(C: int, lo: np.ndarray, hi: np.ndarray) -> tuple[int, int]:
+    """(c0, Ca): the aligned store-column range the query actually reads —
+    first-sample selects need cell max(0, lo.min()); window sums need cells
+    (lo, hi]. Everything outside contributes nothing, so a sub-range query
+    (a "last 30m" dashboard panel over hours of retention) streams and
+    matmuls only its own columns. Constraint: the value block's offset must
+    be a multiple of its width (Pallas block indexing), so Ca grows in
+    128-steps until an aligned start covers the range — worst case the full
+    store (c0=0, Ca=C), typical dashboards a small suffix of it. C must be
+    a multiple of 128; callers get (0, C) otherwise."""
+    if C % 128 != 0 or len(lo) == 0:
+        return 0, C
+    first = max(0, int(lo.min()))
+    last = min(C - 1, int(hi.max()))
+    if last < first:                      # empty windows: minimal block
+        last = first
+    c1 = _roundup(last + 1, 128)
+    Ca = c1 - (first // 128) * 128
+    while Ca < C:
+        c0 = (first // Ca) * Ca
+        # the block must cover [c0, c1) AND stay inside the store: for a
+        # non-power-of-two C the last aligned block start can overhang the
+        # store edge (e.g. C=640, Ca=384 -> c0=384, c0+Ca=768), which would
+        # under-slice the band operand and read value columns past C
+        if c0 + Ca >= c1 and c0 + Ca <= C:
+            return c0, Ca
+        Ca += 128
+    return 0, C
+
+
 @functools.lru_cache(maxsize=64)
 def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
-                narrow: bool = False):
+                narrow: bool = False, c0: int = 0, Ck: int = 0):
     call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                        S, Sb, C, Tp, G, interpret, narrow)
+                        S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
 
     # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
     # inside the jit — on a tunneled device every extra dispatch is a
@@ -207,9 +256,12 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 
 def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
                   base_ts: int, interval_ms: int):
-    """Band/one-hot/edge operands as host arrays (band[C,Tp], ohlo[C,Tp],
-    lo[1,Tp], hi[1,Tp], rel[1,Tp]) — shared by the single-chip upload cache
-    below and the mesh path (which replicates them across shard devices)."""
+    """Band/one-hot/edge operands as host arrays + active column range:
+    (band, ohlo, lo[1,Tp], hi[1,Tp], rel[1,Tp], c0, Ck) — shared by the
+    single-chip upload cache below and the mesh path (which replicates them
+    across shard devices). For a sub-range query the band/ohlo rows are
+    sliced to the active [c0, c0+Ck*128) columns (the tiled kernel streams
+    only those store tiles); full-range queries keep [C, Tp] operands."""
     T = len(out_ts)
     lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
     rel = out_ts - base_ts
@@ -221,8 +273,12 @@ def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
     band[:, :T] = gridfns.band_matrix(C, lo, hi, True, np.float32)
     ohlo = np.zeros((C, Tp), np.float32)
     ohlo[:, :T] = gridfns.onehot_matrix(C, np.maximum(lo, 0), np.float32)
+    c0, Ca = active_columns(C, lo, hi)
+    if Ca < C:
+        band = np.ascontiguousarray(band[c0:c0 + Ca])
+        ohlo = np.ascontiguousarray(ohlo[c0:c0 + Ca])
     return (band, ohlo, lo_p.reshape(1, Tp), hi_p.reshape(1, Tp),
-            rel_p.reshape(1, Tp))
+            rel_p.reshape(1, Tp), c0, Ca)
 
 
 @functools.lru_cache(maxsize=32)
@@ -232,8 +288,9 @@ def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
     upload matters: repeated host->device transfers of the [C, Tp] bands per
     row-batch would dominate over a tunneled device link."""
     out_ts = np.frombuffer(out_ts_key, np.int64)
-    return tuple(jnp.asarray(a) for a in
-                 host_operands(C, Tp, out_ts, window_ms, base_ts, interval_ms))
+    *arrs, c0, Ck = host_operands(C, Tp, out_ts, window_ms, base_ts,
+                                  interval_ms)
+    return tuple(jnp.asarray(a) for a in arrs) + (c0, Ck)
 
 
 # conservative VMEM-driven caps for the fused path; beyond them callers must
@@ -302,14 +359,15 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     Sb = 512 if S % 512 == 0 else (S if S <= 512 else None)
     G = _roundup(max(num_groups, 8), 8)
 
-    band, ohlo, lo_d, hi_d, rel_d = _device_operands(
+    band, ohlo, lo_d, hi_d, rel_d, c0, Ck = _device_operands(
         C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
         int(window_ms), int(base_ts), int(interval_ms))
 
     needs_sumsq = op in ("stddev", "stdvar")
     interpret = jax.default_backend() != "tpu"
     call = _build_call(fn, needs_sumsq, int(window_ms), int(interval_ms),
-                       S, Sb, C, Tp, G, interpret, narrow is not None)
+                       S, Sb, C, Tp, G, interpret, narrow is not None,
+                       c0, Ck)
     # the framework runs with x64 on (int64 timestamps); Mosaic rejects the
     # i64 scalars x64 tracing injects (grid index maps, roll shifts), and the
     # kernel itself is pure f32/i32 — so trace the call with x64 off

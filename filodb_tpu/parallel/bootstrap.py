@@ -115,6 +115,215 @@ class FileRegistrarDiscovery(SeedDiscovery):
         return {m["addr"]: m.get("claims") or {} for m in self._live_entries()}
 
 
+class DnsSrvSeedDiscovery(SeedDiscovery):
+    """Seeds from DNS SRV records (ref: DnsSrvClusterSeedDiscovery.scala:12,87
+    — resolve ``_filodb._tcp.<domain>`` and join the returned host:port set).
+
+    Kubernetes headless services and Consul DNS both publish peers this way.
+    The stdlib has no SRV resolver, so a minimal RFC-1035 query/parse lives
+    here (same dependency-free stance as utils/snappy.py); name compression
+    pointers in answers are handled."""
+
+    SRV, IN = 33, 1
+
+    def __init__(self, srv_name: str, resolver: str | None = None,
+                 timeout_s: float = 3.0):
+        self.srv_name = srv_name.rstrip(".")
+        self.timeout_s = timeout_s
+        self.resolver = resolver or self._system_resolver()
+
+    @staticmethod
+    def _system_resolver() -> str:
+        try:
+            with open("/etc/resolv.conf") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2 and parts[0] == "nameserver":
+                        ns = parts[1]
+                        # IPv6 literals must be bracketed — "fd00::1:53"
+                        # would parse as a DIFFERENT address
+                        return f"[{ns}]:53" if ":" in ns else f"{ns}:53"
+        except OSError:
+            pass
+        return "127.0.0.1:53"
+
+    @staticmethod
+    def _encode_name(name: str) -> bytes:
+        out = b""
+        for label in name.split("."):
+            raw = label.encode()
+            out += bytes([len(raw)]) + raw
+        return out + b"\x00"
+
+    @staticmethod
+    def _read_name(buf: bytes, off: int) -> tuple[str, int]:
+        """Domain name at ``off``; follows RFC-1035 compression pointers.
+        Returns (name, offset-after-the-name-as-stored)."""
+        labels, jumped, end = [], False, off
+        hops = 0
+        while True:
+            ln = buf[off]
+            if ln & 0xC0 == 0xC0:             # compression pointer
+                if not jumped:
+                    end = off + 2
+                off = ((ln & 0x3F) << 8) | buf[off + 1]
+                jumped = True
+                hops += 1
+                if hops > 64:
+                    raise ValueError("DNS name pointer loop")
+                continue
+            if ln == 0:
+                if not jumped:
+                    end = off + 1
+                return ".".join(labels), end
+            off += 1
+            labels.append(buf[off:off + ln].decode())
+            off += ln
+
+    def _resolver_addr(self) -> tuple[str, int, int]:
+        """(host, port, socket family) — handles '[v6]:53', bare IPv6
+        literals (port defaults to 53), and host:port."""
+        r = self.resolver
+        if r.startswith("["):                      # [v6]:port
+            host, _, rest = r[1:].partition("]")
+            port = int(rest.lstrip(":") or 53)
+        elif r.count(":") > 1:                     # bare IPv6 literal
+            host, port = r, 53
+        elif ":" in r:
+            host, port_s = r.rsplit(":", 1)
+            port = int(port_s)
+        else:
+            host, port = r, 53
+        fam = (socket.AF_INET6 if ":" in host else socket.AF_INET)
+        return host, port, fam
+
+    def query_srv(self) -> list[tuple[int, int, int, str]]:
+        """[(priority, weight, port, target)] for the SRV name."""
+        import struct as st
+        qid = int.from_bytes(os.urandom(2), "big")
+        msg = (st.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0)
+               + self._encode_name(self.srv_name) + st.pack(">HH", self.SRV, self.IN))
+        host, port, fam = self._resolver_addr()
+        with socket.socket(fam, socket.SOCK_DGRAM) as s:
+            s.settimeout(self.timeout_s)
+            s.sendto(msg, (host, port))
+            buf, _ = s.recvfrom(4096)
+        rid, flags, qd, an, _ns, _ar = st.unpack(">HHHHHH", buf[:12])
+        if rid != qid:
+            raise ValueError("DNS response id mismatch")
+        if flags & 0x0200:
+            # TC: the SRV RRset exceeded the UDP payload — a silently partial
+            # peer list would bootstrap an undersized world
+            raise ValueError(
+                "truncated DNS response (TC): SRV record set too large for "
+                "UDP; configure fewer/shorter records or a TCP-capable "
+                "registrar (ConsulSeedDiscovery)")
+        rcode = flags & 0x000F
+        if rcode:
+            # SERVFAIL/NXDOMAIN etc must not read as an empty (healthy) seed
+            # list — that bootstraps a single-node world silently
+            raise ValueError(
+                f"DNS SRV query for {self.srv_name!r} failed with rcode "
+                f"{rcode}")
+        off = 12
+        for _ in range(qd):                   # skip the echoed question
+            _, off = self._read_name(buf, off)
+            off += 4
+        out = []
+        for _ in range(an):
+            _, off = self._read_name(buf, off)
+            rtype, _cls, _ttl, rdlen = st.unpack(">HHIH", buf[off:off + 10])
+            off += 10
+            if rtype == self.SRV:
+                prio, weight, port = st.unpack(">HHH", buf[off:off + 6])
+                target, _ = self._read_name(buf, off + 6)
+                out.append((prio, weight, port, target))
+            off += rdlen
+        return out
+
+    def discover(self) -> list[str]:
+        return sorted(f"{target}:{port}"
+                      for _p, _w, port, target in self.query_srv())
+
+
+class ConsulSeedDiscovery(SeedDiscovery):
+    """Registration-based discovery against a Consul-compatible HTTP registry
+    (ref: ConsulClusterSeedDiscovery.scala + ConsulClient.scala:5 — nodes
+    register a service and discover peers from the catalog).
+
+    Liveness: each registration stamps a heartbeat timestamp into the service
+    Meta; ``discover()`` drops entries whose stamp is older than ``stale_s``
+    (the FileRegistrarDiscovery expiry rule — a crashed node must not inflate
+    the resolved world forever). Entries registered by other tooling (no
+    stamp) are kept: their lifecycle belongs to Consul's own health checks.
+    Shard-ownership ``claims`` ride Meta too, so rejoining nodes adopt the
+    incumbent assignment exactly as with the file registrar."""
+
+    def __init__(self, base_url: str, service: str = "filodb",
+                 timeout_s: float = 5.0, stale_s: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.service = service
+        self.timeout_s = timeout_s
+        self.stale_s = stale_s
+
+    def _http(self, method: str, path: str, body: dict | None = None):
+        import urllib.request
+        req = urllib.request.Request(
+            self.base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            raw = r.read()
+        return json.loads(raw) if raw else None
+
+    def register(self, addr: str, claims: dict | None = None) -> None:
+        host, port_s = addr.rsplit(":", 1)
+        self._http("PUT", "/v1/agent/service/register", {
+            "Name": self.service, "ID": f"{self.service}-{addr}",
+            "Address": host, "Port": int(port_s),
+            "Meta": {"filodb_ts": str(time.time()),
+                     "filodb_claims": json.dumps(claims or {})}})
+
+    heartbeat = register     # re-registration refreshes the timestamp
+
+    def deregister(self, addr: str) -> None:
+        self._http("PUT",
+                   f"/v1/agent/service/deregister/{self.service}-{addr}")
+
+    def _live_rows(self):
+        rows = self._http("GET", f"/v1/catalog/service/{self.service}") or []
+        now = time.time()
+        for r in rows:
+            meta = (r.get("ServiceMeta") or r.get("Meta") or {})
+            ts = meta.get("filodb_ts")
+            if ts is not None and now - float(ts) > self.stale_s:
+                continue      # our own dead entry; foreign entries stay
+            yield r, meta
+
+    def discover(self) -> list[str]:
+        out = set()
+        for r, _meta in self._live_rows():
+            host = r.get("ServiceAddress") or r.get("Address")
+            port = r.get("ServicePort")
+            if host and port:
+                out.add(f"{host}:{port}")
+        return sorted(out)
+
+    def claims(self) -> dict[str, dict]:
+        """Live members' shard-ownership claims (FileRegistrar API twin)."""
+        out = {}
+        for r, meta in self._live_rows():
+            host = r.get("ServiceAddress") or r.get("Address")
+            port = r.get("ServicePort")
+            if host and port:
+                try:
+                    out[f"{host}:{port}"] = json.loads(
+                        meta.get("filodb_claims") or "{}")
+                except ValueError:
+                    out[f"{host}:{port}"] = {}
+        return out
+
+
 # --------------------------------------------------------------------------
 # Bootstrap: discovery -> jax.distributed world
 # --------------------------------------------------------------------------

@@ -89,11 +89,11 @@ def dist_aggregate(ts_g, val_g, n_g, gids_g, out_ts, window_ms, a0, a1,
 
 @functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
                                              "window_ms", "interval_ms",
-                                             "S", "C", "Tp"))
+                                             "S", "C", "Tp", "c0", "Ck"))
 def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
                          fn: str, op: str, num_groups: int, mesh: Mesh,
                          window_ms: int, interval_ms: int,
-                         S: int, C: int, Tp: int):
+                         S: int, C: int, Tp: int, c0: int = 0, Ck: int = 0):
     """Fused single-pass map phase on every shard + psum of its partial-state
     layout over the shard axis — the multi-chip twin of
     ``fusedgrid.fused_grid_aggregate`` (ref: AggrOverRangeVectors.scala:62 —
@@ -104,7 +104,8 @@ def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
     Sb = 512 if S % 512 == 0 else S
     call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
                                   S, Sb, C, Tp, num_groups,
-                                  jax.default_backend() != "tpu")
+                                  jax.default_backend() != "tpu",
+                                  c0=c0, Ck=Ck)
 
     def per_shard(val, n, gids, band, ohlo, lo, hi, rel):
         outs = call(val[0].astype(jnp.float32),
@@ -195,25 +196,23 @@ class MeshQueryExecutor:
             Tp = (max(T, 1) + 127) // 128 * 128
             # cached per query shape — repeated [C, Tp] band uploads would
             # dominate on a tunneled device link (same cache as single-chip)
-            band, ohlo, lo, hi, rel = fusedgrid._device_operands(
+            band, ohlo, lo, hi, rel, c0, Ck = fusedgrid._device_operands(
                 C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
                 int(window_ms), base_ts, int(interval_ms))
             with jax.enable_x64(False):
                 out = dist_fused_aggregate(
                     val_g, n_g, gids, band, ohlo, lo, hi, rel,
                     fn, op, G, self.dstore.mesh, int(window_ms),
-                    int(interval_ms), S, C, Tp)
+                    int(interval_ms), S, C, Tp, c0, Ck)
             self.last_path = "fused"
             res = LazyMeshResult(out, num_groups, T)
             return res.resolve() if fetch else res
         # bucket the step count (pad to a multiple of 32, repeating the last
         # step): dist_aggregate jit-compiles per output shape and ad-hoc
         # dashboards would otherwise recompile per query — the same compile-
-        # space bucketing as the in-process path (query/exec.py _pad_steps)
-        T = len(out_ts)
-        Tpad = -(-max(T, 1) // 32) * 32
-        out_eval = (out_ts if Tpad == T else np.concatenate(
-            [out_ts, np.full(Tpad - T, out_ts[-1], np.int64)]))
+        # space bucketing as the in-process path
+        from ..query.exec import _pad_steps
+        out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
         out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_eval),
                              jnp.int64(window_ms), jnp.float64(args[0]),
                              jnp.float64(args[1]), fn, op, G, self.dstore.mesh)

@@ -280,3 +280,116 @@ def test_self_stale_quarantine(tmp_path):
     # discovery rather than re-announcing a dead node
     time.sleep(0.25)
     assert "me:1" not in reg.discover()
+
+
+def test_dns_srv_discovery():
+    """SRV resolution against an in-process fake DNS server whose answers use
+    RFC-1035 compression pointers (the shape real servers emit); ref:
+    DnsSrvClusterSeedDiscovery.scala:12,87."""
+    import socket
+    import struct
+    import threading
+
+    from filodb_tpu.parallel.bootstrap import DnsSrvSeedDiscovery
+
+    srv_name = "_filodb._tcp.example.local"
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+
+    def encode_name(name):
+        out = b""
+        for label in name.split("."):
+            out += bytes([len(label)]) + label.encode()
+        return out + b"\x00"
+
+    def serve_once():
+        data, peer = sock.recvfrom(4096)
+        qid = data[:2]
+        # answers: two SRV records; NAME is a compression pointer to the
+        # question name at offset 12; targets are plain encoded names
+        ans = b""
+        for prio, weight, tport, target in ((10, 5, 9001, "node-b.example.local"),
+                                            (10, 5, 9000, "node-a.example.local")):
+            tgt = encode_name(target)
+            ans += (b"\xc0\x0c" + struct.pack(">HHIH", 33, 1, 60, 6 + len(tgt))
+                    + struct.pack(">HHH", prio, weight, tport) + tgt)
+        resp = (qid + struct.pack(">HHHHH", 0x8180, 1, 2, 0, 0)
+                + encode_name(srv_name) + struct.pack(">HH", 33, 1) + ans)
+        sock.sendto(resp, peer)
+
+    t = threading.Thread(target=serve_once, daemon=True)
+    t.start()
+    try:
+        d = DnsSrvSeedDiscovery(srv_name, resolver=f"127.0.0.1:{port}")
+        assert d.discover() == ["node-a.example.local:9000",
+                                "node-b.example.local:9001"]
+    finally:
+        sock.close()
+
+
+def test_consul_discovery_register_and_catalog():
+    """Register/discover against a Consul-compatible HTTP registry (ref:
+    ConsulClient.scala:5) served by an in-process stub."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from filodb_tpu.parallel.bootstrap import ConsulSeedDiscovery
+
+    services = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            if self.path.startswith("/v1/agent/service/deregister/"):
+                services.pop(self.path.rsplit("/", 1)[-1], None)
+                self.send_response(200)
+                self.end_headers()
+                return
+            body = _json.loads(self.rfile.read(
+                int(self.headers.get("Content-Length", 0))))
+            assert self.path == "/v1/agent/service/register"
+            services[body["ID"]] = body
+            self.send_response(200)
+            self.end_headers()
+
+        def do_GET(self):
+            name = self.path.rsplit("/", 1)[-1]
+            rows = [{"ServiceAddress": s["Address"], "ServicePort": s["Port"],
+                     "ServiceMeta": s.get("Meta", {})}
+                    for s in services.values() if s["Name"] == name]
+            raw = _json.dumps(rows).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_port}"
+        d = ConsulSeedDiscovery(base, service="filodb")
+        assert d.discover() == []
+        d.register("10.0.0.1:9000")
+        d.register("10.0.0.2:9000")
+        assert d.discover() == ["10.0.0.1:9000", "10.0.0.2:9000"]
+        # a second registry user under another service name stays separate
+        other = ConsulSeedDiscovery(base, service="gateway")
+        other.register("10.0.0.3:7000")
+        assert d.discover() == ["10.0.0.1:9000", "10.0.0.2:9000"]
+        # claims ride the registration; a dead node ages out of discovery
+        d.register("10.0.0.1:9000", claims={"prometheus": [0, 1]})
+        assert d.claims()["10.0.0.1:9000"] == {"prometheus": [0, 1]}
+        stale = ConsulSeedDiscovery(base, service="filodb", stale_s=0.0)
+        import time as _t
+        _t.sleep(0.05)
+        assert stale.discover() == []          # every stamped entry expired
+        d.deregister("10.0.0.1:9000")
+        d.deregister("10.0.0.2:9000")
+        assert d.discover() == []
+    finally:
+        httpd.shutdown()

@@ -222,3 +222,96 @@ def test_engine_grid_path_survives_churn_and_compaction():
     g3, g4 = _series_by_host(r3), _series_by_host(r4)
     for h in g3:
         np.testing.assert_array_equal(g3[h], g4[h], err_msg=f"post-compact {h}")
+
+
+def test_fused_tiled_subrange_matches_full():
+    """The column-tiled kernel (active_columns picks a strict sub-range of a
+    128-multiple store) must match direct per-series Prometheus evaluation
+    AND the full-store general path — windows near tile boundaries, counter
+    zero-clamp, and a short-n (churned) row all land in different tiles."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.ops import fusedgrid, rangefns
+    from filodb_tpu.ops.aggregators import present_partials
+
+    S, C = 16, 512
+    NSAMP = 500
+    rng = np.random.default_rng(13)
+    counters = np.cumsum(rng.exponential(5, (S, NSAMP)), axis=1).astype(np.float32)
+    val = np.zeros((S, C), np.float32)
+    val[:, :NSAMP] = counters
+    n = np.full(S, NSAMP, np.int32)
+    n[3] = 220                       # short row: last_cell clamps mid-range
+    ts_full = BASE + np.arange(NSAMP, dtype=np.int64) * IV
+
+    # sub-range: cells ~[290, 420] -> tiles 2..3 of 4 (c0=256, Ck=2)
+    out_ts = np.arange(BASE + 3_000_000, BASE + 4_200_001, 40_000, dtype=np.int64)
+    window = 100_000
+    lo, hi = __import__("filodb_tpu.ops.gridfns", fromlist=["grid_edges"]).grid_edges(
+        out_ts, window, BASE, IV)
+    c0, Ca = fusedgrid.active_columns(C, lo, hi)
+    assert c0 > 0 and Ca < C, (c0, Ca)   # genuinely sub-range
+
+    gids = np.arange(S, dtype=np.int32) % 4
+    parts = fusedgrid.fused_grid_aggregate(
+        "sum", "rate", jnp.asarray(val), jnp.asarray(n), jnp.asarray(gids), 4,
+        out_ts, window, BASE, IV)
+    got = np.asarray(present_partials("sum", parts))[:4]
+
+    # oracle: general searchsorted kernel per series, summed per group
+    ts_rows = np.full((S, C), np.iinfo(np.int64).max, np.int64)
+    for s in range(S):
+        ts_rows[s, :n[s]] = ts_full[:n[s]]
+    mat = np.asarray(rangefns.periodic_samples(
+        jnp.asarray(ts_rows), jnp.asarray(val), jnp.asarray(n),
+        out_ts, window, "rate"))
+    want = np.zeros((4, len(out_ts)))
+    for g in range(4):
+        rows = mat[gids == g]
+        want[g] = np.nansum(np.where(np.isnan(rows), 0, rows), axis=0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-3)
+
+
+def test_active_columns_never_overhangs_store():
+    """For every 128-multiple C and window placement, the chosen block stays
+    inside the store and covers the needed cells (regression: C=640 with
+    cells ~407..530 used to return c0=384, Ca=384 -> c0+Ca=768 > C, clipping
+    the band operand and reading value columns past the store edge)."""
+    from filodb_tpu.ops.fusedgrid import active_columns
+
+    for C in (128, 256, 384, 512, 640, 768, 896, 1024):
+        for first in range(0, C, 37):
+            for width in (1, 40, 130, 300):
+                last = min(C - 1, first + width)
+                lo = np.array([first], np.int64)
+                hi = np.array([last], np.int64)
+                c0, Ca = active_columns(C, lo, hi)
+                assert c0 % Ca == 0, (C, first, width, c0, Ca)
+                assert c0 + Ca <= C, (C, first, width, c0, Ca)
+                assert c0 <= first and c0 + Ca >= min(C, last + 1), \
+                    (C, first, width, c0, Ca)
+
+    # the reviewer's exact counterexample, end-to-end through the kernel
+    import jax.numpy as jnp
+
+    from filodb_tpu.ops import fusedgrid, rangefns
+    from filodb_tpu.ops.aggregators import present_partials
+
+    S, C, NSAMP = 16, 640, 600
+    rng = np.random.default_rng(17)
+    val = np.zeros((S, C), np.float32)
+    val[:, :NSAMP] = np.cumsum(rng.exponential(5, (S, NSAMP)), axis=1)
+    n = np.full(S, NSAMP, np.int32)
+    out_ts = np.arange(BASE + 4_200_000, BASE + 5_300_001, 40_000, dtype=np.int64)
+    window = 100_000
+    parts = fusedgrid.fused_grid_aggregate(
+        "sum", "rate", jnp.asarray(val), jnp.asarray(n),
+        jnp.zeros(S, jnp.int32), 1, out_ts, window, BASE, IV)
+    got = np.asarray(present_partials("sum", parts))[0]
+    ts_rows = np.broadcast_to(BASE + np.arange(C, dtype=np.int64) * IV, (S, C))
+    ts_rows = np.where(np.arange(C) < NSAMP, ts_rows, np.iinfo(np.int64).max)
+    mat = np.asarray(rangefns.periodic_samples(
+        jnp.asarray(ts_rows), jnp.asarray(val), jnp.asarray(n),
+        out_ts, window, "rate"))
+    want = np.nansum(np.where(np.isnan(mat), 0, mat), axis=0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-3)
