@@ -135,8 +135,21 @@ def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
 
 def grid_operands(C: int, out_ts: np.ndarray, window_ms: int, fn: str,
                   base_ts: int, interval_ms: int, dtype=np.float32):
-    """Host-side static operands for _grid_kernel (bands, one-hots, edges)."""
-    out_ts = np.asarray(out_ts)
+    """Device-resident static operands for _grid_kernel (bands, one-hots,
+    edges), cached per query shape: rebuilding AND re-uploading four [C, T]
+    matrices per query costs tens of ms over a tunneled device link —
+    measured 91 ms/dispatch (f64) for a histogram query whose actual device
+    work is sub-millisecond. Same rationale as fusedgrid._device_operands."""
+    key = np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes()
+    return _grid_operands_cached(C, key, int(window_ms), int(base_ts),
+                                 int(interval_ms), np.dtype(dtype).str)
+
+
+@functools.lru_cache(maxsize=32)
+def _grid_operands_cached(C: int, out_ts_key: bytes, window_ms: int,
+                          base_ts: int, interval_ms: int, dtype_str: str):
+    out_ts = np.frombuffer(out_ts_key, np.int64)
+    dtype = np.dtype(dtype_str)
     lo, hi = grid_edges(out_ts, window_ms, base_ts, interval_ms)
     rel = out_ts - base_ts
     assert abs(rel).max() < 2**31 and window_ms < 2**31, "grid range exceeds i32"
@@ -227,6 +240,47 @@ def _grid_hist_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
         return jnp.where((cnt >= 2)[:, :, None], scaled, jnp.nan)
 
     raise ValueError(fn)  # pragma: no cover
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "num_groups"))
+def _fused_hist_quantile_kernel(q, les, val, n, gids, fn, num_groups,
+                                band, band_open, onehot_lo, onehot_hi, lo, hi,
+                                rel_out, window_ms, interval_ms, stale_ms):
+    """ONE device program for histogram_quantile(q, sum by(...) (fn(m[w])))
+    on a grid-aligned histogram shard: per-bucket range function + bucket-wise
+    group sum + Prometheus quantile, fetched with a single sync. Each stage
+    dispatched separately costs a host->device submission round trip (~10ms
+    on a tunneled link, and all dispatches serialize under the shard lock) —
+    fusing them is the difference between 4 round trips per query and one
+    (ref: HistogramQueryBenchmark.scala is the bar; the reference streams
+    bucket rates through one iterator chain for the same reason)."""
+    from . import aggregators
+    hist = _grid_hist_kernel(fn, val, n, band, band_open, onehot_lo,
+                             onehot_hi, lo, hi, rel_out, window_ms,
+                             interval_ms, stale_ms)
+    S, T, B = hist.shape
+    parts = aggregators.partial_aggregate("sum", hist.reshape(S, T * B),
+                                          gids, num_groups)
+    summed = jnp.where(parts["count"] == 0, jnp.nan, parts["sum"])
+    return histogram_quantile(q, les, summed.reshape(num_groups, T, B))
+
+
+def fused_hist_quantile_grid(q: float, les, val, n, gids, num_groups: int,
+                             out_ts: np.ndarray, window_ms: int, fn: str,
+                             base_ts: int, interval_ms: int,
+                             stale_ms: int = 300_000):
+    """Entry for the fused path: builds/caches the grid operands and runs
+    :func:`_fused_hist_quantile_kernel`; returns the [G, T] device array."""
+    C = val.shape[1]
+    dtype = np.float64 if val.dtype == jnp.float64 else np.float32
+    ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
+    return _fused_hist_quantile_kernel(
+        jnp.float64(q), jnp.asarray(les), val, jnp.asarray(n, jnp.int32),
+        jnp.asarray(gids, jnp.int32), fn, num_groups,
+        ops["band"], ops["band_open"], ops["onehot_lo"],
+        ops["onehot_hi"], ops["lo"], ops["hi"], ops["rel_out"],
+        ops["window_ms"], ops["interval_ms"],
+        jnp.int32(min(stale_ms, 2**31 - 1)))
 
 
 def periodic_samples_grid_hist(val, n, out_ts: np.ndarray, window_ms: int, fn: str,

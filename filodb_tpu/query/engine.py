@@ -84,9 +84,88 @@ class QueryEngine:
             res = self._try_mesh(plan)
             if res is not None:
                 return res
+        res = self._try_fused_hist(plan)
+        if res is not None:
+            return res
         self.last_exec_path = "local"
         exec_plan = self.planner.materialize(plan)
         return exec_plan.run(self._ctx())
+
+    def _try_fused_hist(self, plan: L.LogicalPlan) -> QueryResult | None:
+        """histogram_quantile(q, sum by(...) (fn(m[w]))) on a single
+        grid-aligned native-histogram shard runs as ONE device program
+        (ops/gridfns.fused_hist_quantile_grid) — per-bucket rates, bucket-wise
+        group sums, and the quantile never surface as separate dispatches.
+        Anything off-pattern returns None and takes the general ExecPlan path
+        (ref: HistogramQueryBenchmark.scala is the latency bar)."""
+        if not (isinstance(plan, L.ApplyInstantFunction)
+                and plan.function == "histogram_quantile"
+                and isinstance(plan.vectors, L.Aggregate)):
+            return None
+        agg = plan.vectors
+        if agg.operator != "sum" or agg.params:
+            return None
+        inner = agg.vectors
+        if not isinstance(inner, L.PeriodicSeriesWithWindowing):
+            return None
+        from ..ops import gridfns
+        fn, raw = inner.function, inner.series
+        if fn not in gridfns.HIST_GRID_FNS or raw.columns:
+            return None
+        shards = self.memstore.shards_of(self.dataset)
+        if len(shards) != 1:
+            return None
+        sh = shards[0]
+        if sh.store is None or getattr(sh, "bucket_les", None) is None:
+            return None
+        if sh.store.grid_info() is None:
+            return None              # off-grid store: general path outright
+        from .exec import (SelectRawPartitionsExec, SeriesSelection,
+                           _group_ids_for, _pad_steps, _pow2,
+                           check_sample_limit)
+        step = max(inner.step_ms, 1)
+        out_ts = np.arange(inner.start_ms, inner.end_ms + 1, step,
+                           dtype=np.int64)
+        if len(out_ts) == 0:
+            return None
+        q = float(plan.function_args[0])
+        leaf = SelectRawPartitionsExec(
+            shard=sh.shard_num, filters=tuple(raw.filters),
+            start_ms=raw.range_selector.from_ms,
+            end_ms=raw.range_selector.to_ms)
+        ctx = self._ctx()
+        with sh.lock:
+            # rare off-pattern outcomes below (cold data, churn minority)
+            # re-run the leaf on the general path — acceptable on the slow
+            # path; the common aligned case pays it once
+            data = leaf.do_execute(ctx)
+            if (not isinstance(data, SeriesSelection) or data.grid is None
+                    or data.bucket_les is None
+                    or (data.grid_minority is not None
+                        and len(data.grid_minority))):
+                return None          # cold/off-grid/churned: general path
+            out_eval, T = _pad_steps(out_ts)
+            window = inner.window_ms
+            if (max(abs(int(out_ts[0]) - data.grid[0]),
+                    abs(int(out_ts[-1]) - data.grid[0])) + window >= 2**31):
+                return None
+            R = data.val.shape[0]
+            gids, uniq, G = _group_ids_for(data.keys, data.rows, R,
+                                           agg.by, agg.without)
+            if not uniq:
+                self.last_exec_path = "fused-hist"
+                return QueryResult(ResultMatrix(
+                    out_ts, np.zeros((0, len(out_ts))), []))
+            base_ts, interval_ms = data.grid
+            out = gridfns.fused_hist_quantile_grid(
+                q, np.asarray(data.bucket_les, np.float64), data.val, data.n,
+                gids, _pow2(G), out_eval, window, fn,
+                base_ts, interval_ms, stale_ms=ctx.stale_ms)
+        self.last_exec_path = "fused-hist"
+        vals = np.asarray(out)[:G, :T]
+        m = ResultMatrix(out_ts, vals, list(uniq))
+        check_sample_limit(m.num_series, T, self.config.sample_limit)
+        return QueryResult(m)
 
     # -- mesh dispatch (ref: queryengine2/QueryEngine.scala:59-67 — the
     # planner routes every query through per-shard dispatchers; here the
@@ -183,11 +262,8 @@ class QueryEngine:
                                 G, args=(a0, a1), fetch=False)
         self.last_exec_path = f"mesh-{ex.last_path}"
         m = ResultMatrix(out_ts, lazy.resolve(), list(uniq))
-        if m.num_series * len(out_ts) > self.config.sample_limit:
-            from .rangevector import QueryError
-            raise QueryError(
-                f"result too large: {m.num_series} series x {len(out_ts)} "
-                f"steps > sample limit {self.config.sample_limit}")
+        from .exec import check_sample_limit
+        check_sample_limit(m.num_series, len(out_ts), self.config.sample_limit)
         return QueryResult(m)
 
     # -- metadata queries (ref: QueryActor label-values / series paths) -------

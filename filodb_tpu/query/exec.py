@@ -117,6 +117,15 @@ def _gather_rows_padded(ts, val, n, rows: np.ndarray):
     return ts_g, jnp.take(val, rid, axis=0), n_g.astype(jnp.int32), P
 
 
+def check_sample_limit(num_series: int, steps: int, limit: int) -> None:
+    """Shared result-size guard (ref: QueryConfig sample limits) — one
+    definition for the ExecPlan, mesh, and fused-hist result paths."""
+    if num_series * steps > limit:
+        raise QueryError(
+            f"result too large: {num_series} series x {steps} steps "
+            f"> sample limit {limit}")
+
+
 def _pad_steps(out_ts: np.ndarray) -> tuple[np.ndarray, int]:
     """(padded out_ts to a multiple of 32 by repeating the last step, true T).
     Window kernels jit-compile per output shape; padding buckets the compile
@@ -1034,10 +1043,7 @@ class ExecPlan:
     def run(self, ctx: QueryContext) -> QueryResult:
         data = self.execute(ctx)
         m = _as_matrix(data).to_host()
-        if m.num_series * len(m.out_ts) > ctx.sample_limit:
-            raise QueryError(
-                f"result too large: {m.num_series} series x {len(m.out_ts)} steps "
-                f"> sample limit {ctx.sample_limit}")
+        check_sample_limit(m.num_series, len(m.out_ts), ctx.sample_limit)
         return QueryResult(m)
 
     def do_execute(self, ctx):  # pragma: no cover - interface

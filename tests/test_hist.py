@@ -402,3 +402,30 @@ def test_classic_le_quantile_semantics():
         mat([({"le": "1"}, [10.0, 10.0]), ({"le": "+Inf"}, [10.0, 10.0])]),
         None)
     assert np.asarray(out.values).shape == (1, 2)
+
+
+def test_fused_hist_quantile_route_and_parity(hist_engine):
+    """histogram_quantile(q, sum(rate)) takes the single-dispatch fused
+    device program; result matches the general ExecPlan path exactly (same
+    algebra, same partial layout)."""
+    eng, les, data = hist_engine
+    start, end, step = BASE + 600_000, BASE + 900_000, 60_000
+    q = "histogram_quantile(0.9, sum(rate(req_latency[2m])))"
+    r1 = eng.query_range(q, start, end, step)
+    assert eng.last_exec_path == "fused-hist"
+    # grouping by an absent label still routes fused and must equal the
+    # global sum (one group)
+    r2 = eng.query_range(
+        "histogram_quantile(0.9, sum by (__absent__) (rate(req_latency[2m])))",
+        start, end, step)
+    assert eng.last_exec_path == "fused-hist"
+    (_k, _t, v1), = list(r1.matrix.iter_series())
+    (_k, _t, v2), = list(r2.matrix.iter_series())
+    np.testing.assert_allclose(v1, v2, rtol=1e-12, equal_nan=True)
+    # general-path oracle: identical engine with the fused route disabled
+    eng2 = QueryEngine(eng.memstore, eng.dataset)
+    eng2._try_fused_hist = lambda plan: None
+    r3 = eng2.query_range(q, start, end, step)
+    assert eng2.last_exec_path == "local"
+    (_k, _t, v3), = list(r3.matrix.iter_series())
+    np.testing.assert_allclose(v1, v3, rtol=1e-12, equal_nan=True)
