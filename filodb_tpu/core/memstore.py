@@ -85,6 +85,9 @@ class TimeSeriesShard:
         # above remain the source of truth (and the fallback when no
         # toolchain). Mirrored on create/release/recover.
         from . import native as _native
+        # native inserts for NEW series are deferred and batched: one ctypes
+        # call per container instead of one per key (see _flush_native_locked)
+        self._pending_native: list = []
         self._native_ps = (_native.NativePartSet(config.max_series_per_shard)
                            if _native.available() else None)
         # hash each pid was INSERTED under (container-supplied for ingest):
@@ -189,6 +192,7 @@ class TimeSeriesShard:
         i = start
         while i < n_sets:
             if self._native_ps is not None:
+                self._flush_native_locked()   # re-probes must see this batch
                 pids = self._native_ps.resolve_batch(hashes[i:], keys[i:])
             else:
                 g = self._part_key_to_id.get
@@ -211,6 +215,15 @@ class TimeSeriesShard:
                     break          # eviction ran: re-probe the tail
         return n_sets
 
+    def _flush_native_locked(self) -> None:
+        """Land deferred part-key inserts in one native call. Must run
+        before any native probe or removal: within a container, creations are
+        visible through _part_key_to_id; across operations the native table
+        is the source of truth."""
+        if self._pending_native:
+            self._native_ps.insert_batch(self._pending_native)
+            self._pending_native.clear()
+
     def _create_series_locked(self, labels, pk: bytes, ph: int, first_ts,
                               protected) -> int | None:
         """Admit a new series: assign a slot (evicting under pressure), index
@@ -232,7 +245,7 @@ class TimeSeriesShard:
         self._part_key_to_id[pk] = pid
         self._part_key_of_id[pid] = pk
         if self._native_ps is not None:
-            self._native_ps.insert(ph, pk, pid)
+            self._pending_native.append((ph, pk, pid))
             self._pid_hash[pid] = ph
         self.index.add_part_key(pid, labels, start_time=first_ts)
         if self.sink is not None:
@@ -286,6 +299,7 @@ class TimeSeriesShard:
                 del self._part_key_to_id[pk]
                 self._evicted_keys.add(pk)
                 if self._native_ps is not None:
+                    self._flush_native_locked()
                     # remove under the hash it was INSERTED with (see
                     # _pid_hash) — never a recomputed one
                     self._native_ps.remove(int(self._pid_hash[pid]), pk)
@@ -634,11 +648,14 @@ class TimeSeriesShard:
                 recovered_keys.append((pid, pk))
                 self.index.add_part_key(pid, labels, start)
             if self._native_ps is not None and recovered_keys:
-                # one native batch hash instead of a per-key Python FNV loop
+                # one native batch hash + ONE batch insert (per-key ctypes
+                # calls cost ~10us each — material at 100k recovered series)
                 from .native import fnv1a64_batch
                 hashes = fnv1a64_batch([pk for _pid, pk in recovered_keys])
-                for (pid, pk), h in zip(recovered_keys, hashes):
-                    self._native_ps.insert(int(h), pk, pid)
+                self._native_ps.insert_batch(
+                    [(int(h), pk, pid)
+                     for (pid, pk), h in zip(recovered_keys, hashes)])
+                for (pid, _pk), h in zip(recovered_keys, hashes):
                     self._pid_hash[pid] = h
         # 2. chunks -> device store (batched appends, flush order == time order).
         #    Chunks of purged partitions are skipped; for a reused slot, samples

@@ -57,6 +57,9 @@ def _load():
                                      ctypes.c_int64, ctypes.c_void_p]
     lib.fnv1a64_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_int64, ctypes.c_void_p]
+    lib.ps_insert_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_int64]
     lib.sorted_intersect_i32.restype = ctypes.c_int64
     lib.sorted_intersect_i32.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                          ctypes.c_void_p, ctypes.c_int64,
@@ -111,6 +114,20 @@ class NativePartSet:
 
     def insert(self, hash_: int, key: bytes, pid: int) -> None:
         self._lib.ps_insert(self._h, hash_, key, len(key), pid)
+
+    def insert_batch(self, entries: list) -> None:
+        """[(hash, key bytes, pid)] in ONE native call (per-key ctypes
+        costs ~10us; a cold container registers thousands of new series)."""
+        if not entries:
+            return
+        hashes = np.fromiter((e[0] for e in entries), np.uint64,
+                             count=len(entries))
+        blob, offs = _concat_keys([e[1] for e in entries])
+        pids = np.fromiter((e[2] for e in entries), np.int32,
+                           count=len(entries))
+        self._lib.ps_insert_batch(self._h, hashes.ctypes.data, blob,
+                                  offs.ctypes.data, pids.ctypes.data,
+                                  len(entries))
 
     def remove(self, hash_: int, key: bytes) -> bool:
         return bool(self._lib.ps_remove(self._h, hash_, key, len(key)))

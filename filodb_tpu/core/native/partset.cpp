@@ -192,6 +192,18 @@ int32_t ps_remove(void* h, uint64_t hash, const uint8_t* key, uint32_t len) {
     }
 }
 
+// Batch insert: one call for a whole container's worth of new series —
+// per-key ctypes calls cost ~10us each, the dominant term of cold-path
+// registration (TimeSeriesShard.scala:1183's getOrAdd loop is the analog).
+void ps_insert_batch(void* h, const uint64_t* hashes, const uint8_t* keys,
+                     const uint64_t* offs, const int32_t* pids, int64_t n) {
+    PartSet* s = (PartSet*)h;
+    for (int64_t i = 0; i < n; i++) {
+        ps_insert_raw(s, hashes[i], keys + offs[i],
+                      (uint32_t)(offs[i + 1] - offs[i]), pids[i]);
+    }
+}
+
 // Batch probe: keys concatenated, offs[n+1] prefix offsets. out_pids[i] = pid
 // or -1 on miss. Returns miss count.
 int64_t ps_resolve_batch(void* h, const uint64_t* hashes, const uint8_t* keys,

@@ -29,7 +29,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .schemas import Schema, part_key_of, shard_key_of
+from .schemas import Schema, part_key_bytes, part_key_of, shard_key_of
 
 _MAGIC = 0x46545243  # 'FTRC'
 _HDR = struct.Struct("<IHHII")
@@ -197,7 +197,8 @@ class RecordBuilder:
         a fancy-index of the per-set hashes, so add() does no hashing at all
         (ref: BinaryRecords carry their part-key region; RecordBuilder
         sortAndComputeHashes batches the hash work)."""
-        key = tuple(sorted(labels.items()))
+        items = sorted(labels.items())
+        key = tuple(items)
         idx = self._label_key_to_idx.get(key)
         if idx is None:
             cached = self._hash_cache.get(key)
@@ -205,8 +206,10 @@ class RecordBuilder:
                 opts = self.schema.options
                 # [pk, sk, part_hash?, shard_hash?] — hashes filled in by the
                 # first build() and reused across builds (long-lived gateway
-                # builders must not re-hash stable series every flush)
-                cached = [part_key_of(labels, opts),
+                # builders must not re-hash stable series every flush); the
+                # part key derives from the ALREADY-sorted memo items (one
+                # sort per unique series, not three)
+                cached = [part_key_bytes(items, opts.ignore_shard_key_tags),
                           shard_key_of(labels, opts), None, None]
                 self._hash_cache[key] = cached
             idx = len(self._labels)

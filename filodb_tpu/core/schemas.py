@@ -154,17 +154,22 @@ class Schemas:
         return self.by_name[key] if isinstance(key, str) else self.by_id[key]
 
 
+def part_key_bytes(sorted_items, ignore) -> bytes:
+    """Canonical key bytes from PRE-SORTED (k, v) items — the builder hot
+    path sorts once and derives part key, shard key, and its memo key from
+    the same pass (each unique series pays this exactly once per builder)."""
+    # build one str and encode once: ~3x faster than per-item encodes
+    return "\x00".join(f"{k}\x01{v}" for k, v in sorted_items
+                       if k not in ignore).encode()
+
+
 def part_key_of(labels: Mapping[str, str], options: DatasetOptions = DatasetOptions()) -> bytes:
     """Canonical partition-key bytes for a label set (sorted, ignoring configured tags).
 
     Reference: BinaryRecord2 part keys sort their map field so identical label sets
     hash identically (binaryrecord2/RecordBuilder.scala sortAndComputeHashes).
     """
-    ignore = options.ignore_shard_key_tags
-    items = sorted((k, v) for k, v in labels.items() if k not in ignore)
-    # build one str and encode once: ~3x faster than per-item encodes on the
-    # ingest hot path (each unique series pays this exactly once per builder)
-    return "\x00".join(f"{k}\x01{v}" for k, v in items).encode()
+    return part_key_bytes(sorted(labels.items()), options.ignore_shard_key_tags)
 
 
 def shard_key_of(labels: Mapping[str, str], options: DatasetOptions = DatasetOptions()) -> bytes:
@@ -173,5 +178,8 @@ def shard_key_of(labels: Mapping[str, str], options: DatasetOptions = DatasetOpt
     Reference: RecordBuilder.shardKeyHash / doc/sharding.md:27-47 — the shard-key
     hash selects the shard group; the full part-key hash spreads within the group.
     """
-    items = [(k, labels.get(k, "")) for k in options.shard_key_columns]
-    return b"\x00".join(k.encode() + b"\x01" + v.encode() for k, v in items)
+    g = labels.get
+    # one str build + one encode (UTF-8 is context-free: encoding the joined
+    # string equals joining the per-item encodings)
+    return "\x00".join(f"{k}\x01{g(k, '')}"
+                       for k in options.shard_key_columns).encode()

@@ -141,13 +141,27 @@ def grid_operands(C: int, out_ts: np.ndarray, window_ms: int, fn: str,
     measured 91 ms/dispatch (f64) for a histogram query whose actual device
     work is sub-millisecond. Same rationale as fusedgrid._device_operands."""
     key = np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes()
+    dtype = np.dtype(dtype)
+    # bound retained HBM: four [C, T] matrices per entry x 32 entries; large
+    # shapes (long dashboards on f64 stores) stay transient as before the
+    # cache existed (fusedgrid's cache is bounded the same way by its shape
+    # gates)
+    if 4 * C * len(out_ts) * dtype.itemsize > 16 << 20:
+        return _grid_operands_build(C, key, int(window_ms), int(base_ts),
+                                    int(interval_ms), dtype.str)
     return _grid_operands_cached(C, key, int(window_ms), int(base_ts),
-                                 int(interval_ms), np.dtype(dtype).str)
+                                 int(interval_ms), dtype.str)
 
 
 @functools.lru_cache(maxsize=32)
 def _grid_operands_cached(C: int, out_ts_key: bytes, window_ms: int,
                           base_ts: int, interval_ms: int, dtype_str: str):
+    return _grid_operands_build(C, out_ts_key, window_ms, base_ts,
+                                interval_ms, dtype_str)
+
+
+def _grid_operands_build(C: int, out_ts_key: bytes, window_ms: int,
+                         base_ts: int, interval_ms: int, dtype_str: str):
     out_ts = np.frombuffer(out_ts_key, np.int64)
     dtype = np.dtype(dtype_str)
     lo, hi = grid_edges(out_ts, window_ms, base_ts, interval_ms)
