@@ -170,6 +170,14 @@ class FiloHttpServer:
             self._remote_storage(h, m.group(1), m.group(2))
             return
 
+        # cross-node plan dispatch: a peer ships an ExecPlan subtree for a
+        # shard this node owns; partials go back as tagged binary (ref:
+        # PlanDispatcher.scala — the receiving coordinator runs the subtree)
+        m = re.fullmatch(r"/exec/([^/]+)", path)
+        if m and h.command == "POST":
+            self._exec_plan(h, m.group(1))
+            return
+
         if h.command == "POST":
             ln = int(h.headers.get("Content-Length") or 0)
             if ln:
@@ -212,19 +220,25 @@ class FiloHttpServer:
             h._send(200, {"status": "success", "data": matrix_to_prom_json(res)})
             return
 
+        # local=1 marks a peer's metadata fan-out request: answer from local
+        # shards only (stops mutual-recursion between nodes)
+        local_only = bool(q.get("local"))
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/labels", path)
         if m:
             engine = self.engines[m.group(1)]
             h._send(200, {"status": "success",
-                          "data": self._run(engine.label_names, Priority.METADATA)})
+                          "data": self._run(
+                              lambda: engine.label_names(local_only=local_only),
+                              Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/label/([^/]+)/values", path)
         if m:
             engine = self.engines[m.group(1)]
             name = m.group(2)
             h._send(200, {"status": "success",
-                          "data": self._run(lambda: engine.label_values(name),
-                                            Priority.METADATA)})
+                          "data": self._run(
+                              lambda: engine.label_values(name, local_only=local_only),
+                              Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/series", path)
         if m:
@@ -235,17 +249,46 @@ class FiloHttpServer:
 
             def fetch_series():
                 data = []
-                for labels in engine.series(filters, start, end):
+                seen = set()
+                for labels in engine.series(filters, start, end,
+                                            local_only=local_only):
                     d = dict(labels)
                     if "_metric_" in d:
                         d["__name__"] = d.pop("_metric_")
-                    data.append(d)
+                    key = tuple(sorted(d.items()))
+                    if key not in seen:       # peers may re-report takeovers
+                        seen.add(key)
+                        data.append(d)
                 return data
 
             h._send(200, {"status": "success",
                           "data": self._run(fetch_series, Priority.METADATA)})
             return
         h._send(404, {"status": "error", "error": f"unknown path {path}"})
+
+    # -- cross-node plan execution (ref: PlanDispatcher receiving side) -------
+
+    def _exec_plan(self, h, dataset: str) -> None:
+        engine = self.engines.get(dataset)
+        if engine is None:
+            h._send(404, {"status": "error", "error": f"no dataset {dataset}"})
+            return
+        body = h.rfile.read(int(h.headers.get("Content-Length") or 0))
+        from ..query import wire
+
+        # executes on the HTTP handler thread, NOT the scheduler's QUERY lane:
+        # the root query already passed admission control on the caller node
+        # and its worker blocks on this response — queueing subtrees behind
+        # other root queries would deadlock two saturated nodes against each
+        # other (every worker waiting on a peer whose workers all wait back)
+        plan = wire.deserialize_plan(body)
+        data = plan.execute(engine._ctx())
+        payload = wire.serialize_result(data)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)
 
     # -- Prometheus remote storage protocol (snappy + protobuf) ---------------
 

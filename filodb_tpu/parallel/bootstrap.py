@@ -79,17 +79,19 @@ class FileRegistrarDiscovery(SeedDiscovery):
         safe = addr.replace(":", "_").replace("/", "_")
         return os.path.join(self.path, f"{safe}.member")
 
-    def register(self, addr: str, claims: dict | None = None) -> None:
+    def register(self, addr: str, claims: dict | None = None,
+                 http: str | None = None) -> None:
         """Heartbeat, optionally carrying the node's shard ownership claims
-        ({dataset: [shard ids]}). Claims let a (re)joining node adopt the
-        incumbent assignment instead of computing a fresh one — the file
-        registrar's stand-in for the reference's cluster-singleton
-        ShardManager state."""
+        ({dataset: [shard ids]}) and its HTTP endpoint ("host:port").
+        Claims let a (re)joining node adopt the incumbent assignment instead
+        of computing a fresh one; the endpoint lets peers dispatch query
+        subtrees to this node (query/wire.py) when the member address isn't
+        itself the HTTP address."""
         tmp = self._member_file(addr) + ".tmp"
         with self._lock:
             with open(tmp, "w") as f:
                 f.write(json.dumps({"addr": addr, "ts": time.time(),
-                                    "claims": claims or {}}))
+                                    "claims": claims or {}, "http": http}))
             os.replace(tmp, self._member_file(addr))
 
     heartbeat = register     # a re-registration refreshes the timestamp
@@ -113,6 +115,11 @@ class FileRegistrarDiscovery(SeedDiscovery):
     def claims(self) -> dict[str, dict]:
         """Live members' shard-ownership claims: addr -> {dataset: [ids]}."""
         return {m["addr"]: m.get("claims") or {} for m in self._live_entries()}
+
+    def endpoints(self) -> dict[str, str]:
+        """Live members' published HTTP endpoints: addr -> "host:port"."""
+        return {m["addr"]: m["http"] for m in self._live_entries()
+                if m.get("http")}
 
 
 class DnsSrvSeedDiscovery(SeedDiscovery):
@@ -276,13 +283,16 @@ class ConsulSeedDiscovery(SeedDiscovery):
             raw = r.read()
         return json.loads(raw) if raw else None
 
-    def register(self, addr: str, claims: dict | None = None) -> None:
+    def register(self, addr: str, claims: dict | None = None,
+                 http: str | None = None) -> None:
         host, port_s = addr.rsplit(":", 1)
+        meta = {"filodb_ts": str(time.time()),
+                "filodb_claims": json.dumps(claims or {})}
+        if http:
+            meta["filodb_http"] = http
         self._http("PUT", "/v1/agent/service/register", {
             "Name": self.service, "ID": f"{self.service}-{addr}",
-            "Address": host, "Port": int(port_s),
-            "Meta": {"filodb_ts": str(time.time()),
-                     "filodb_claims": json.dumps(claims or {})}})
+            "Address": host, "Port": int(port_s), "Meta": meta})
 
     heartbeat = register     # re-registration refreshes the timestamp
 
@@ -321,6 +331,16 @@ class ConsulSeedDiscovery(SeedDiscovery):
                         meta.get("filodb_claims") or "{}")
                 except ValueError:
                     out[f"{host}:{port}"] = {}
+        return out
+
+    def endpoints(self) -> dict[str, str]:
+        """Live members' published HTTP endpoints (FileRegistrar API twin)."""
+        out = {}
+        for r, meta in self._live_rows():
+            host = r.get("ServiceAddress") or r.get("Address")
+            port = r.get("ServicePort")
+            if host and port and meta.get("filodb_http"):
+                out[f"{host}:{port}"] = meta["filodb_http"]
         return out
 
 
@@ -408,6 +428,9 @@ class MembershipMonitor(threading.Thread):
         # optional provider of this node's shard-ownership claims, published
         # with every heartbeat so late joiners adopt the incumbent assignment
         self.claims_fn = None
+        # this node's HTTP endpoint ("host:port"), published with heartbeats
+        # so peers can dispatch query subtrees here (query/wire.py)
+        self.http_addr: str | None = None
         # fired when OUR OWN heartbeat gap exceeded stale_s — peers have
         # declared us dead and reassigned our shards, so we must fail-stop
         # (the Akka quarantine analog: a removed-but-alive node restarts)
@@ -427,10 +450,7 @@ class MembershipMonitor(threading.Thread):
             self._stop_ev.set()
             self.on_self_stale()
             return
-        if self.claims_fn is not None:
-            self.registrar.heartbeat(self.self_addr, self.claims_fn())
-        else:
-            self.registrar.heartbeat(self.self_addr)
+        self._beat()
         self._last_beat = now
         live = set(self.registrar.discover())
         for gone in sorted(self._known - live - {self.self_addr}):
@@ -440,15 +460,28 @@ class MembershipMonitor(threading.Thread):
                 self.on_up(fresh)
         self._known = live
 
+    def _beat(self) -> None:
+        claims = self.claims_fn() if self.claims_fn is not None else None
+        try:
+            self.registrar.heartbeat(self.self_addr, claims,
+                                     http=self.http_addr)
+            return
+        except TypeError:
+            pass     # custom registrar predating endpoint/claims publication
+        if claims is not None:
+            try:
+                self.registrar.heartbeat(self.self_addr, claims)
+                return
+            except TypeError:
+                pass
+        self.registrar.heartbeat(self.self_addr)
+
     def publish_now(self) -> None:
         """Push a fresh heartbeat (with current claims) immediately — called
         on assignment changes so joiners reading the registrar see takeover
         state without waiting out the heartbeat interval."""
         try:
-            if self.claims_fn is not None:
-                self.registrar.heartbeat(self.self_addr, self.claims_fn())
-            else:
-                self.registrar.heartbeat(self.self_addr)
+            self._beat()
         except Exception:
             log.exception("claim publish failed")
 

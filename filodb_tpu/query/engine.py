@@ -34,6 +34,38 @@ MESH_OPS = frozenset({"sum", "avg", "count", "group", "stddev", "stdvar",
 _EXCLUDED_GID = 1 << 30
 
 
+def _sel_quote(v: str) -> str:
+    """PromQL double-quoted string: backslashes and quotes escape, so label
+    values containing either round-trip through the peer's parser instead of
+    silently failing the whole fan-out."""
+    return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _filters_to_selector(filters) -> str:
+    """Render column filters back into a PromQL selector string for peer
+    metadata fan-out (the inverse of http/api._selector_to_filters)."""
+    import re as _re
+
+    from ..core import filters as F
+    parts = []
+    for f in filters:
+        label = "__name__" if f.label == "_metric_" else f.label
+        if isinstance(f, F.Equals):
+            parts.append(f'{label}={_sel_quote(f.value)}')
+        elif isinstance(f, F.NotEquals):
+            parts.append(f'{label}!={_sel_quote(f.value)}')
+        elif isinstance(f, F.EqualsRegex):
+            parts.append(f'{label}=~{_sel_quote(f.pattern)}')
+        elif isinstance(f, F.NotEqualsRegex):
+            parts.append(f'{label}!~{_sel_quote(f.pattern)}')
+        elif isinstance(f, F.In):
+            # literal alternation: each member regex-escaped (an In value
+            # like "1.5" must not match "125")
+            alt = "|".join(_re.escape(v) for v in f.values)
+            parts.append(f'{label}=~{_sel_quote(alt)}')
+    return "{" + ",".join(parts) + "}"
+
+
 @dataclass
 class QueryConfig:
     """Ref: query/.../QueryConfig.scala (stale-sample-after, sample limits)."""
@@ -44,7 +76,15 @@ class QueryConfig:
 class QueryEngine:
     def __init__(self, memstore: TimeSeriesMemStore, dataset: str,
                  shard_mapper: ShardMapper | None = None,
-                 config: QueryConfig = QueryConfig(), mesh=None):
+                 config: QueryConfig = QueryConfig(), mesh=None,
+                 cluster=None, node: str | None = None,
+                 endpoint_resolver=None):
+        """``cluster``/``node``: the ShardManager's shard->node view and this
+        node's name — leaves for peer-owned shards dispatch remotely
+        (query/wire.py RemoteLeafExec; ref: PlanDispatcher.scala).
+        ``endpoint_resolver(node) -> "host:port" | None`` maps a node name to
+        its HTTP endpoint (registrar-published); None falls back to treating
+        the node name itself as host:port."""
         self.memstore = memstore
         self.dataset = dataset
         num_shards = max(len(memstore.shards_of(dataset)), 1)
@@ -56,12 +96,36 @@ class QueryEngine:
         # jax.sharding.Mesh with one device per shard: aggregate queries
         # execute via shard_map + psum instead of the host scatter-gather
         self.mesh = mesh
+        self.cluster = cluster
+        self.node = node
+        self.endpoint_resolver = endpoint_resolver
         # route taken by the last query:
         # "mesh-fused" | "mesh-twostep" | "mesh-empty" | "local"
         self.last_exec_path: str | None = None
         schema = memstore._dataset_schema.get(dataset)
         opts = schema.options if schema else None
-        self.planner = QueryPlanner(self.mapper, opts) if opts else QueryPlanner(self.mapper)
+        route = self._route_endpoint if cluster is not None else None
+        kw = dict(route_fn=route, dataset=dataset)
+        self.planner = (QueryPlanner(self.mapper, opts, **kw) if opts
+                        else QueryPlanner(self.mapper, **kw))
+
+    def _route_endpoint(self, shard: int) -> str | None:
+        """HTTP endpoint of the peer owning ``shard``, or None when this node
+        serves it locally (ref: queryengine2/QueryEngine.scala:506 —
+        co-locate each leaf with its shard's node)."""
+        if self.cluster is None or self.node is None:
+            return None
+        try:
+            owner = self.cluster.node_of(self.dataset, shard)
+        except KeyError:
+            return None
+        if owner is None or owner == self.node:
+            return None
+        if self.endpoint_resolver is not None:
+            ep = self.endpoint_resolver(owner)
+            if ep:
+                return ep
+        return owner
 
     def _ctx(self) -> QueryContext:
         return QueryContext(self.memstore, self.dataset,
@@ -113,7 +177,7 @@ class QueryEngine:
         if fn not in gridfns.HIST_GRID_FNS or raw.columns:
             return None
         shards = self.memstore.shards_of(self.dataset)
-        if len(shards) != 1:
+        if len(shards) != 1 or self._has_remote_shards():
             return None
         sh = shards[0]
         if sh.store is None or getattr(sh, "bucket_les", None) is None:
@@ -266,28 +330,92 @@ class QueryEngine:
         check_sample_limit(m.num_series, len(out_ts), self.config.sample_limit)
         return QueryResult(m)
 
+    # -- cross-node helpers ---------------------------------------------------
+
+    def _has_remote_shards(self) -> bool:
+        if self.cluster is None or self.node is None:
+            return False
+        return any(self._route_endpoint(s) is not None
+                   for s in self.mapper.all_shards())
+
+    def _peer_endpoints(self) -> list[str]:
+        """Distinct HTTP endpoints of peers owning shards of this dataset."""
+        eps: dict[str, None] = {}
+        for s in self.mapper.all_shards():
+            ep = self._route_endpoint(s)
+            if ep is not None:
+                eps.setdefault(ep)
+        return list(eps)
+
+    def _peer_metadata(self, path: str) -> list:
+        """Fan a metadata request out to all peers concurrently (local=1
+        stops recursion); an unreachable peer is skipped — its shards are
+        mid-reassignment and metadata is best-effort (ref: the coordinator's
+        metadata scatter). Concurrent fan-out bounds latency to the slowest
+        single peer rather than the sum of timeouts."""
+        import json as _json
+        import logging
+        import urllib.request
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(ep: str) -> list:
+            sep = "&" if "?" in path else "?"
+            url = f"http://{ep}/promql/{self.dataset}{path}{sep}local=1"
+            try:
+                with urllib.request.urlopen(url, timeout=10.0) as r:
+                    return _json.load(r).get("data") or []
+            except Exception:  # noqa: BLE001
+                logging.getLogger("filodb_tpu.query").warning(
+                    "metadata fan-out to peer %s failed; partial result", ep)
+                return []
+
+        eps = self._peer_endpoints()
+        if not eps:
+            return []
+        if len(eps) == 1:
+            return fetch(eps[0])
+        with ThreadPoolExecutor(max_workers=min(len(eps), 16)) as pool:
+            return [v for chunk in pool.map(fetch, eps) for v in chunk]
+
     # -- metadata queries (ref: QueryActor label-values / series paths) -------
 
-    def label_values(self, label: str, filters=None, top_k=None) -> list[str]:
+    def label_values(self, label: str, filters=None, top_k=None,
+                     local_only: bool = False) -> list[str]:
         vals: dict[str, None] = {}
         for shard in self.memstore.shards_of(self.dataset):
             for v in shard.label_values(label, filters, top_k=top_k):
                 vals[v] = None
+        if not local_only and filters is None:
+            for v in self._peer_metadata(f"/api/v1/label/{label}/values"):
+                vals[v] = None
         return sorted(vals)
 
-    def label_names(self, filters=None) -> list[str]:
+    def label_names(self, filters=None, local_only: bool = False) -> list[str]:
         names: set[str] = set()
         for shard in self.memstore.shards_of(self.dataset):
             names.update(shard.label_names(filters))
+        if not local_only and filters is None:
+            names.update(self._peer_metadata("/api/v1/labels"))
         return sorted(names)
 
-    def series(self, filters, start_ms: int, end_ms: int) -> list[dict[str, str]]:
+    def series(self, filters, start_ms: int, end_ms: int,
+               local_only: bool = False) -> list[dict[str, str]]:
         out = []
         for shard in self.memstore.shards_of(self.dataset):
             # ids and labels under one lock: a concurrent purge reuses slots
             with shard.lock:
                 pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
                 out.extend(shard.index.labels_of(int(p)) for p in pids)
+        if not local_only and self._has_remote_shards():
+            from urllib.parse import quote
+            sel = _filters_to_selector(filters)
+            path = (f"/api/v1/series?match[]={quote(sel)}"
+                    f"&start={start_ms / 1000.0}&end={end_ms / 1000.0}")
+            for d in self._peer_metadata(path):
+                if "__name__" in d:
+                    d = dict(d)
+                    d["_metric_"] = d.pop("__name__")
+                out.append(d)
         return out
 
     def raw_series(self, filters, start_ms: int, end_ms: int):

@@ -1260,17 +1260,33 @@ class SelectRawPartitionsExec(ExecPlan):
                                g_min, narrow)
 
 
+def _execute_children(children, ctx):
+    """Execute child plans, fanning remote leaves out concurrently: peer
+    round-trips overlap each other AND the local shards' device work (ref:
+    NonLeafExecPlan dispatches children as parallel Observables). Local
+    children stay on the calling thread — shard locks already serialize
+    device-buffer capture."""
+    remote = [c for c in children if getattr(c, "IS_REMOTE", False)]
+    if len(remote) < 1 or len(children) == 1:
+        return [c.execute(ctx) for c in children]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(len(remote), 16)) as pool:
+        futs = {id(c): pool.submit(c.execute, ctx) for c in remote}
+        return [futs[id(c)].result() if id(c) in futs else c.execute(ctx)
+                for c in children]
+
+
 @dataclass
 class DistConcatExec(ExecPlan):
     """Concatenate child results (ref: DistConcatExec.scala — shard fan-in)."""
     children: list = field(default_factory=list)
 
     def do_execute(self, ctx):
-        mats = [_as_matrix(c.execute(ctx)).to_host() for c in self.children]
-        mats = [m for m in mats if m.num_series]
+        all_mats = [_as_matrix(r).to_host()
+                    for r in _execute_children(self.children, ctx)]
+        mats = [m for m in all_mats if m.num_series]
         if not mats:
-            first = self.children[0].execute(ctx)
-            return _as_matrix(first)
+            return all_mats[0]
         out_ts = mats[0].out_ts
         vals = np.concatenate([np.asarray(m.values) for m in mats], axis=0)
         keys = [k for m in mats for k in m.keys]
@@ -1291,7 +1307,7 @@ class ReduceAggregateExec(ExecPlan):
     children: list = field(default_factory=list)
 
     def do_execute(self, ctx):
-        results = [c.execute(ctx) for c in self.children]
+        results = _execute_children(self.children, ctx)
         # the per-shard group cap is data-dependent, so a sibling shard may
         # have fallen back to a full matrix: normalization happens inside
         # (the matrix has full information; the reverse is impossible)

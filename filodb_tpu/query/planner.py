@@ -27,9 +27,20 @@ _SET_OPS = {"and", "or", "unless"}
 
 class QueryPlanner:
     def __init__(self, shard_mapper: ShardMapper | None = None,
-                 options: DatasetOptions = DatasetOptions()):
+                 options: DatasetOptions = DatasetOptions(),
+                 route_fn=None, dataset: str = "",
+                 remote_timeout_s: float = 30.0):
+        """``route_fn(shard) -> "host:port" | None``: the HTTP endpoint of the
+        peer owning a non-local shard, or None for locally-served shards.
+        Leaves for peer-owned shards materialize as RemoteLeafExec — the
+        subplan ships to the owner and only partials come back (ref:
+        queryengine2/QueryEngine.scala:506 picks the shard-owning node's
+        dispatcher for every leaf)."""
         self.mapper = shard_mapper or ShardMapper(1)
         self.options = options
+        self.route_fn = route_fn
+        self.dataset = dataset
+        self.remote_timeout_s = remote_timeout_s
 
     # -- shard selection (ref: QueryEngine.shardsFromFilters :181-222) -------
 
@@ -46,13 +57,24 @@ class QueryPlanner:
     def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
         return self._walk(plan)
 
+    def _route(self, leaf: ExecPlan) -> ExecPlan:
+        """Wrap a leaf for a peer-owned shard in a RemoteLeafExec; later
+        transformer push-downs land on the wrapper and ship as the plan's
+        wire prefix (query/wire.py)."""
+        ep = self.route_fn(leaf.shard) if self.route_fn else None
+        if ep is None:
+            return leaf
+        from .wire import RemoteLeafExec
+        return RemoteLeafExec(endpoint=ep, dataset=self.dataset, inner=leaf,
+                              timeout_s=self.remote_timeout_s)
+
     def _leaves(self, raw: L.RawSeries, psm: PeriodicSamplesMapper) -> list[ExecPlan]:
         shards = self.shards_for_filters(raw.filters)
         return [
-            SelectRawPartitionsExec(
+            self._route(SelectRawPartitionsExec(
                 transformers=[psm], shard=s, filters=tuple(raw.filters),
                 start_ms=raw.range_selector.from_ms, end_ms=raw.range_selector.to_ms,
-                column=raw.columns[0] if raw.columns else "")
+                column=raw.columns[0] if raw.columns else ""))
             for s in shards
         ]
 
@@ -118,10 +140,10 @@ class QueryPlanner:
             return self._walk(p.scalar)
         if isinstance(p, L.RawChunkMeta):
             shards = self.shards_for_filters(list(p.filters))
-            children = [SelectChunkInfosExec(
+            children = [self._route(SelectChunkInfosExec(
                 shard=s, filters=tuple(p.filters),
                 start_ms=p.range_selector.from_ms,
-                end_ms=p.range_selector.to_ms, column=p.column) for s in shards]
+                end_ms=p.range_selector.to_ms, column=p.column)) for s in shards]
             return self._fan_in(children)
         raise QueryError(f"cannot materialize {type(p).__name__}")
 

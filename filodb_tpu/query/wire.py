@@ -1,0 +1,320 @@
+"""Cross-node query dispatch: ExecPlan wire codec + RemoteLeafExec.
+
+Reference: query/.../exec/PlanDispatcher.scala (ActorPlanDispatcher ships an
+ExecPlan subtree to the node owning its shard), ExecPlan.scala
+``NonLeafExecPlan.dispatchRemotePlan`` (children dispatch remotely, partials
+reduce on the caller), and the Kryo result serialization the reference uses
+for cross-node results (SerializableRangeVector). The co-location decision
+(pick the dispatcher of the shard-owning node) matches
+coordinator/.../queryengine2/QueryEngine.scala:506.
+
+Design here: plans are SMALL — a leaf selector plus its pushed-down
+transformer chain — so they travel as a whitelisted JSON envelope (never
+pickle: a query peer must not be a remote-code-execution vector). Results
+are BIG, so they travel as tagged binary: raw little-endian arrays with a
+tiny JSON header. The map phase (PeriodicSamplesMapper + AggregateMapReduce)
+executes on the data-owning node; only per-group partial state
+(AggPartial / TopKPartial / SketchPartial / CountValuesPartial) or the final
+matrix crosses the wire — the same partial formats the in-process reduce
+already merges heterogeneously (exec.py:_merge_heterogeneous).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, fields, replace
+
+import numpy as np
+
+from ..core import filters as F
+from .exec import (AggPartial, AggregateMapReduce, AggregatePresenter,
+                   CountValuesPartial, ExecPlan, InstantVectorFunctionMapper,
+                   MatrixView, MiscellaneousFunctionMapper,
+                   PeriodicSamplesMapper, ScalarOperationMapper,
+                   SelectChunkInfosExec, SelectRawPartitionsExec,
+                   SketchPartial, SortFunctionMapper, TopKPartial, _as_matrix)
+from .rangevector import (QueryError, RangeVectorKey, ResultMatrix,
+                          deserialize_matrix, serialize_matrix)
+
+# -- plan envelope (JSON, whitelisted types) ---------------------------------
+
+_LEAF_TYPES = {c.__name__: c for c in
+               (SelectRawPartitionsExec, SelectChunkInfosExec)}
+_TRANSFORMER_TYPES = {c.__name__: c for c in
+                      (PeriodicSamplesMapper, InstantVectorFunctionMapper,
+                       ScalarOperationMapper, AggregateMapReduce,
+                       AggregatePresenter, SortFunctionMapper,
+                       MiscellaneousFunctionMapper)}
+_FILTER_TYPES = {c.__name__: c for c in
+                 (F.Equals, F.NotEquals, F.In, F.EqualsRegex, F.NotEqualsRegex)}
+
+_SCALARS = (bool, int, float, str, type(None))
+
+
+class NotWireable(Exception):
+    """A plan/transformer holds state that cannot ship (e.g. a
+    ScalarOperationMapper whose operand is a materialized subplan)."""
+
+
+def _enc_val(v):
+    if isinstance(v, _SCALARS):
+        return v
+    if isinstance(v, (tuple, list)):
+        if all(isinstance(x, _SCALARS) for x in v):
+            return list(v)
+    raise NotWireable(f"field value {v!r} not wire-encodable")
+
+
+def _enc_filters(fs) -> list:
+    out = []
+    for f in fs:
+        name = type(f).__name__
+        if name not in _FILTER_TYPES:
+            raise NotWireable(f"filter {name} not wire-encodable")
+        out.append([name] + [_enc_val(getattr(f, fl.name))
+                             for fl in fields(f)])
+    return out
+
+
+def _dec_filters(rows) -> tuple:
+    out = []
+    for row in rows:
+        cls = _FILTER_TYPES[row[0]]
+        args = [tuple(a) if isinstance(a, list) else a for a in row[1:]]
+        out.append(cls(*args))
+    return tuple(out)
+
+
+def _enc_transformer(t) -> dict:
+    name = type(t).__name__
+    if name not in _TRANSFORMER_TYPES:
+        raise NotWireable(f"transformer {name} not wire-encodable")
+    d = {"t": name}
+    for fl in fields(t):
+        d[fl.name] = _enc_val(getattr(t, fl.name))
+    return d
+
+
+def _dec_transformer(d: dict):
+    cls = _TRANSFORMER_TYPES[d["t"]]
+    kw = {}
+    for fl in fields(cls):
+        if fl.name not in d:
+            continue
+        v = d[fl.name]
+        kw[fl.name] = tuple(v) if isinstance(v, list) else v
+    return cls(**kw)
+
+
+def is_wire_transformer(t) -> bool:
+    try:
+        _enc_transformer(t)
+        return True
+    except NotWireable:
+        return False
+
+
+def serialize_plan(plan: ExecPlan) -> bytes:
+    name = type(plan).__name__
+    if name not in _LEAF_TYPES:
+        raise NotWireable(f"plan {name} not wire-encodable")
+    d = {"t": name,
+         "transformers": [_enc_transformer(t) for t in plan.transformers],
+         "filters": _enc_filters(plan.filters)}
+    for fl in fields(plan):
+        if fl.name in ("transformers", "filters"):
+            continue
+        d[fl.name] = _enc_val(getattr(plan, fl.name))
+    return json.dumps(d, separators=(",", ":")).encode()
+
+
+def deserialize_plan(buf: bytes) -> ExecPlan:
+    try:
+        d = json.loads(buf)
+        cls = _LEAF_TYPES[d.pop("t")]
+        kw = {"transformers": [_dec_transformer(t)
+                               for t in d.pop("transformers", [])],
+              "filters": _dec_filters(d.pop("filters", []))}
+        for fl in fields(cls):
+            if fl.name in d:
+                v = d[fl.name]
+                kw[fl.name] = tuple(v) if isinstance(v, list) else v
+        return cls(**kw)
+    except (KeyError, TypeError, ValueError) as e:
+        raise QueryError(f"malformed remote exec plan: {e}") from None
+
+
+# -- result codec (tagged binary) --------------------------------------------
+#
+# layout: 1-byte tag + u32 meta_len + meta JSON + concatenated raw arrays.
+# meta["arrays"] lists [dtype, shape] per array in payload order.
+
+def _pack(tag: bytes, meta: dict, arrays: list[np.ndarray]) -> bytes:
+    meta = dict(meta)
+    meta["arrays"] = [[a.dtype.str, list(a.shape)] for a in arrays]
+    mb = json.dumps(meta, separators=(",", ":")).encode()
+    parts = [tag, struct.pack("<I", len(mb)), mb]
+    parts += [np.ascontiguousarray(a).tobytes() for a in arrays]
+    return b"".join(parts)
+
+
+def _unpack(buf: bytes) -> tuple[bytes, dict, list[np.ndarray]]:
+    tag = buf[:1]
+    (mlen,) = struct.unpack_from("<I", buf, 1)
+    meta = json.loads(buf[5:5 + mlen])
+    off = 5 + mlen
+    arrays = []
+    for dtype, shape in meta["arrays"]:
+        n = int(np.prod(shape)) if shape else 1
+        a = np.frombuffer(buf, np.dtype(dtype), n, off).reshape(shape).copy()
+        arrays.append(a)
+        off += a.nbytes
+    return tag, meta, arrays
+
+
+def _enc_keys(keys) -> list:
+    return [list(map(list, k.labels)) for k in keys]
+
+
+def _dec_keys(rows) -> list[RangeVectorKey]:
+    return [RangeVectorKey(tuple((a, b) for a, b in k)) for k in rows]
+
+
+def _resolved_parts(parts) -> dict[str, np.ndarray]:
+    """AggPartial.parts may be a lazy on-device bundle (fused path with
+    fetch=False): resolve to host numpy before hitting the wire."""
+    import jax
+    if hasattr(parts, "parts_of"):
+        parts = parts.parts_of(jax.device_get(parts._outs))
+    return {k: np.asarray(v) for k, v in parts.items()}
+
+
+def serialize_result(data) -> bytes:
+    if isinstance(data, MatrixView):
+        data = data.compact()
+    if isinstance(data, AggPartial):
+        parts = _resolved_parts(data.parts)
+        names = sorted(parts)
+        meta = {"op": data.op, "names": names, "num_groups": data.num_groups,
+                "group_keys": _enc_keys(data.group_keys),
+                "has_les": data.bucket_les is not None}
+        arrays = [np.asarray(data.out_ts, "<i8")]
+        if data.bucket_les is not None:
+            arrays.append(np.asarray(data.bucket_les, "<f8"))
+        arrays += [np.asarray(parts[n], "<f8") for n in names]
+        return _pack(b"A", meta, arrays)
+    if isinstance(data, TopKPartial):
+        meta = {"k": data.k, "bottom": data.bottom,
+                "group_keys": _enc_keys(data.group_keys),
+                "key_table": _enc_keys(data.key_table)}
+        return _pack(b"T", meta, [np.asarray(data.out_ts, "<i8"),
+                                  np.asarray(data.values, "<f8"),
+                                  np.asarray(data.key_ref, "<i8")])
+    if isinstance(data, SketchPartial):
+        meta = {"q": data.q, "group_keys": _enc_keys(data.group_keys)}
+        return _pack(b"S", meta, [np.asarray(data.out_ts, "<i8"),
+                                  np.asarray(data.counts, "<f4")])
+    if isinstance(data, CountValuesPartial):
+        items = sorted(data.entries.items())
+        meta = {"label": data.label, "group_keys": _enc_keys(data.group_keys),
+                "entries": [[gi, vstr] for (gi, vstr), _ in items]}
+        rows = (np.stack([np.asarray(r, np.float64) for _, r in items])
+                if items else np.zeros((0, len(data.out_ts))))
+        return _pack(b"C", meta, [np.asarray(data.out_ts, "<i8"),
+                                  np.asarray(rows, "<f8")])
+    m = _as_matrix(data)
+    return b"M" + serialize_matrix(m)
+
+
+def deserialize_result(buf: bytes):
+    tag = buf[:1]
+    if tag == b"M":
+        return deserialize_matrix(buf[1:])
+    tag, meta, arrays = _unpack(buf)
+    if tag == b"A":
+        out_ts = arrays[0]
+        i = 1
+        les = None
+        if meta["has_les"]:
+            les = arrays[i]
+            i += 1
+        parts = dict(zip(meta["names"], arrays[i:]))
+        return AggPartial(meta["op"], out_ts, parts,
+                          _dec_keys(meta["group_keys"]), meta["num_groups"],
+                          les)
+    if tag == b"T":
+        out_ts, values, key_ref = arrays
+        return TopKPartial(meta["k"], meta["bottom"], out_ts,
+                           _dec_keys(meta["group_keys"]), values, key_ref,
+                           _dec_keys(meta["key_table"]))
+    if tag == b"S":
+        out_ts, counts = arrays
+        return SketchPartial(meta["q"], out_ts,
+                             _dec_keys(meta["group_keys"]), counts)
+    if tag == b"C":
+        out_ts, rows = arrays
+        entries = {(gi, vstr): rows[i]
+                   for i, (gi, vstr) in enumerate(meta["entries"])}
+        return CountValuesPartial(meta["label"], out_ts,
+                                  _dec_keys(meta["group_keys"]), entries)
+    raise QueryError(f"unknown remote result tag {tag!r}")
+
+
+# -- the remote leaf ---------------------------------------------------------
+
+@dataclass
+class RemoteLeafExec(ExecPlan):
+    """A leaf whose shard lives on a peer node: ship the subplan (selector +
+    the wire-able prefix of the transformer chain, including a pushed-down
+    AggregateMapReduce) to the owner's ``/exec`` endpoint and return the
+    deserialized partial/matrix. Transformers that cannot ship (rare:
+    a scalar-operand subplan) apply locally to the returned matrix — the
+    chain order is preserved because only a suffix stays local.
+
+    Ref: PlanDispatcher.scala ActorPlanDispatcher.dispatch + ExecPlan.scala
+    ``dispatchRemotePlan``; the owner-node pick is the planner's
+    (queryengine2/QueryEngine.scala:506 analog in planner.py)."""
+    endpoint: str = ""           # peer "host:port" of its HTTP API
+    dataset: str = ""
+    inner: ExecPlan = None
+    timeout_s: float = 30.0
+
+    IS_REMOTE = True             # non-leaf parents fan these out in threads
+
+    def execute(self, ctx):
+        ship, local = [], []
+        for t in self.transformers:
+            (ship if not local and is_wire_transformer(t) else local).append(t)
+        plan = replace(self.inner,
+                       transformers=list(self.inner.transformers) + ship)
+        body = serialize_plan(plan)
+        url = f"http://{self.endpoint}/exec/{self.dataset}"
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise QueryError(
+                f"remote exec on {self.endpoint} for shard "
+                f"{getattr(self.inner, 'shard', '?')} failed: {msg}") from None
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise QueryError(
+                f"peer {self.endpoint} unreachable for shard "
+                f"{getattr(self.inner, 'shard', '?')}: {e}; the query is "
+                "retryable once shards reassign") from None
+        data = deserialize_result(payload)
+        for t in local:
+            data = t.apply(data, ctx)
+        return data
+
+    def do_execute(self, ctx):  # pragma: no cover — execute() is overridden
+        raise NotImplementedError

@@ -163,6 +163,8 @@ class FiloServer:
         self._ds_res: list[int] = []
         self._cascade_stop = None
         self._cascade_wm: dict[int, int] = {}
+        self._endpoints: dict[str, str] = {}
+        self._endpoints_at = 0.0
 
     def _start_shard(self, dataset: str, shard_num: int) -> None:
         """Bring up one owned shard: store + (optionally) its bus consumer
@@ -230,6 +232,21 @@ class FiloServer:
             c.start()
         else:
             self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
+
+    def _resolve_endpoint(self, node: str) -> str | None:
+        """HTTP endpoint of a peer node, from registrar heartbeats (each node
+        publishes its own with MembershipMonitor.http_addr). A short TTL cache
+        keeps per-query registrar reads off the query path."""
+        if self._registrar is None or not hasattr(self._registrar, "endpoints"):
+            return None
+        now = time.monotonic()
+        if now - self._endpoints_at > 1.0:
+            try:
+                self._endpoints = self._registrar.endpoints()
+                self._endpoints_at = now
+            except Exception:
+                log.exception("registrar endpoint read failed")
+        return self._endpoints.get(node)
 
     def _quarantine(self) -> None:
         """Our heartbeat lapsed past stale_after: peers have declared us dead
@@ -359,8 +376,12 @@ class FiloServer:
                 mesh = make_mesh(devs)
         except Exception:
             mesh = None
-        self.engines[dataset] = QueryEngine(self.memstore, dataset, mapper,
-                                            cfg.query_config(), mesh=mesh)
+        # cluster + endpoint resolver: leaves for peer-owned shards dispatch
+        # over HTTP /exec (query/wire.py RemoteLeafExec) instead of erroring
+        self.engines[dataset] = QueryEngine(
+            self.memstore, dataset, mapper, cfg.query_config(), mesh=mesh,
+            cluster=self.manager, node=self.node,
+            endpoint_resolver=self._resolve_endpoint)
 
         # remote-write sink: durable bus publish when configured, else direct
         # ingest. The whole batch is validated against owned shards BEFORE
@@ -400,6 +421,17 @@ class FiloServer:
             self.membership.claims_fn = lambda: {
                 ds: [int(s) for s in self.manager.shards_of_node(ds, self.node)]
                 for ds in list(self.engines)}
+            # publish OUR http endpoint so peers can dispatch plan subtrees
+            # here; the bound port is authoritative (config may say port 0).
+            # A wildcard bind address is not dialable by peers: advertise the
+            # cluster self_addr's host instead (or the explicit
+            # http.advertise override for NAT/multi-homed hosts)
+            adv = cfg.get("http.advertise")
+            if not adv:
+                adv = cfg["http.host"]
+                if adv in ("0.0.0.0", "::", ""):
+                    adv = self.node.rsplit(":", 1)[0]
+            self.membership.http_addr = f"{adv}:{self.http.port}"
             self.membership.poll_once()
             self.membership.start()
         if self._ds_publish is not None and len(self._ds_res) > 1:

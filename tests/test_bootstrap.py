@@ -220,6 +220,40 @@ def test_two_node_elastic_recovery(tmp_path):
         assert {a.manager.node_of("prometheus", s) for s in (0, 1)} == \
             {"node-a:1", "node-b:1"}
         b_shard = a.manager.shards_of_node("prometheus", "node-b:1")[0]
+        # STEADY-STATE spanning query: both nodes alive, each owning one
+        # shard — a query issued to EITHER node must see both shards' data
+        # via cross-node dispatch (query/wire.py RemoteLeafExec; before
+        # round 5 this topology could not answer any unfiltered query)
+        import time as _t
+
+        import numpy as np
+        for s in (0, 1):
+            prod = BrokerBus(f"127.0.0.1:{broker.port}", s)
+            bld = RecordBuilder(GAUGE)
+            for t in range(10):
+                bld.add({"_metric_": "m", "host": f"steady{s}"},
+                        BASE + t * 1000, float(t + s))
+            prod.publish(bld.build())
+            prod.close()
+        for srv in (a, b):
+            deadline = _t.time() + 20
+            while _t.time() < deadline:
+                try:
+                    r = srv.engines["prometheus"].query_instant(
+                        'count(m{host=~"steady.*"})', BASE + 9_000)
+                    if r.matrix.num_series and \
+                            float(np.asarray(r.matrix.values)[0, 0]) == 2.0:
+                        break
+                except Exception:  # noqa: BLE001 — peer endpoint not yet published
+                    pass
+                _t.sleep(0.25)
+            else:
+                raise AssertionError(
+                    f"steady-state spanning query never saw both shards on {srv.node}")
+            # the spanning sum crosses the wire as partials and matches
+            r = srv.engines["prometheus"].query_instant(
+                'sum(m{host=~"steady.*"})', BASE + 9_000)
+            assert float(np.asarray(r.matrix.values)[0, 0]) == 19.0  # 9 + 10
         b.shutdown()                      # node-b dies (heartbeats stop)
         import time as _t
         deadline = _t.time() + 20
