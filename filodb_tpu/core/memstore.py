@@ -198,6 +198,9 @@ class TimeSeriesShard:
                 g = self._part_key_to_id.get
                 pids = np.fromiter((g(k, -1) for k in keys[i:]), np.int32,
                                    count=n_sets - i)
+            if i == start and self._bulk_create_locked(container, mapping,
+                                                       pids, i, first_ts):
+                return n_sets
             epoch0 = self._release_epoch
             seg = i
             for j in range(seg, n_sets):
@@ -214,6 +217,78 @@ class TimeSeriesShard:
                 if self._release_epoch != epoch0 and i < n_sets:
                     break          # eviction ran: re-probe the tail
         return n_sets
+
+    BULK_CREATE_MIN = 512      # below this, per-key creation wins
+
+    def _bulk_create_locked(self, container, mapping, probe_pids,
+                            seg: int, first_ts) -> bool:
+        """Registration fast path: admit ALL of a probe's misses in one bulk
+        pass — dense pid assignment, bulk index add from the container's
+        canonical key bytes, one dict update for the key maps (ref:
+        TimeSeriesShard.scala:1183 getOrAddPartitionAndIngest +
+        PartKeyLuceneIndex.addPartKey; jmh IngestionBenchmark is the bar).
+
+        Only when nothing per-key can happen: enough free capacity for every
+        miss without eviction (and none ever evicted — the bloom re-ingest
+        accounting stays exact), no reusable slots (dense append only), and
+        no ignored shard-key tags (the index stores ALL labels; key bytes
+        drop ignored ones). Returns False untouched otherwise."""
+        miss = np.nonzero(probe_pids < 0)[0]
+        if len(miss) < self.BULK_CREATE_MIN:
+            return False
+        if (self._free_pids or self.stats.partitions_evicted
+                or self.schema.options.ignore_shard_key_tags
+                or len(self.index) + len(miss) > self.config.max_series_per_shard):
+            return False
+        keys, hashes = container.resolved_keys()
+        label_sets = container.label_sets
+        n_sets = len(label_sets)
+        base = len(self.index)
+        new_pids = np.arange(base, base + len(miss), dtype=np.int64)
+        new_keys = [keys[seg + j] for j in miss.tolist()]
+        # builder interning makes label sets unique, but hand-built containers
+        # may repeat a key — the per-key path dedups those; bulk cannot
+        if len(set(new_keys)) != len(new_keys):
+            return False
+        # columnar fast path: the builder's per-label columns skip pair-bytes
+        # parsing entirely (one dict probe per value); only valid when the
+        # whole container is new series (columns align 1:1 with the misses)
+        added = False
+        if (container.label_columns is not None and seg == 0
+                and len(miss) == n_sets):
+            fixed, vary, cols = container.label_columns
+            added = self.index.add_part_keys_columnar(
+                new_pids, fixed, vary, cols, first_ts)
+        if not added:
+            counts_hint = np.fromiter((len(label_sets[seg + j])
+                                       for j in miss.tolist()), np.int64,
+                                      count=len(miss))
+            if not self.index.add_part_keys_bulk(new_pids, new_keys, first_ts,
+                                                 counts_hint=counts_hint):
+                return False
+        pid_list = new_pids.tolist()
+        self._part_key_to_id.update(zip(new_keys, pid_list))
+        self._part_key_of_id.update(zip(pid_list, new_keys))
+        if self._native_ps is not None:
+            # straight to the native table (array form, no per-entry tuples);
+            # deferred inserts must land FIRST to keep insertion order sane
+            hs = hashes[seg + miss]
+            self._flush_native_locked()
+            self._native_ps.insert_arrays(hs, new_keys, new_pids.astype(np.int32))
+            self._pid_hash[new_pids] = hs
+        if self.sink is not None:
+            # 4-tuple form: labels stay a (sequence, index) reference so the
+            # dicts build at flush time OUTSIDE the shard lock — a 1M-series
+            # batch must not pay n dict builds in the locked ingest path
+            self._partkey_log.extend(
+                (pid, label_sets, seg + j, first_ts)
+                for pid, j in zip(pid_list, miss.tolist()))
+        self.stats.series_created += len(miss)
+        seg_map = mapping[seg:seg + (n_sets - seg)]
+        hit = probe_pids >= 0
+        seg_map[hit] = probe_pids[hit]
+        seg_map[miss] = new_pids
+        return True
 
     def _flush_native_locked(self) -> None:
         """Land deferred part-key inserts in one native call. Must run
@@ -347,9 +422,18 @@ class TimeSeriesShard:
             if not log:
                 return
             try:
-                self.sink.write_part_keys(
-                    self.dataset, self.shard_num,
-                    [(int(pid), labels, int(start)) for pid, labels, start in log])
+                # rows are (pid, labels, start) or the bulk path's deferred
+                # (pid, labels_seq, idx, start) — materialized here, off the
+                # shard lock
+                rows = []
+                for e in log:
+                    if len(e) == 3:
+                        pid, labels, start = e
+                    else:
+                        pid, seq, i, start = e
+                        labels = seq[i]
+                    rows.append((int(pid), labels, int(start)))
+                self.sink.write_part_keys(self.dataset, self.shard_num, rows)
             except Exception:
                 # transient sink failure: the events must survive for retry —
                 # prepend (they predate anything queued meanwhile)

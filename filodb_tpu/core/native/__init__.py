@@ -129,6 +129,18 @@ class NativePartSet:
                                   offs.ctypes.data, pids.ctypes.data,
                                   len(entries))
 
+    def insert_arrays(self, hashes: np.ndarray, keys: list[bytes],
+                      pids: np.ndarray) -> None:
+        """Array form of insert_batch (registration hot path: no per-entry
+        tuples or int() conversions on the Python side)."""
+        if not len(keys):
+            return
+        blob, offs = _concat_keys(keys)
+        h = np.ascontiguousarray(hashes, np.uint64)
+        p = np.ascontiguousarray(pids, np.int32)
+        self._lib.ps_insert_batch(self._h, h.ctypes.data, blob,
+                                  offs.ctypes.data, p.ctypes.data, len(keys))
+
     def remove(self, hash_: int, key: bytes) -> bool:
         return bool(self._lib.ps_remove(self._h, hash_, key, len(key)))
 

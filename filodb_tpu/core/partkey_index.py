@@ -53,13 +53,14 @@ def _intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
 class _Postings:
     """Append-friendly posting list with lazy sorted-array compaction."""
 
-    __slots__ = ("_new", "_arr", "vid", "nid")
+    __slots__ = ("_new", "_arr", "vid", "nid", "dropped")
 
     def __init__(self, vid: int = 0, nid: int = 0):
         self._new: list[int] = []
         self._arr: np.ndarray = _EMPTY
         self.vid = vid                   # id of this value in its name's pool
         self.nid = nid                   # id of its label name (arena pair)
+        self.dropped = False             # detached from _inv by a removal
 
     def add(self, part_id: int) -> None:
         self._new.append(part_id)
@@ -99,6 +100,18 @@ class _I64Vec:
             self._buf = grown
         self._buf[self.n] = v
         self.n += 1
+
+    def extend(self, arr: np.ndarray) -> None:
+        need = self.n + len(arr)
+        if need > len(self._buf):
+            cap = len(self._buf)
+            while cap < need:
+                cap *= 2
+            grown = np.empty(cap, np.int64)
+            grown[: self.n] = self._buf[: self.n]
+            self._buf = grown
+        self._buf[self.n:need] = arr
+        self.n = need
 
     def view(self) -> np.ndarray:
         return self._buf[: self.n]
@@ -157,13 +170,26 @@ class PartKeyIndex:
         # intersection, so changing query windows still hit
         self._epoch = 0
         self._filter_cache: dict[tuple, tuple[int, np.ndarray]] = {}
+        # registration hot path: raw pair bytes (b"name\x01value") -> its
+        # _Postings, so the bulk add does ONE dict probe per label pair
+        # instead of two nested gets + string decodes (entries whose postings
+        # a removal detached carry dropped=True and re-intern on next hit)
+        self._pair_cache: dict[bytes, _Postings] = {}
+        # deferred postings (the Lucene NRT-buffer analog: addPartKey returns
+        # after buffering; readers see the docs because every read path
+        # drains first). The columnar bulk add's all-new values park here as
+        # (values, vid_base, pid_list) segments; _drain builds their
+        # _Postings in one batched pass on the first read/mutation that
+        # touches the name. Pools and vid maps are ALWAYS eager — only the
+        # per-value postings objects are deferred.
+        self._pending_cols: dict[str, list] = {}
 
     LIVE_END = np.iinfo(np.int64).max
 
     def __len__(self) -> int:
         return len(self._off)
 
-    def _intern(self, name: str, value: str) -> tuple[int, int, _Postings]:
+    def _intern_name(self, name: str) -> int:
         nid = self._name_id.get(name)
         if nid is None:
             nid = self._name_id[name] = len(self._name_pool)
@@ -172,6 +198,33 @@ class PartKeyIndex:
             self._vid_of.append({})
             self._pool_version.append(0)
             self._postings_epoch.append(0)
+        return nid
+
+    def _drain(self, name: str) -> None:
+        """Materialize deferred postings segments for one label name; every
+        path that reads or mutates a name's postings calls this first."""
+        segs = self._pending_cols.pop(name, None)
+        if not segs:
+            return
+        nid = self._name_id[name]
+        vals = self._inv[name]
+        pool = self._val_pool[nid]
+        for col, vid_base, pid_list in segs:
+            ps = list(map(_Postings, range(vid_base, vid_base + len(col)),
+                          [nid] * len(col)))
+            for p, pid in zip(ps, pid_list):
+                p._new.append(pid)
+            # pooled (canonical) string instances key _inv
+            vals.update(zip(pool[vid_base:vid_base + len(col)], ps))
+
+    def _drain_all(self) -> None:
+        for name in list(self._pending_cols):
+            self._drain(name)
+
+    def _intern(self, name: str, value: str) -> tuple[int, int, _Postings]:
+        nid = self._intern_name(name)
+        if self._pending_cols:
+            self._drain(name)
         vals = self._inv[name]
         p = vals.get(value)
         if p is None:
@@ -184,6 +237,177 @@ class PartKeyIndex:
             # reuse the pooled (canonical) string instance as the _inv key
             p = vals[self._val_pool[nid][vid]] = _Postings(vid, nid)
         return nid, p.vid, p
+
+    def _bulk_preamble(self, part_ids: np.ndarray, n: int,
+                       start_time: int) -> np.ndarray | None:
+        """Shared dense-append validation for the bulk add paths; returns the
+        pid array (None => caller must fall back to per-key adds). Bumps the
+        epoch/max-start bookkeeping on success."""
+        pids = np.asarray(part_ids, np.int64)
+        if (int(pids[0]) != len(self._off)
+                or (n > 1 and not (np.diff(pids) == 1).all())):
+            return None
+        self._epoch += 1
+        if start_time > self._max_start:
+            self._max_start = start_time
+        return pids
+
+    def _bulk_columns_commit(self, n: int, L: int, nid_row, vid_mat,
+                             start_time: int) -> None:
+        """Append arena/offset/time columns for ``n`` keys of ``L`` labels
+        each, from per-label nid/vid columns — pure numpy, no per-key work."""
+        base_off = len(self._arena) // 2
+        arena_mat = np.empty((n, L, 2), np.uint32)
+        arena_mat[:, :, 0] = nid_row
+        arena_mat[:, :, 1] = vid_mat
+        self._arena.frombytes(arena_mat.tobytes())
+        offs = base_off + L * np.arange(n, dtype=np.uint64)
+        self._off.frombytes(offs.tobytes())
+        self._cnt.frombytes(np.full(n, L, np.uint32).tobytes())
+        self._start.extend(np.full(n, start_time, np.int64))
+        self._end.extend(np.full(n, self.LIVE_END, np.int64))
+
+    def add_part_keys_columnar(self, part_ids: np.ndarray, fixed: dict,
+                               vary: list[str], cols: list,
+                               start_time: int) -> bool:
+        """Columnar bulk add: label values arrive as per-name COLUMNS (the
+        builder's add_series_batch shape), so interning needs one dict probe
+        per value — no pair-bytes building or parsing at all — and the label
+        arena assembles as one [n, L, 2] numpy write. The fastest
+        registration path (ref: PartKeyLuceneIndex.addPartKey bulk ingest,
+        jmh PartKeyIndexBenchmark is the bar); per-key equivalent to
+        add_part_key. Dense pid appends only — returns False untouched
+        otherwise."""
+        n = len(part_ids)
+        if n == 0:
+            return True
+        L = len(fixed) + len(vary)
+        if L == 0 or any(len(c) != n for c in cols):
+            return False
+        pids = self._bulk_preamble(part_ids, n, start_time)
+        if pids is None:
+            return False
+        pid_list = pids.tolist()
+        nid_row = np.empty(L, np.uint32)
+        vid_mat = np.empty((n, L), np.uint32)
+        touched: list[int] = []
+        ci = 0
+        for name, value in fixed.items():
+            nid, vid, p = self._intern(name, value)
+            p._new.extend(pid_list)
+            nid_row[ci] = nid
+            vid_mat[:, ci] = vid
+            touched.append(nid)
+            ci += 1
+        for name, col in zip(vary, cols):
+            nid = self._intern_name(name)
+            vals = self._inv[name]
+            vd = self._vid_of[nid]
+            pool = self._val_pool[nid]
+            # all-new-distinct subpath (the registration shape: every series
+            # brings a fresh value): dedup + overlap checks are C-speed set
+            # ops, pools/vid maps extend in bulk, and per value only the
+            # postings object itself is built
+            dedup = dict.fromkeys(col)
+            if len(dedup) == n and not (dedup.keys() & vd.keys()):
+                base_vid = len(pool)
+                pool.extend(col)
+                vd.update(zip(col, range(base_vid, base_vid + n)))
+                # postings deferred (NRT buffer): readers drain on access
+                self._pending_cols.setdefault(name, []).append(
+                    (col, base_vid, pid_list))
+                self._pool_version[nid] += n
+                vid_mat[:, ci] = np.arange(base_vid, base_vid + n,
+                                           dtype=np.uint32)
+            else:
+                self._drain(name)     # the general loop probes _inv directly
+                get = vals.get
+                vids: list[int] = []
+                vap = vids.append
+                new_pool = 0
+                for v, pid in zip(col, pid_list):
+                    p = get(v)
+                    if p is None:
+                        vid = vd.get(v)
+                        if vid is None:
+                            vid = vd[v] = len(pool)
+                            pool.append(v)
+                            new_pool += 1
+                        # pooled (canonical) string instance keys _inv
+                        p = vals[pool[vid]] = _Postings(vid, nid)
+                    p._new.append(pid)
+                    vap(p.vid)
+                if new_pool:
+                    self._pool_version[nid] += new_pool
+                vid_mat[:, ci] = vids
+            nid_row[ci] = nid
+            touched.append(nid)
+            ci += 1
+        for nid in touched:
+            self._postings_epoch[nid] += 1
+        self._bulk_columns_commit(n, L, nid_row, vid_mat, start_time)
+        return True
+
+    def add_part_keys_bulk(self, part_ids: np.ndarray, keys: list[bytes],
+                           start_time: int,
+                           counts_hint: np.ndarray | None = None) -> bool:
+        """Vectorized add of many NEW part keys parsed straight from the
+        canonical key bytes (``name\\x01value`` pairs joined by ``\\x00`` —
+        schemas.part_key_bytes; the v3 container wire already carries them).
+
+        The 1M-series registration hot path (ref: PartKeyLuceneIndex.addPartKey
+        consuming BinaryRecord key regions, TimeSeriesShard.scala:1183): ONE
+        C-speed split over the whole batch, one dict probe per label pair
+        (keyed by the raw pair bytes — string decode and pool interning only
+        per DISTINCT pair), arena/offset/time columns extended in bulk.
+
+        Handles only densely appended part ids with non-empty keys; returns
+        False (with NO state mutated) so the caller falls back to per-key
+        ``add_part_key`` otherwise. ``counts_hint`` (labels per key, from the
+        caller's label dicts) guards against values containing the separator
+        byte — a mismatch rejects the batch before any mutation."""
+        n = len(keys)
+        if n == 0:
+            return True
+        counts = np.fromiter((k.count(b"\x00") for k in keys), np.int64,
+                             count=n) + 1
+        if counts_hint is not None and not np.array_equal(counts, counts_hint):
+            return False
+        if min(len(k) for k in keys) == 0:
+            return False                       # label-less key: per-key path
+        pids = self._bulk_preamble(part_ids, n, start_time)
+        if pids is None:
+            return False
+        pairs = b"\x00".join(keys).split(b"\x00")
+        cache = self._pair_cache
+        arena_ext = array("I")
+        ap = arena_ext.append
+        touched: set[int] = set()
+        for pair, pid in zip(pairs, np.repeat(pids, counts).tolist()):
+            p = cache.get(pair)
+            if p is None or p.dropped:
+                nm, _, val = pair.partition(b"\x01")
+                _nid, _vid, p = self._intern(nm.decode(), val.decode())
+                p.dropped = False
+                cache[pair] = p
+            ap(p.nid)
+            ap(p.vid)
+            p._new.append(pid)
+            touched.add(p.nid)
+        for nid in touched:
+            self._postings_epoch[nid] += 1
+        if len(cache) > (1 << 22):
+            # backstop: the cache re-warms from _intern; unbounded growth on
+            # never-compacting all-distinct workloads must not
+            self._pair_cache = {}
+        base_off = len(self._arena) // 2
+        self._arena.extend(arena_ext)
+        offs = base_off + np.concatenate(([0], np.cumsum(counts[:-1])))
+        self._off.frombytes(offs.astype(np.uint64).tobytes())
+        self._cnt.frombytes(counts.astype(np.uint32).tobytes())
+        self._start.extend(np.full(n, start_time, np.int64))
+        self._end.extend(np.full(n, self.LIVE_END, np.int64))
+        return True
 
     def add_part_key(self, part_id: int, labels: dict[str, str], start_time: int,
                      end_time: int = LIVE_END) -> None:
@@ -216,7 +440,10 @@ class PartKeyIndex:
         inv = self._inv
         arena = self._arena
         pe = self._postings_epoch
+        pending = self._pending_cols
         for name, value in labels.items():
+            if pending and name in pending:
+                self._drain(name)
             vals = inv.get(name)
             p = vals.get(value) if vals is not None else None
             if p is None:
@@ -265,6 +492,8 @@ class PartKeyIndex:
 
     def _postings_for(self, f: Filter) -> np.ndarray:
         """Union of postings whose label value satisfies the (positive) filter."""
+        if self._pending_cols:
+            self._drain(f.label)
         vals = self._inv.get(f.label)
         if not vals:
             return _EMPTY
@@ -436,6 +665,7 @@ class PartKeyIndex:
                 self._num_ended += 1     # disables the all-live fast path
             self._end[pid] = -1          # matches no [start, end] overlap query
         for name, values in touched.items():
+            self._drain(name)
             nid = self._name_id.get(name)
             if nid is not None:
                 self._postings_epoch[nid] += 1   # invalidate cached unions
@@ -444,6 +674,7 @@ class PartKeyIndex:
                 if p is not None:
                     p.remove(removed)
                     if not len(p):
+                        p.dropped = True       # invalidate _pair_cache entry
                         del self._inv[name][value]
                         # value string stays in the pool: vids are stable and a
                         # re-added value re-interns under a fresh vid
@@ -461,6 +692,7 @@ class PartKeyIndex:
         total = len(self._arena) // 2
         if self._dead_pairs == 0 or self._dead_pairs <= total * min_dead_ratio:
             return False
+        self._drain_all()      # the rebuild below walks every _inv entry
         # re-pool: keep only values that still have live postings; vids renumber
         new_pools: list[list[str]] = [[] for _ in self._name_pool]
         new_vid_of: list[dict[str, int]] = [{} for _ in self._name_pool]
@@ -487,6 +719,10 @@ class PartKeyIndex:
         self._val_pool = new_pools
         self._vid_of = new_vid_of
         self._dead_pairs = 0
+        # churn reclaim extends to the pair cache: dropped entries would
+        # otherwise pin dead values' bytes + postings forever
+        self._pair_cache = {k: p for k, p in self._pair_cache.items()
+                            if not p.dropped}
         # pools rebuilt: every cached blob/match/union is stale (decoding a
         # stale blob's line offsets against the new pool would return the
         # WRONG values' postings)
@@ -503,6 +739,8 @@ class PartKeyIndex:
                      top_k: int | None = None) -> list[str]:
         """Distinct values of ``label``; top-k by series count when requested
         (ref: PartKeyLuceneIndex indexValues top-k terms)."""
+        if self._pending_cols:
+            self._drain(label)
         vals = self._inv.get(label)
         if not vals:
             return []

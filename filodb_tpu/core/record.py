@@ -66,6 +66,11 @@ class RecordContainer:
     bucket_les: np.ndarray | None = None   # f64 [nbuckets] histogram bucket tops
     part_keys: list[bytes] | None = None   # canonical key bytes per label set
     set_hashes: np.ndarray | None = None   # uint64 [n_sets] fnv1a64(part_keys)
+    # columnar label structure (fixed: dict, vary: [name], cols: [[value]])
+    # when the whole container came from ONE add_series_batch call — the
+    # index's columnar bulk add consumes it directly (never serialized;
+    # wire consumers re-derive nothing and fall back to key-bytes parsing)
+    label_columns: tuple | None = None
 
     def __len__(self) -> int:
         return len(self.ts)
@@ -83,7 +88,8 @@ class RecordContainer:
         return self.part_keys, self.set_hashes
 
     def to_bytes(self) -> bytes:
-        blob = json.dumps(self.label_sets, separators=(",", ":")).encode()
+        blob = json.dumps(list(self.label_sets),
+                          separators=(",", ":")).encode()
         n = len(self.ts)
         parts = [
             _HDR.pack(_MAGIC, 3, self.schema.schema_id, n, len(blob)),
@@ -156,6 +162,53 @@ class RecordContainer:
                    label_sets, bucket_les, part_keys, set_hashes)
 
 
+class _LazyBatchLabels:
+    """Label dicts of a pure add_series_batch container, materialized only on
+    first access: the columnar registration path reads just ``len()``, so a
+    1M-series container never builds its 1M dicts at all (ref: the
+    reference's ingest never materializes label maps either — BinaryRecords
+    carry the key bytes and Lucene docs build from those)."""
+
+    __slots__ = ("fixed", "vary", "cols", "_real")
+
+    def __init__(self, fixed: dict, vary: list, cols: list):
+        self.fixed = fixed
+        self.vary = vary
+        self.cols = cols
+        self._real = None
+
+    def _mat(self) -> list:
+        if self._real is None:
+            fixed, vary = self.fixed, self.vary
+            out = []
+            for row in zip(*self.cols):
+                d = dict(fixed)
+                d.update(zip(vary, row))
+                out.append(d)
+            self._real = out
+        return self._real
+
+    def __len__(self) -> int:
+        return len(self.cols[0]) if self.cols else 0
+
+    def __getitem__(self, i):
+        if self._real is not None:
+            return self._real[i]
+        if isinstance(i, slice):
+            return self._mat()[i]
+        # single-row access builds ONE dict — consumers that touch a few
+        # rows (partkey-log flush, debug paths) never materialize the batch
+        d = dict(self.fixed)
+        d.update((k, c[i]) for k, c in zip(self.vary, self.cols))
+        return d
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __eq__(self, other):
+        return list(self) == list(other)
+
+
 class RecordBuilder:
     """Accumulates samples into RecordContainers (ref: RecordBuilder.scala:31).
 
@@ -189,6 +242,9 @@ class RecordBuilder:
         self._shard_keys: list[bytes] = []  # shard-key bytes per label set
         self._set_entries: list[list] = []  # _hash_cache rows per label set
         self._label_key_to_idx: dict[tuple, int] = {}
+        # (fixed, vary, cols) when the container is exactly ONE
+        # add_series_batch call; anything else clears it
+        self._batch_cols: tuple | None = None
 
     def _intern(self, labels: dict[str, str]) -> int:
         """Label interning: canonical part/shard key BYTES are computed once
@@ -246,7 +302,15 @@ class RecordBuilder:
                 row[off:off + w] = np.asarray(v, np.float64)
         return row
 
+    def _to_list_labels(self) -> None:
+        """Materialize a lazy batch-label sequence so per-record appends can
+        extend it (a container mixing batch + singles loses the shortcut)."""
+        if not isinstance(self._labels, list):
+            self._labels = list(self._labels)
+
     def add(self, labels: dict[str, str], ts_ms: int, value) -> None:
+        self._batch_cols = None       # mixed container: no columnar shortcut
+        self._to_list_labels()
         idx = self._intern(labels)
         self._ts.append(ts_ms)
         if self.schema.is_multi_column:
@@ -284,10 +348,97 @@ class RecordBuilder:
                 rows[:, off:off + w] = v
         return rows
 
+    def add_series_batch(self, labels: dict, ts_ms: int, value: float) -> None:
+        """Register MANY series in one call: ``labels`` maps each label name
+        to either a shared string or a sequence of per-series values (all
+        sequences the same length). Every series receives one sample at
+        ``ts_ms`` — the registration / discovery shape (ref: jmh
+        IngestionBenchmark building containers of distinct part keys;
+        RecordBuilder.scala addFromReader batch path).
+
+        The hot path is vectorized: canonical part/shard key bytes come from
+        ONE format template applied per series (labels sorted once, not per
+        record) and hashing stays batched in build(); per-series Python work
+        is one string format + one dict literal."""
+        seqs = {k: v for k, v in labels.items() if not isinstance(v, str)}
+        if not seqs:
+            self.add(dict(labels), ts_ms, value)
+            return
+        lens = {len(v) for v in seqs.values()}
+        if len(lens) != 1:
+            raise ValueError(f"varying-label lengths differ: "
+                             f"{ {k: len(v) for k, v in seqs.items()} }")
+        (n,) = lens
+        if n == 0:
+            return
+        names = sorted(labels)
+        opts = self.schema.options
+        ignore = set(opts.ignore_shard_key_tags)
+        vary = sorted(seqs)               # positional order for both templates
+        pos = {k: i for i, k in enumerate(vary)}
+        esc = lambda s: s.replace("{", "{{").replace("}", "}}")  # noqa: E731
+        # part-key template over sorted labels: varying values drop in by
+        # position, shared ones are literal (brace-escaped — a value
+        # containing {} must not be parsed as a format field)
+        pk_tmpl = "\x00".join(
+            f"{esc(k)}\x01{{{pos[k]}}}" if k in seqs
+            else f"{esc(k)}\x01{esc(labels[k])}"
+            for k in names if k not in ignore)
+        sk_vary = any(k in seqs for k in opts.shard_key_columns)
+        sk_tmpl = "\x00".join(
+            f"{esc(k)}\x01{{{pos[k]}}}" if k in seqs
+            else f"{esc(k)}\x01{esc(labels.get(k, ''))}"
+            for k in opts.shard_key_columns)
+        cols = [list(seqs[k]) for k in vary]
+        base_idx = len(self._labels)
+        fixed = {k: v for k, v in labels.items() if isinstance(v, str)}
+        fmt_pk, fmt_sk = pk_tmpl.format, sk_tmpl.format
+        if base_idx == 0 and self._batch_cols is None:
+            # pure-batch container: label dicts stay lazy (never built unless
+            # someone reads them) and the index consumes the columns directly
+            self._batch_cols = (fixed, vary, cols)
+            self._labels = _LazyBatchLabels(fixed, vary, cols)
+            if len(cols) == 1:
+                self._part_keys.extend(
+                    fmt_pk(v).encode() for v in cols[0])
+                if sk_vary:
+                    self._shard_keys.extend(
+                        fmt_sk(v).encode() for v in cols[0])
+            else:
+                for row in zip(*cols):
+                    self._part_keys.append(fmt_pk(*row).encode())
+                    if sk_vary:
+                        self._shard_keys.append(fmt_sk(*row).encode())
+        else:
+            self._batch_cols = None
+            self._to_list_labels()
+            for row in zip(*cols):
+                d = dict(fixed)
+                d.update(zip(vary, row))
+                self._labels.append(d)
+                self._part_keys.append(fmt_pk(*row).encode())
+                if sk_vary:
+                    self._shard_keys.append(fmt_sk(*row).encode())
+        if not sk_vary:
+            # .format() unescapes the {{ }} literals even with no fields
+            self._shard_keys.extend([fmt_sk().encode()] * n)
+        # hashes batch-computed at build(); the shared None sentinel marks
+        # "no memo row" — build() special-cases the pure-batch container
+        self._set_entries.extend([None] * n)
+        self._ts.extend([int(ts_ms)] * n)
+        if self.schema.is_multi_column:
+            value = self._flatten_value(value)
+            self._vals.extend([value] * n)
+        else:
+            self._vals.extend([float(value)] * n)
+        self._pidx.extend(range(base_idx, base_idx + n))
+
     def add_batch(self, labels: dict[str, str], ts_ms, values) -> None:
         """Bulk samples for ONE series: hashing/label interning happens once
         and the arrays ride through build() without per-sample Python work —
         the path for backfills, CSV imports, and synthetic generators."""
+        self._batch_cols = None       # mixed container: no columnar shortcut
+        self._to_list_labels()
         idx = self._intern(labels)
         ts_ms = np.asarray(ts_ms, np.int64)
         n = len(ts_ms)
@@ -324,23 +475,37 @@ class RecordBuilder:
             pidx = np.concatenate(([pidx] if len(self._pidx) else [])
                                   + [b[2] for b in self._batches])
         # hash only sets whose memo rows lack hashes (first sighting); stable
-        # series across builds reuse their memoized hashes
-        need = [i for i, e in enumerate(self._set_entries) if e[2] is None]
-        if need:
-            phs = self._hash_keys([self._part_keys[i] for i in need])
-            shs = (self._hash_keys([self._shard_keys[i] for i in need])
-                   & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            for j, i in enumerate(need):
-                self._set_entries[i][2] = int(phs[j])
-                self._set_entries[i][3] = int(shs[j])
-        set_hashes = np.fromiter((e[2] for e in self._set_entries), np.uint64,
-                                 count=len(self._set_entries))
-        set_shard = np.fromiter((e[3] for e in self._set_entries), np.uint32,
-                                count=len(self._set_entries))
+        # series across builds reuse their memoized hashes. A pure batch
+        # container (every entry the None sentinel) hashes in one pass with
+        # no per-set bookkeeping at all — the registration hot path
+        entries = self._set_entries
+        if self._batch_cols is not None or all(e is None for e in entries):
+            set_hashes = self._hash_keys(self._part_keys)
+            set_shard = (self._hash_keys(self._shard_keys)
+                         & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        else:
+            need = [i for i, e in enumerate(entries)
+                    if e is None or e[2] is None]
+            if need:
+                phs = self._hash_keys([self._part_keys[i] for i in need])
+                shs = (self._hash_keys([self._shard_keys[i] for i in need])
+                       & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                for j, i in enumerate(need):
+                    e = entries[i]
+                    if e is None:
+                        entries[i] = [None, None, int(phs[j]), int(shs[j])]
+                    else:
+                        e[2] = int(phs[j])
+                        e[3] = int(shs[j])
+            set_hashes = np.fromiter((e[2] for e in entries), np.uint64,
+                                     count=len(entries))
+            set_shard = np.fromiter((e[3] for e in entries), np.uint32,
+                                    count=len(entries))
         ph = set_hashes[pidx] if len(pidx) else np.zeros(0, np.uint64)
         sh = set_shard[pidx] if len(pidx) else np.zeros(0, np.uint32)
         rc = RecordContainer(self.schema, ts, vals, ph, sh, pidx,
                              self._labels, self.bucket_les,
-                             self._part_keys, set_hashes)
+                             self._part_keys, set_hashes,
+                             label_columns=self._batch_cols)
         self.reset()
         return rc

@@ -1,0 +1,217 @@
+"""Bulk registration path: builder columnar batches, bulk/columnar index
+adds with NRT-deferred postings, and the memstore bulk-create fast path
+(ref analogs: jmh IngestionBenchmark + PartKeyIndexBenchmark — the 1M-series
+registration bar; Lucene's IndexWriter buffers docs and readers see them
+after refresh, here drain-on-read)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import filters as F
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.partkey_index import PartKeyIndex
+from filodb_tpu.core.record import RecordBuilder, RecordContainer
+from filodb_tpu.core.schemas import GAUGE
+
+BASE = 1_700_000_000_000
+
+
+def _store(n=64, **kw):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=n, samples_per_series=16,
+                      flush_batch_size=10**9, dtype="float64", **kw)
+    return ms, ms.setup("prometheus", GAUGE, 0, cfg)
+
+
+# -- builder ----------------------------------------------------------------
+
+def test_add_series_batch_equals_per_record_adds():
+    n = 500
+    b1 = RecordBuilder(GAUGE)
+    for i in range(n):
+        b1.add({"_metric_": "m", "host": f"h{i}", "dc": f"d{i % 3}"}, BASE, 2.5)
+    c1 = b1.build()
+    b2 = RecordBuilder(GAUGE)
+    b2.add_series_batch({"_metric_": "m", "host": [f"h{i}" for i in range(n)],
+                         "dc": [f"d{i % 3}" for i in range(n)]}, BASE, 2.5)
+    c2 = b2.build()
+    assert c1.part_keys == c2.part_keys
+    assert (c1.part_hash == c2.part_hash).all()
+    assert (c1.shard_hash == c2.shard_hash).all()
+    assert list(c1.label_sets) == list(c2.label_sets)
+    assert (c1.ts == c2.ts).all() and (c1.values == c2.values).all()
+
+
+def test_add_series_batch_brace_and_separator_values():
+    """Label values containing format braces must not corrupt the key
+    templates; per-record and batch paths must agree byte-for-byte."""
+    vals = ["a{b}", "{{x}}", "plain", "{0}"]
+    b1 = RecordBuilder(GAUGE)
+    for v in vals:
+        b1.add({"_metric_": "m{}", "host": v}, BASE, 1.0)
+    b2 = RecordBuilder(GAUGE)
+    b2.add_series_batch({"_metric_": "m{}", "host": list(vals)}, BASE, 1.0)
+    assert b1.build().part_keys == b2.build().part_keys
+
+
+def test_add_series_batch_wire_roundtrip():
+    b = RecordBuilder(GAUGE)
+    b.add_series_batch({"_metric_": "m", "host": ["a", "b"]}, BASE, 7.0)
+    c = b.build()
+    back = RecordContainer.from_bytes(c.to_bytes(), {GAUGE.schema_id: GAUGE})
+    assert list(back.label_sets) == list(c.label_sets)
+    assert back.part_keys == c.part_keys
+    assert (back.ts == c.ts).all()
+
+
+def test_mixed_batch_and_single_adds():
+    b = RecordBuilder(GAUGE)
+    b.add_series_batch({"_metric_": "m", "host": ["a", "b"]}, BASE, 1.0)
+    b.add({"_metric_": "m", "host": "c"}, BASE + 1, 2.0)
+    c = b.build()
+    assert c.label_columns is None        # mixed: columnar shortcut dropped
+    assert [ls["host"] for ls in c.label_sets] == ["a", "b", "c"]
+    assert len(c.part_keys) == 3
+
+
+def test_batch_length_mismatch_raises():
+    b = RecordBuilder(GAUGE)
+    with pytest.raises(ValueError, match="lengths differ"):
+        b.add_series_batch({"_metric_": "m", "host": ["a", "b"],
+                            "dc": ["x"]}, BASE, 1.0)
+
+
+# -- index bulk adds + NRT drain --------------------------------------------
+
+def _bulk_index(n=100, defer=True):
+    ix = PartKeyIndex()
+    keys = [f"_metric_\x01m\x00host\x01h{i}".encode() for i in range(n)]
+    if defer:
+        ok = ix.add_part_keys_columnar(
+            np.arange(n), {"_metric_": "m"}, ["host"],
+            [[f"h{i}" for i in range(n)]], BASE)
+    else:
+        ok = ix.add_part_keys_bulk(np.arange(n), keys, BASE)
+    assert ok
+    return ix
+
+
+@pytest.mark.parametrize("defer", [True, False])
+def test_bulk_add_queryable_immediately(defer):
+    ix = _bulk_index(100, defer)
+    assert len(ix) == 100
+    assert list(ix.part_ids_from_filters([F.Equals("host", "h42")], 0, BASE + 1)) == [42]
+    assert len(ix.part_ids_from_filters([F.EqualsRegex("host", "h1.")], 0, BASE + 1)) == 10
+    assert ix.labels_of(7) == {"_metric_": "m", "host": "h7"}
+    assert "h99" in ix.label_values("host")
+    assert ix.label_names() == ["_metric_", "host"]
+
+
+def test_pending_drain_on_per_key_add_and_remove():
+    ix = _bulk_index(50)
+    # a per-key add touching the pending name must see the buffered postings
+    ix.add_part_key(50, {"_metric_": "m", "host": "h7"}, BASE)
+    ids = ix.part_ids_from_filters([F.Equals("host", "h7")], 0, BASE + 1)
+    assert sorted(ids.tolist()) == [7, 50]
+    # removal while another batch is pending
+    ix.add_part_keys_columnar(np.array([51, 52]), {"_metric_": "m"},
+                              ["host"], [["x1", "x2"]], BASE)
+    ix.remove_part_keys(np.array([51]))
+    assert list(ix.part_ids_from_filters([F.Equals("host", "x2")], 0, BASE + 1)) == [52]
+    assert len(ix.part_ids_from_filters([F.Equals("host", "x1")], 0, BASE + 1)) == 0
+
+
+def test_columnar_duplicate_values_take_general_path():
+    ix = PartKeyIndex()
+    ok = ix.add_part_keys_columnar(np.arange(6), {"_metric_": "m"},
+                                   ["dc"], [["a", "b", "a", "c", "b", "a"]],
+                                   BASE)
+    assert ok
+    assert sorted(ix.part_ids_from_filters([F.Equals("dc", "a")], 0, BASE + 1)
+                  .tolist()) == [0, 2, 5]
+    assert ix.label_values("dc") == ["a", "b", "c"]
+
+
+def test_bulk_bytes_counts_hint_mismatch_falls_back():
+    ix = PartKeyIndex()
+    keys = [b"_metric_\x01m\x00host\x01h0", b"_metric_\x01m\x00host\x01h1"]
+    assert not ix.add_part_keys_bulk(np.arange(2), keys, BASE,
+                                     counts_hint=np.array([2, 3]))
+    assert len(ix) == 0                    # nothing mutated
+    assert ix.add_part_keys_bulk(np.arange(2), keys, BASE,
+                                 counts_hint=np.array([2, 2]))
+    assert len(ix) == 2
+
+
+def test_bulk_non_dense_pids_rejected():
+    ix = _bulk_index(10)
+    assert not ix.add_part_keys_bulk(np.array([20, 21]),
+                                     [b"a\x01b", b"a\x01c"], BASE)
+    assert not ix.add_part_keys_columnar(np.array([5, 6]), {}, ["a"],
+                                         [["x", "y"]], BASE)
+
+
+# -- memstore bulk create ----------------------------------------------------
+
+def test_memstore_bulk_create_matches_sequential(tmp_path):
+    def build(n, bulk):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=2048, samples_per_series=16,
+                          flush_batch_size=10**9, dtype="float64")
+        sh = ms.setup("prometheus", GAUGE, 0, cfg)
+        b = RecordBuilder(GAUGE)
+        if bulk:
+            b.add_series_batch(
+                {"_metric_": "m", "host": [f"h{i}" for i in range(n)],
+                 "dc": [f"d{i % 7}" for i in range(n)]}, BASE, 1.0)
+        else:
+            for i in range(n):
+                b.add({"_metric_": "m", "host": f"h{i}", "dc": f"d{i % 7}"},
+                      BASE, 1.0)
+        sh.ingest(b.build())
+        return sh
+
+    n = 1500   # above BULK_CREATE_MIN
+    sa, sb = build(n, False), build(n, True)
+    assert sb.num_series == n
+    for filt in ([F.Equals("host", "h3")], [F.Equals("dc", "d5")],
+                 [F.EqualsRegex("host", "h1..")], [F.NotEquals("dc", "d0")]):
+        pa = sa.part_ids_from_filters(list(filt), 0, BASE + 1)
+        pb = sb.part_ids_from_filters(list(filt), 0, BASE + 1)
+        assert np.array_equal(np.sort(pa), np.sort(pb)), filt
+    # native table agrees with the python map after bulk insert
+    c = RecordBuilder(GAUGE)
+    c.add({"_metric_": "m", "host": "h3", "dc": "d3"}, BASE + 5, 9.0)
+    sb.ingest(c.build())                  # existing series: must resolve, not dup
+    assert sb.num_series == n
+
+
+def test_memstore_bulk_respects_capacity_pressure():
+    """Near capacity, the bulk path must decline and the eviction-capable
+    per-key path admit what fits."""
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=600, samples_per_series=16,
+                      flush_batch_size=10**9, dtype="float64")
+    sh = ms.setup("prometheus", GAUGE, 0, cfg)
+    b = RecordBuilder(GAUGE)
+    b.add_series_batch({"_metric_": "m",
+                        "host": [f"h{i}" for i in range(700)]}, BASE, 1.0)
+    sh.ingest(b.build())                   # 700 > 600: per-key path + eviction
+    assert sh.num_series <= 600
+    assert sh.stats.partitions_evicted > 0 or sh.num_series == 600
+
+
+def test_bulk_then_flush_and_query_end_to_end():
+    from filodb_tpu.query.engine import QueryEngine
+    ms, sh = _store(n=4096)
+    b = RecordBuilder(GAUGE)
+    n = 1024
+    b.add_series_batch({"_metric_": "m", "host": [f"h{i}" for i in range(n)]},
+                       BASE, 5.0)
+    ms.ingest("prometheus", 0, b.build())
+    sh.flush()
+    eng = QueryEngine(ms, "prometheus")
+    r = eng.query_instant("count(m)", BASE + 1000)
+    assert float(np.asarray(r.matrix.values)[0, 0]) == n
+    r = eng.query_instant('sum(m{host="h17"})', BASE + 1000)
+    assert float(np.asarray(r.matrix.values)[0, 0]) == 5.0
