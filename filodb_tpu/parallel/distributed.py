@@ -35,71 +35,230 @@ class DistributedStore:
     """Global sharded view over per-shard device stores.
 
     Each TimeSeriesShard's SeriesStore already lives on one mesh device; this
-    assembles the per-device blocks into global arrays [NSHARD, S, C] sharded on
+    assembles the per-device blocks into global arrays [NDEV, S, C] sharded on
     the "shard" mesh axis with ``make_array_from_single_device_arrays`` — zero
     copy, the shards' HBM blocks become one logical array.
-    """
+
+    Shards-per-device >= 1: with ``ns == slots * ndev`` shards placed
+    round-robin (shard i on device i % ndev — standalone's placement), slot j
+    assembles the global array of shards ``[j*ndev + d for d]``; programs
+    loop the per-device slot blocks at trace time and reduce locally before
+    the collective. No concatenation — per-slot views stay zero-copy."""
 
     def __init__(self, mesh: Mesh, shards):
         self.mesh = mesh
         self.shards = shards
         ns = len(shards)
-        assert ns == mesh.devices.size, "one shard per mesh device"
+        ndev = mesh.devices.size
+        assert ns % ndev == 0, "shards must divide evenly over mesh devices"
+        self.slots = ns // ndev
+        self.ndev = ndev
         s0 = shards[0].store
         self.S, self.C = s0.S, s0.C
         self.sharding = NamedSharding(mesh, P("shard"))
 
     def _global(self, per_shard_arrays, extra_shape, dtype):
-        ns = len(self.shards)
-        shape = (ns,) + extra_shape
+        ndev = len(per_shard_arrays)
+        shape = (ndev,) + extra_shape
         arrs = [a.reshape((1,) + extra_shape) for a in per_shard_arrays]
         return jax.make_array_from_single_device_arrays(
             shape, self.sharding, arrs)
 
+    def _slot(self, j: int):
+        return [self.shards[j * self.ndev + d] for d in range(self.ndev)]
+
     def arrays(self):
-        ts = self._global([s.store.ts for s in self.shards], (self.S, self.C), jnp.int64)
-        val = self._global([s.store.val for s in self.shards], (self.S, self.C), None)
-        n = self._global([s.store.n for s in self.shards], (self.S,), jnp.int32)
-        return ts, val, n
+        """Per-slot tuples of (ts, val, n) global arrays."""
+        out = []
+        for j in range(self.slots):
+            ss = self._slot(j)
+            out.append((
+                self._global([s.store.ts for s in ss], (self.S, self.C), jnp.int64),
+                self._global([s.store.val for s in ss], (self.S, self.C), None),
+                self._global([s.store.n for s in ss], (self.S,), jnp.int32)))
+        return out
+
+    def global_gids(self, group_ids_per_shard):
+        """Per-slot global [NDEV, S] gid arrays, device_put to each shard's
+        device (caller passes one [S] array per shard, shard order)."""
+        out = []
+        for j in range(self.slots):
+            arrs = []
+            for d in range(self.ndev):
+                sh = self.shards[j * self.ndev + d]
+                g = group_ids_per_shard[j * self.ndev + d]
+                dev = list(sh.store.ts.devices())[0]
+                arrs.append(jax.device_put(jnp.asarray(g, jnp.int32), dev))
+            out.append(self._global(arrs, (self.S,), jnp.int32))
+        return out
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh"))
-def dist_aggregate(ts_g, val_g, n_g, gids_g, out_ts, window_ms, a0, a1,
-                   fn: str, op: str, num_groups: int, mesh: Mesh):
-    """One compiled distributed query step: range function per shard block +
-    segment partials + psum over the shard axis; every shard ends with the same
-    [G, T] final matrix (taken from shard 0 by the caller)."""
-
-    def per_shard(ts, val, n, gids):
+def _slot_matrix(fn, slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
+    """Yield the per-slot [S, T] matrix + [S] gids of THIS device's blocks."""
+    for (ts, val, n), gids in zip(slot_tvn, slot_gids):
         acc = jnp.float64 if val.dtype == jnp.float64 else jnp.float32
         mat = rangefns._periodic(fn, ts[0], val[0], n[0], out_ts, window_ms,
                                  a0, a1, w_cap=256, acc=acc)
-        parts = aggregators.partial_aggregate(op, mat, gids[0], num_groups)
+        yield mat, gids[0]
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh"))
+def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
+                   fn: str, op: str, num_groups: int, mesh: Mesh):
+    """One compiled distributed query step: range function per resident slot
+    block + segment partials combined locally + psum over the shard axis;
+    every device ends with the same [G, T] final matrix (taken from device 0
+    by the caller)."""
+
+    def per_device(slot_tvn, slot_gids):
+        parts = None
+        for mat, gids in _slot_matrix(fn, slot_tvn, slot_gids, out_ts,
+                                      window_ms, a0, a1):
+            p = aggregators.partial_aggregate(op, mat, gids, num_groups)
+            parts = (p if parts is None
+                     else aggregators.combine_partials(op, parts, p))
         parts = {k: jax.lax.psum(v, "shard") if k not in ("min", "max")
                  else (jax.lax.pmin(v, "shard") if k == "min" else jax.lax.pmax(v, "shard"))
                  for k, v in parts.items()}
         return aggregators.present_partials(op, parts)[None]
 
     return jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P("shard"), P("shard"), P("shard"), P("shard")),
+        per_device, mesh=mesh,
+        in_specs=(P("shard"), P("shard")),
         out_specs=P("shard"),
-    )(ts_g, val_g, n_g, gids_g)
+    )(slot_tvn, slot_gids)
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "num_groups", "mesh"))
+def dist_quantile_sketch(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
+                         fn: str, num_groups: int, mesh: Mesh):
+    """Distributed quantile map phase: per-slot range function -> DDSketch
+    log-bucket counts scattered on device -> psum over the shard axis.
+    Bucketing matches ops/aggregators.quantile_sketch bit-for-bit (same
+    gamma/width/edge rules) so the psum'd counts present identically to the
+    host merge (ref: AggrOverRangeVectors t-digest partials crossing the
+    reduce, :244)."""
+    B = aggregators.SKETCH_BUCKETS
+    W = aggregators.SKETCH_WIDTH
+    lg = float(np.log(aggregators.SKETCH_GAMMA))
+
+    def per_device(slot_tvn, slot_gids):
+        T = out_ts.shape[0]
+        counts = jnp.zeros((num_groups * W, T), jnp.float32)
+        for mat, gids in _slot_matrix(fn, slot_tvn, slot_gids, out_ts,
+                                      window_ms, a0, a1):
+            matf = mat.astype(jnp.float64)
+            mag = jnp.abs(matf)
+            bi = jnp.ceil(jnp.log(mag / aggregators.SKETCH_MIN) / lg)
+            bi = jnp.nan_to_num(bi, nan=1.0, posinf=B - 1, neginf=1.0)
+            bi = jnp.clip(bi, 1, B - 1).astype(jnp.int32)
+            idx = jnp.where(mag <= aggregators.SKETCH_MIN, B,
+                            jnp.where(matf > 0, B + bi, B - bi))
+            idx = jnp.where(jnp.isposinf(matf), 2 * B, idx)
+            idx = jnp.where(jnp.isneginf(matf), 0, idx)
+            # rows outside the selection carry an out-of-range gid; mask
+            # BEFORE the id arithmetic (gid * W would overflow/wrap back
+            # into range) and zero their scatter weight
+            sel = gids < num_groups
+            g = jnp.where(sel, gids, 0)
+            w = jnp.where(jnp.isnan(matf) | ~sel[:, None], 0.0,
+                          1.0).astype(jnp.float32)
+            comb = g[:, None] * W + idx
+            tix = jnp.broadcast_to(jnp.arange(T)[None, :], comb.shape)
+            counts = counts.at[comb, tix].add(w)
+        counts = jax.lax.psum(counts, "shard")
+        return counts.reshape(1, num_groups, W, T)
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("shard"), P("shard")),
+        out_specs=P("shard"),
+    )(slot_tvn, slot_gids)
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "k", "bottom",
+                                             "num_groups", "mesh", "ndev"))
+def dist_topk(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
+              fn: str, k: int, bottom: bool, num_groups: int, mesh: Mesh,
+              ndev: int):
+    """Distributed topk/bottomk: per-slot local top-k candidates, then ONE
+    all_gather of the fixed-size [G, T, slots*k] candidate blocks and a
+    global re-select — only k*shards candidates cross the ICI, never the
+    [S, T] matrices (ref: TopKPartial crossing the reduce node). Returns
+    (values, rows, shard_ids, present) each [G, T, k]; rows are store rows
+    on the owning shard."""
+    fmax = float(np.finfo(np.float64).max)
+    fill = np.inf if bottom else -np.inf
+
+    def per_device(slot_tvn, slot_gids):
+        T = out_ts.shape[0]
+        dev = jax.lax.axis_index("shard")
+        vs, rs, ss, oks = [], [], [], []
+        for j, (mat, gids) in enumerate(_slot_matrix(
+                fn, slot_tvn, slot_gids, out_ts, window_ms, a0, a1)):
+            matf = mat.astype(jnp.float64)
+            valid = ~jnp.isnan(matf)
+            # real +/-Inf must outrank empty (fill) slots on ties: clamp to
+            # +/-DBL_MAX in the sort domain only (same rule as _map_topk)
+            sortable = jnp.clip(matf, -fmax, fmax)
+            kk = min(k, matf.shape[0])
+            gv_l, gr_l, gok_l = [], [], []
+            for gi in range(num_groups):
+                m = (gids == gi)[:, None] & valid
+                sv = jnp.where(m, sortable, fill)
+                sv = -sv if bottom else sv
+                _, topi = jax.lax.top_k(sv.T, kk)            # [T, kk]
+                gv_l.append(jnp.take_along_axis(matf.T, topi, axis=1))
+                gr_l.append(topi)
+                gok_l.append(jnp.take_along_axis(m.T, topi, axis=1))
+            vs.append(jnp.stack(gv_l))                       # [G, T, kk]
+            rs.append(jnp.stack(gr_l))
+            oks.append(jnp.stack(gok_l))
+            ss.append(jnp.full((num_groups, T, kk),
+                               j * ndev, jnp.int32) + dev)
+        lv = jnp.concatenate(vs, axis=2)
+        lr = jnp.concatenate(rs, axis=2).astype(jnp.int32)
+        lsh = jnp.concatenate(ss, axis=2)
+        lok = jnp.concatenate(oks, axis=2)
+        gv = jnp.moveaxis(jax.lax.all_gather(lv, "shard"), 0, 2)
+        gr = jnp.moveaxis(jax.lax.all_gather(lr, "shard"), 0, 2)
+        gsh = jnp.moveaxis(jax.lax.all_gather(lsh, "shard"), 0, 2)
+        gok = jnp.moveaxis(jax.lax.all_gather(lok, "shard"), 0, 2)
+        C = gv.shape[2] * gv.shape[3]
+        gv = gv.reshape(num_groups, T, C)
+        gr = gr.reshape(num_groups, T, C)
+        gsh = gsh.reshape(num_groups, T, C)
+        gok = gok.reshape(num_groups, T, C)
+        sv = jnp.where(gok, jnp.clip(gv, -fmax, fmax), fill)
+        sv = -sv if bottom else sv
+        kk2 = min(k, C)
+        _, sel = jax.lax.top_k(sv, kk2)                      # [G, T, kk2]
+        return (jnp.take_along_axis(gv, sel, axis=2)[None],
+                jnp.take_along_axis(gr, sel, axis=2)[None],
+                jnp.take_along_axis(gsh, sel, axis=2)[None],
+                jnp.take_along_axis(gok, sel, axis=2)[None])
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("shard"), P("shard")),
+        out_specs=(P("shard"), P("shard"), P("shard"), P("shard")),
+    )(slot_tvn, slot_gids)
 
 
 @functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
                                              "window_ms", "interval_ms",
                                              "S", "C", "Tp", "c0", "Ck"))
-def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
+def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
                          fn: str, op: str, num_groups: int, mesh: Mesh,
                          window_ms: int, interval_ms: int,
                          S: int, C: int, Tp: int, c0: int = 0, Ck: int = 0):
-    """Fused single-pass map phase on every shard + psum of its partial-state
-    layout over the shard axis — the multi-chip twin of
+    """Fused single-pass map phase on every resident slot block + psum of the
+    partial-state layout over the shard axis — the multi-chip twin of
     ``fusedgrid.fused_grid_aggregate`` (ref: AggrOverRangeVectors.scala:62 —
     the same AggregateMapReduce map phase runs identically on every data
     node; the psum IS the reduce node). Band/edge operands are replicated;
-    each shard streams only its resident [S, C] block."""
+    each device streams only its resident [S, C] blocks, one kernel pass per
+    slot, partials summed locally before the collective."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
     call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
@@ -107,11 +266,14 @@ def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
                                   jax.default_backend() != "tpu",
                                   c0=c0, Ck=Ck)
 
-    def per_shard(val, n, gids, band, ohlo, lo, hi, rel):
-        outs = call(val[0].astype(jnp.float32),
-                    n[0].astype(jnp.int32).reshape(S, 1),
-                    gids[0].astype(jnp.int32).reshape(S, 1),
-                    band, ohlo, lo, hi, rel)
+    def per_device(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel):
+        outs = None
+        for val, n, gids in zip(slot_vals, slot_ns, slot_gids):
+            o = call(val[0].astype(jnp.float32),
+                     n[0].astype(jnp.int32).reshape(S, 1),
+                     gids[0].astype(jnp.int32).reshape(S, 1),
+                     band, ohlo, lo, hi, rel)
+            outs = o if outs is None else tuple(a + b for a, b in zip(outs, o))
         parts = ({"count": jax.lax.psum(outs[1], "shard")}
                  if op in ("count", "group") else
                  {k: jax.lax.psum(v, "shard")
@@ -119,14 +281,14 @@ def dist_fused_aggregate(val_g, n_g, gids_g, band, ohlo, lo, hi, rel,
         return aggregators.present_partials(op, parts)[None]
 
     return jax.shard_map(
-        per_shard, mesh=mesh,
+        per_device, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P(), P(), P(), P(), P()),
         out_specs=P("shard"),
         # pallas_call emits ShapeDtypeStructs without varying-mesh-axis
         # annotations; the kernel is per-shard-local so vma checking adds
         # nothing here
         check_vma=False,
-    )(val_g, n_g, gids_g, band, ohlo, lo, hi, rel)
+    )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
 class LazyMeshResult:
@@ -181,11 +343,8 @@ class MeshQueryExecutor:
     def aggregate(self, fn: str, op: str, out_ts: np.ndarray, window_ms: int,
                   group_ids_per_shard: list[np.ndarray], num_groups: int,
                   args=(0.0, 0.0), fetch: bool = True):
-        ts_g, val_g, n_g = self.dstore.arrays()
-        devs = list(self.dstore.mesh.devices.ravel())
-        gids = self.dstore._global(
-            [jax.device_put(jnp.asarray(g, jnp.int32), d)
-             for g, d in zip(group_ids_per_shard, devs)], (self.dstore.S,), jnp.int32)
+        slot_tvn = tuple(self.dstore.arrays())
+        slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
         G = _pow2(num_groups)
         S, C, T = self.dstore.S, self.dstore.C, len(out_ts)
         grid = (self._fused_grid()
@@ -201,7 +360,8 @@ class MeshQueryExecutor:
                 int(window_ms), base_ts, int(interval_ms))
             with jax.enable_x64(False):
                 out = dist_fused_aggregate(
-                    val_g, n_g, gids, band, ohlo, lo, hi, rel,
+                    tuple(t[1] for t in slot_tvn), tuple(t[2] for t in slot_tvn),
+                    slot_gids, band, ohlo, lo, hi, rel,
                     fn, op, G, self.dstore.mesh, int(window_ms),
                     int(interval_ms), S, C, Tp, c0, Ck)
             self.last_path = "fused"
@@ -213,12 +373,69 @@ class MeshQueryExecutor:
         # space bucketing as the in-process path
         from ..query.exec import _pad_steps
         out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
-        out = dist_aggregate(ts_g, val_g, n_g, gids, jnp.asarray(out_eval),
+        out = dist_aggregate(slot_tvn, slot_gids, jnp.asarray(out_eval),
                              jnp.int64(window_ms), jnp.float64(args[0]),
                              jnp.float64(args[1]), fn, op, G, self.dstore.mesh)
         self.last_path = "twostep"
         res = LazyMeshResult(out, num_groups, T)
         return res.resolve() if fetch else res
+
+    def quantile(self, fn: str, out_ts: np.ndarray, window_ms: int,
+                 group_ids_per_shard: list[np.ndarray], num_groups: int,
+                 q: float, args=(0.0, 0.0)):
+        """Distributed quantile: sketch counts psum over the mesh; returns a
+        LazySketch whose resolve() presents [G, T] on host (same presenter as
+        the in-process SketchPartial merge)."""
+        slot_tvn = tuple(self.dstore.arrays())
+        slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
+        from ..query.exec import _pad_steps
+        out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
+        # pow2-bucket the group count: a churning by() cardinality must not
+        # compile a fresh program per distinct G (same rule as aggregate())
+        Gp = _pow2(num_groups)
+        out = dist_quantile_sketch(slot_tvn, slot_gids, jnp.asarray(out_eval),
+                                   jnp.int64(window_ms), jnp.float64(args[0]),
+                                   jnp.float64(args[1]), fn, Gp,
+                                   self.dstore.mesh)
+        self.last_path = "sketch"
+
+        class LazySketch:
+            def resolve(self_inner) -> np.ndarray:
+                counts = np.asarray(
+                    out.addressable_shards[0].data[0])[:num_groups, :, :T]
+                return aggregators.present_quantile_sketch(counts, q)
+        return LazySketch()
+
+    def topk(self, fn: str, out_ts: np.ndarray, window_ms: int,
+             group_ids_per_shard: list[np.ndarray], num_groups: int,
+             k: int, bottom: bool, args=(0.0, 0.0)):
+        """Distributed topk/bottomk: local candidates + ONE all_gather of
+        fixed-size blocks + global re-select, all on the mesh. Returns a lazy
+        handle resolving to (values [G, k, T], shard_ids, rows, present) —
+        the caller maps (shard, row) back to series keys."""
+        slot_tvn = tuple(self.dstore.arrays())
+        slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
+        from ..query.exec import _pad_steps
+        out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
+        Gp = _pow2(num_groups)    # compile-space bucketing, as aggregate()
+        outs = dist_topk(slot_tvn, slot_gids, jnp.asarray(out_eval),
+                         jnp.int64(window_ms), jnp.float64(args[0]),
+                         jnp.float64(args[1]), fn, int(k), bool(bottom),
+                         Gp, self.dstore.mesh, self.dstore.ndev)
+        self.last_path = "topk"
+
+        class LazyTopK:
+            def resolve(self_inner):
+                v, r, sh, ok = (np.asarray(
+                    o.addressable_shards[0].data[0])[:num_groups]
+                    for o in outs)
+                # [G, T, k] -> [G, k, T]; un-padded steps only
+                mv = np.moveaxis(v, 2, 1)[:, :, :T]
+                return (np.where(np.moveaxis(ok, 2, 1)[:, :, :T], mv, np.nan),
+                        np.moveaxis(sh, 2, 1)[:, :, :T],
+                        np.moveaxis(r, 2, 1)[:, :, :T],
+                        np.moveaxis(ok, 2, 1)[:, :, :T])
+        return LazyTopK()
 
 
 def _pow2(n: int, floor: int = 8) -> int:

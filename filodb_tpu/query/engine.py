@@ -29,6 +29,15 @@ from .rangevector import QueryResult, RangeVectorKey, ResultMatrix
 # (psum/pmin/pmax — ops/aggregators.py partial layout)
 MESH_OPS = frozenset({"sum", "avg", "count", "group", "stddev", "stdvar",
                       "min", "max"})
+# order statistics lowered onto the mesh: topk/bottomk gather fixed-size
+# candidate blocks (parallel/distributed.dist_topk), quantile psums sketch
+# counts. count_values stays on the host merge: its partial state is keyed
+# by rendered value STRINGS — there is no fixed-size device layout to
+# gather, and only [distinct values] rows cross shards anyway.
+MESH_ORDER_OPS = frozenset({"topk", "bottomk", "quantile"})
+# device-side per-group loops in dist_topk compile per group: cap G like the
+# in-process order-stat map does (exec.AggregateMapReduce.ORDER_STAT_MAX_GROUPS)
+MESH_TOPK_MAX_GROUPS = 16
 # rows outside the selection: a group id no kernel's one-hot/segment scatter
 # ever matches (OOB scatter updates drop; one-hot comparisons never equal it)
 _EXCLUDED_GID = 1 << 30
@@ -236,29 +245,44 @@ class QueryEngine:
     # per-shard dispatch IS the shard_map and the reduce IS the psum) --------
 
     def _mesh_executor(self, shards):
-        """A MeshQueryExecutor when every shard's store lives on its mesh
-        device with one common [S, C] shape, else None (host fallback)."""
+        """A MeshQueryExecutor when every shard's store lives on its
+        round-robin mesh device (shard i on device i % ndev — standalone's
+        placement; shards-per-device >= 1) with one common [S, C] shape,
+        else None (host fallback)."""
         from ..parallel.distributed import DistributedStore, MeshQueryExecutor
-        if self.mesh is None or self.mesh.devices.size != len(shards):
+        if self.mesh is None:
+            return None
+        ndev = self.mesh.devices.size
+        if len(shards) < ndev or len(shards) % ndev:
             return None
         devs = list(self.mesh.devices.ravel())
         s0 = shards[0].store
         if s0 is None:
             return None
-        for sh, dev in zip(shards, devs):
+        for i, sh in enumerate(shards):
             st = sh.store
             if (st is None or getattr(sh, "bucket_les", None) is not None
                     or st.val.ndim != 2 or (st.S, st.C) != (s0.S, s0.C)
-                    or list(st.ts.devices())[0] != dev):
+                    or list(st.ts.devices())[0] != devs[i % ndev]):
                 return None
         return MeshQueryExecutor(DistributedStore(self.mesh, shards))
 
     def _try_mesh(self, plan: L.LogicalPlan) -> QueryResult | None:
-        """Execute ``op(fn(selector[w]))`` via shard_map/psum when the plan
-        shape, operator, and store layout allow; None => caller falls back."""
-        if not isinstance(plan, L.Aggregate) or plan.operator not in MESH_OPS:
+        """Execute ``op(fn(selector[w]))`` via the mesh when the plan shape,
+        operator, and store layout allow; None => caller falls back. Basic
+        aggregates reduce via psum; topk/bottomk all_gather candidate blocks
+        and quantile psums sketch counts (ref: AggrOverRangeVectors.scala:244
+        — every aggregation's map phase runs at the data)."""
+        if not isinstance(plan, L.Aggregate):
             return None
-        if plan.params:
+        op = plan.operator
+        if op in MESH_OPS:
+            if plan.params:
+                return None
+        elif op in MESH_ORDER_OPS:
+            if len(plan.params) != 1:
+                return None
+        else:
             return None
         inner = plan.vectors
         if isinstance(inner, L.PeriodicSeriesWithWindowing):
@@ -322,13 +346,74 @@ class QueryEngine:
             # query of a new (fn, op, G-bucket, T-bucket) shape still traces
             # and compiles here — step-count bucketing inside the executor
             # bounds that compile space exactly like the in-process path
-            lazy = ex.aggregate(fn, plan.operator, out_ts, window, gids_list,
-                                G, args=(a0, a1), fetch=False)
+            if op == "quantile":
+                # same safety gates as the in-process order-stat map: group
+                # cap + dense-sketch memory cap (every device allocates the
+                # [Gp, W, T] counts; the host route falls back to the exact
+                # matrix instead of dying in HBM)
+                from ..ops import aggregators as _agg
+                from .exec import _SKETCH_BYTES_CAP, AggregateMapReduce, _pow2
+                if (G > AggregateMapReduce.ORDER_STAT_MAX_GROUPS
+                        or _pow2(G) * _agg.SKETCH_WIDTH
+                        * (len(out_ts) + 31) * 4 > _SKETCH_BYTES_CAP):
+                    return None
+                lazy = ex.quantile(fn, out_ts, window, gids_list, G,
+                                   float(plan.params[0]), args=(a0, a1))
+            elif op in ("topk", "bottomk"):
+                k = max(int(plan.params[0]), 0)
+                if k == 0 or G > MESH_TOPK_MAX_GROUPS:
+                    return None
+                lazy = ex.topk(fn, out_ts, window, gids_list, G, k,
+                               op == "bottomk", args=(a0, a1))
+                # any partition release invalidates (shard, row) -> key
+                # resolution after the fetch; capture the coarse epochs now
+                epochs = [sh._release_epoch for sh in shards]
+            else:
+                lazy = ex.aggregate(fn, op, out_ts, window, gids_list,
+                                    G, args=(a0, a1), fetch=False)
         self.last_exec_path = f"mesh-{ex.last_path}"
-        m = ResultMatrix(out_ts, lazy.resolve(), list(uniq))
+        if op in ("topk", "bottomk"):
+            m = self._present_mesh_topk(lazy, shards, epochs, out_ts,
+                                        list(uniq))
+        else:
+            m = ResultMatrix(out_ts, lazy.resolve(), list(uniq))
         from .exec import check_sample_limit
         check_sample_limit(m.num_series, len(out_ts), self.config.sample_limit)
         return QueryResult(m)
+
+    def _present_mesh_topk(self, lazy, shards, epochs, out_ts,
+                           group_keys) -> ResultMatrix:
+        """Map the mesh topk's (shard, row) winners back to series keys and
+        present them Prometheus-style (union of selected series, values at
+        steps where each made the cut). Key resolution re-takes each winner
+        shard's lock and validates its release epoch — a purge/eviction
+        since dispatch could have re-assigned the row to a new series."""
+        from .exec import QueryError, TopKPartial, _present_topk
+        vals, shard_ids, rows, ok = lazy.resolve()
+        G, k, T = vals.shape
+        flat_ok = ok.ravel()
+        pairs = (shard_ids.ravel()[flat_ok].astype(np.int64) << 32) \
+            | rows.ravel()[flat_ok].astype(np.int64)
+        upairs = np.unique(pairs)
+        key_table = []
+        pair_slot = {}
+        for pr in upairs.tolist():
+            si, row = pr >> 32, pr & 0xFFFFFFFF
+            sh = shards[si]
+            with sh.lock:
+                if sh._release_epoch != epochs[si]:
+                    raise QueryError(
+                        "selection invalidated by concurrent partition "
+                        "release (eviction/purge); retry the query")
+                key_table.append(sh.rv_key_of(int(row)))
+            pair_slot[pr] = len(key_table) - 1
+        key_ref = np.full(G * k * T, -1, np.int64)
+        if len(upairs):
+            idx = np.nonzero(flat_ok)[0]
+            key_ref[idx] = [pair_slot[int(p)] for p in pairs.tolist()]
+        return _present_topk(TopKPartial(
+            k, False, out_ts, group_keys, vals,
+            key_ref.reshape(G, k, T), key_table))
 
     # -- cross-node helpers ---------------------------------------------------
 

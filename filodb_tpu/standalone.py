@@ -364,14 +364,17 @@ class FiloServer:
             self._start_shard(dataset, shard_num)
         self.manager.subscribe(self._on_shard_event)
         mapper = ShardMapper(num_shards, spread=cfg["spread"])
-        # one device per owned shard => PromQL aggregates run on the mesh
-        # (query/engine.py _try_mesh); any other topology stays in-process
+        # shards spread round-robin over local devices (>= 1 per device) =>
+        # PromQL aggregates run on the mesh (query/engine.py _try_mesh); any
+        # other topology (peer-owned shards, indivisible counts) stays on the
+        # in-process / cross-node dispatch paths
         mesh = None
         try:
             import jax
             devs = jax.devices()
             owned = self.manager.shards_of_node(dataset, self.node)
-            if 1 < num_shards == len(owned) == len(devs):
+            if (1 < num_shards == len(owned) and len(devs) > 1
+                    and num_shards % len(devs) == 0):
                 from .parallel.distributed import make_mesh
                 mesh = make_mesh(devs)
         except Exception:

@@ -202,8 +202,8 @@ def test_engine_mesh_fallbacks():
     eng = QueryEngine(ms, "prometheus", mesh=mesh)
     start, end, step = START + 300_000, START + 500_000, 20_000
 
-    # topk carries order-statistic partials — not a psum; local route
-    r = eng.query_range("topk(2, rate(m[5m]))", start, end, step)
+    # count_values partials are value-STRING keyed — host merge, local route
+    r = eng.query_range('count_values("v", count(m) by (grp))', start, end, step)
     assert eng.last_exec_path == "local"
     assert r.matrix.num_series > 0
 
@@ -224,6 +224,88 @@ def test_store_blocks_stay_on_their_devices():
     for i, s in enumerate(shards):
         assert list(s.store.ts.devices())[0] == devs[i]
     dstore = DistributedStore(mesh, shards)
-    ts_g, val_g, n_g = dstore.arrays()
+    ((ts_g, val_g, n_g),) = dstore.arrays()
     assert ts_g.shape == (8, 16, 64)
     assert len(ts_g.sharding.device_set) == 8
+
+
+def test_engine_mesh_topk_and_quantile():
+    """topk/bottomk all_gather fixed-size candidate blocks over the mesh and
+    quantile psums sketch counts — parity with the in-process order-stat
+    path, keys included (ref: AggrOverRangeVectors.scala:244-900)."""
+    from filodb_tpu.query.engine import QueryEngine
+
+    mesh, ms, shards = build_f32_store()
+    eng = QueryEngine(ms, "prometheus", mesh=mesh)
+    local = QueryEngine(ms, "prometheus")
+    start, end, step = START + 300_000, START + 500_000, 20_000
+
+    for q, route in (("topk(3, rate(m[5m]))", "mesh-topk"),
+                     ("bottomk(2, rate(m[5m]))", "mesh-topk"),
+                     ("topk(2, rate(m[5m])) by (grp)", "mesh-topk"),
+                     ('topk(2, rate(m{grp="g1"}[5m]))', "mesh-topk")):
+        r = eng.query_range(q, start, end, step)
+        assert eng.last_exec_path == route, (q, eng.last_exec_path)
+        want = local.query_range(q, start, end, step)
+        assert local.last_exec_path == "local"
+        got = {k: (t.tolist(), v) for k, t, v in r.matrix.iter_series()}
+        exp = {k: (t.tolist(), v) for k, t, v in want.matrix.iter_series()}
+        # same winners at the same steps; values agree within the grid-vs-
+        # general rate-kernel tolerance (the two routes legitimately use
+        # different lowering of the same math)
+        assert set(got) == set(exp), f"{q}: different winners"
+        for k in exp:
+            assert got[k][0] == exp[k][0], f"{q}: {k} selected at different steps"
+            np.testing.assert_allclose(got[k][1], exp[k][1], rtol=2e-4,
+                                       atol=1e-4)
+
+    for q in ("quantile(0.5, rate(m[5m]))",
+              "quantile(0.9, rate(m[5m])) by (grp)"):
+        r = eng.query_range(q, start, end, step)
+        assert eng.last_exec_path == "mesh-sketch", (q, eng.last_exec_path)
+        want = local.query_range(q, start, end, step)
+        got = {k: v for k, _t, v in r.matrix.iter_series()}
+        exp = {k: v for k, _t, v in want.matrix.iter_series()}
+        assert set(got) == set(exp)
+        for k in exp:
+            np.testing.assert_allclose(got[k], exp[k], rtol=1e-9,
+                                       equal_nan=True)
+
+
+def test_mesh_two_shards_per_device():
+    """16 shards on 8 devices: per-device slot blocks reduce locally before
+    the collective (shards-per-device >= 1; the reference never requires one
+    data node per shard either)."""
+    from filodb_tpu.query.engine import QueryEngine
+
+    mesh = make_mesh()
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    devs = list(mesh.devices.ravel())
+    shards = [ms.setup("prometheus", GAUGE, i, cfg, device=devs[i % 8])
+              for i in range(16)]
+    rng = np.random.default_rng(11)
+    for i in range(48):   # 3 series per shard
+        b = RecordBuilder(GAUGE)
+        vals = np.cumsum(rng.exponential(5.0, N))
+        for t in range(N):
+            b.add({"_metric_": "m", "host": f"h{i}", "grp": f"g{i % 4}"},
+                  START + t * INTERVAL, float(vals[t]))
+        ms.ingest("prometheus", i % 16, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "prometheus", mesh=mesh)
+    local = QueryEngine(ms, "prometheus")
+    start, end, step = START + 300_000, START + 500_000, 20_000
+    for q in ("sum(rate(m[5m]))", "sum by (grp) (rate(m[5m]))",
+              "max(rate(m[5m]))", "topk(3, rate(m[5m]))",
+              "quantile(0.5, rate(m[5m]))"):
+        r = eng.query_range(q, start, end, step)
+        assert eng.last_exec_path.startswith("mesh-"), (q, eng.last_exec_path)
+        want = local.query_range(q, start, end, step)
+        got = {k: v for k, _t, v in r.matrix.iter_series()}
+        exp = {k: v for k, _t, v in want.matrix.iter_series()}
+        assert set(got) == set(exp), q
+        for k in exp:
+            np.testing.assert_allclose(got[k], exp[k], rtol=2e-4, atol=1e-4,
+                                       equal_nan=True)
