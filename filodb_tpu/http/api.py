@@ -137,7 +137,9 @@ class FiloHttpServer:
         from dataclasses import asdict
 
         from ..utils.metrics import registry
-        for ds, e in self.engines.items():
+        # snapshot: a downsample serving refresh adds family engines
+        # concurrently (standalone ds_serve_loop)
+        for ds, e in list(self.engines.items()):
             for s in e.memstore.shards_of(ds):
                 tags = {"dataset": ds, "shard": str(s.shard_num)}
                 for k, v in asdict(s.stats).items():
@@ -340,6 +342,6 @@ class FiloHttpServer:
             return {"shards": [
                 {"dataset": ds, "shard": s.shard_num, "status": "Active",
                  "numSeries": s.num_series}
-                for ds, e in self.engines.items()
+                for ds, e in list(self.engines.items())
                 for s in e.memstore.shards_of(ds)]}
         return self.cluster.status()

@@ -25,6 +25,16 @@ from ..core.downsample import (DOWNSAMPLERS, downsample_records,
 from ..core.store import ChunkSetRecord, FileColumnStore
 
 
+def _serving_config(n_series: int, max_samples: int) -> "StoreConfig":
+    """StoreConfig sized to the loaded family (pow2-padded) — the raw-scale
+    default (1M x 1024) would allocate GBs for a few thousand buckets."""
+    from ..core.memstore import StoreConfig
+    p2 = lambda n: 1 << max(n - 1, 1).bit_length()  # noqa: E731
+    return StoreConfig(max_series_per_shard=p2(max(n_series, 16)),
+                       samples_per_series=p2(max(max_samples, 64)),
+                       flush_batch_size=10**9, groups_per_shard=1)
+
+
 def run_batch_downsample(store: FileColumnStore, dataset: str, shard: int,
                          resolution_ms: int, start_ms: int = 0,
                          end_ms: int = 1 << 62, aggs=DOWNSAMPLERS) -> dict[str, int]:
@@ -359,8 +369,10 @@ def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
         pids, ts, cols = fam
         names = tuple(cols)
         schema = ds_schema(names)
-        shard_obj = memstore.setup(family, schema, shard,
-                                   config or StoreConfig())
+        if config is None:
+            uniq, counts = np.unique(pids, return_counts=True)
+            config = _serving_config(len(uniq), int(counts.max()))
+        shard_obj = memstore.setup(family, schema, shard, config)
         labels_by_pid = {pid: labels for pid, labels, _ in
                          (store.read_part_keys(family, shard) or ())}
         order = np.lexsort((ts, pids))
@@ -376,10 +388,21 @@ def load_downsampled(store: FileColumnStore, dataset: str, shard: int,
     meta = store.read_meta(ds_name, shard) if hasattr(store, "read_meta") else {}
     les = np.asarray(meta["bucket_les"]) if meta.get("bucket_les") else None
     schema = PROM_HISTOGRAM if les is not None else GAUGE
-    shard_obj = memstore.setup(ds_name, schema, shard, config or StoreConfig())
+    chunk_groups = list(store.read_chunksets(ds_name, shard) or ())
+    if not chunk_groups:
+        # nothing published under either layout: loading must not fabricate
+        # an empty dataset (or allocate a raw-scale default store for it)
+        raise KeyError(f"no downsampled data for {ds_name} shard {shard}")
+    if config is None:
+        per_pid: dict[int, int] = {}
+        for _g, records in chunk_groups:
+            for r in records:
+                per_pid[r.part_id] = per_pid.get(r.part_id, 0) + len(r.ts)
+        config = _serving_config(len(per_pid), max(per_pid.values()))
+    shard_obj = memstore.setup(ds_name, schema, shard, config)
     labels_by_pid = {pid: labels for pid, labels, _ in
                      (store.read_part_keys(ds_name, shard) or ())}
-    for _g, records in store.read_chunksets(ds_name, shard) or ():
+    for _g, records in chunk_groups:
         for r in records:
             b = RecordBuilder(schema, bucket_les=les)
             labels = labels_by_pid.get(r.part_id, {"_metric_": "unknown"})

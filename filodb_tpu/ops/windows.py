@@ -92,6 +92,15 @@ def window_minmax(val, valid, left, right, op: str, block: int = 32):
     O(window) — and every access is a static-shape gather XLA can fuse.
     """
     P, C = val.shape
+    if C % block:
+        # pad to a block multiple with invalid cells (neutral under the
+        # reduce); windows never index past the caller's right <= C, so the
+        # tail contributes nothing (non-pow2 capacities: downsample-family
+        # stores sized to their bucket count)
+        pad = (-C) % block
+        val = jnp.pad(val, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+        C += pad
     nb = C // block
     neutral = jnp.inf if op == "min" else -jnp.inf
     red = jnp.minimum if op == "min" else jnp.maximum

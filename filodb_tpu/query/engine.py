@@ -87,7 +87,7 @@ class QueryEngine:
                  shard_mapper: ShardMapper | None = None,
                  config: QueryConfig = QueryConfig(), mesh=None,
                  cluster=None, node: str | None = None,
-                 endpoint_resolver=None):
+                 endpoint_resolver=None, route_dataset: str | None = None):
         """``cluster``/``node``: the ShardManager's shard->node view and this
         node's name — leaves for peer-owned shards dispatch remotely
         (query/wire.py RemoteLeafExec; ref: PlanDispatcher.scala).
@@ -108,6 +108,9 @@ class QueryEngine:
         self.cluster = cluster
         self.node = node
         self.endpoint_resolver = endpoint_resolver
+        # dataset name used for shard->node routing: a downsample-family
+        # serving engine ("ds:ds_1m") routes by its RAW dataset's assignment
+        self.route_dataset = route_dataset or dataset
         # route taken by the last query:
         # "mesh-fused" | "mesh-twostep" | "mesh-empty" | "local"
         self.last_exec_path: str | None = None
@@ -125,7 +128,7 @@ class QueryEngine:
         if self.cluster is None or self.node is None:
             return None
         try:
-            owner = self.cluster.node_of(self.dataset, shard)
+            owner = self.cluster.node_of(self.route_dataset, shard)
         except KeyError:
             return None
         if owner is None or owner == self.node:

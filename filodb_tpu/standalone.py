@@ -163,6 +163,7 @@ class FiloServer:
         self._ds_res: list[int] = []
         self._cascade_stop = None
         self._cascade_wm: dict[int, int] = {}
+        self._ds_serve_stop = None
         self._endpoints: dict[str, str] = {}
         self._endpoints_at = 0.0
 
@@ -264,6 +265,8 @@ class FiloServer:
         for c in consumers:
             c.stop()
         for ds in list(self.engines):
+            if ds not in self.manager.map:
+                continue       # downsample-family serving view, not a dataset
             for s in stopped:
                 if self.manager.node_of(ds, s) == self.node:
                     self.manager.set_status(ds, s, ShardStatus.STOPPED)
@@ -421,9 +424,11 @@ class FiloServer:
                 interval_s=parse_duration_ms(cfg["cluster.heartbeat_interval"]) / 1000.0)
             # publish current ownership with each heartbeat so late joiners
             # adopt the incumbent assignment (rejoin without split-brain)
+            # only manager-known datasets claim shards: downsample-family
+            # engines (ds:ds_1m) are serving views, not assignable datasets
             self.membership.claims_fn = lambda: {
                 ds: [int(s) for s in self.manager.shards_of_node(ds, self.node)]
-                for ds in list(self.engines)}
+                for ds in list(self.engines) if ds in self.manager.map}
             # publish OUR http endpoint so peers can dispatch plan subtrees
             # here; the bound port is authoritative (config may say port 0).
             # A wildcard bind address is not dialable by peers: advertise the
@@ -437,6 +442,54 @@ class FiloServer:
             self.membership.http_addr = f"{adv}:{self.http.port}"
             self.membership.poll_once()
             self.membership.start()
+        if self._ds_publish is not None:
+            # serve the downsample families over HTTP: a background refresh
+            # loads each resolution's published chunks from the sink into a
+            # serving memstore and swaps the family's engine atomically, so
+            # /promql/{ds}:ds_1m/... answers PromQL over dMin/dMax/dAvg/...
+            # columns (ref: the reference's separate downsample cluster
+            # reading the downsample tables; here the same process serves
+            # both). Full reload per refresh — family sizes are 1/res of raw.
+            self._ds_serve_stop = threading.Event()
+            serve_s = parse_duration_ms(
+                cfg.get("downsample.serve_interval", "30s")) / 1000.0
+
+            def ds_serve_loop(_ds=dataset, _mapper=mapper):
+                from .core.downsample import ds_family
+                from .jobs.batch_downsampler import load_downsampled
+                while True:
+                    try:
+                        with self._shards_lock:
+                            owned = sorted(self._running)
+                        for res in self._ds_res:
+                            fam = ds_family(_ds, res)
+                            ms = TimeSeriesMemStore()
+                            for s in owned:
+                                try:
+                                    load_downsampled(self._sink, _ds, s, res,
+                                                     "dAvg", ms)
+                                except KeyError:
+                                    continue      # not yet published
+                                except Exception:  # noqa: BLE001
+                                    log.exception(
+                                        "downsample load failed for %s "
+                                        "shard %s", fam, s)
+                            if ms.shards_of(fam):
+                                # cluster-aware like the raw engine: leaves
+                                # for peer-owned shards dispatch to the peer's
+                                # serving view of the same family
+                                self.engines[fam] = QueryEngine(
+                                    ms, fam, _mapper,
+                                    cluster=self.manager, node=self.node,
+                                    endpoint_resolver=self._resolve_endpoint,
+                                    route_dataset=_ds)
+                    except Exception:  # noqa: BLE001
+                        log.exception("downsample serving refresh failed")
+                    if self._ds_serve_stop.wait(serve_s):
+                        return
+
+            threading.Thread(target=ds_serve_loop, daemon=True,
+                             name="ds-serving").start()
         if self._ds_publish is not None and len(self._ds_res) > 1:
             # periodic cascade to coarser resolutions (ref: DownsamplerMain's
             # 6-hourly batch job). Windows advance to the last COMPLETE coarse
@@ -502,6 +555,8 @@ class FiloServer:
     def shutdown(self) -> None:
         if self._cascade_stop is not None:
             self._cascade_stop.set()
+        if self._ds_serve_stop is not None:
+            self._ds_serve_stop.set()
         for c in self.consumers:
             c.stop()
         for c in self.consumers:
