@@ -32,14 +32,47 @@ def main(argv=None) -> int:
     q.add_argument("--start", type=float, required=True, help="unix seconds")
     q.add_argument("--end", type=float, required=True)
     q.add_argument("--step", default="15s")
+    q.add_argument("--resolution", default=None, metavar="RES",
+                   help="query the downsample family instead of raw data "
+                        "(e.g. 1m -> {dataset}:ds_1m; select columns with "
+                        "metric::dAvg)")
 
     lv = sub.add_parser("labelvalues", help="list label values")
     lv.add_argument("label")
     lv.add_argument("--host", default="http://127.0.0.1:8080")
     lv.add_argument("--dataset", default="prometheus")
 
-    st = sub.add_parser("status", help="cluster/shard status")
+    se = sub.add_parser("series", help="list series matching a selector "
+                                       "(timeseriesMetadata analog)")
+    se.add_argument("matcher", help='PromQL selector, e.g. m{dc="east"}')
+    se.add_argument("--host", default="http://127.0.0.1:8080")
+    se.add_argument("--dataset", default="prometheus")
+    se.add_argument("--start", type=float, default=0.0)
+    se.add_argument("--end", type=float, default=4102444800.0)
+
+    st = sub.add_parser("status", help="cluster/shard status; --dataset/"
+                                       "--shard drill into one shard")
     st.add_argument("--host", default="http://127.0.0.1:8080")
+    st.add_argument("--dataset", default=None)
+    st.add_argument("--shard", type=int, default=None)
+
+    ds = sub.add_parser("dataset", help="dataset operations (init/list/"
+                                        "validateSchemas analogs)")
+    dsub = ds.add_subparsers(dest="dscmd", required=True)
+    dc = dsub.add_parser("create", help="register a dataset in a durable "
+                                        "column store directory")
+    dc.add_argument("--data-dir", required=True)
+    dc.add_argument("--dataset", required=True)
+    dc.add_argument("--schema", default="gauge")
+    dc.add_argument("--shards", type=int, default=1)
+    dv = dsub.add_parser("validate", help="resolve + validate a schema "
+                                          "definition, print its layout")
+    dv.add_argument("--schema", default=None, help="schema name")
+    dv.add_argument("--config", default=None, help="server config json "
+                                                   "(validates its schema)")
+    dl = dsub.add_parser("list", help="list datasets")
+    dl.add_argument("--data-dir", default=None)
+    dl.add_argument("--host", default=None)
 
     ic = sub.add_parser("importcsv", help="ingest a CSV into a running server's bus "
                                           "or print container stats")
@@ -50,13 +83,24 @@ def main(argv=None) -> int:
     if args.cmd == "serve":
         return _serve(args)
     if args.cmd == "query":
-        return _http_get(args.host, f"/promql/{args.dataset}/api/v1/query_range",
+        dataset = args.dataset
+        if args.resolution:
+            from .core.downsample import ds_family
+            from .config import parse_duration_ms
+            dataset = ds_family(dataset, parse_duration_ms(args.resolution))
+        return _http_get(args.host, f"/promql/{dataset}/api/v1/query_range",
                          {"query": args.promql, "start": args.start,
                           "end": args.end, "step": args.step})
     if args.cmd == "labelvalues":
         return _http_get(args.host, f"/promql/{args.dataset}/api/v1/label/{args.label}/values", {})
+    if args.cmd == "series":
+        return _http_get(args.host, f"/promql/{args.dataset}/api/v1/series",
+                         {"match[]": args.matcher, "start": args.start,
+                          "end": args.end})
     if args.cmd == "status":
-        return _http_get(args.host, "/api/v1/cluster/status", {})
+        return _status(args)
+    if args.cmd == "dataset":
+        return _dataset(args)
     if args.cmd == "importcsv":
         from .ingest.bus import FileBus
         from .ingest.stream import CsvStream
@@ -97,12 +141,139 @@ def _serve(args) -> int:
     return 0
 
 
-def _http_get(host: str, path: str, params: dict) -> int:
+def _fetch_json(host: str, path: str, params: dict | None = None):
     import urllib.parse
     import urllib.request
     url = host + path + ("?" + urllib.parse.urlencode(params) if params else "")
     with urllib.request.urlopen(url) as r:
-        print(json.dumps(json.load(r), indent=2))
+        return json.load(r)
+
+
+def _status(args) -> int:
+    """Cluster status; with --dataset (and optionally --shard) drill into
+    per-shard rows with live series counts (ref: CliMain dumpShardStatus —
+    per-shard status lines)."""
+    payload = _fetch_json(args.host, "/api/v1/cluster/status")
+    data = payload.get("data", payload)
+    if args.dataset is None:
+        print(json.dumps(payload, indent=2))
+        return 0
+    shards = (data.get("datasets", {}).get(args.dataset)
+              or data.get("shards"))
+    if shards is None:
+        print(f"dataset {args.dataset!r} unknown to the cluster", file=sys.stderr)
+        return 1
+    # live per-shard series counts from the metrics endpoint
+    counts: dict[str, str] = {}
+    try:
+        import urllib.request
+        with urllib.request.urlopen(args.host + "/metrics") as r:
+            for line in r.read().decode().splitlines():
+                if line.startswith("filodb_shard_num_series{"):
+                    labels, val = line[len("filodb_shard_num_series"):].rsplit(" ", 1)
+                    if f'dataset="{args.dataset}"' in labels:
+                        import re as _re
+                        m = _re.search(r'shard="(\d+)"', labels)
+                        if m:
+                            counts[m.group(1)] = val.strip()
+    except Exception:  # noqa: BLE001 — metrics endpoint optional
+        pass
+    if isinstance(shards, dict):
+        rows = sorted(shards.items(), key=lambda kv: int(kv[0]))
+    else:   # single-node fallback shape: list of shard dicts
+        rows = [(str(s["shard"]), s) for s in shards
+                if s.get("dataset") == args.dataset]
+        if not rows:
+            print(f"dataset {args.dataset!r} unknown to the server",
+                  file=sys.stderr)
+            return 1
+    shown = 0
+    for sid, info in rows:
+        if args.shard is not None and int(sid) != args.shard:
+            continue
+        node = info.get("node", "-")
+        status = info.get("status", "-")
+        nseries = counts.get(str(sid), info.get("numSeries", "-"))
+        print(f"shard {sid:>4}  node={node}  status={status}  "
+              f"numSeries={nseries}")
+        shown += 1
+    if args.shard is not None and not shown:
+        print(f"shard {args.shard} not found in dataset {args.dataset!r}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _dataset(args) -> int:
+    """Dataset verbs (ref: CliMain init/list/validateSchemas)."""
+    if args.dscmd == "create":
+        from .core.store import FileColumnStore
+        from .core.memstore import TimeSeriesMemStore
+        schemas = TimeSeriesMemStore().schemas
+        try:
+            schema = schemas[args.schema]
+        except KeyError:
+            print(f"unknown schema {args.schema!r}; available: "
+                  f"{sorted(schemas.by_name)}", file=sys.stderr)
+            return 1
+        store = FileColumnStore(args.data_dir)
+        for shard in range(args.shards):
+            meta = store.read_meta(args.dataset, shard) or {}
+            meta.update({"schema": schema.name, "num_shards": args.shards})
+            store.write_meta(args.dataset, shard, meta)
+        print(f"created dataset {args.dataset!r} ({args.shards} shards, "
+              f"schema {schema.name}) in {args.data_dir}")
+        return 0
+    if args.dscmd == "validate":
+        from .core.memstore import TimeSeriesMemStore
+        schemas = TimeSeriesMemStore().schemas
+        name = args.schema
+        if args.config:
+            with open(args.config) as f:
+                name = json.load(f).get("schema", "gauge")
+        if name is None:
+            names = sorted(schemas.by_name)
+        else:
+            names = [name]
+        rc = 0
+        for nm in names:
+            try:
+                sch = schemas[nm]
+            except KeyError:
+                print(f"{nm}\tUNKNOWN (available: {sorted(schemas.by_name)})")
+                rc = 1
+                continue
+            cols = ", ".join(f"{c.name}:{c.ctype.name.lower()}"
+                             + (":counter" if c.is_counter else "")
+                             for c in sch.columns)
+            print(f"{nm}\tOK\tcolumns=[{cols}]\tvalue_column={sch.value_column}"
+                  f"\tdownsamplers={list(sch.downsamplers)}")
+        return rc
+    if args.dscmd == "list":
+        if args.host:
+            payload = _fetch_json(args.host, "/api/v1/cluster/status")
+            data = payload.get("data", payload)
+            names = sorted(data.get("datasets", {})) or sorted(
+                {s["dataset"] for s in data.get("shards", [])})
+            for n in names:
+                print(n)
+            return 0
+        if args.data_dir:
+            import os
+            if not os.path.isdir(args.data_dir):
+                print(f"no such directory {args.data_dir}", file=sys.stderr)
+                return 1
+            for n in sorted(os.listdir(args.data_dir)):
+                if os.path.isdir(os.path.join(args.data_dir, n)):
+                    print(n)
+            return 0
+        print("dataset list needs --host or --data-dir", file=sys.stderr)
+        return 2
+    return 2
+
+
+def _http_get(host: str, path: str, params: dict) -> int:
+    print(json.dumps(_fetch_json(host, path, params), indent=2))
     return 0
 
 

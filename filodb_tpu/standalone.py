@@ -479,7 +479,7 @@ class FiloServer:
                                 # for peer-owned shards dispatch to the peer's
                                 # serving view of the same family
                                 self.engines[fam] = QueryEngine(
-                                    ms, fam, _mapper,
+                                    ms, fam, _mapper, cfg.query_config(),
                                     cluster=self.manager, node=self.node,
                                     endpoint_resolver=self._resolve_endpoint,
                                     route_dataset=_ds)

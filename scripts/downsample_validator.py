@@ -52,8 +52,16 @@ def _res_ms(resolution: str) -> int:
     return int(m.group(1)) * mult[m.group(2)]
 
 
-def _res_label(res_ms: int) -> str:
-    return f"{res_ms // 1000}s" if res_ms < 60_000 else f"{res_ms // 60_000}m"
+def _family(dataset: str, res_ms: int) -> str:
+    """Downsample family name; uses the framework's own naming when the
+    package is importable (always, in-repo) so the two can never drift."""
+    try:
+        from filodb_tpu.core.downsample import ds_family
+        return ds_family(dataset, res_ms)
+    except ImportError:
+        suffix = (f"{res_ms // 60_000}m" if res_ms % 60_000 == 0
+                  else f"{res_ms // 1000}s")
+        return f"{dataset}:ds_{suffix}"
 
 
 def _query_range(url: str, dataset: str, promql: str, start_ms: int,
@@ -77,17 +85,28 @@ def _query_range(url: str, dataset: str, promql: str, start_ms: int,
 
 
 def compare_results(raw: dict, ds: dict, rtol: float) -> dict:
-    """Compare two {series_key: {ts: value}} maps; counts mismatches over
-    timestamps present on both sides and raw series missing from ds."""
+    """Compare two {series_key: {ts: value}} maps: mismatches over shared
+    timestamps, raw series entirely missing from ds, and INTERIOR gaps —
+    raw buckets between a ds series' first and last emitted bucket with no
+    ds point are lost downsample data. Raw points after the ds series' last
+    bucket are expected lag (in-progress bucket, serving refresh) and are
+    not failures."""
     c = {"series_raw": len(raw), "series_ds": len(ds), "compared": 0,
-         "mismatches": 0, "max_rel_err": 0.0, "missing_ds_series": 0}
+         "mismatches": 0, "max_rel_err": 0.0, "missing_ds_series": 0,
+         "missing_ds_points": 0}
     for key, raw_pts in raw.items():
         ds_pts = ds.get(key)
         if ds_pts is None:
             c["missing_ds_series"] += 1
             continue
-        for t in sorted(set(raw_pts) & set(ds_pts)):
-            a, b = raw_pts[t], ds_pts[t]
+        lo, hi = min(ds_pts), max(ds_pts)
+        for t in sorted(raw_pts):
+            b = ds_pts.get(t)
+            if b is None:
+                if lo < t < hi:
+                    c["missing_ds_points"] += 1   # interior gap: lost bucket
+                continue
+            a = raw_pts[t]
             denom = max(abs(a), abs(b), 1e-12)
             rel = abs(a - b) / denom
             c["max_rel_err"] = max(c["max_rel_err"], rel)
@@ -103,7 +122,7 @@ def validate(url: str, dataset: str, resolution: str, metric: str,
     """Compare raw vs downsampled aggregates; returns a report dict with
     per-check pass/fail counts and the worst relative error seen."""
     res = _res_ms(resolution)
-    ds_dataset = f"{dataset}:ds_{_res_label(res)}"
+    ds_dataset = _family(dataset, res)
     # evaluate at bucket-end timestamps ((b+1)*res - 1): exact bucket cover
     first = (start_ms // res + 1) * res - 1
     url = url.rstrip("/")
@@ -120,7 +139,8 @@ def validate(url: str, dataset: str, resolution: str, metric: str,
         c = compare_results(raw, ds, rtol)
         report["checks"][col] = c
         report["checked"] += c["compared"]
-        report["failed"] += c["mismatches"] + c["missing_ds_series"]
+        report["failed"] += (c["mismatches"] + c["missing_ds_series"]
+                             + c["missing_ds_points"])
     report["ok"] = report["failed"] == 0 and report["checked"] > 0
     return report
 

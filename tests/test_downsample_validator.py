@@ -100,6 +100,10 @@ def test_validator_detects_mismatches():
     # a raw series entirely absent from the downsample dataset
     c = mod.compare_results(raw, {key: {1000: 5.0}}, rtol=1e-9)
     assert c["missing_ds_series"] == 1
+    # an INTERIOR dropped bucket is lost data; trailing lag is not
+    c = mod.compare_results({key: {1000: 5.0, 2000: 6.0, 3000: 7.0, 4000: 8.0}},
+                            {key: {1000: 5.0, 3000: 7.0}}, rtol=1e-9)
+    assert c["missing_ds_points"] == 1     # t=2000 gap; t=4000 is lag
     # drift inside tolerance passes, outside fails
     ds_drift = {key: {1000: 5.0 * (1 + 1e-7)}}
     assert mod.compare_results({key: {1000: 5.0}}, ds_drift,

@@ -137,3 +137,81 @@ def test_cli_importcsv_and_status(tmp_path, capsys):
     rc = main(["importcsv", str(p), "--bus", str(tmp_path / "bus.log")])
     assert rc == 0
     assert "published 2 samples" in capsys.readouterr().out
+
+
+def test_cli_dataset_verbs(tmp_path, capsys):
+    """Dataset create/validate/list (ref: CliMain init/list/validateSchemas)."""
+    from filodb_tpu.cli import main
+    from filodb_tpu.core.store import FileColumnStore
+
+    rc = main(["dataset", "create", "--data-dir", str(tmp_path / "d"),
+               "--dataset", "metrics", "--schema", "prom-counter",
+               "--shards", "2"])
+    assert rc == 0
+    meta = FileColumnStore(str(tmp_path / "d")).read_meta("metrics", 1)
+    assert meta["schema"] == "prom-counter" and meta["num_shards"] == 2
+
+    assert main(["dataset", "create", "--data-dir", str(tmp_path / "d"),
+                 "--dataset", "x", "--schema", "nope"]) == 1
+    capsys.readouterr()
+
+    assert main(["dataset", "validate", "--schema", "gauge"]) == 0
+    out = capsys.readouterr().out
+    assert "gauge\tOK" in out and "timestamp:timestamp" in out
+    assert main(["dataset", "validate", "--schema", "bogus"]) == 1
+    capsys.readouterr()
+    assert main(["dataset", "validate"]) == 0     # validates every schema
+    out = capsys.readouterr().out
+    assert "prom-histogram\tOK" in out
+
+    assert main(["dataset", "list", "--data-dir", str(tmp_path / "d")]) == 0
+    assert "metrics" in capsys.readouterr().out
+
+
+def test_cli_status_drilldown_and_ds_query(capsys):
+    """Per-shard status drill-down + --resolution downsample query flag."""
+    import numpy as np
+
+    from filodb_tpu.cli import main
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.http.api import FiloHttpServer
+    from filodb_tpu.query.engine import QueryEngine
+
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    ms = TimeSeriesMemStore()
+    for s in (0, 1):
+        ms.setup("prometheus", GAUGE, s, cfg)
+        b = RecordBuilder(GAUGE)
+        for t in range(5):
+            b.add({"_metric_": "m", "host": f"h{s}"}, 1_000_000 + t * 1000,
+                  float(t))
+        ms.ingest("prometheus", s, b.build())
+    ms.flush_all()
+    # a second engine standing in for a served downsample family
+    engines = {"prometheus": QueryEngine(ms, "prometheus"),
+               "prometheus:ds_1m": QueryEngine(ms, "prometheus")}
+    srv = FiloHttpServer(engines, port=0).start()
+    try:
+        host = f"http://127.0.0.1:{srv.port}"
+        assert main(["status", "--host", host, "--dataset", "prometheus"]) == 0
+        out = capsys.readouterr().out
+        assert "shard    0" in out and "shard    1" in out
+        assert "numSeries=1" in out
+        assert main(["status", "--host", host, "--dataset", "prometheus",
+                     "--shard", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "shard    1" in out and "shard    0" not in out
+        assert main(["status", "--host", host, "--dataset", "prometheus",
+                     "--shard", "9"]) == 1
+        capsys.readouterr()
+        # --resolution routes to the family dataset
+        assert main(["query", "count(m)", "--host", host, "--resolution", "1m",
+                     "--start", "1000", "--end", "1010", "--step", "5s"]) == 0
+        assert '"status": "success"' in capsys.readouterr().out
+        assert main(["series", 'm{host="h0"}', "--host", host]) == 0
+        assert '"host": "h0"' in capsys.readouterr().out
+    finally:
+        srv.stop()
