@@ -115,12 +115,131 @@ def _pad_size(m: int) -> int:
     return size
 
 
+@jax.jit
+def _decode_narrow(q, vmin, scale, pool, pool_rows):
+    """Reconstruct the f32 value block from the narrow-resident state:
+    quantized rows decode as vmin + (q + 32768) * scale (bit-exact for rows
+    the encoder marked ok — ops/narrow.py contract); raw-pool rows overlay
+    their exact f32 values (pool pad rows carry row index S -> dropped)."""
+    v = vmin[:, None] + (q.astype(jnp.float32) + 32768.0) * scale[:, None]
+    return v.at[pool_rows].set(pool, mode="drop")
+
+
+def _derive_ts_impl(first, n, interval, C):
+    """Reconstruct the i64 timestamp block of a grid-contiguous store from
+    per-row first timestamps: ts[r, k] = first[r] + k * interval for k < n[r]
+    (TS_PAD beyond, and everywhere for empty rows)."""
+    col = jax.lax.broadcasted_iota(jnp.int64, (first.shape[0], C), 1)
+    live = (col < n[:, None]) & (first[:, None] >= 0)
+    return jnp.where(live, first[:, None] + col * interval, TS_PAD)
+
+
+_derive_ts = jax.jit(_derive_ts_impl, static_argnums=(3,))
+
+
+def _verify_ts_impl(ts, first, n, interval, C):
+    """One fused derive-and-compare reduction — materializing the derived
+    block (plus the TPU's i64 hi/lo split temps) would transiently need
+    several x the block itself at 1M x 768."""
+    return jnp.all(ts == _derive_ts_impl(first, n, interval, C))
+
+
+_verify_ts = jax.jit(_verify_ts_impl, static_argnums=(4,))
+
+
+@jax.jit
+def _decode_narrow_rows(q, vmin, scale, pool, pool_slot, rid):
+    """Decode ONLY the given store rows ([P] ids): quantized reconstruction
+    with pool-value overlay — minority-cohort fixes must not materialize the
+    full [S, C] block (several GB at 1M x 768) for a handful of rows."""
+    qg = jnp.take(q, rid, axis=0)
+    v = (jnp.take(vmin, rid)[:, None]
+         + (qg.astype(jnp.float32) + 32768.0)
+         * jnp.take(scale, rid)[:, None])
+    slot = jnp.take(pool_slot, rid, mode="clip")
+    pv = jnp.take(pool, jnp.maximum(slot, 0), axis=0, mode="clip")
+    return jnp.where((slot >= 0)[:, None], pv, v)
+
+
+def _derive_ts_rows_impl(first_g, n_g, interval, C):
+    col = jax.lax.broadcasted_iota(jnp.int64, (first_g.shape[0], C), 1)
+    live = (col < n_g[:, None]) & (first_g[:, None] >= 0)
+    return jnp.where(live, first_g[:, None] + col * interval, TS_PAD)
+
+
+_derive_ts_rows = jax.jit(_derive_ts_rows_impl, static_argnums=(3,))
+
+
+class _Deferred:
+    """Base for lazy views of elided store blocks: shape metadata for
+    planning; ``materialize()`` reconstructs. General query paths funnel
+    through query/exec._dval; the fused/grid paths never materialize."""
+
+    __slots__ = ("_store", "_arr")
+    ndim = 2
+
+    def __init__(self, store: "SeriesStore"):
+        self._store = store
+        self._arr = None
+
+    @property
+    def shape(self):
+        return (self._store.S, self._store.C)
+
+    def materialize(self):
+        if self._arr is None:
+            self._arr = self._build()
+        return self._arr
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+
+class DeferredDecode(_Deferred):
+    """Lazy f32 view of a narrow-resident store's value block."""
+
+    dtype = np.dtype(np.float32)
+
+    def _build(self):
+        return self._store.value_block()
+
+    def gather_rows(self, rid):
+        """[P, C] f32 of the given rows only (row-wise decode; falls back to
+        the materialized block if one already exists or the store changed
+        residency since this view was handed out)."""
+        st = self._store
+        if self._arr is None and st._narrow is not None:
+            q, vmin, scale, pool, _pp, slot, _ok = st._narrow
+            return _decode_narrow_rows(q, vmin, scale, pool, slot, rid)
+        return jnp.take(self.materialize(), rid, axis=0)
+
+
+class DeferredTs(_Deferred):
+    """Lazy i64 view of an elided (grid-derived) timestamp block."""
+
+    dtype = np.dtype(np.int64)
+
+    def _build(self):
+        return self._store.ts_block()
+
+    def gather_rows(self, rid):
+        """[P, C] i64 of the given rows only (row-wise derivation)."""
+        st = self._store
+        if self._arr is None and st._ts_elided:
+            first_g = jnp.take(jnp.asarray(st.first_ts), rid)
+            n_g = jnp.take(st.n, rid)
+            return _derive_ts_rows(first_g, n_g, jnp.int64(st.grid_interval),
+                                   st.C)
+        return jnp.take(self.materialize(), rid, axis=0)
+
+
 @dataclass
 class SeriesStoreStats:
     samples_appended: int = 0
     out_of_order_dropped: int = 0
     capacity_dropped: int = 0
     compactions: int = 0
+    frees: int = 0
 
 
 class SeriesStore:
@@ -198,6 +317,14 @@ class SeriesStore:
         # (ops/narrow.py); the query leaf consults it when enabled
         from ..ops.narrow import NarrowMirror
         self.narrow = NarrowMirror()
+        # narrow-RESIDENT state (StoreConfig.narrow_resident): when set, the
+        # i16 quantized form IS the only resident value copy — self.val is
+        # None and f32 views decode on demand (see compress_resident)
+        self._narrow = None
+        # grid-derived timestamp elision: ts[S, C] freed, derived from
+        # (first_ts, n, grid_interval) on demand — the 8B/sample column is
+        # redundant on a grid-contiguous store (compress_resident)
+        self._ts_elided = False
 
     def _pre_donate(self, what: str) -> None:
         """Every buffer-donating mutation funnels through here: assert the
@@ -205,6 +332,151 @@ class SeriesStore:
         if self.owner_lock is not None:
             diagnostics.assert_owned(self.owner_lock, what)
         self.detective.record(what)
+
+    # -- narrow-resident lifecycle ------------------------------------------
+    #
+    # Reference role: the read hot path of the reference keeps values ONLY in
+    # compressed form (NibblePack/delta chunks) and decompresses on access
+    # (memory/.../format/vectors/DoubleVector.scala:1-60, doc/compression.md)
+    # — bytes-per-sample is the capacity lever. TPU analog: after a flush the
+    # value column compresses to i16 (q, vmin, scale) and the f32 array is
+    # FREED; rows that don't round-trip bit-exactly keep their raw f32 in a
+    # small cohort pool. Appends rehydrate (write buffers stay raw in the
+    # reference too); the next flush re-compresses. Queries stream the i16
+    # state in the fused kernel, or decode a transient f32 for general paths.
+
+    def mutation_epoch(self) -> tuple:
+        """Changes whenever a donating mutation ran (append/compact/free) —
+        the two-phase compression's staleness check."""
+        s = self.stats
+        return (s.samples_appended, s.compactions, s.frees)
+
+    def compress_prepare(self):
+        """Phase 1 (NO lock needed): stream the store into the compressed
+        form — quantized values + cohort pool, and the ts-derivability
+        verdict. Pure reads + host fetches; a concurrent donating mutation
+        surfaces as RuntimeError (caller retries next flush). Returns None
+        when the store/data doesn't qualify (multi-column, histogram, f64,
+        mostly non-quantizable rows)."""
+        prep_val = None
+        if self._narrow is None:
+            if (self.layout is not None or self.nbuckets
+                    or self.dtype != jnp.float32 or self.val is None):
+                return None
+            from ..ops.narrow import build_narrow
+            q, vmin, scale, ok = build_narrow(self.val, self.n)
+            ok_host = np.asarray(ok)
+            live = self.n_host > 0
+            bad = np.nonzero(live & ~ok_host)[0].astype(np.int32)
+            if len(bad) > 0.25 * max(int(live.sum()), 1):
+                return None    # mostly continuous floats: raw f32 is cheaper
+            Rp = 1
+            while Rp < len(bad):
+                Rp *= 2
+            pp = np.full(Rp, self.S, np.int32)  # pads scatter-drop on decode
+            pp[:len(bad)] = bad
+            pool = jnp.take(self.val, jnp.asarray(np.minimum(pp, self.S - 1)),
+                            axis=0)
+            # pool slot per row (-1 = quantized): row-wise decodes overlay
+            # pool values without touching the full block
+            slot = np.full(self.S, -1, np.int32)
+            slot[bad] = np.arange(len(bad), dtype=np.int32)
+            prep_val = (q, vmin, scale, pool, jnp.asarray(pp),
+                        jnp.asarray(slot), ok_host)
+        ts_ok = False
+        if not self._ts_elided and self.ts is not None \
+                and self.grid_info() is not None:
+            # the grid invariant guarantees derivability; verify anyway —
+            # a silently wrong timestamp block must be impossible
+            ts_ok = bool(_verify_ts(self.ts, jnp.asarray(self.first_ts),
+                                    self.n, jnp.int64(self.grid_interval),
+                                    self.C))
+        return (prep_val, ts_ok)
+
+    def compress_commit(self, prep) -> None:
+        """Phase 2 (under the shard lock): swap the compressed state in and
+        free the raw blocks. Caller verified mutation_epoch() is unchanged."""
+        prep_val, ts_ok = prep
+        self._pre_donate("SeriesStore.compress_resident")
+        if prep_val is not None:
+            self._narrow = prep_val
+            self.val = None    # the f32 block's HBM is released here
+        if ts_ok and not self._ts_elided:
+            self.ts = None     # the 8B/sample block's HBM released here
+            self._ts_elided = True
+
+    def compress_resident(self) -> bool:
+        """One-call form (caller holds the shard lock): adopt the
+        compressed-resident state — i16 quantized rows + raw-f32 cohort pool
+        as the only value copy, timestamps elided on grid-contiguous stores.
+        Returns True when resident-narrow (already or newly)."""
+        if self._narrow is not None and (self._ts_elided
+                                         or self.grid_info() is None):
+            return True
+        prep = self.compress_prepare()
+        if prep is None:
+            return self._narrow is not None
+        self.compress_commit(prep)
+        return self._narrow is not None or self._ts_elided
+
+    def _rehydrate(self) -> None:
+        """Restore the resident f32/i64 blocks (mutations write raw); the
+        next compress_resident() re-adopts the compressed state."""
+        if self._narrow is None and not self._ts_elided:
+            return
+        self._pre_donate("SeriesStore.rehydrate")
+        if self._narrow is not None:
+            q, vmin, scale, pool, pp, _slot, _ok = self._narrow
+            self.val = _decode_narrow(q, vmin, scale, pool, pp)
+            self._narrow = None
+        if self._ts_elided:
+            self.ts = _derive_ts(jnp.asarray(self.first_ts), self.n,
+                                 jnp.int64(self.grid_interval), self.C)
+            self._ts_elided = False
+
+    def value_block(self):
+        """f32 value block: the resident array, or a TRANSIENT decode of the
+        narrow state (not retained — capacity stays at i16 + pool)."""
+        if self._narrow is None:
+            return self.val
+        q, vmin, scale, pool, pp, _slot, _ok = self._narrow
+        return _decode_narrow(q, vmin, scale, pool, pp)
+
+    def ts_block(self):
+        """i64 timestamp block: resident, or a TRANSIENT grid derivation."""
+        if not self._ts_elided:
+            return self.ts
+        return _derive_ts(jnp.asarray(self.first_ts), self.n,
+                          jnp.int64(self.grid_interval), self.C)
+
+    def narrow_operands(self):
+        """(q, vmin, scale, ok_host) when narrow-resident, else None — the
+        fused kernel's direct-stream operands (same layout as the mirror)."""
+        if self._narrow is None:
+            return None
+        q, vmin, scale, _pool, _pp, _slot, ok = self._narrow
+        return q, vmin, scale, ok
+
+    @property
+    def is_narrow_resident(self) -> bool:
+        return self._narrow is not None or self._ts_elided
+
+    def resident_value_bytes(self) -> int:
+        """Resident HBM bytes of the value state (capacity accounting)."""
+        if self._narrow is None:
+            v = self.val
+            return 0 if v is None else v.size * v.dtype.itemsize
+        q, vmin, scale, pool, _pp, _slot, _ok = self._narrow
+        return (q.size * 2 + vmin.size * 4 + scale.size * 4
+                + pool.size * 4)
+
+    def resident_sample_bytes(self) -> int:
+        """Total resident HBM of the (ts + value) sample state — the
+        retention-per-HBM-byte accounting: ts elision + i16 values take a
+        12B/sample f32 store to ~2B/sample."""
+        t = 0 if self._ts_elided or self.ts is None \
+            else self.ts.size * self.ts.dtype.itemsize
+        return t + self.resident_value_bytes()
 
     # -- ingest -------------------------------------------------------------
 
@@ -255,6 +527,7 @@ class SeriesStore:
         m = len(r)
         if m == 0:
             return 0
+        self._rehydrate()      # mutations write the raw f32 block
         self._pre_donate("SeriesStore.append")
         # host bookkeeping
         uniq, first_pos = np.unique(r, return_index=True)
@@ -413,6 +686,7 @@ class SeriesStore:
     def compact(self, cutoff_ts: int) -> None:
         """Evict samples older than ``cutoff_ts`` (amortized; ref: block reclaim
         by time bucket, BlockManager.scala markBucketedBlocksReclaimable)."""
+        self._rehydrate()      # the shift gathers the raw f32 block
         self._pre_donate("SeriesStore.compact")
         if self.extra:
             self.ts, self.val, self.extra, self.n = _compact_multi(
@@ -434,6 +708,8 @@ class SeriesStore:
         donated in-place — no transient second copy of the [S, C] arrays."""
         if len(part_ids) == 0:
             return
+        self._rehydrate()      # the scatter resets the raw ts block
+        self.stats.frees += 1
         self._pre_donate("SeriesStore.free_rows")
         m = len(part_ids)
         P = _pad_size(m)
@@ -451,11 +727,19 @@ class SeriesStore:
     def arrays(self, column: str | None = None):
         """(ts[S,C], val, n[S]) device arrays for query kernels; ``column``
         selects a named value column of a multi-column store (None = the
-        schema's default column)."""
-        return self.ts, self.column_array(column), self.n
+        schema's default column). Compressed-resident stores return deferred
+        views (the grid/fused paths plan from shape metadata and never
+        materialize; general paths decode transients at exec._dval)."""
+        ts = DeferredTs(self) if self._ts_elided else self.ts
+        return ts, self.column_array(column), self.n
 
     def column_array(self, column: str | None = None):
         if column is None or column == self.default_col:
+            if self._narrow is not None:
+                # deferred view: the fused path streams the i16 state and
+                # never decodes; general paths materialize a transient f32
+                # at their single choke points (query/exec.py _dval)
+                return DeferredDecode(self)
             return self.val
         if column in self.extra:
             return self.extra[column]
@@ -465,4 +749,7 @@ class SeriesStore:
         """Host copy of one series (tests/debug/ODP)."""
         cnt = int(self.n_host[part_id])
         v = self.column_array(column)
-        return (np.asarray(self.ts[part_id, :cnt]), np.asarray(v[part_id, :cnt]))
+        if isinstance(v, DeferredDecode):
+            v = v.materialize()
+        t = self.ts_block()
+        return (np.asarray(t[part_id, :cnt]), np.asarray(v[part_id, :cnt]))

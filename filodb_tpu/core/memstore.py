@@ -54,6 +54,13 @@ class StoreConfig:
     # series — enable on deployments where the value stream, not the MXU,
     # is the measured bottleneck
     narrow_mirror: bool = False
+    # narrow-RESIDENT: after each flush the f32 value block compresses to
+    # i16 (q, vmin, scale) + a raw-f32 cohort pool for non-quantizable rows
+    # and the f32 array is FREED — ~2x value-retention per HBM byte. Appends
+    # rehydrate (write buffers stay raw, like the reference's); the fused
+    # query path streams the i16 state directly; general paths decode a
+    # transient. Scalar f32 single-column stores only.
+    narrow_resident: bool = False
 
 
 @dataclass
@@ -548,9 +555,11 @@ class TimeSeriesShard:
                 return 0
             written = self._flush_staged_locked()
         self.store.throttle()
-        if self.config.narrow_mirror:
+        if self.config.narrow_mirror and not self.config.narrow_resident:
             # flush-time rebuild, outside the lock: the build streams the
-            # whole store and fetches the ok flags — queries only CONSULT
+            # whole store and fetches the ok flags — queries only CONSULT.
+            # (Pointless alongside narrow_resident — the i16 state IS the
+            # store there, and refresh would read the freed f32 block.)
             self.store.narrow.refresh(self.store)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
@@ -561,7 +570,32 @@ class TimeSeriesShard:
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
             with self.lock:
                 self.store.compact(cutoff)
+        if self.config.narrow_resident:
+            # adopt/refresh the compressed-resident state AFTER any compact
+            # (compact rehydrates — compressing first would be discarded
+            # work). Two-phase: the streaming build + host fetches run
+            # OUTSIDE the shard lock; only the swap takes it.
+            self._compress_resident_two_phase()
         return written
+
+    def _compress_resident_two_phase(self) -> None:
+        """Build the compressed-resident state without the shard lock, then
+        swap under it iff nothing mutated meanwhile (a racing append donates
+        the very buffers the build streams — detected and retried next
+        flush; ref: the NarrowMirror outside-the-lock rule)."""
+        st = self.store
+        if st is None:
+            return
+        epoch0 = st.mutation_epoch()
+        try:
+            prep = st.compress_prepare()
+        except RuntimeError:
+            return                 # racing donation invalidated the build
+        if prep is None:
+            return
+        with self.lock:
+            if st.mutation_epoch() == epoch0:
+                st.compress_commit(prep)
 
     # -- persistence flush pipeline (ref: TimeSeriesShard.doFlushSteps :814) --
 
@@ -867,9 +901,18 @@ class TimeSeriesShard:
                     col_off = off
                     break
         rows_ts, rows_val = [], []
+        # one decode for the whole batch when the store is compressed-
+        # resident: per-pid series_snapshot would re-decode per series
+        from .chunkstore import DeferredDecode
+        vsrc = self.store.column_array(column)
+        if isinstance(vsrc, DeferredDecode):
+            vsrc = vsrc.materialize()
+        tsrc = self.store.ts_block()
         for p in pids:
             p = int(p)
-            hot_t, hot_v = self.store.series_snapshot(p, column)
+            cnt = int(self.store.n_host[p])
+            hot_t = np.asarray(tsrc[p, :cnt])
+            hot_v = np.asarray(vsrc[p, :cnt])
             boundary = hot_t[0] if len(hot_t) else (1 << 62)
             if cold_ts[p]:
                 ct = np.concatenate(cold_ts[p])

@@ -265,6 +265,7 @@ class QueryEngine:
         for i, sh in enumerate(shards):
             st = sh.store
             if (st is None or getattr(sh, "bucket_les", None) is not None
+                    or getattr(st, "is_narrow_resident", False)
                     or st.val.ndim != 2 or (st.S, st.C) != (s0.S, s0.C)
                     or list(st.ts.devices())[0] != devs[i % ndev]):
                 return None

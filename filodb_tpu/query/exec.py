@@ -99,13 +99,22 @@ def _pow2(n: int, floor: int = 8) -> int:
     return p
 
 
+def _dval(arr):
+    """Materialize a compressed-resident store's deferred view (transient
+    f32 decode / i64 grid derivation); real arrays pass through. The single
+    choke point general query paths funnel through — the fused/grid paths
+    plan from shape metadata and never call this."""
+    from ..core.chunkstore import _Deferred
+    return arr.materialize() if isinstance(arr, _Deferred) else arr
+
+
 def _gather_rows_padded(ts, val, n, rows: np.ndarray):
     """Gather the given array rows padded to a pow2 row count (kernel-shape
     stability). Pad rows are fully disabled: n = 0 AND timestamps forced to
     the pad sentinel — the general kernels derive windows from timestamps, so
     a pad row aliasing row 0's real data would otherwise produce phantom
     (non-NaN) outputs that aggregation counts as present."""
-    from ..core.chunkstore import TS_PAD
+    from ..core.chunkstore import TS_PAD, _Deferred
     M = len(rows)
     P = _pow2(M)
     pad = np.zeros(P, np.int32)
@@ -113,8 +122,14 @@ def _gather_rows_padded(ts, val, n, rows: np.ndarray):
     rid = jnp.asarray(pad)
     real = jnp.arange(P) < M
     n_g = jnp.where(real, jnp.take(n, rid), 0)
-    ts_g = jnp.where(real[:, None], jnp.take(ts, rid, axis=0), TS_PAD)
-    return ts_g, jnp.take(val, rid, axis=0), n_g.astype(jnp.int32), P
+    # deferred (compressed-resident) blocks gather row-wise — a minority fix
+    # over a few rows must not materialize the full [S, C] block
+    ts_rows = (ts.gather_rows(rid) if isinstance(ts, _Deferred)
+               else jnp.take(ts, rid, axis=0))
+    val_rows = (val.gather_rows(rid) if isinstance(val, _Deferred)
+                else jnp.take(val, rid, axis=0))
+    ts_g = jnp.where(real[:, None], ts_rows, TS_PAD)
+    return ts_g, val_rows, n_g.astype(jnp.int32), P
 
 
 def check_sample_limit(num_series: int, steps: int, limit: int) -> None:
@@ -157,7 +172,7 @@ class FusedWindowData:
         # per-dashboard-shape compile cost on the hot f32 path
         out_eval, T = _pad_steps(self.out_ts)
         vals = gridfns.periodic_samples_grid(
-            self.sel.val, self.sel.n, out_eval, self.window, self.fn,
+            _dval(self.sel.val), self.sel.n, out_eval, self.window, self.fn,
             base_ts, interval_ms, stale_ms=self.stale_ms)
         minority = self.sel.grid_minority
         if minority is not None and len(minority):
@@ -265,15 +280,17 @@ class PeriodicSamplesMapper(Transformer):
                 # function with the aggregation in one HBM pass
                 return FusedWindowData(data, out_ts, window, fn, ctx.stale_ms)
             base_ts, interval_ms = data.grid
-            vals = gridfns.periodic_samples_grid(data.val, data.n, out_eval, window,
+            vals = gridfns.periodic_samples_grid(_dval(data.val), data.n,
+                                                 out_eval, window,
                                                  fn, base_ts, interval_ms,
                                                  stale_ms=ctx.stale_ms)
             if minority is not None and len(minority):
                 vals = _correct_minority_cohort(data, vals, out_eval, window,
                                                 fn, a0, a1)
         else:
-            vals = rangefns.periodic_samples(data.ts, data.val, data.n, out_eval,
-                                             window, fn, a0, a1)
+            vals = rangefns.periodic_samples(_dval(data.ts), _dval(data.val),
+                                             data.n, out_eval, window, fn,
+                                             a0, a1)
         if Tpad != T:
             vals = vals[:, :T]
         return MatrixView(out_ts, vals, data.keys, data.rows)
@@ -548,9 +565,13 @@ class AggregateMapReduce(Transformer):
         else:
             gids_dev = jnp.asarray(gids)
         # fetch=False: the leaf holds the shard lock through this dispatch —
-        # the blocking host fetch happens at present/merge time, outside it
+        # the blocking host fetch happens at present/merge time, outside it.
+        # With narrow operands the kernel streams the i16 state and sel.val
+        # may stay a deferred decode (shape metadata only)
         parts = fusedgrid.fused_grid_aggregate(
-            self.operator, data.fn, sel.val, n_eff, gids_dev, Gp,
+            self.operator, data.fn,
+            sel.val if narrow is not None else _dval(sel.val),
+            n_eff, gids_dev, Gp,
             data.out_ts, data.window, base_ts, interval_ms, fetch=False,
             narrow=narrow)
         if has_minority:
@@ -1247,9 +1268,13 @@ class SelectRawPartitionsExec(ExecPlan):
                  if minority_sel is not None else None)
         narrow = None
         if (grid is not None and col is None and les is None
-                and shard.config.narrow_mirror and store.S % 512 == 0
+                and (store.S % 512 == 0 or store.S <= 512)
                 and val.ndim == 2):
-            nd = store.narrow.get(store)
+            # narrow-resident state first (the i16 form IS the store), then
+            # the optional mirror (an extra copy alongside f32)
+            nd = store.narrow_operands()
+            if nd is None and shard.config.narrow_mirror:
+                nd = store.narrow.get(store)
             if nd is not None:
                 q, vmin, scale, ok_host = nd
                 bad = pids[~ok_host[pids]].astype(np.int32)
@@ -1610,7 +1635,8 @@ class SelectChunkInfosExec(ExecPlan):
                 p = int(p)
                 labels = dict(shard.index.labels_of(p))
                 n = int(st.n_host[p])
-                per_sample = 8 + (st.val.dtype.itemsize
+                vcol = st.column_array()
+                per_sample = 8 + (vcol.dtype.itemsize
                                   * max(st.nbuckets, 1))
                 labels.update({
                     "_id_": str(p),

@@ -464,8 +464,119 @@ def bench_gateway(full: bool) -> None:
     emit("gateway", "influx_parse", n * it / dt, "lines/s")
 
 
+def bench_narrow_resident(full: bool) -> None:
+    """Compressed-resident store (StoreConfig.narrow_resident): retention per
+    HBM byte vs the raw f32 store, decode bit-parity, and the fused-path
+    device-marginal ms/dispatch ratio (bar: <= ~1.3x of the f32 path).
+    Ref: doc/compression.md + DoubleVector.scala — the reference's read path
+    keeps values only compressed; here i16 quantized values + grid-derived
+    timestamps replace the 12B/sample raw blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.core.chunkstore import TS_PAD
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.query.engine import QueryEngine
+
+    S = (1 << 20) if full else (1 << 14)
+    C = 768 if full else 256
+    NS = 720 if full else 200
+
+    def build(narrow: bool):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=S, samples_per_series=C,
+                          flush_batch_size=10**9, dtype="float32",
+                          narrow_resident=narrow)
+        sh = ms.setup("prometheus", "gauge", 0, cfg)
+        # register a handful of series through the real path to seed the
+        # index, then install integer-valued (quantizable) bulk data
+        from filodb_tpu.core.record import RecordBuilder
+        from filodb_tpu.core.schemas import GAUGE
+        b = RecordBuilder(GAUGE)
+        b.add_series_batch({"_metric_": "m",
+                            "host": [f"h{i}" for i in range(S)]}, BASE, 0.0)
+        sh.ingest(b.build())
+        with sh.lock:
+            sh._stage_pid.clear(); sh._stage_ts.clear()
+            sh._stage_val.clear(); sh._staged = 0
+        st = sh.store
+        st.ts = st.val = st.n = None
+
+        @jax.jit
+        def mk(key):
+            inc = jax.random.randint(key, (S, NS), 1, 50).astype(jnp.float32)
+            v = jnp.cumsum(inc, axis=1)
+            return jnp.zeros((st.S, C), jnp.float32).at[:S, :NS].set(v)
+
+        st.val = mk(jax.random.PRNGKey(3))
+        ts_row = np.full(C, TS_PAD, np.int64)
+        ts_row[:NS] = BASE + np.arange(NS, dtype=np.int64) * IV
+        st.ts = jnp.tile(jnp.asarray(ts_row), (st.S, 1))
+        st.n = jnp.full(st.S, NS, jnp.int32)
+        st.n_host = np.full(st.S, NS, np.int32)
+        st.first_ts = np.full(st.S, BASE, np.int64)
+        st.last_ts = np.full(st.S, BASE + (NS - 1) * IV, np.int64)
+        st.grid_base, st.grid_interval, st.grid_ok = BASE, IV, True
+        st._cohorts = None
+        if narrow:
+            with sh.lock:
+                assert st.compress_resident(), "quantizable data must compress"
+        return ms, sh
+
+    start = BASE + 300_000
+    end = BASE + (NS - 1) * IV
+    q = "sum(rate(m[5m]))"
+
+    def marginal_ms(eng, K=24, reps=3):
+        """Device-marginal per-dispatch: K pipelined queries, median of
+        reps (tunnel-floor-robust, same methodology as bench.py)."""
+        eng.query_range(q, start, end, 150_000)       # warm compile
+        outs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(K):
+                eng.query_range(q, start, end, 150_000)
+            outs.append((time.perf_counter() - t0) / K * 1000)
+        return sorted(outs)[len(outs) // 2]
+
+    ms_f32, sh_f32 = build(False)
+    e_f32 = QueryEngine(ms_f32, "prometheus")
+    f32_ms = marginal_ms(e_f32)
+    f32_bytes = sh_f32.store.resident_sample_bytes()
+    r_f32 = e_f32.query_range(q, start, end, 150_000)
+    (_k, _t, a), = list(r_f32.matrix.iter_series())
+    a = np.asarray(a).copy()
+    # release the f32 store's HBM before building the narrow one: at full
+    # scale (1M x 768) the two residencies do not fit together
+    st0 = sh_f32.store
+    st0.ts = st0.val = st0.n = None
+    del ms_f32, sh_f32, e_f32, r_f32, st0
+
+    ms_nr, sh_nr = build(True)
+    st = sh_nr.store
+    assert st.is_narrow_resident and st.val is None and st.ts is None
+    e_nr = QueryEngine(ms_nr, "prometheus")
+    nr_ms = marginal_ms(e_nr)
+    nr_bytes = st.resident_sample_bytes()
+    r_nr = e_nr.query_range(q, start, end, 150_000)
+
+    # bit parity of the flagship aggregate between residencies
+    (_k, _t, b), = list(r_nr.matrix.iter_series())
+    assert np.array_equal(a, b), "narrow-resident query diverged"
+
+    retention = f32_bytes / max(nr_bytes, 1)
+    emit("narrow_resident", "resident_bytes_f32", f32_bytes, "bytes")
+    emit("narrow_resident", "resident_bytes_narrow", nr_bytes, "bytes")
+    emit("narrow_resident", "retention_multiple_at_fixed_hbm", retention, "x")
+    emit("narrow_resident", "fused_ms_f32", f32_ms, "ms/query")
+    emit("narrow_resident", "fused_ms_narrow", nr_ms, "ms/query")
+    emit("narrow_resident", "fused_ratio_narrow_vs_f32", nr_ms / f32_ms, "x")
+    emit("narrow_resident", "bit_parity", 1.0, "bool")
+
+
 SUITES = {
     "ingestion": bench_ingestion,
+    "narrow_resident": bench_narrow_resident,
     "encoding": bench_encoding,
     "partkey_index": bench_partkey_index,
     "hist_ingest": bench_hist_ingest,
