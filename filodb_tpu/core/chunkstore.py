@@ -137,14 +137,29 @@ def _derive_ts_impl(first, n, interval, C):
 _derive_ts = jax.jit(_derive_ts_impl, static_argnums=(3,))
 
 
-def _verify_ts_impl(ts, first, n, interval, C):
-    """One fused derive-and-compare reduction — materializing the derived
-    block (plus the TPU's i64 hi/lo split temps) would transiently need
-    several x the block itself at 1M x 768."""
+def _verify_ts_block_impl(ts, first, n, interval, C):
+    """Fused derive-and-compare reduction over a ROW BLOCK — a whole-store
+    comparison at 1M x 768 materializes multi-GB i64 hi/lo split temps and
+    dies exactly when HBM is tight (the situation compression exists for)."""
     return jnp.all(ts == _derive_ts_impl(first, n, interval, C))
 
 
-_verify_ts = jax.jit(_verify_ts_impl, static_argnums=(4,))
+_verify_ts_block = jax.jit(_verify_ts_block_impl, static_argnums=(4,))
+
+_VERIFY_BLOCK_ROWS = 1 << 16
+
+
+def _verify_ts(ts, first, n, interval, C) -> bool:
+    S = ts.shape[0]
+    B = _VERIFY_BLOCK_ROWS
+    if S <= B:
+        return bool(_verify_ts_block(ts, first, n, interval, C))
+    for i in range(0, S, B):
+        j = min(i + B, S)
+        if not bool(_verify_ts_block(ts[i:j], first[i:j], n[i:j],
+                                     interval, C)):
+            return False
+    return True
 
 
 @jax.jit
@@ -161,13 +176,8 @@ def _decode_narrow_rows(q, vmin, scale, pool, pool_slot, rid):
     return jnp.where((slot >= 0)[:, None], pv, v)
 
 
-def _derive_ts_rows_impl(first_g, n_g, interval, C):
-    col = jax.lax.broadcasted_iota(jnp.int64, (first_g.shape[0], C), 1)
-    live = (col < n_g[:, None]) & (first_g[:, None] >= 0)
-    return jnp.where(live, first_g[:, None] + col * interval, TS_PAD)
-
-
-_derive_ts_rows = jax.jit(_derive_ts_rows_impl, static_argnums=(3,))
+# row-wise derivation is the same rule applied to a gathered first/n pair
+_derive_ts_rows = _derive_ts
 
 
 class _Deferred:
@@ -745,11 +755,18 @@ class SeriesStore:
             return self.extra[column]
         raise KeyError(f"unknown value column {column!r}")
 
-    def series_snapshot(self, part_id: int, column: str | None = None):
-        """Host copy of one series (tests/debug/ODP)."""
-        cnt = int(self.n_host[part_id])
+    def snapshot_arrays(self, column: str | None = None):
+        """(ts, val) blocks materialized ONCE for per-series slicing loops —
+        callers iterating many pids must use this instead of per-pid
+        series_snapshot (which would re-decode a compressed-resident store's
+        full block per series)."""
         v = self.column_array(column)
         if isinstance(v, DeferredDecode):
             v = v.materialize()
-        t = self.ts_block()
+        return self.ts_block(), v
+
+    def series_snapshot(self, part_id: int, column: str | None = None):
+        """Host copy of one series (tests/debug; loops use snapshot_arrays)."""
+        cnt = int(self.n_host[part_id])
+        t, v = self.snapshot_arrays(column)
         return (np.asarray(t[part_id, :cnt]), np.asarray(v[part_id, :cnt]))

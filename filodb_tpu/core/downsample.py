@@ -115,10 +115,15 @@ class InlineDownsampler:
         if st is None:
             return
         self._seeded_last = np.full(st.S, -(1 << 62), np.int64)
+        # one block materialization for the whole scan (a compressed-resident
+        # store must not decode its full block once per pid)
+        tsrc, vsrc = st.snapshot_arrays()
         for pid in range(st.S):
-            if st.n_host[pid] == 0:
+            cnt = int(st.n_host[pid])
+            if cnt == 0:
                 continue
-            t, v = st.series_snapshot(pid)
+            t = np.asarray(tsrc[pid, :cnt])
+            v = np.asarray(vsrc[pid, :cnt])
             sel = t > self.floor_ms
             if sel.any():
                 self._ingest(shard, np.full(int(sel.sum()), pid, np.int32),

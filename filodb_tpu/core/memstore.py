@@ -551,9 +551,15 @@ class TimeSeriesShard:
         a hot ingest loop must run at the device's retirement rate, or its
         dispatch backlog starves concurrent query fetches."""
         with self.lock:
-            if not self._staged:
-                return 0
-            written = self._flush_staged_locked()
+            staged = bool(self._staged)
+            written = self._flush_staged_locked() if staged else 0
+        if not staged:
+            # nothing new — but a purge/compact since the last flush may have
+            # rehydrated a compressed-resident store; re-adopt, else the
+            # quiesced shard silently sits at raw 12B/sample residency
+            if self.config.narrow_resident:
+                self._compress_resident_two_phase()
+            return 0
         self.store.throttle()
         if self.config.narrow_mirror and not self.config.narrow_resident:
             # flush-time rebuild, outside the lock: the build streams the
@@ -587,6 +593,15 @@ class TimeSeriesShard:
         if st is None:
             return
         epoch0 = st.mutation_epoch()
+        # idempotence: fully compressed already, or nothing mutated since the
+        # last (possibly declined) attempt — a declined 25%-gate store must
+        # not re-run the full-store build on every empty flush tick
+        if st._narrow is not None and (st._ts_elided
+                                       or st.grid_info() is None):
+            return
+        if getattr(self, "_last_compress_epoch", None) == epoch0:
+            return
+        self._last_compress_epoch = epoch0
         try:
             prep = st.compress_prepare()
         except RuntimeError:

@@ -529,7 +529,13 @@ class QueryEngine:
                     rows = [(ts_a[i, :n_a[i]], val_a[i, :n_a[i]])
                             for i in range(len(pids))]
                 else:
-                    rows = [shard.store.series_snapshot(int(p)) for p in pids]
+                    # one block materialization for the whole selection — a
+                    # compressed-resident store must not decode per series
+                    tsrc, vsrc = shard.store.snapshot_arrays()
+                    nh = shard.store.n_host
+                    rows = [(np.asarray(tsrc[int(p), :nh[int(p)]]),
+                             np.asarray(vsrc[int(p), :nh[int(p)]]))
+                            for p in pids]
             for lbl, (t, v) in zip(labels, rows):
                 keep = (t >= start_ms) & (t <= end_ms)
                 if keep.any():

@@ -1630,13 +1630,13 @@ class SelectChunkInfosExec(ExecPlan):
                     sink_chunks[r.part_id] = sink_chunks.get(r.part_id, 0) + 1
         st = shard.store
         keys, vals = [], []
+        vcol_itemsize = st.column_array().dtype.itemsize   # loop-invariant
         with shard.lock:
             for p in pids:
                 p = int(p)
                 labels = dict(shard.index.labels_of(p))
                 n = int(st.n_host[p])
-                vcol = st.column_array()
-                per_sample = 8 + (vcol.dtype.itemsize
+                per_sample = 8 + (vcol_itemsize
                                   * max(st.nbuckets, 1))
                 labels.update({
                     "_id_": str(p),

@@ -315,3 +315,39 @@ def test_active_columns_never_overhangs_store():
         out_ts, window, "rate"))
     want = np.nansum(np.where(np.isnan(mat), 0, mat), axis=0)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-3)
+
+
+def test_grid_operand_cache_bound_and_hits():
+    """The per-query-shape operand cache (ops/gridfns.grid_operands): small
+    shapes cache (identical device objects on repeat), oversized shapes
+    (> 16MB of [C, T] operands) stay transient, and the LRU stays bounded at
+    32 entries (round-4 weak item: bound/eviction behavior untested)."""
+    from filodb_tpu.ops import gridfns
+
+    gridfns._grid_operands_cached.cache_clear()
+    out_ts = np.arange(1_000_000, 1_000_000 + 32 * 30_000, 30_000, np.int64)
+    a = gridfns.grid_operands(64, out_ts, 60_000, "rate", 1_000_000, 10_000)
+    b = gridfns.grid_operands(64, out_ts, 60_000, "rate", 1_000_000, 10_000)
+    assert a["band"] is b["band"], "same shape must hit the cache"
+    info = gridfns._grid_operands_cached.cache_info()
+    assert info.hits >= 1 and info.maxsize == 32
+
+    # a different step grid is a different entry
+    out_ts2 = out_ts + 15_000
+    c = gridfns.grid_operands(64, out_ts2, 60_000, "rate", 1_000_000, 10_000)
+    assert c["band"] is not a["band"]
+
+    # oversized operands (4 * C * T * itemsize > 16MB) bypass the cache
+    big_ts = np.arange(1_000_000, 1_000_000 + 2048 * 30_000, 30_000, np.int64)
+    before = gridfns._grid_operands_cached.cache_info().currsize
+    d1 = gridfns.grid_operands(1024, big_ts, 60_000, "rate", 1_000_000,
+                               10_000, dtype=np.float64)
+    d2 = gridfns.grid_operands(1024, big_ts, 60_000, "rate", 1_000_000,
+                               10_000, dtype=np.float64)
+    assert d1["band"] is not d2["band"], "oversized shapes must stay transient"
+    assert gridfns._grid_operands_cached.cache_info().currsize == before
+
+    # LRU eviction keeps the entry count at maxsize
+    for i in range(40):
+        gridfns.grid_operands(64, out_ts + i, 60_000, "rate", 1_000_000, 10_000)
+    assert gridfns._grid_operands_cached.cache_info().currsize <= 32

@@ -180,7 +180,8 @@ def test_narrow_resident_compact_and_odp(tmp_path):
     assert sh.store.is_narrow_resident
     sh.store.compact(START + 20 * INTERVAL)
     assert not sh.store.is_narrow_resident   # rehydrated for the shift
-    sh.flush()                                # nothing staged: still compresses?
+    sh.flush()          # nothing staged — the quiesced shard MUST re-compress
+    assert sh.store.is_narrow_resident
     pids = sh.part_ids_from_filters([], START, START + 40 * INTERVAL)
     assert sh.needs_paging(pids, START)
     ts_a, val_a, n_a = sh.read_with_paging(pids, START, START + 40 * INTERVAL)
