@@ -12,6 +12,7 @@ import json
 import re
 import threading
 import traceback
+from contextlib import contextmanager
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -83,6 +84,12 @@ class FiloHttpServer:
         self.cluster = cluster
         self.writers = writers or {}
         self.scheduler = scheduler
+        # admission control for peer fan-out legs (/exec, read?local=1):
+        # they must NOT queue behind the scheduler's QUERY lane (the root
+        # request holds a QUERY worker blocked on this response — two
+        # saturated nodes would deadlock), but an unbounded handler-thread
+        # free-for-all is a DoS vector; a bounded semaphore gives both
+        self._leg_sem = threading.BoundedSemaphore(16)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -152,6 +159,17 @@ class FiloHttpServer:
                     registry.gauge("filodb_shard_lock_long_holds", tags) \
                         .update(float(s.lock.long_holds))
 
+    @contextmanager
+    def _leg_guard(self):
+        """Bounded admission for peer fan-out legs running on the handler
+        thread; saturation sheds with 503 like the scheduler would."""
+        if not self._leg_sem.acquire(timeout=30.0):
+            raise SchedulerBusy("peer-leg capacity saturated; retry later")
+        try:
+            yield
+        finally:
+            self._leg_sem.release()
+
     def _run(self, fn, priority: Priority):
         """Run query work through the priority scheduler when configured."""
         if self.scheduler is None:
@@ -169,7 +187,8 @@ class FiloHttpServer:
         # them before the urlencoded body parsing below consumes rfile
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/(read|write)", path)
         if m and h.command == "POST":
-            self._remote_storage(h, m.group(1), m.group(2))
+            self._remote_storage(h, m.group(1), m.group(2),
+                                 local=bool(q.get("local")))
             return
 
         # cross-node plan dispatch: a peer ships an ExecPlan subtree for a
@@ -275,7 +294,14 @@ class FiloHttpServer:
         if engine is None:
             h._send(404, {"status": "error", "error": f"no dataset {dataset}"})
             return
-        body = h.rfile.read(int(h.headers.get("Content-Length") or 0))
+        ln = int(h.headers.get("Content-Length") or 0)
+        if ln > (16 << 20):
+            # plans are a selector + transformer chain — kilobytes; a
+            # multi-MB body is malformed or hostile, not a bigger query
+            h._send(413, {"status": "error", "errorType": "bad_data",
+                          "error": f"exec plan too large ({ln} bytes)"})
+            return
+        body = h.rfile.read(ln)
         from ..query import wire
 
         # executes on the HTTP handler thread, NOT the scheduler's QUERY lane:
@@ -283,9 +309,10 @@ class FiloHttpServer:
         # and its worker blocks on this response — queueing subtrees behind
         # other root queries would deadlock two saturated nodes against each
         # other (every worker waiting on a peer whose workers all wait back)
-        plan = wire.deserialize_plan(body)
-        data = plan.execute(engine._ctx())
-        payload = wire.serialize_result(data)
+        with self._leg_guard():
+            plan = wire.deserialize_plan(body)
+            data = plan.execute(engine._ctx())
+            payload = wire.serialize_result(data)
         h.send_response(200)
         h.send_header("Content-Type", "application/octet-stream")
         h.send_header("Content-Length", str(len(payload)))
@@ -294,7 +321,8 @@ class FiloHttpServer:
 
     # -- Prometheus remote storage protocol (snappy + protobuf) ---------------
 
-    def _remote_storage(self, h, dataset: str, which: str) -> None:
+    def _remote_storage(self, h, dataset: str, which: str,
+                        local: bool = False) -> None:
         from google.protobuf.message import DecodeError
 
         engine = self.engines.get(dataset)
@@ -303,21 +331,31 @@ class FiloHttpServer:
             return
         body = h.rfile.read(int(h.headers.get("Content-Length") or 0))
         try:
-            self._remote_storage_inner(h, engine, dataset, which, body)
+            self._remote_storage_inner(h, engine, dataset, which, body, local)
         except (ValueError, DecodeError) as e:
             # bad snappy framing / protobuf — client error, not a server fault
             h._send(400, {"status": "error", "errorType": "bad_data",
                           "error": f"malformed remote-{which} body: {e}"})
 
     def _remote_storage_inner(self, h, engine, dataset: str, which: str,
-                              body: bytes) -> None:
+                              body: bytes, local: bool = False) -> None:
         from ..promql import remote
 
         if which == "read":
             # remote read is a full data-reading query — it goes through the
-            # scheduler's QUERY lane like query_range, not the handler thread
-            payload = self._run(lambda: remote.read_request(body, engine),
-                                Priority.QUERY)
+            # scheduler's QUERY lane like query_range, not the handler thread.
+            # local=1 marks a peer's fan-out leg: answer from local shards
+            # only AND stay on the handler thread (the root request holds a
+            # QUERY-lane worker that blocks on this response — queueing the
+            # leg behind other root queries would deadlock saturated nodes,
+            # same rule as /exec)
+            if local:
+                with self._leg_guard():
+                    payload = remote.read_request(body, engine,
+                                                  local_only=True)
+            else:
+                payload = self._run(
+                    lambda: remote.read_request(body, engine), Priority.QUERY)
             h.send_response(200)
             h.send_header("Content-Type", "application/x-protobuf")
             h.send_header("Content-Encoding", "snappy")

@@ -29,10 +29,23 @@ def matchers_to_filters(matchers) -> list:
             for m in matchers]
 
 
-def read_request(body: bytes, engine) -> bytes:
-    """snappy(ReadRequest) -> snappy(ReadResponse) against one dataset engine."""
+def read_request(body: bytes, engine, local_only: bool = False) -> bytes:
+    """snappy(ReadRequest) -> snappy(ReadResponse) against one dataset engine.
+
+    On a multi-node cluster the raw request is forwarded VERBATIM to every
+    peer owning shards of the dataset (with local=1 stopping recursion) and
+    the peers' ReadResponses merge per query — each node contributes exactly
+    its own shards' series, so the union is duplicate-free (ref: the
+    reference's remote-read serves from whichever node the LB hits, which
+    proxies through its coordinator's scatter)."""
     req = pb.ReadRequest()
     req.ParseFromString(snappy.decompress(body))
+    # kick the peer scatter off BEFORE the local scan so the two overlap
+    # (latency = max(local, slowest peer), not their sum)
+    handle = None
+    if not local_only and getattr(engine, "_has_remote_shards", None) \
+            and engine._has_remote_shards():
+        handle = engine.peer_scatter_begin(_peer_read_fetch(body, engine))
     resp = pb.ReadResponse()
     for q in req.queries:
         result = resp.results.add()
@@ -45,7 +58,38 @@ def read_request(body: bytes, engine) -> bytes:
                 series.labels.add(name=wire_name, value=labels[name])
             for t, v in zip(ts.tolist(), vals.tolist()):
                 series.samples.add(value=float(v), timestamp_ms=int(t))
+    if handle is not None:
+        # raw reads are DATA queries: a dead peer must fail the request
+        # loudly (same rule as query_range's RemoteLeafExec), never return
+        # a silently partial ReadResponse a backfill would record as truth
+        from ..query.rangevector import QueryError
+        for ep, peer in engine.peer_scatter_join(handle):
+            if isinstance(peer, Exception):
+                raise QueryError(
+                    f"remote-read peer {ep} failed: {peer}; the query is "
+                    "retryable once shards reassign")
+            for i, pres in enumerate(peer.results):
+                if i < len(resp.results):
+                    resp.results[i].timeseries.extend(pres.timeseries)
     return snappy.compress(resp.SerializeToString())
+
+
+def _peer_read_fetch(body: bytes, engine):
+    """fetch(ep) forwarding the raw ReadRequest verbatim to a peer's
+    local-only read endpoint and parsing its ReadResponse."""
+    import urllib.request
+
+    def fetch(ep: str):
+        url = f"http://{ep}/promql/{engine.dataset}/api/v1/read?local=1"
+        rq = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/x-protobuf",
+                     "Content-Encoding": "snappy"})
+        with urllib.request.urlopen(rq, timeout=30.0) as r:
+            peer = pb.ReadResponse()
+            peer.ParseFromString(snappy.decompress(r.read()))
+            return peer
+    return fetch
 
 
 def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:

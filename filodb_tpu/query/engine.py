@@ -436,35 +436,59 @@ class QueryEngine:
                 eps.setdefault(ep)
         return list(eps)
 
+    def peer_scatter_begin(self, fetch):
+        """Start ``fetch(ep)`` for every peer endpoint concurrently; returns
+        an opaque handle for :meth:`peer_scatter_join` (None when no peers).
+        Begin/join are split so callers can overlap their LOCAL work with the
+        peer round-trips (the shared scatter scaffold for metadata and
+        remote-read fan-outs)."""
+        from concurrent.futures import ThreadPoolExecutor
+        eps = self._peer_endpoints()
+        if not eps:
+            return None
+        pool = ThreadPoolExecutor(max_workers=min(len(eps), 16))
+        futs = [(ep, pool.submit(fetch, ep)) for ep in eps]
+        return (pool, futs)
+
+    @staticmethod
+    def peer_scatter_join(handle) -> list:
+        """[(endpoint, result-or-Exception)] for a begun scatter."""
+        if handle is None:
+            return []
+        pool, futs = handle
+        out = []
+        for ep, f in futs:
+            try:
+                out.append((ep, f.result()))
+            except Exception as e:  # noqa: BLE001 — caller decides severity
+                out.append((ep, e))
+        pool.shutdown(wait=False)
+        return out
+
     def _peer_metadata(self, path: str) -> list:
         """Fan a metadata request out to all peers concurrently (local=1
         stops recursion); an unreachable peer is skipped — its shards are
         mid-reassignment and metadata is best-effort (ref: the coordinator's
-        metadata scatter). Concurrent fan-out bounds latency to the slowest
-        single peer rather than the sum of timeouts."""
+        metadata scatter). Raw DATA reads are NOT best-effort — they use the
+        same scatter but raise on peer failure (promql/remote.py)."""
         import json as _json
         import logging
         import urllib.request
-        from concurrent.futures import ThreadPoolExecutor
 
         def fetch(ep: str) -> list:
             sep = "&" if "?" in path else "?"
             url = f"http://{ep}/promql/{self.dataset}{path}{sep}local=1"
-            try:
-                with urllib.request.urlopen(url, timeout=10.0) as r:
-                    return _json.load(r).get("data") or []
-            except Exception:  # noqa: BLE001
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                return _json.load(r).get("data") or []
+
+        out: list = []
+        for ep, res in self.peer_scatter_join(self.peer_scatter_begin(fetch)):
+            if isinstance(res, Exception):
                 logging.getLogger("filodb_tpu.query").warning(
                     "metadata fan-out to peer %s failed; partial result", ep)
-                return []
-
-        eps = self._peer_endpoints()
-        if not eps:
-            return []
-        if len(eps) == 1:
-            return fetch(eps[0])
-        with ThreadPoolExecutor(max_workers=min(len(eps), 16)) as pool:
-            return [v for chunk in pool.map(fetch, eps) for v in chunk]
+            else:
+                out.extend(res)
+        return out
 
     # -- metadata queries (ref: QueryActor label-values / series paths) -------
 

@@ -239,6 +239,58 @@ def test_metadata_federation(two_node):
         assert as_sets(got) == as_sets(want)
 
 
+def test_remote_read_federation(two_node):
+    """Prometheus remote-read on a multi-node cluster returns BOTH nodes'
+    raw series from either entry point (the raw request forwards verbatim to
+    peers with local=1; per-query timeseries splice duplicate-free)."""
+    import urllib.request
+
+    from filodb_tpu.promql import remote_storage_pb2 as pb
+    from filodb_tpu.utils import snappy
+
+    engines, oracle, _mgr, eps, _servers = two_node
+    rr = pb.ReadRequest()
+    q = rr.queries.add()
+    q.start_timestamp_ms = START
+    q.end_timestamp_ms = START + N * INTERVAL
+    m = q.matchers.add()
+    m.type = 0                      # EQ
+    m.name = "__name__"
+    m.value = "m"
+    body = snappy.compress(rr.SerializeToString())
+    want = {tuple(sorted(d.items()))
+            for d in oracle.series([F.Equals("_metric_", "m")], START,
+                                   START + N * INTERVAL)}
+    for node in ("a", "b"):
+        req = urllib.request.Request(
+            f"http://{eps[node]}/promql/{DATASET}/api/v1/read", data=body,
+            method="POST", headers={"Content-Type": "application/x-protobuf"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            resp = pb.ReadResponse()
+            resp.ParseFromString(snappy.decompress(r.read()))
+        (res,) = resp.results
+        got = set()
+        for series in res.timeseries:
+            labels = {("_metric_" if lp.name == "__name__" else lp.name):
+                      lp.value for lp in series.labels}
+            got.add(tuple(sorted(labels.items())))
+            assert len(series.samples) == N
+        assert got == want, f"node {node}: remote-read missing peer series"
+
+
+def test_exec_rejects_oversized_plan(two_node):
+    import urllib.error
+    import urllib.request
+
+    _engines, _oracle, _mgr, eps, _servers = two_node
+    req = urllib.request.Request(
+        f"http://{eps['a']}/exec/{DATASET}", data=b"x" * 64, method="POST",
+        headers={"Content-Length": str(64 << 20)})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 413
+
+
 def test_peer_unreachable_is_loud(two_node):
     engines, _oracle, mgr, eps, _servers = two_node
     saved = eps["b"]
