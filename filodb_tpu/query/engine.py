@@ -23,7 +23,8 @@ from ..promql import parser as promql
 from . import logical as L
 from .exec import QueryContext, group_keys_of
 from .planner import QueryPlanner
-from .rangevector import QueryResult, RangeVectorKey, ResultMatrix
+from .rangevector import (QueryError, QueryResult, RangeVectorKey,
+                          ResultMatrix)
 
 # aggregation operators whose partial state crosses the mesh collective
 # (psum/pmin/pmax — ops/aggregators.py partial layout)
@@ -41,6 +42,21 @@ MESH_TOPK_MAX_GROUPS = 16
 # rows outside the selection: a group id no kernel's one-hot/segment scatter
 # ever matches (OOB scatter updates drop; one-hot comparisons never equal it)
 _EXCLUDED_GID = 1 << 30
+
+
+def _walk_plans(plan):
+    """Yield every node of an ExecPlan tree (children/lhs/rhs/inner links)."""
+    stack = [plan]
+    while stack:
+        p = stack.pop()
+        yield p
+        for attr in ("children", "lhs", "rhs", "inner", "child"):
+            v = getattr(p, attr, None)
+            if isinstance(v, list):
+                stack.extend(v)
+            elif v is not None and hasattr(v, "transformers"):
+                stack.append(v)
+    return
 
 
 def _sel_quote(v: str) -> str:
@@ -165,7 +181,32 @@ class QueryEngine:
             return res
         self.last_exec_path = "local"
         exec_plan = self.planner.materialize(plan)
-        return exec_plan.run(self._ctx())
+        try:
+            return exec_plan.run(self._ctx())
+        except Exception as e:
+            from .wire import RemoteLeafExec, RemotePeerError
+            if not isinstance(e, RemotePeerError) or self.cluster is None:
+                raise
+            # the peer died mid-query: re-materialize (the ShardManager may
+            # already have reassigned its shards to a survivor) and retry
+            # ONCE — but only if the failed shard actually ROUTES differently
+            # now; re-dispatching the identical plan to the same dead
+            # endpoint would just double the timeout
+            retry = self.planner.materialize(plan)
+            for leaf in _walk_plans(retry):
+                if (isinstance(leaf, RemoteLeafExec)
+                        and getattr(leaf.inner, "shard", None) == e.shard
+                        and leaf.endpoint == e.endpoint):
+                    raise
+            self.last_exec_path = "local-replanned"
+            try:
+                return retry.run(self._ctx())
+            except QueryError as e2:
+                # e.g. the reassigned shard's takeover recovery still lags
+                # the map update: name both failures, stay retryable
+                raise QueryError(
+                    f"retry after peer failure also failed: {e2} "
+                    f"(first failure: {e})") from e2
 
     def _try_fused_hist(self, plan: L.LogicalPlan) -> QueryResult | None:
         """histogram_quantile(q, sum by(...) (fn(m[w]))) on a single

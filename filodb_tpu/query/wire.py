@@ -59,6 +59,18 @@ class NotWireable(Exception):
     ScalarOperationMapper whose operand is a materialized subplan)."""
 
 
+class RemotePeerError(QueryError):
+    """A peer dispatch failed (unreachable / transport error). The engine
+    re-plans and retries ONCE — and only if the failed shard's route actually
+    changed (ref: the reference retries via Akka ask-timeouts + shard-map
+    subscription updates)."""
+
+    def __init__(self, msg: str, endpoint: str = "", shard: int = -1):
+        super().__init__(msg)
+        self.endpoint = endpoint
+        self.shard = shard
+
+
 def _enc_val(v):
     if isinstance(v, _SCALARS):
         return v
@@ -307,10 +319,11 @@ class RemoteLeafExec(ExecPlan):
                 f"remote exec on {self.endpoint} for shard "
                 f"{getattr(self.inner, 'shard', '?')} failed: {msg}") from None
         except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise QueryError(
-                f"peer {self.endpoint} unreachable for shard "
-                f"{getattr(self.inner, 'shard', '?')}: {e}; the query is "
-                "retryable once shards reassign") from None
+            shard = int(getattr(self.inner, "shard", -1))
+            raise RemotePeerError(
+                f"peer {self.endpoint} unreachable for shard {shard}: {e}; "
+                "the query is retryable once shards reassign",
+                endpoint=self.endpoint, shard=shard) from None
         data = deserialize_result(payload)
         for t in local:
             data = t.apply(data, ctx)

@@ -110,3 +110,89 @@ def test_validator_detects_mismatches():
                                rtol=1e-6)["mismatches"] == 0
     assert mod.compare_results({key: {1000: 5.0}}, ds_drift,
                                rtol=1e-8)["mismatches"] == 1
+
+
+@pytest.mark.slow
+def test_validator_on_two_node_cluster(tmp_path):
+    """Downsample families on a TWO-node cluster: each node serves its own
+    shard's family from the shared sink and routes the peer's shard via
+    cross-node dispatch (QueryEngine route_dataset) — the validator must
+    pass against EITHER node's HTTP port, seeing every series."""
+    from filodb_tpu.ingest.broker import BrokerBus, BrokerServer
+
+    broker = BrokerServer(str(tmp_path / "broker"), num_partitions=2).start()
+    reg = str(tmp_path / "members.jsonl")
+
+    def server(name):
+        return FiloServer(Config({
+            "num_shards": 2, "bus_addr": f"127.0.0.1:{broker.port}",
+            "data_dir": str(tmp_path / "data" / name.replace(":", "_")),
+            "http": {"port": 0},
+            "cluster": {"registrar": reg, "self_addr": name,
+                        "heartbeat_interval": "200ms", "stale_after": "5s",
+                        "min_members": 2, "join_timeout": "20s"},
+            "downsample": {"enabled": True, "resolutions": ["1m"],
+                           "serve_interval": "500ms"},
+            "store": {"max_series_per_shard": 16, "samples_per_series": 128,
+                      "flush_batch_size": 10**9, "groups_per_shard": 1},
+        }))
+
+    import threading
+    servers = {}
+    errors = {}
+
+    def starter(n):
+        try:
+            servers[n] = server(n).start()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors[n] = e
+
+    threads = [threading.Thread(target=starter, args=(n,))
+               for n in ("node-a:1", "node-b:1")]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join(timeout=40)
+        assert not errors, f"server start failed: {errors}"
+        assert set(servers) == {"node-a:1", "node-b:1"}, \
+            f"server start hung: {sorted(servers)}"
+        a, b = servers["node-a:1"], servers["node-b:1"]
+        rng = np.random.default_rng(5)
+        for s in (0, 1):
+            bus = BrokerBus(f"127.0.0.1:{broker.port}", s)
+            bld = RecordBuilder(GAUGE)
+            for i in range(2):
+                vals = 40.0 * (s * 2 + i + 1) + rng.normal(0, 3, 60)
+                for t in range(60):
+                    bld.add({"_metric_": "m", "host": f"s{s}h{i}"},
+                            BASE + 500 + t * 7_000, float(vals[t]))
+            bus.publish(bld.build())
+            bus.close()
+
+        mod = _load_validator()
+        for srv in (a, b):
+            url = f"http://127.0.0.1:{srv.http.port}"
+            deadline = time.time() + 90
+            report = None
+            while time.time() < deadline:
+                try:
+                    report = mod.validate(url, "prometheus", "1m", "m",
+                                          BASE, BASE + 60 * 7_000)
+                    # all 4 series (2 per shard) visible from THIS node
+                    if report["ok"] and all(
+                            c["series_raw"] == 4 and c["series_ds"] == 4
+                            for c in report["checks"].values()):
+                        break
+                except Exception:  # noqa: BLE001 — families not served yet
+                    pass
+                time.sleep(0.5)
+            assert report is not None and report["ok"], (srv.node, report)
+            for col, c in report["checks"].items():
+                assert c["series_raw"] == 4 and c["series_ds"] == 4, \
+                    (srv.node, col, c)
+                assert c["mismatches"] == 0 and c["missing_ds_series"] == 0
+    finally:
+        for srv in servers.values():
+            srv.shutdown()
+        broker.stop()

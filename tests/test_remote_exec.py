@@ -291,6 +291,49 @@ def test_exec_rejects_oversized_plan(two_node):
     assert ei.value.code == 413
 
 
+def test_peer_death_replans_once_to_survivor():
+    """A peer dying between plan materialization and execution raises
+    RemotePeerError; the engine re-materializes against the (by then
+    updated) shard map and retries ONCE — the takeover window shrinks to
+    one round-trip instead of surfacing every mid-reassignment query."""
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset(DATASET, 2)
+    owner = {s: mgr.node_of(DATASET, s) for s in (0, 1)}
+    ms_a = TimeSeriesMemStore()
+    # node a holds BOTH shards' stores (the post-takeover state a survivor
+    # reaches after recovery)
+    for s in (0, 1):
+        ms_a.setup(DATASET, GAUGE, s, _cfg())
+        for i in range(4):
+            _ingest(ms_a, s, s * 4 + i)
+    ms_a.flush_all()
+
+    state = {"failed": False}
+
+    def resolver(node):
+        if node == owner[1] and owner[1] != "a" and not state["failed"]:
+            state["failed"] = True
+            # the membership monitor declares the peer dead concurrently:
+            # ownership moves to the survivor before the engine's retry
+            mgr.remove_node(owner[1])
+            return "127.0.0.1:1"          # nothing listens there
+        return None
+
+    # make shard 1 the remote one regardless of which node the strategy
+    # picked: query from the node owning shard 0
+    me = owner[0]
+    eng = QueryEngine(ms_a, DATASET, ShardMapper(2), cluster=mgr, node=me,
+                      endpoint_resolver=resolver)
+    if owner[1] == me:
+        pytest.skip("strategy assigned both shards to one node")
+    r = eng.query_range("count(m)", START + 600_000, START + 900_000, 30_000)
+    assert state["failed"], "the dead peer was never dispatched to"
+    assert eng.last_exec_path == "local-replanned"
+    assert float(np.asarray(r.matrix.values)[0, 0]) == 8.0
+
+
 def test_peer_unreachable_is_loud(two_node):
     engines, _oracle, mgr, eps, _servers = two_node
     saved = eps["b"]
