@@ -60,13 +60,17 @@ def _parse_step(v: str) -> int:
 
 
 def _selector_to_filters(sel: str):
+    from dataclasses import replace
+
     from ..promql.parser import Parser
     expr = Parser(sel).parse()
     filters = list(expr.matchers)
     if expr.metric:
         filters.append(F.Equals("_metric_", expr.metric))
-    return [F.Equals("_metric_", f.value) if isinstance(f, F.Equals) and f.label == "__name__"
-            else f for f in filters]
+    # __name__ aliases the internal metric label for EVERY matcher kind —
+    # a regex/not-equals metric matcher left as __name__ would match nothing
+    return [replace(f, label="_metric_") if f.label == "__name__" else f
+            for f in filters]
 
 
 class FiloHttpServer:
@@ -181,7 +185,8 @@ class FiloHttpServer:
     def _route(self, h) -> None:
         url = urlparse(h.path)
         path = url.path
-        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        qs = parse_qs(url.query)
+        q = {k: v[0] for k, v in qs.items()}
 
         # remote read/write carry snappy-compressed protobuf bodies — handle
         # them before the urlencoded body parsing below consumes rfile
@@ -203,7 +208,11 @@ class FiloHttpServer:
             ln = int(h.headers.get("Content-Length") or 0)
             if ln:
                 body = h.rfile.read(ln).decode()
-                q.update({k: v[0] for k, v in parse_qs(body).items()})
+                bqs = parse_qs(body)
+                q.update({k: v[0] for k, v in bqs.items()})
+                for k, v in bqs.items():
+                    qs.setdefault(k, []).extend(x for x in v
+                                                if x not in qs.get(k, []))
 
         if path == "/__health":
             h._send(200, {"status": "healthy"})
@@ -244,42 +253,62 @@ class FiloHttpServer:
         # local=1 marks a peer's metadata fan-out request: answer from local
         # shards only (stops mutual-recursion between nodes)
         local_only = bool(q.get("local"))
+        # optional match[] selectors restrict labels/values to matching
+        # series; REPEATED selectors union (Prometheus API semantics)
+        mfilter_sets = [_selector_to_filters(sel)
+                        for sel in qs.get("match[]", [])]
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/labels", path)
         if m:
             engine = self.engines[m.group(1)]
+
+            def fetch_names():
+                out: set = set()
+                for filt in (mfilter_sets or [None]):
+                    out.update(engine.label_names(filt,
+                                                  local_only=local_only))
+                return sorted(out)
+
             h._send(200, {"status": "success",
-                          "data": self._run(
-                              lambda: engine.label_names(local_only=local_only),
-                              Priority.METADATA)})
+                          "data": self._run(fetch_names, Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/label/([^/]+)/values", path)
         if m:
             engine = self.engines[m.group(1)]
             name = m.group(2)
+
+            def fetch_values():
+                out: set = set()
+                for filt in (mfilter_sets or [None]):
+                    out.update(engine.label_values(name, filt,
+                                                   local_only=local_only))
+                return sorted(out)
+
             h._send(200, {"status": "success",
-                          "data": self._run(
-                              lambda: engine.label_values(name, local_only=local_only),
-                              Priority.METADATA)})
+                          "data": self._run(fetch_values, Priority.METADATA)})
             return
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/series", path)
         if m:
             engine = self.engines[m.group(1)]
-            filters = _selector_to_filters(q["match[]"])
+            if not mfilter_sets:
+                h._send(400, {"status": "error", "errorType": "bad_data",
+                              "error": "series requires at least one match[]"})
+                return
             start = _parse_time(q.get("start", "0"))
             end = _parse_time(q.get("end", "9999999999"))
 
             def fetch_series():
                 data = []
                 seen = set()
-                for labels in engine.series(filters, start, end,
-                                            local_only=local_only):
-                    d = dict(labels)
-                    if "_metric_" in d:
-                        d["__name__"] = d.pop("_metric_")
-                    key = tuple(sorted(d.items()))
-                    if key not in seen:       # peers may re-report takeovers
-                        seen.add(key)
-                        data.append(d)
+                for filt in mfilter_sets:
+                    for labels in engine.series(filt, start, end,
+                                                local_only=local_only):
+                        d = dict(labels)
+                        if "_metric_" in d:
+                            d["__name__"] = d.pop("_metric_")
+                        key = tuple(sorted(d.items()))
+                        if key not in seen:   # selector overlap / takeovers
+                            seen.add(key)
+                            data.append(d)
                 return data
 
             h._send(200, {"status": "success",

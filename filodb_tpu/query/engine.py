@@ -533,14 +533,23 @@ class QueryEngine:
 
     # -- metadata queries (ref: QueryActor label-values / series paths) -------
 
+    @staticmethod
+    def _match_suffix(filters) -> str:
+        if not filters:
+            return ""
+        from urllib.parse import quote
+        return "?match[]=" + quote(_filters_to_selector(filters))
+
     def label_values(self, label: str, filters=None, top_k=None,
                      local_only: bool = False) -> list[str]:
         vals: dict[str, None] = {}
         for shard in self.memstore.shards_of(self.dataset):
             for v in shard.label_values(label, filters, top_k=top_k):
                 vals[v] = None
-        if not local_only and filters is None:
-            for v in self._peer_metadata(f"/api/v1/label/{label}/values"):
+        if not local_only:
+            for v in self._peer_metadata(
+                    f"/api/v1/label/{label}/values"
+                    + self._match_suffix(filters)):
                 vals[v] = None
         return sorted(vals)
 
@@ -548,8 +557,9 @@ class QueryEngine:
         names: set[str] = set()
         for shard in self.memstore.shards_of(self.dataset):
             names.update(shard.label_names(filters))
-        if not local_only and filters is None:
-            names.update(self._peer_metadata("/api/v1/labels"))
+        if not local_only:
+            names.update(self._peer_metadata(
+                "/api/v1/labels" + self._match_suffix(filters)))
         return sorted(names)
 
     def series(self, filters, start_ms: int, end_ms: int,
@@ -561,9 +571,10 @@ class QueryEngine:
                 pids = shard.part_ids_from_filters(list(filters), start_ms, end_ms)
                 out.extend(shard.index.labels_of(int(p)) for p in pids)
         if not local_only and self._has_remote_shards():
-            from urllib.parse import quote
-            sel = _filters_to_selector(filters)
-            path = (f"/api/v1/series?match[]={quote(sel)}"
+            from ..core import filters as F
+            sfx = self._match_suffix(
+                filters or [F.EqualsRegex("_metric_", ".*")])
+            path = (f"/api/v1/series{sfx}"
                     f"&start={start_ms / 1000.0}&end={end_ms / 1000.0}")
             for d in self._peer_metadata(path):
                 if "__name__" in d:

@@ -231,6 +231,11 @@ def test_metadata_federation(two_node):
     for n in ("a", "b"):
         assert engines[n].label_values("host") == oracle.label_values("host")
         assert engines[n].label_names() == oracle.label_names()
+        # filtered lookups federate too (match[] rides the peer URL)
+        filt = [F.Equals("dc", "dc1")]
+        got = engines[n].label_values("host", filt)
+        want = oracle.label_values("host", filt)
+        assert got == want and 0 < len(got) < len(oracle.label_values("host"))
         got = engines[n].series([F.Equals("_metric_", "m")], START,
                                 START + N * INTERVAL)
         want = oracle.series([F.Equals("_metric_", "m")], START,
@@ -344,3 +349,38 @@ def test_peer_unreachable_is_loud(two_node):
                                      START + 900_000, 30_000)
     finally:
         eps["b"] = saved
+
+
+def test_labels_match_selector_union(two_node):
+    """match[] on labels endpoints: restricts to matching series, repeated
+    selectors UNION, and __name__ aliases for every matcher kind."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    _engines, _oracle, _mgr, eps, _servers = two_node
+
+    def get(path, params):
+        qs = "&".join(f"{k}={urllib.parse.quote(v)}" for k, v in params)
+        with urllib.request.urlopen(
+                f"http://{eps['a']}/promql/{DATASET}/api/v1/{path}?{qs}",
+                timeout=15) as r:
+            return json.load(r)["data"]
+
+    all_hosts = get("label/host/values", [])
+    assert len(all_hosts) == 8
+    one = get("label/host/values", [("match[]", '{dc="dc0"}')])
+    assert 0 < len(one) < len(all_hosts)
+    both = get("label/host/values", [("match[]", '{dc="dc0"}'),
+                                     ("match[]", '{dc="dc1"}')])
+    assert both == all_hosts                   # union of the two selectors
+    # a regex __name__ matcher must alias to the metric label
+    rx = get("label/host/values", [("match[]", '{__name__=~"m2?"}')])
+    assert rx == all_hosts
+    assert get("label/host/values", [("match[]", '{__name__="absent"}')]) == []
+    # /series without match[] is a 400, not a 500
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://{eps['a']}/promql/{DATASET}/api/v1/series", timeout=15)
+    assert ei.value.code == 400
