@@ -187,6 +187,9 @@ QUERIES = [
     'abs(m) * 2',
     'sort_desc(sum by (host) (m))',
     'sum(rate(absent_metric[2m]))',
+    'm * scalar(sum(m2))',           # step-varying scalar operand subplan
+    'clamp_max(rate(m[2m]), 0.5)',
+    'm and on(host, dc) m2',
 ]
 
 
@@ -384,3 +387,63 @@ def test_labels_match_selector_union(two_node):
         urllib.request.urlopen(
             f"http://{eps['a']}/promql/{DATASET}/api/v1/series", timeout=15)
     assert ei.value.code == 400
+
+
+def test_two_node_histogram_parity():
+    """Native-histogram aggregates across nodes: bucket-wise AggPartials
+    (with bucket bounds) cross the wire and histogram_quantile presents
+    identically to a single-node oracle."""
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset("histds", 2)
+    owner = {s: mgr.node_of("histds", s) for s in (0, 1)}
+    les = np.array([1.0, 2.0, 4.0, 8.0, np.inf])
+    rng = np.random.default_rng(7)
+
+    def hcfg():
+        return StoreConfig(max_series_per_shard=8, samples_per_series=128,
+                           flush_batch_size=10**9, dtype="float64")
+
+    stores = {"a": TimeSeriesMemStore(), "b": TimeSeriesMemStore()}
+    oracle_ms = TimeSeriesMemStore()
+    NH = 100
+    for s in (0, 1):
+        stores[owner[s]].setup("histds", PROM_HISTOGRAM, s, hcfg())
+        oracle_ms.setup("histds", PROM_HISTOGRAM, s, hcfg())
+        for r in range(3):
+            counts = np.cumsum(np.cumsum(rng.poisson(0.4, (NH, 5)), axis=0),
+                               axis=1).astype(np.float64)
+            for ms in (stores[owner[s]], oracle_ms):
+                b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+                for t in range(NH):
+                    b.add({"_metric_": "lat", "pod": f"p{s}-{r}"},
+                          START + t * INTERVAL, counts[t])
+                ms.ingest("histds", s, b.build())
+    for ms in (*stores.values(), oracle_ms):
+        ms.flush_all()
+
+    eps: dict[str, str] = {}
+    engines = {n: QueryEngine(stores[n], "histds", ShardMapper(2),
+                              cluster=mgr, node=n, endpoint_resolver=eps.get)
+               for n in ("a", "b")}
+    servers = {n: FiloHttpServer({"histds": engines[n]}, port=0).start()
+               for n in ("a", "b")}
+    for n, srv in servers.items():
+        eps[n] = f"127.0.0.1:{srv.port}"
+    oracle = QueryEngine(oracle_ms, "histds")
+    try:
+        start, end, step = START + 400_000, START + (NH - 10) * INTERVAL, 60_000
+        for q in ("histogram_quantile(0.9, sum(rate(lat[2m])))",
+                  "sum(rate(lat[2m]))",          # histogram-valued result
+                  "sum by (pod) (rate(lat[2m]))"):
+            want = _as_comparable(oracle.query_range(q, start, end, step))
+            for n in ("a", "b"):
+                got = _as_comparable(
+                    engines[n].query_range(q, start, end, step))
+                assert got == want, f"node {n} diverged on {q!r}"
+    finally:
+        for srv in servers.values():
+            srv.stop()
