@@ -130,16 +130,37 @@ def _ingest(ms, shard, i, metric="m"):
     ms.ingest(DATASET, shard, b.build())
 
 
+def _two_node_scaffold(dataset: str):
+    """(mgr, owner) for a 2-shard dataset split across nodes a/b — asserted:
+    the load-based strategy is not contractually round-robin."""
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset(dataset, 2)
+    owner = {s: mgr.node_of(dataset, s) for s in (0, 1)}
+    assert set(owner.values()) == {"a", "b"}
+    return mgr, owner
+
+
+def _two_node_serving(dataset: str, stores, mgr):
+    """(engines, eps, servers): per-node engines + HTTP servers with
+    registrar-style endpoint resolution — the shared cluster wiring."""
+    eps: dict[str, str] = {}
+    engines = {n: QueryEngine(stores[n], dataset, ShardMapper(2),
+                              cluster=mgr, node=n, endpoint_resolver=eps.get)
+               for n in ("a", "b")}
+    servers = {n: FiloHttpServer({dataset: engines[n]}, port=0).start()
+               for n in ("a", "b")}
+    for n, srv in servers.items():
+        eps[n] = f"127.0.0.1:{srv.port}"
+    return engines, eps, servers
+
+
 @pytest.fixture(scope="module")
 def two_node():
     """Two nodes each owning ONE shard of a 2-shard dataset (the topology the
     reference runs in production), plus a single-node oracle owning both."""
-    mgr = ShardManager()
-    mgr.add_node("a")
-    mgr.add_node("b")
-    mgr.add_dataset(DATASET, 2)
-    owner = {s: mgr.node_of(DATASET, s) for s in (0, 1)}
-    assert set(owner.values()) == {"a", "b"}
+    mgr, owner = _two_node_scaffold(DATASET)
 
     stores = {"a": TimeSeriesMemStore(), "b": TimeSeriesMemStore()}
     oracle_ms = TimeSeriesMemStore()
@@ -153,15 +174,7 @@ def two_node():
     for ms in (*stores.values(), oracle_ms):
         ms.flush_all()
 
-    eps: dict[str, str] = {}
-    engines = {n: QueryEngine(stores[n], DATASET, ShardMapper(2),
-                              cluster=mgr, node=n,
-                              endpoint_resolver=eps.get)
-               for n in ("a", "b")}
-    servers = {n: FiloHttpServer({DATASET: engines[n]}, port=0).start()
-               for n in ("a", "b")}
-    for n, srv in servers.items():
-        eps[n] = f"127.0.0.1:{srv.port}"
+    engines, eps, servers = _two_node_serving(DATASET, stores, mgr)
     oracle = QueryEngine(oracle_ms, DATASET, ShardMapper(2))
     try:
         yield engines, oracle, mgr, eps, servers
@@ -395,11 +408,7 @@ def test_two_node_histogram_parity():
     identically to a single-node oracle."""
     from filodb_tpu.core.schemas import PROM_HISTOGRAM
 
-    mgr = ShardManager()
-    mgr.add_node("a")
-    mgr.add_node("b")
-    mgr.add_dataset("histds", 2)
-    owner = {s: mgr.node_of("histds", s) for s in (0, 1)}
+    mgr, owner = _two_node_scaffold("histds")
     les = np.array([1.0, 2.0, 4.0, 8.0, np.inf])
     rng = np.random.default_rng(7)
 
@@ -425,14 +434,7 @@ def test_two_node_histogram_parity():
     for ms in (*stores.values(), oracle_ms):
         ms.flush_all()
 
-    eps: dict[str, str] = {}
-    engines = {n: QueryEngine(stores[n], "histds", ShardMapper(2),
-                              cluster=mgr, node=n, endpoint_resolver=eps.get)
-               for n in ("a", "b")}
-    servers = {n: FiloHttpServer({"histds": engines[n]}, port=0).start()
-               for n in ("a", "b")}
-    for n, srv in servers.items():
-        eps[n] = f"127.0.0.1:{srv.port}"
+    engines, eps, servers = _two_node_serving("histds", stores, mgr)
     oracle = QueryEngine(oracle_ms, "histds")
     try:
         start, end, step = START + 400_000, START + (NH - 10) * INTERVAL, 60_000
