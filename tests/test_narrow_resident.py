@@ -188,3 +188,62 @@ def test_narrow_resident_compact_and_odp(tmp_path):
     assert (n_a == 40).all()
     for i in range(len(pids)):
         np.testing.assert_allclose(val_a[i, :40], np.arange(40.0))
+
+
+def test_two_phase_compress_aborts_on_racing_mutation():
+    """A mutation landing between the (unlocked) build and the swap must
+    abort the commit — the stale compressed state would drop the race's
+    samples. The next flush re-attempts on the new epoch."""
+    ms, sh = _build(True)
+    st = sh.store
+    assert st.is_narrow_resident
+    # rehydrate via an append, then race the re-compression
+    b = RecordBuilder(GAUGE)
+    b.add({"_metric_": "m", "host": "h0", "grp": "g0"},
+          START + (N + 1) * INTERVAL, 7.0)
+    ms.ingest("prometheus", 0, b.build())
+    orig_prepare = st.compress_prepare
+
+    def racing_prepare():
+        prep = orig_prepare()
+        # a concurrent append mutates AFTER the build snapshot
+        rb = RecordBuilder(GAUGE)
+        rb.add({"_metric_": "m", "host": "h0", "grp": "g0"},
+               START + (N + 2) * INTERVAL, 9.0)
+        ms.ingest("prometheus", 0, rb.build())
+        with sh.lock:
+            sh._flush_staged_locked()
+        return prep
+
+    st.compress_prepare = racing_prepare
+    sh.flush()
+    st.compress_prepare = orig_prepare
+    assert not st.is_narrow_resident, "stale build must not commit"
+    # the racing sample survived and the next quiet flush re-compresses
+    sh.flush()
+    assert st.is_narrow_resident
+    eng = QueryEngine(ms, "prometheus")
+    r = eng.query_instant('m{host="h0"}', START + (N + 2) * INTERVAL)
+    assert float(np.asarray(r.matrix.values)[0, -1]) == 9.0
+
+
+def test_gather_rows_matches_full_materialization():
+    """Row-wise decode/derivation (minority fixes) must agree bit-for-bit
+    with the full block materialization."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.core.chunkstore import DeferredTs
+
+    ms, sh = _build(True, mixed=True)
+    st = sh.store
+    assert st.is_narrow_resident
+    rid = jnp.asarray(np.array([0, 3, 7, 11], np.int32))
+    dv = st.column_array()
+    assert isinstance(dv, DeferredDecode)
+    rows = np.asarray(dv.gather_rows(rid))
+    full = np.asarray(st.value_block())
+    np.testing.assert_array_equal(rows, full[np.asarray(rid)])
+    dt = DeferredTs(st)
+    trows = np.asarray(dt.gather_rows(rid))
+    tfull = np.asarray(st.ts_block())
+    np.testing.assert_array_equal(trows, tfull[np.asarray(rid)])
