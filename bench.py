@@ -187,7 +187,8 @@ def stream_probe(val):
         out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
         interpret=jax.default_backend() != "tpu")
-    with jax.enable_x64(False):
+    from filodb_tpu.utils import enable_x64
+    with enable_x64(False):
         f = jax.jit(call)
         np.asarray(f(val))
         lat = []

@@ -33,6 +33,15 @@ DEFAULTS: dict[str, Any] = {
         # *data time* (max ingested ts), so backfilled workloads behave the same
         # as live ones (ref: TimeSeriesShard.purgeExpiredPartitions cadence)
         "purge_interval": "10m",
+        # compressed-resident store shapes (the reference keeps everything
+        # compressed in memory — doc/compression.md): "off" keeps raw
+        # f32/i64 blocks; "gauge" adopts i16 quantized values + grid-derived
+        # timestamps on scalar f32 stores; "all" extends to [S, C, B]
+        # histogram stores (i8/i16 2D-delta bucket blocks)
+        "compressed_residency": "off",
+        # keep an i16 mirror ALONGSIDE raw f32 (bandwidth, not capacity);
+        # ignored when compressed_residency is active
+        "narrow_mirror": False,
     },
     "query": {
         "stale_sample_after": "5m",
@@ -139,6 +148,8 @@ class Config:
             groups_per_shard=s["groups_per_shard"],
             retention_ms=parse_duration_ms(s["retention"]),
             dtype=s["dtype"],
+            compressed_residency=s.get("compressed_residency", "off"),
+            narrow_mirror=bool(s.get("narrow_mirror", False)),
         )
 
     def query_config(self):

@@ -163,6 +163,31 @@ def _verify_ts(ts, first, n, interval, C) -> bool:
 
 
 @jax.jit
+def _decode_hist(dd, first_d, pool, pool_rows):
+    """Reconstruct the f32 [S, C, B] bucket block from the hist-resident
+    state: v = cumsum_b(first_d + cumsum_c dd) (bit-exact for rows the
+    encoder marked ok — ops/narrow.py build_narrow_hist contract); pool rows
+    overlay their exact f32 blocks. Cells beyond a row's valid count extend
+    the last frame constantly (the raw store holds zeros there) — every
+    consumer masks by ``n``, same as the scalar decode's out-of-range cells."""
+    d = first_d[:, None, :] + jnp.cumsum(dd.astype(jnp.float32), axis=1)
+    v = jnp.cumsum(d, axis=2)
+    return v.at[pool_rows].set(pool, mode="drop")
+
+
+@jax.jit
+def _decode_hist_rows(dd, first_d, pool, pool_slot, rid):
+    """Decode ONLY the given store rows ([P] ids) of a hist-resident block —
+    minority/pool fixes must not materialize the full [S, C, B] f32 block."""
+    ddg = jnp.take(dd, rid, axis=0).astype(jnp.float32)
+    d = jnp.take(first_d, rid, axis=0)[:, None, :] + jnp.cumsum(ddg, axis=1)
+    v = jnp.cumsum(d, axis=2)
+    slot = jnp.take(pool_slot, rid, mode="clip")
+    pv = jnp.take(pool, jnp.maximum(slot, 0), axis=0, mode="clip")
+    return jnp.where((slot >= 0)[:, None, None], pv, v)
+
+
+@jax.jit
 def _decode_narrow_rows(q, vmin, scale, pool, pool_slot, rid):
     """Decode ONLY the given store rows ([P] ids): quantized reconstruction
     with pool-value overlay — minority-cohort fixes must not materialize the
@@ -221,6 +246,30 @@ class DeferredDecode(_Deferred):
         if self._arr is None and st._narrow is not None:
             q, vmin, scale, pool, _pp, slot, _ok = st._narrow
             return _decode_narrow_rows(q, vmin, scale, pool, slot, rid)
+        return jnp.take(self.materialize(), rid, axis=0)
+
+
+class DeferredDecodeHist(_Deferred):
+    """Lazy f32 view of a hist-resident store's [S, C, B] bucket block."""
+
+    dtype = np.dtype(np.float32)
+    ndim = 3
+
+    @property
+    def shape(self):
+        return (self._store.S, self._store.C, self._store.nbuckets)
+
+    def _build(self):
+        return self._store.value_block()
+
+    def gather_rows(self, rid):
+        """[P, C, B] f32 of the given rows only (row-wise decode + pool
+        overlay; falls back to a materialized block if one exists or the
+        store changed residency since this view was handed out)."""
+        st = self._store
+        if self._arr is None and st._nhist is not None:
+            dd, first_d, pool, _pp, slot, _ok = st._nhist
+            return _decode_hist_rows(dd, first_d, pool, slot, rid)
         return jnp.take(self.materialize(), rid, axis=0)
 
 
@@ -327,10 +376,15 @@ class SeriesStore:
         # (ops/narrow.py); the query leaf consults it when enabled
         from ..ops.narrow import NarrowMirror
         self.narrow = NarrowMirror()
-        # narrow-RESIDENT state (StoreConfig.narrow_resident): when set, the
-        # i16 quantized form IS the only resident value copy — self.val is
-        # None and f32 views decode on demand (see compress_resident)
+        # narrow-RESIDENT state (StoreConfig.narrow_resident /
+        # compressed_residency): when set, the i16 quantized form IS the only
+        # resident value copy — self.val is None and f32 views decode on
+        # demand (see compress_resident)
         self._narrow = None
+        # histogram twin: (dd i8/i16 [S,C,B], first_d f32 [S,B], pool, pp,
+        # slot, ok_host) — the 2D-delta form of the cumulative bucket block
+        # (compressed_residency="all")
+        self._nhist = None
         # grid-derived timestamp elision: ts[S, C] freed, derived from
         # (first_ts, n, grid_interval) on demand — the 8B/sample column is
         # redundant on a grid-contiguous store (compress_resident)
@@ -361,38 +415,85 @@ class SeriesStore:
         s = self.stats
         return (s.samples_appended, s.compactions, s.frees)
 
-    def compress_prepare(self):
+    def _cohort_pool(self, bad: np.ndarray):
+        """(pool, pp, slot) for the rows that don't round-trip bit-exactly:
+        their raw f32 rows, the padded row-id vector (pads scatter-drop on
+        decode), and the per-row pool slot (-1 = quantized) so row-wise
+        decodes overlay pool values without touching the full block."""
+        Rp = 1
+        while Rp < len(bad):
+            Rp *= 2
+        pp = np.full(Rp, self.S, np.int32)
+        pp[:len(bad)] = bad
+        pool = jnp.take(self.val, jnp.asarray(np.minimum(pp, self.S - 1)),
+                        axis=0)
+        slot = np.full(self.S, -1, np.int32)
+        slot[bad] = np.arange(len(bad), dtype=np.int32)
+        return pool, jnp.asarray(pp), jnp.asarray(slot)
+
+    def _bad_rows(self, ok_host: np.ndarray):
+        """Live rows failing the bit-exactness contract, or None when they
+        exceed the 25% cohort gate (raw f32 is then the cheaper residency)."""
+        live = self.n_host > 0
+        bad = np.nonzero(live & ~ok_host)[0].astype(np.int32)
+        if len(bad) > 0.25 * max(int(live.sum()), 1):
+            return None
+        return bad
+
+    def _prepare_scalar(self):
+        from ..ops.narrow import build_narrow
+        q, vmin, scale, ok = build_narrow(self.val, self.n)
+        ok_host = np.asarray(ok)
+        bad = self._bad_rows(ok_host)
+        if bad is None:
+            return None    # mostly continuous floats: raw f32 is cheaper
+        pool, pp, slot = self._cohort_pool(bad)
+        return ("q", (q, vmin, scale, pool, pp, slot, ok_host))
+
+    def _prepare_hist(self):
+        """2D-delta residency for the [S, C, B] bucket block: the narrowest
+        signed dtype (i8, then i16) whose bit-exact rows keep the cohort pool
+        under the gate wins — quiet histograms' delta-of-deltas are near zero,
+        so i8 usually carries them at a quarter of the raw f32 bytes."""
+        from ..ops.narrow import build_narrow_hist, cast_narrow_hist_i8
+        dd16, first_d, ok16, ok8 = build_narrow_hist(self.val, self.n)
+        ok8_host, ok16_host = np.asarray(ok8), np.asarray(ok16)
+        bad8 = self._bad_rows(ok8_host)
+        if bad8 is not None:
+            dd, bad, ok_host = cast_narrow_hist_i8(dd16), bad8, ok8_host
+        else:
+            bad16 = self._bad_rows(ok16_host)
+            if bad16 is None:
+                return None   # mostly inexact/bursty rows: keep raw f32
+            dd, bad, ok_host = dd16, bad16, ok16_host
+        pool, pp, slot = self._cohort_pool(bad)
+        return ("h", (dd, first_d, pool, pp, slot, ok_host))
+
+    def compress_prepare(self, hist: bool = True):
         """Phase 1 (NO lock needed): stream the store into the compressed
-        form — quantized values + cohort pool, and the ts-derivability
-        verdict. Pure reads + host fetches; a concurrent donating mutation
-        surfaces as RuntimeError (caller retries next flush). Returns None
-        when the store/data doesn't qualify (multi-column, histogram, f64,
-        mostly non-quantizable rows)."""
+        form — quantized scalar values / 2D-delta bucket blocks + cohort
+        pool, and the ts-derivability verdict. Pure reads + host fetches; a
+        concurrent donating mutation surfaces as RuntimeError (caller retries
+        next flush). Returns None when the store/data doesn't qualify
+        (multi-column, f64, mostly non-quantizable rows, or a histogram
+        store with ``hist=False`` — the shard's residency-mode gate)."""
         prep_val = None
-        if self._narrow is None:
-            if (self.layout is not None or self.nbuckets
-                    or self.dtype != jnp.float32 or self.val is None):
+        if self._narrow is None and self._nhist is None:
+            if self.dtype != jnp.float32 or self.val is None:
                 return None
-            from ..ops.narrow import build_narrow
-            q, vmin, scale, ok = build_narrow(self.val, self.n)
-            ok_host = np.asarray(ok)
-            live = self.n_host > 0
-            bad = np.nonzero(live & ~ok_host)[0].astype(np.int32)
-            if len(bad) > 0.25 * max(int(live.sum()), 1):
-                return None    # mostly continuous floats: raw f32 is cheaper
-            Rp = 1
-            while Rp < len(bad):
-                Rp *= 2
-            pp = np.full(Rp, self.S, np.int32)  # pads scatter-drop on decode
-            pp[:len(bad)] = bad
-            pool = jnp.take(self.val, jnp.asarray(np.minimum(pp, self.S - 1)),
-                            axis=0)
-            # pool slot per row (-1 = quantized): row-wise decodes overlay
-            # pool values without touching the full block
-            slot = np.full(self.S, -1, np.int32)
-            slot[bad] = np.arange(len(bad), dtype=np.int32)
-            prep_val = (q, vmin, scale, pool, jnp.asarray(pp),
-                        jnp.asarray(slot), ok_host)
+            if self.nbuckets:
+                # histogram stores compress their DEFAULT [S, C, B] bucket
+                # block — the dominant bytes; a multi-column store's named
+                # scalar columns (prom-histogram's sum/count) stay raw
+                if not hist:
+                    return None
+                prep_val = self._prepare_hist()
+            elif self.layout is None:
+                prep_val = self._prepare_scalar()
+            else:
+                return None   # multi-column scalar stores stay raw
+            if prep_val is None:
+                return None
         ts_ok = False
         if not self._ts_elided and self.ts is not None \
                 and self.grid_info() is not None:
@@ -409,36 +510,49 @@ class SeriesStore:
         prep_val, ts_ok = prep
         self._pre_donate("SeriesStore.compress_resident")
         if prep_val is not None:
-            self._narrow = prep_val
+            kind, data = prep_val
+            if kind == "h":
+                self._nhist = data
+            else:
+                self._narrow = data
             self.val = None    # the f32 block's HBM is released here
         if ts_ok and not self._ts_elided:
             self.ts = None     # the 8B/sample block's HBM released here
             self._ts_elided = True
 
-    def compress_resident(self) -> bool:
+    @property
+    def _val_compressed(self) -> bool:
+        return self._narrow is not None or self._nhist is not None
+
+    def compress_resident(self, hist: bool = True) -> bool:
         """One-call form (caller holds the shard lock): adopt the
-        compressed-resident state — i16 quantized rows + raw-f32 cohort pool
-        as the only value copy, timestamps elided on grid-contiguous stores.
-        Returns True when resident-narrow (already or newly)."""
-        if self._narrow is not None and (self._ts_elided
-                                         or self.grid_info() is None):
+        compressed-resident state — i16 quantized rows (or i8/i16 2D-delta
+        bucket blocks) + raw-f32 cohort pool as the only value copy,
+        timestamps elided on grid-contiguous stores. Returns True when
+        resident-narrow (already or newly)."""
+        if self._val_compressed and (self._ts_elided
+                                     or self.grid_info() is None):
             return True
-        prep = self.compress_prepare()
+        prep = self.compress_prepare(hist=hist)
         if prep is None:
-            return self._narrow is not None
+            return self._val_compressed
         self.compress_commit(prep)
-        return self._narrow is not None or self._ts_elided
+        return self._val_compressed or self._ts_elided
 
     def _rehydrate(self) -> None:
         """Restore the resident f32/i64 blocks (mutations write raw); the
         next compress_resident() re-adopts the compressed state."""
-        if self._narrow is None and not self._ts_elided:
+        if not self._val_compressed and not self._ts_elided:
             return
         self._pre_donate("SeriesStore.rehydrate")
         if self._narrow is not None:
             q, vmin, scale, pool, pp, _slot, _ok = self._narrow
             self.val = _decode_narrow(q, vmin, scale, pool, pp)
             self._narrow = None
+        elif self._nhist is not None:
+            dd, first_d, pool, pp, _slot, _ok = self._nhist
+            self.val = _decode_hist(dd, first_d, pool, pp)
+            self._nhist = None
         if self._ts_elided:
             self.ts = _derive_ts(jnp.asarray(self.first_ts), self.n,
                                  jnp.int64(self.grid_interval), self.C)
@@ -446,11 +560,15 @@ class SeriesStore:
 
     def value_block(self):
         """f32 value block: the resident array, or a TRANSIENT decode of the
-        narrow state (not retained — capacity stays at i16 + pool)."""
-        if self._narrow is None:
-            return self.val
-        q, vmin, scale, pool, pp, _slot, _ok = self._narrow
-        return _decode_narrow(q, vmin, scale, pool, pp)
+        narrow state (not retained — capacity stays at the compressed form +
+        pool)."""
+        if self._narrow is not None:
+            q, vmin, scale, pool, pp, _slot, _ok = self._narrow
+            return _decode_narrow(q, vmin, scale, pool, pp)
+        if self._nhist is not None:
+            dd, first_d, pool, pp, _slot, _ok = self._nhist
+            return _decode_hist(dd, first_d, pool, pp)
+        return self.val
 
     def ts_block(self):
         """i64 timestamp block: resident, or a TRANSIENT grid derivation."""
@@ -467,18 +585,30 @@ class SeriesStore:
         q, vmin, scale, _pool, _pp, _slot, ok = self._narrow
         return q, vmin, scale, ok
 
+    def hist_operands(self):
+        """(dd, first_d, ok_host) when hist-resident, else None — the narrow
+        hist grid kernels' direct-stream operands (ops/gridfns.py *_narrow)."""
+        if self._nhist is None:
+            return None
+        dd, first_d, _pool, _pp, _slot, ok = self._nhist
+        return dd, first_d, ok
+
     @property
     def is_narrow_resident(self) -> bool:
-        return self._narrow is not None or self._ts_elided
+        return self._val_compressed or self._ts_elided
 
     def resident_value_bytes(self) -> int:
         """Resident HBM bytes of the value state (capacity accounting)."""
-        if self._narrow is None:
-            v = self.val
-            return 0 if v is None else v.size * v.dtype.itemsize
-        q, vmin, scale, pool, _pp, _slot, _ok = self._narrow
-        return (q.size * 2 + vmin.size * 4 + scale.size * 4
-                + pool.size * 4)
+        if self._narrow is not None:
+            q, vmin, scale, pool, _pp, _slot, _ok = self._narrow
+            return (q.size * 2 + vmin.size * 4 + scale.size * 4
+                    + pool.size * 4)
+        if self._nhist is not None:
+            dd, first_d, pool, _pp, _slot, _ok = self._nhist
+            return (dd.size * dd.dtype.itemsize + first_d.size * 4
+                    + pool.size * 4)
+        v = self.val
+        return 0 if v is None else v.size * v.dtype.itemsize
 
     def resident_sample_bytes(self) -> int:
         """Total resident HBM of the (ts + value) sample state — the
@@ -750,6 +880,8 @@ class SeriesStore:
                 # never decodes; general paths materialize a transient f32
                 # at their single choke points (query/exec.py _dval)
                 return DeferredDecode(self)
+            if self._nhist is not None:
+                return DeferredDecodeHist(self)
             return self.val
         if column in self.extra:
             return self.extra[column]
@@ -761,7 +893,7 @@ class SeriesStore:
         series_snapshot (which would re-decode a compressed-resident store's
         full block per series)."""
         v = self.column_array(column)
-        if isinstance(v, DeferredDecode):
+        if isinstance(v, _Deferred):
             v = v.materialize()
         return self.ts_block(), v
 

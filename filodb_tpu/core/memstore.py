@@ -59,8 +59,30 @@ class StoreConfig:
     # and the f32 array is FREED — ~2x value-retention per HBM byte. Appends
     # rehydrate (write buffers stay raw, like the reference's); the fused
     # query path streams the i16 state directly; general paths decode a
-    # transient. Scalar f32 single-column stores only.
+    # transient. Scalar f32 single-column stores only. (Back-compat alias
+    # for compressed_residency="gauge".)
     narrow_resident: bool = False
+    # which store shapes adopt the compressed-resident form after flush
+    # (server knob: config.py store.compressed_residency):
+    #   "off"   — raw f32/i64 blocks stay resident
+    #   "gauge" — scalar f32 single-column stores (i16 quantized + ts elision)
+    #   "all"   — gauge AND [S, C, B] histogram stores (i8/i16 2D-delta bucket
+    #             blocks — the reference keeps ALL in-memory data compressed,
+    #             histograms most of all: doc/compression.md "Histograms")
+    compressed_residency: str = "off"
+
+    def __post_init__(self):
+        if self.compressed_residency not in ("off", "gauge", "all"):
+            raise ValueError(
+                f"compressed_residency must be off|gauge|all, "
+                f"got {self.compressed_residency!r}")
+
+    def residency_mode(self) -> str:
+        """Effective residency mode ("off" | "gauge" | "all"), folding the
+        legacy narrow_resident flag in."""
+        if self.compressed_residency != "off":
+            return self.compressed_residency
+        return "gauge" if self.narrow_resident else "off"
 
 
 @dataclass
@@ -553,19 +575,20 @@ class TimeSeriesShard:
         with self.lock:
             staged = bool(self._staged)
             written = self._flush_staged_locked() if staged else 0
+        residency = self.config.residency_mode()
         if not staged:
             # nothing new — but a purge/compact since the last flush may have
             # rehydrated a compressed-resident store; re-adopt, else the
             # quiesced shard silently sits at raw 12B/sample residency
-            if self.config.narrow_resident:
-                self._compress_resident_two_phase()
+            if residency != "off":
+                self._compress_resident_two_phase(residency)
             return 0
         self.store.throttle()
-        if self.config.narrow_mirror and not self.config.narrow_resident:
+        if self.config.narrow_mirror and residency == "off":
             # flush-time rebuild, outside the lock: the build streams the
             # whole store and fetches the ok flags — queries only CONSULT.
-            # (Pointless alongside narrow_resident — the i16 state IS the
-            # store there, and refresh would read the freed f32 block.)
+            # (Pointless alongside compressed residency — the i16 state IS
+            # the store there, and refresh would read the freed f32 block.)
             self.store.narrow.refresh(self.store)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
@@ -576,34 +599,37 @@ class TimeSeriesShard:
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
             with self.lock:
                 self.store.compact(cutoff)
-        if self.config.narrow_resident:
+        if residency != "off":
             # adopt/refresh the compressed-resident state AFTER any compact
             # (compact rehydrates — compressing first would be discarded
             # work). Two-phase: the streaming build + host fetches run
             # OUTSIDE the shard lock; only the swap takes it.
-            self._compress_resident_two_phase()
+            self._compress_resident_two_phase(residency)
         return written
 
-    def _compress_resident_two_phase(self) -> None:
+    def _compress_resident_two_phase(self, mode: str = "gauge") -> None:
         """Build the compressed-resident state without the shard lock, then
         swap under it iff nothing mutated meanwhile (a racing append donates
         the very buffers the build streams — detected and retried next
-        flush; ref: the NarrowMirror outside-the-lock rule)."""
+        flush; ref: the NarrowMirror outside-the-lock rule). ``mode`` gates
+        which store shapes compress (histograms only under "all")."""
         st = self.store
         if st is None:
+            return
+        if st.nbuckets and mode != "all":
             return
         epoch0 = st.mutation_epoch()
         # idempotence: fully compressed already, or nothing mutated since the
         # last (possibly declined) attempt — a declined 25%-gate store must
         # not re-run the full-store build on every empty flush tick
-        if st._narrow is not None and (st._ts_elided
-                                       or st.grid_info() is None):
+        if st._val_compressed and (st._ts_elided
+                                   or st.grid_info() is None):
             return
         if getattr(self, "_last_compress_epoch", None) == epoch0:
             return
         self._last_compress_epoch = epoch0
         try:
-            prep = st.compress_prepare()
+            prep = st.compress_prepare(hist=mode == "all")
         except RuntimeError:
             return                 # racing donation invalidated the build
         if prep is None:
@@ -918,9 +944,9 @@ class TimeSeriesShard:
         rows_ts, rows_val = [], []
         # one decode for the whole batch when the store is compressed-
         # resident: per-pid series_snapshot would re-decode per series
-        from .chunkstore import DeferredDecode
+        from .chunkstore import _Deferred
         vsrc = self.store.column_array(column)
-        if isinstance(vsrc, DeferredDecode):
+        if isinstance(vsrc, _Deferred):
             vsrc = vsrc.materialize()
         tsrc = self.store.ts_block()
         for p in pids:
@@ -978,6 +1004,11 @@ class TimeSeriesShard:
     def label_values(self, label: str, filters=None, top_k=None) -> list[str]:
         with self.lock:
             return self.index.label_values(label, filters, top_k=top_k)
+
+    def label_value_counts(self, label: str, filters=None,
+                           top_k=None) -> list[tuple[str, int]]:
+        with self.lock:
+            return self.index.label_value_counts(label, filters, top_k=top_k)
 
     def label_names(self, filters=None) -> list[str]:
         with self.lock:

@@ -734,16 +734,13 @@ class PartKeyIndex:
         self._regex_union_cache.clear()
         return True
 
-    def label_values(self, label: str, filters: list[Filter] | None = None,
-                     start_time: int = 0, end_time: int = 1 << 62,
-                     top_k: int | None = None) -> list[str]:
-        """Distinct values of ``label``; top-k by series count when requested
-        (ref: PartKeyLuceneIndex indexValues top-k terms)."""
+    def _label_value_counter(self, label: str, filters, start_time,
+                             end_time) -> Counter:
         if self._pending_cols:
             self._drain(label)
         vals = self._inv.get(label)
         if not vals:
-            return []
+            return Counter()
         if filters:
             matching = self.part_ids_from_filters(filters, start_time, end_time)
             counts = Counter()
@@ -753,9 +750,29 @@ class PartKeyIndex:
                     counts[v] = c
         else:
             counts = Counter({v: len(p) for v, p in vals.items()})
+        return counts
+
+    def label_values(self, label: str, filters: list[Filter] | None = None,
+                     start_time: int = 0, end_time: int = 1 << 62,
+                     top_k: int | None = None) -> list[str]:
+        """Distinct values of ``label``; top-k by series count when requested
+        (ref: PartKeyLuceneIndex indexValues top-k terms)."""
+        counts = self._label_value_counter(label, filters, start_time, end_time)
         if top_k is not None:
             return [v for v, _ in counts.most_common(top_k)]
         return sorted(counts)
+
+    def label_value_counts(self, label: str,
+                           filters: list[Filter] | None = None,
+                           start_time: int = 0, end_time: int = 1 << 62,
+                           top_k: int | None = None) -> list[tuple[str, int]]:
+        """(value, series_count) pairs — the cross-node top-k merge needs the
+        counts, not just each node's ranked list (a value barely in one
+        node's local top-k can dominate cluster-wide)."""
+        counts = self._label_value_counter(label, filters, start_time, end_time)
+        if top_k is not None:
+            return counts.most_common(top_k)
+        return sorted(counts.items())
 
     def label_names(self, filters: list[Filter] | None = None,
                     start_time: int = 0, end_time: int = 1 << 62) -> list[str]:

@@ -192,8 +192,11 @@ class FiloHttpServer:
         # them before the urlencoded body parsing below consumes rfile
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/(read|write)", path)
         if m and h.command == "POST":
+            # strict marker: ONLY local=1 means "peer fan-out leg". A client
+            # sending local=0 (or garbage) must get the full cluster answer,
+            # not a silently partial local-only one
             self._remote_storage(h, m.group(1), m.group(2),
-                                 local=bool(q.get("local")))
+                                 local=q.get("local") == "1")
             return
 
         # cross-node plan dispatch: a peer ships an ExecPlan subtree for a
@@ -250,9 +253,10 @@ class FiloHttpServer:
             h._send(200, {"status": "success", "data": matrix_to_prom_json(res)})
             return
 
-        # local=1 marks a peer's metadata fan-out request: answer from local
-        # shards only (stops mutual-recursion between nodes)
-        local_only = bool(q.get("local"))
+        # local=1 (strictly) marks a peer's metadata fan-out request: answer
+        # from local shards only (stops mutual-recursion between nodes);
+        # local=0 or malformed values mean a normal client request
+        local_only = q.get("local") == "1"
         # optional match[] selectors restrict labels/values to matching
         # series; REPEATED selectors union (Prometheus API semantics)
         mfilter_sets = [_selector_to_filters(sel)
@@ -275,8 +279,29 @@ class FiloHttpServer:
         if m:
             engine = self.engines[m.group(1)]
             name = m.group(2)
+            top_k = int(q["top_k"]) if q.get("top_k") else None
+            # counts=1: peer-leg form — return [value, series_count] pairs so
+            # the caller can re-rank ACROSS nodes (a value barely in one
+            # node's local top-k may dominate cluster-wide)
+            counted = q.get("counts") == "1"
 
             def fetch_values():
+                if top_k is not None or counted:
+                    from collections import Counter
+                    c: Counter = Counter()
+                    for filt in (mfilter_sets or [None]):
+                        # element-wise MAX across repeated match[] selectors:
+                        # overlapping selectors match the same series, so
+                        # summing would count them once per selector and
+                        # skew the ranking (never overcounts; exact for the
+                        # single-selector peer-leg form)
+                        for v, n in engine.label_value_counts(
+                                name, filt, top_k=top_k,
+                                local_only=local_only).items():
+                            c[v] = max(c[v], n)
+                    ranked = c.most_common(top_k)
+                    return ([[v, n] for v, n in ranked] if counted
+                            else [v for v, _ in ranked])
                 out: set = set()
                 for filt in (mfilter_sets or [None]):
                     out.update(engine.label_values(name, filt,

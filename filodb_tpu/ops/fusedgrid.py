@@ -371,7 +371,8 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     # the framework runs with x64 on (int64 timestamps); Mosaic rejects the
     # i64 scalars x64 tracing injects (grid index maps, roll shifts), and the
     # kernel itself is pure f32/i32 — so trace the call with x64 off
-    with jax.enable_x64(False):
+    from ..utils import enable_x64
+    with enable_x64(False):
         if narrow is not None:
             q, vmin, scale = narrow
             outs = call(q, vmin, scale, jnp.asarray(n), jnp.asarray(gids),

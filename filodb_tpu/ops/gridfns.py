@@ -256,6 +256,218 @@ def _grid_hist_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
     raise ValueError(fn)  # pragma: no cover
 
 
+# ---- narrow (2D-delta resident) histograms ----------------------------------
+#
+# The hist-resident store keeps dd[s,c,b] = (bucket-delta of frame c) minus
+# (bucket-delta of frame c-1) as i8/i16 plus first_d[s,b] f32 (ops/narrow.py
+# build_narrow_hist). Every time-axis reduction the grid kernels need is
+# LINEAR in the frames, so it commutes with the bucket cumsum:
+#
+#   inc[s,c,:]   = v[s,c,:] - v[s,c-1,:]        = cumsum_b dd[s,c,:]
+#   window delta = einsum(inc, band)            = cumsum_b einsum(dd, band)
+#   v_ext[s,c,:] = F[s,:] + sum_{c'<=c} inc     (F = cumsum_b first_d,
+#                                                constant past the last frame)
+#
+# so the kernels below matmul the NARROW dd block and run one [S, T, B]
+# bucket cumsum on the output — the whole-store f32 temp never exists, and
+# results are bit-identical to the raw kernel on rows the encoder verified
+# (integer components stay exact in f32 through both summation orders).
+
+def grid_operands_hist_narrow(C: int, out_ts: np.ndarray, window_ms: int,
+                              base_ts: int, interval_ms: int):
+    """Static operands for the narrow hist kernel, cached per query shape
+    (same rationale as :func:`grid_operands`): the open band for window
+    deltas, prefix bands selecting v_ext at the lo/hi cells, the weighted
+    band W[c, t] = #{window-t cells >= c} for sum_over_time, and the static
+    (unmasked) per-step cell count."""
+    key = np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes()
+    if 4 * C * len(out_ts) * 4 > 16 << 20:
+        return _hist_narrow_operands_build(C, key, int(window_ms),
+                                           int(base_ts), int(interval_ms))
+    return _hist_narrow_operands_cached(C, key, int(window_ms), int(base_ts),
+                                        int(interval_ms))
+
+
+@functools.lru_cache(maxsize=32)
+def _hist_narrow_operands_cached(C, out_ts_key, window_ms, base_ts, interval_ms):
+    return _hist_narrow_operands_build(C, out_ts_key, window_ms, base_ts,
+                                       interval_ms)
+
+
+def _hist_narrow_operands_build(C, out_ts_key, window_ms, base_ts, interval_ms):
+    out_ts = np.frombuffer(out_ts_key, np.int64)
+    lo, hi = grid_edges(out_ts, window_ms, base_ts, interval_ms)
+    rel = out_ts - base_ts
+    assert abs(rel).max() < 2**31 and window_ms < 2**31, "grid range exceeds i32"
+    T = len(out_ts)
+    zeros = np.zeros(T, np.int64)
+    l0 = np.maximum(lo, 0)
+    h0 = np.minimum(hi, C - 1)
+    # W[c, t] = #{cells in [l0_t, h0_t] >= c}; rows past h0 (and empty
+    # windows) are 0. Cell 0's weight multiplies a zero dd frame — harmless.
+    c = np.arange(C)[:, None]
+    wband = np.maximum(h0[None, :] - np.maximum(c, l0[None, :]) + 1, 0) \
+        .astype(np.float32)
+    wband[:, h0 < l0] = 0.0
+    return dict(
+        band_open=jnp.asarray(band_matrix(C, lo, hi, True, np.float32)),
+        prefix_lo=jnp.asarray(band_matrix(C, zeros,
+                                          np.minimum(l0, C - 1), True,
+                                          np.float32)),
+        prefix_hi=jnp.asarray(band_matrix(C, zeros, np.clip(hi, 0, C - 1),
+                                          True, np.float32)),
+        wband=jnp.asarray(wband),
+        cnt_static=jnp.asarray(np.maximum(h0 - l0 + 1, 0).astype(np.int32)),
+        lo=jnp.asarray(lo.astype(np.int32)), hi=jnp.asarray(hi.astype(np.int32)),
+        rel_out=jnp.asarray(rel.astype(np.int32)),
+        window_ms=jnp.int32(window_ms), interval_ms=jnp.int32(interval_ms),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("fn",))
+def _grid_hist_kernel_narrow(fn, dd, first_d, n, band_open, prefix_lo,
+                             prefix_hi, wband, cnt_static, lo, hi, rel_out,
+                             window_ms, interval_ms, stale_ms):
+    """Narrow variant of :func:`_grid_hist_kernel`: streams the i8/i16 dd
+    block through the static matmuls and finishes with one bucket cumsum on
+    the [S, T, B] output — numerics match the raw kernel bit-for-bit on rows
+    the encoder verified (same masks, same extrapolation algebra)."""
+    f32 = jnp.float32
+    ddf = dd.astype(f32)
+    F = jnp.cumsum(first_d, axis=1)                               # [S, B]
+    last_cell = n[:, None] - 1
+    f_idx = jnp.maximum(lo, 0)[None, :]
+    l_idx = jnp.minimum(hi[None, :], last_cell)
+    cnt = jnp.maximum(l_idx - f_idx + 1, 0)                       # [S, T]
+    cnt_f = cnt.astype(f32)
+
+    if fn == "sum_over_time":
+        ext = jnp.cumsum(jnp.einsum("scb,ct->stb", ddf, wband), axis=2) \
+            + cnt_static[None, :, None].astype(f32) * F[:, None, :]
+        # v_ext extends the last frame past each row's valid count: subtract
+        # the overhang cells' worth of it to match the raw masked sum
+        v_last = F + jnp.cumsum(jnp.sum(ddf, axis=1), axis=1)     # [S, B]
+        over = (cnt_static[None, :] - cnt).astype(f32)
+        s = ext - over[:, :, None] * v_last[:, None, :]
+        return jnp.where((cnt >= 1)[:, :, None], s, jnp.nan)
+
+    if fn in ("last_sample", "last_over_time"):
+        l_v = F[:, None, :] + jnp.cumsum(
+            jnp.einsum("scb,ct->stb", ddf, prefix_hi), axis=2)
+        # v_ext at cell clip(hi): v[hi] when hi is valid, the row's last
+        # frame beyond it — exactly the raw kernel's static/row_last select
+        ok = cnt >= 1
+        if fn == "last_sample":
+            l_rel = l_idx * interval_ms
+            ok = ok & ((rel_out[None, :] - l_rel) <= stale_ms)
+        return jnp.where(ok[:, :, None], l_v, jnp.nan)
+
+    if fn in ("rate", "increase", "delta"):
+        is_counter = fn != "delta"
+        delta = jnp.cumsum(jnp.einsum("scb,ct->stb", ddf, band_open), axis=2)
+        f_v = F[:, None, :] + jnp.cumsum(
+            jnp.einsum("scb,ct->stb", ddf, prefix_lo), axis=2)
+        f_rel = f_idx * interval_ms
+        l_rel = l_idx * interval_ms
+        win_end = rel_out[None, :]
+        dur_start = (f_rel - (win_end - window_ms)).astype(f32) / 1000.0
+        dur_end = (win_end - l_rel).astype(f32) / 1000.0
+        sampled = (l_rel - f_rel).astype(f32) / 1000.0
+        avg_dur = sampled / (cnt_f - 1.0)
+        thresh = avg_dur * 1.1
+        extrap = sampled
+        extrap = extrap + jnp.where(dur_start < thresh, dur_start, avg_dur / 2)
+        extrap = extrap + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
+        factor = (extrap / sampled)[:, :, None]
+        if is_counter:
+            dur_zero = jnp.where(delta > 0,
+                                 sampled[:, :, None] * (f_v / delta), jnp.inf)
+            ds = jnp.broadcast_to(dur_start[:, :, None], delta.shape)
+            ds = jnp.where((delta > 0) & (f_v >= 0) & (dur_zero < ds),
+                           dur_zero, ds)
+            extrap_b = sampled[:, :, None] + \
+                jnp.where(ds < thresh[:, :, None], ds, avg_dur[:, :, None] / 2) + \
+                jnp.where(dur_end[:, :, None] < thresh[:, :, None],
+                          dur_end[:, :, None], avg_dur[:, :, None] / 2)
+            factor = extrap_b / sampled[:, :, None]
+        scaled = delta * factor
+        if fn == "rate":
+            scaled = scaled * (1000.0 / window_ms.astype(f32))
+        return jnp.where((cnt >= 2)[:, :, None], scaled, jnp.nan)
+
+    raise ValueError(fn)  # pragma: no cover
+
+
+def periodic_samples_grid_hist_narrow(dd, first_d, n, out_ts: np.ndarray,
+                                      window_ms: int, fn: str, base_ts: int,
+                                      interval_ms: int,
+                                      stale_ms: int = 300_000):
+    """Narrow hist grid path: [S, T, B] output streamed off the dd block."""
+    C = dd.shape[1]
+    ops = grid_operands_hist_narrow(C, out_ts, window_ms, base_ts, interval_ms)
+    return _grid_hist_kernel_narrow(
+        fn, dd, first_d, jnp.asarray(n, jnp.int32), ops["band_open"],
+        ops["prefix_lo"], ops["prefix_hi"], ops["wband"], ops["cnt_static"],
+        ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
+        ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fn", "num_groups", "has_corr"))
+def _fused_hist_quantile_narrow_kernel(q, les, dd, first_d, n, gids, fn,
+                                       num_groups, has_corr, corr_sum,
+                                       corr_cnt, band_open, prefix_lo,
+                                       prefix_hi, wband, cnt_static, lo, hi,
+                                       rel_out, window_ms, interval_ms,
+                                       stale_ms):
+    """Narrow twin of :func:`_fused_hist_quantile_kernel`: per-bucket range
+    function off the dd block + bucket-wise group sum + quantile, one device
+    program. ``corr_sum``/``corr_cnt`` carry the cohort-pool rows' partial
+    state (computed row-wise by the caller; those rows' gids are excluded
+    here) — zero-shaped placeholders when ``has_corr`` is False."""
+    from . import aggregators
+    hist = _grid_hist_kernel_narrow(fn, dd, first_d, n, band_open, prefix_lo,
+                                    prefix_hi, wband, cnt_static, lo, hi,
+                                    rel_out, window_ms, interval_ms, stale_ms)
+    S, T, B = hist.shape
+    parts = aggregators.partial_aggregate("sum", hist.reshape(S, T * B),
+                                          gids, num_groups)
+    psum, pcnt = parts["sum"], parts["count"]
+    if has_corr:
+        psum = psum + corr_sum
+        pcnt = pcnt + corr_cnt
+    summed = jnp.where(pcnt == 0, jnp.nan, psum)
+    return histogram_quantile(q, les, summed.reshape(num_groups, T, B))
+
+
+def fused_hist_quantile_grid_narrow(q: float, les, dd, first_d, n, gids,
+                                    num_groups: int, out_ts: np.ndarray,
+                                    window_ms: int, fn: str, base_ts: int,
+                                    interval_ms: int, stale_ms: int = 300_000,
+                                    corr=None):
+    """Entry for the fused narrow path (hist-resident stores): builds/caches
+    the narrow operands and runs the one-program kernel; returns [G, T]."""
+    C = dd.shape[1]
+    ops = grid_operands_hist_narrow(C, out_ts, window_ms, base_ts, interval_ms)
+    T = len(out_ts)
+    B = dd.shape[2]
+    if corr is None:
+        z = jnp.zeros((num_groups, T * B), jnp.float32)
+        corr_sum = corr_cnt = z
+        has_corr = False
+    else:
+        corr_sum, corr_cnt = corr
+        has_corr = True
+    return _fused_hist_quantile_narrow_kernel(
+        jnp.float64(q), jnp.asarray(les), dd, first_d,
+        jnp.asarray(n, jnp.int32), jnp.asarray(gids, jnp.int32), fn,
+        num_groups, has_corr, corr_sum, corr_cnt,
+        ops["band_open"], ops["prefix_lo"], ops["prefix_hi"], ops["wband"],
+        ops["cnt_static"], ops["lo"], ops["hi"], ops["rel_out"],
+        ops["window_ms"], ops["interval_ms"],
+        jnp.int32(min(stale_ms, 2**31 - 1)))
+
+
 @functools.partial(jax.jit, static_argnames=("fn", "num_groups"))
 def _fused_hist_quantile_kernel(q, les, val, n, gids, fn, num_groups,
                                 band, band_open, onehot_lo, onehot_hi, lo, hi,

@@ -64,6 +64,67 @@ def build_narrow(val, n):
     return (q - 32768.0).astype(jnp.int16), vmin, scale, ok
 
 
+# ---- histogram stores -------------------------------------------------------
+#
+# Device analog of the wire codec's 2D-delta (memory/hist.py, ref
+# doc/compression.md "Histograms"): buckets are cumulative, so the bucket-axis
+# delta d[s,c,:] is small and non-negative, and the time-axis delta of THOSE
+# (dd) is near zero for quiet series. The resident form keeps dd as i8/i16
+# [S, C, B] plus each row's first-frame bucket deltas f32 [S, B]; the f32
+# block reconstructs as v = cumsum_b(first_d + cumsum_c dd). Every reduction
+# over the time axis the grid kernels need commutes with the bucket cumsum,
+# so queries can matmul the narrow dd block directly (ops/gridfns.py
+# *_narrow) — the whole-store f32 temp never exists.
+#
+# Losslessness contract (same as the scalar form): a row is ``ok`` only when
+# every valid cell round-trips bit-exactly in f32 — integer-valued bucket
+# counts below 2^24 qualify; rows that don't keep raw f32 in the cohort pool.
+
+@jax.jit
+def build_narrow_hist(val, n):
+    """One streaming pass over a [S, C, B] cumulative-bucket block:
+    (dd i16[S, C, B], first_d f32[S, B], ok16 bool[S], ok8 bool[S]).
+
+    ``okN`` marks rows that BOTH round-trip bit-exactly, stay MONOTONE over
+    time, and whose dd fits the N-bit signed range; the caller picks the
+    narrowest dtype whose pool stays under the cohort gate. Monotonicity is
+    part of the contract because the raw rate/increase kernels clamp negative
+    per-step increments (counter-reset correction) — a nonlinear step the
+    narrow kernels' telescoped matmuls cannot reproduce, so a row with a
+    reset must take the cohort pool and the raw path. dd is zero at cell 0
+    (the first frame lives in ``first_d``) and beyond each row's valid
+    count, so decodes extend the last frame constantly — consumers mask by
+    ``n`` exactly like the raw store's kernels do."""
+    col = jax.lax.broadcasted_iota(jnp.int32, val.shape[:2], 1)
+    valid = col < n[:, None]
+    v = jnp.where(valid[:, :, None], val.astype(jnp.float32), 0.0)
+    d = jnp.diff(v, axis=2, prepend=0.0)           # bucket deltas [S, C, B]
+    first_d = d[:, 0, :]
+    dd = jnp.diff(d, axis=1, prepend=0.0)          # 2D delta along time
+    pair = (valid & (col > 0))[:, :, None]
+    dd = jnp.where(pair, dd, 0.0)
+    # bit-exact round trip: integer components stay exact through both
+    # cumsums as long as every partial sum is f32-representable
+    v_rec = jnp.cumsum(first_d[:, None, :] + jnp.cumsum(dd, axis=1), axis=2)
+    exact = jnp.where(valid[:, :, None], v_rec == v, True)
+    # counter-reset detection: any negative per-step bucket increment
+    # (inc = cumsum_b dd) disqualifies the row — see contract above
+    inc = jnp.cumsum(dd, axis=2)
+    mono = jnp.where(pair, inc >= 0.0, True)
+    ok_rt = (jnp.all(jnp.all(exact, axis=2), axis=1)
+             & jnp.all(jnp.all(mono, axis=2), axis=1))
+    fit16 = jnp.all(jnp.all((dd >= -32768.0) & (dd <= 32767.0), axis=2), axis=1)
+    fit8 = jnp.all(jnp.all((dd >= -128.0) & (dd <= 127.0), axis=2), axis=1)
+    return dd.astype(jnp.int16), first_d, ok_rt & fit16, ok_rt & fit8
+
+
+@jax.jit
+def cast_narrow_hist_i8(dd16):
+    """i16 -> i8 narrowing for stores whose ok rows all fit 8 bits (pool rows
+    may wrap — their dd is never read; decodes overlay the pool row-wise)."""
+    return dd16.astype(jnp.int8)
+
+
 class NarrowMirror:
     """Narrow mirror of a SeriesStore's value column, refreshed at FLUSH
     time (outside the shard lock — the build streams the whole store and

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import aggregators, fusedgrid, rangefns
+from ..utils import shard_map as _shard_map
 
 
 def make_mesh(devices=None, axis: str = "shard") -> Mesh:
@@ -68,13 +69,56 @@ class DistributedStore:
         return [self.shards[j * self.ndev + d] for d in range(self.ndev)]
 
     def arrays(self):
-        """Per-slot tuples of (ts, val, n) global arrays."""
+        """Per-slot tuples of (ts, val, n) global arrays. Narrow-resident
+        shards contribute TRANSIENT decodes (ts_block/value_block run on the
+        shard's own device, so placement is unchanged) — the general
+        collectives read the same f32/i64 view either way; the fused route
+        streams the compressed state instead via :meth:`narrow_arrays`."""
         out = []
         for j in range(self.slots):
             ss = self._slot(j)
             out.append((
-                self._global([s.store.ts for s in ss], (self.S, self.C), jnp.int64),
-                self._global([s.store.val for s in ss], (self.S, self.C), None),
+                self._global([s.store.ts_block() for s in ss],
+                             (self.S, self.C), jnp.int64),
+                self._global([s.store.value_block() for s in ss],
+                             (self.S, self.C), None),
+                self._global([s.store.n for s in ss], (self.S,), jnp.int32)))
+        return out
+
+    def value_arrays(self):
+        """Per-slot (val, n) global arrays — the fused route never reads ts,
+        so narrow-resident shards skip the i64 grid derivation entirely."""
+        out = []
+        for j in range(self.slots):
+            ss = self._slot(j)
+            out.append((
+                self._global([s.store.value_block() for s in ss],
+                             (self.S, self.C), None),
+                self._global([s.store.n for s in ss], (self.S,), jnp.int32)))
+        return out
+
+    def narrow_arrays(self):
+        """Per-slot (q, vmin, scale, n) global arrays of the narrow-resident
+        state, or None unless EVERY shard is narrow-resident with no live
+        cohort-pool rows (a pool row would need a per-shard row-wise fix —
+        those stores take the transient-decode fused route instead)."""
+        per_shard = []
+        for sh in self.shards:
+            nd = sh.store.narrow_operands()
+            if nd is None:
+                return None
+            q, vmin, scale, ok = nd
+            if (~ok & (sh.store.n_host > 0)).any():
+                return None
+            per_shard.append((q, vmin, scale))
+        out = []
+        for j in range(self.slots):
+            ss = self._slot(j)
+            ops = per_shard[j * self.ndev:(j + 1) * self.ndev]
+            out.append((
+                self._global([q for q, _v, _s in ops], (self.S, self.C), None),
+                self._global([v for _q, v, _s in ops], (self.S,), None),
+                self._global([s for _q, _v, s in ops], (self.S,), None),
                 self._global([s.store.n for s in ss], (self.S,), jnp.int32)))
         return out
 
@@ -87,7 +131,8 @@ class DistributedStore:
             for d in range(self.ndev):
                 sh = self.shards[j * self.ndev + d]
                 g = group_ids_per_shard[j * self.ndev + d]
-                dev = list(sh.store.ts.devices())[0]
+                # n is resident under every residency state (ts may be elided)
+                dev = list(sh.store.n.devices())[0]
                 arrs.append(jax.device_put(jnp.asarray(g, jnp.int32), dev))
             out.append(self._global(arrs, (self.S,), jnp.int32))
         return out
@@ -122,7 +167,7 @@ def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
                  for k, v in parts.items()}
         return aggregators.present_partials(op, parts)[None]
 
-    return jax.shard_map(
+    return _shard_map(
         per_device, mesh=mesh,
         in_specs=(P("shard"), P("shard")),
         out_specs=P("shard"),
@@ -169,7 +214,7 @@ def dist_quantile_sketch(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
         counts = jax.lax.psum(counts, "shard")
         return counts.reshape(1, num_groups, W, T)
 
-    return jax.shard_map(
+    return _shard_map(
         per_device, mesh=mesh,
         in_specs=(P("shard"), P("shard")),
         out_specs=P("shard"),
@@ -238,7 +283,7 @@ def dist_topk(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
                 jnp.take_along_axis(gsh, sel, axis=2)[None],
                 jnp.take_along_axis(gok, sel, axis=2)[None])
 
-    return jax.shard_map(
+    return _shard_map(
         per_device, mesh=mesh,
         in_specs=(P("shard"), P("shard")),
         out_specs=(P("shard"), P("shard"), P("shard"), P("shard")),
@@ -280,7 +325,7 @@ def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
                   for k, v in zip(("sum", "count", "sumsq"), outs)})
         return aggregators.present_partials(op, parts)[None]
 
-    return jax.shard_map(
+    return _shard_map(
         per_device, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P(), P(), P(), P(), P()),
         out_specs=P("shard"),
@@ -289,6 +334,53 @@ def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
         # nothing here
         check_vma=False,
     )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
+                                             "window_ms", "interval_ms",
+                                             "S", "C", "Tp", "c0", "Ck"))
+def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
+                                slot_gids, band, ohlo, lo, hi, rel,
+                                fn: str, op: str, num_groups: int, mesh: Mesh,
+                                window_ms: int, interval_ms: int,
+                                S: int, C: int, Tp: int, c0: int = 0,
+                                Ck: int = 0):
+    """Narrow twin of :func:`dist_fused_aggregate`: every shard's resident
+    i16 quantized state streams straight through the fused Pallas kernel
+    (half the HBM bytes, decode in VMEM — ops/narrow.py) and the partial
+    state psums over the shard axis. Compressed-resident stores stay
+    mesh-eligible without ever materializing their f32 blocks."""
+    needs_sumsq = op in ("stddev", "stdvar")
+    Sb = 512 if S % 512 == 0 else S
+    call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                                  S, Sb, C, Tp, num_groups,
+                                  jax.default_backend() != "tpu",
+                                  narrow=True, c0=c0, Ck=Ck)
+
+    def per_device(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
+                   band, ohlo, lo, hi, rel):
+        outs = None
+        for q, vmin, scale, n, gids in zip(slot_qs, slot_vmins, slot_scales,
+                                           slot_ns, slot_gids):
+            o = call(q[0], vmin[0].reshape(S, 1), scale[0].reshape(S, 1),
+                     n[0].astype(jnp.int32).reshape(S, 1),
+                     gids[0].astype(jnp.int32).reshape(S, 1),
+                     band, ohlo, lo, hi, rel)
+            outs = o if outs is None else tuple(a + b for a, b in zip(outs, o))
+        parts = ({"count": jax.lax.psum(outs[1], "shard")}
+                 if op in ("count", "group") else
+                 {k: jax.lax.psum(v, "shard")
+                  for k, v in zip(("sum", "count", "sumsq"), outs)})
+        return aggregators.present_partials(op, parts)[None]
+
+    return _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard"),
+                  P(), P(), P(), P(), P()),
+        out_specs=P("shard"),
+        check_vma=False,
+    )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
+      band, ohlo, lo, hi, rel)
 
 
 class LazyMeshResult:
@@ -343,7 +435,6 @@ class MeshQueryExecutor:
     def aggregate(self, fn: str, op: str, out_ts: np.ndarray, window_ms: int,
                   group_ids_per_shard: list[np.ndarray], num_groups: int,
                   args=(0.0, 0.0), fetch: bool = True):
-        slot_tvn = tuple(self.dstore.arrays())
         slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
         G = _pow2(num_groups)
         S, C, T = self.dstore.S, self.dstore.C, len(out_ts)
@@ -358,15 +449,34 @@ class MeshQueryExecutor:
             band, ohlo, lo, hi, rel, c0, Ck = fusedgrid._device_operands(
                 C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
                 int(window_ms), base_ts, int(interval_ms))
-            with jax.enable_x64(False):
-                out = dist_fused_aggregate(
-                    tuple(t[1] for t in slot_tvn), tuple(t[2] for t in slot_tvn),
-                    slot_gids, band, ohlo, lo, hi, rel,
-                    fn, op, G, self.dstore.mesh, int(window_ms),
-                    int(interval_ms), S, C, Tp, c0, Ck)
-            self.last_path = "fused"
+            # narrow-resident shards stream their i16 state through the
+            # fused kernel; stores with cohort-pool rows (or raw residency)
+            # feed it the f32 view instead (a transient decode per shard
+            # when compressed — bit-identical by the round-trip contract)
+            narrow = self.dstore.narrow_arrays()
+            from ..utils import enable_x64
+            with enable_x64(False):
+                if narrow is not None:
+                    out = dist_fused_aggregate_narrow(
+                        tuple(t[0] for t in narrow),
+                        tuple(t[1] for t in narrow),
+                        tuple(t[2] for t in narrow),
+                        tuple(t[3] for t in narrow),
+                        slot_gids, band, ohlo, lo, hi, rel,
+                        fn, op, G, self.dstore.mesh, int(window_ms),
+                        int(interval_ms), S, C, Tp, c0, Ck)
+                else:
+                    slot_vn = tuple(self.dstore.value_arrays())
+                    out = dist_fused_aggregate(
+                        tuple(t[0] for t in slot_vn),
+                        tuple(t[1] for t in slot_vn),
+                        slot_gids, band, ohlo, lo, hi, rel,
+                        fn, op, G, self.dstore.mesh, int(window_ms),
+                        int(interval_ms), S, C, Tp, c0, Ck)
+            self.last_path = "fused-narrow" if narrow is not None else "fused"
             res = LazyMeshResult(out, num_groups, T)
             return res.resolve() if fetch else res
+        slot_tvn = tuple(self.dstore.arrays())
         # bucket the step count (pad to a multiple of 32, repeating the last
         # step): dist_aggregate jit-compiles per output shape and ad-hoc
         # dashboards would otherwise recompile per query — the same compile-

@@ -274,10 +274,41 @@ class QueryEngine:
                 return QueryResult(ResultMatrix(
                     out_ts, np.zeros((0, len(out_ts))), []))
             base_ts, interval_ms = data.grid
-            out = gridfns.fused_hist_quantile_grid(
-                q, np.asarray(data.bucket_les, np.float64), data.val, data.n,
-                gids, _pow2(G), out_eval, window, fn,
-                base_ts, interval_ms, stale_ms=ctx.stale_ms)
+            if data.hist_narrow is not None:
+                # hist-resident store: one fused program off the i8/i16
+                # 2D-delta block — the [S, C, B] f32 temp never exists.
+                # Cohort-pool rows are excluded from the stream and folded
+                # back in as group partials from a row-wise decode.
+                import jax.numpy as jnp
+                from ..ops import rangefns
+                from .exec import _gather_rows_padded, _segment_partial
+                dd, first_d, bad = data.hist_narrow
+                Gp = _pow2(G)
+                corr = None
+                if len(bad):
+                    bad_gids = gids[bad].copy()
+                    gids = gids.copy()
+                    gids[bad] = _EXCLUDED_GID
+                    sub_ts, sub_val, sub_n, P = _gather_rows_padded(
+                        data.ts, data.val, data.n, bad)
+                    hc = rangefns.periodic_samples_hist(
+                        sub_ts, sub_val, sub_n, out_eval, window, fn, 0.0)
+                    Tp, B = hc.shape[1], hc.shape[2]
+                    cg = np.full(P, _EXCLUDED_GID, np.int32)
+                    cg[:len(bad)] = bad_gids
+                    parts = _segment_partial(
+                        "sum", hc.reshape(P, Tp * B), jnp.asarray(cg), Gp)
+                    corr = (parts["sum"].astype(jnp.float32),
+                            parts["count"].astype(jnp.float32))
+                out = gridfns.fused_hist_quantile_grid_narrow(
+                    q, np.asarray(data.bucket_les, np.float64), dd, first_d,
+                    data.n, gids, Gp, out_eval, window, fn,
+                    base_ts, interval_ms, stale_ms=ctx.stale_ms, corr=corr)
+            else:
+                out = gridfns.fused_hist_quantile_grid(
+                    q, np.asarray(data.bucket_les, np.float64), data.val,
+                    data.n, gids, _pow2(G), out_eval, window, fn,
+                    base_ts, interval_ms, stale_ms=ctx.stale_ms)
         self.last_exec_path = "fused-hist"
         vals = np.asarray(out)[:G, :T]
         m = ResultMatrix(out_ts, vals, list(uniq))
@@ -292,7 +323,12 @@ class QueryEngine:
         """A MeshQueryExecutor when every shard's store lives on its
         round-robin mesh device (shard i on device i % ndev — standalone's
         placement; shards-per-device >= 1) with one common [S, C] shape,
-        else None (host fallback)."""
+        else None (host fallback). Narrow-resident gauge stores qualify: the
+        fused mesh path streams their i16 state (or a transient per-shard
+        decode feeds the general collectives) — compressed residency and the
+        mesh are no longer mutually exclusive. Call under the shard locks: a
+        flush's compress_commit between this check and dispatch would
+        otherwise swap ``val`` out from under the arrays capture."""
         from ..parallel.distributed import DistributedStore, MeshQueryExecutor
         if self.mesh is None:
             return None
@@ -306,9 +342,13 @@ class QueryEngine:
         for i, sh in enumerate(shards):
             st = sh.store
             if (st is None or getattr(sh, "bucket_les", None) is not None
-                    or getattr(st, "is_narrow_resident", False)
-                    or st.val.ndim != 2 or (st.S, st.C) != (s0.S, s0.C)
-                    or list(st.ts.devices())[0] != devs[i % ndev]):
+                    or st.nbuckets or st.layout is not None
+                    or (st.val is not None and st.val.ndim != 2)
+                    or (st.val is None and st._narrow is None)
+                    or (st.S, st.C) != (s0.S, s0.C)
+                    # n is resident under every residency state; ts/val may
+                    # be elided forms that derive on the same device
+                    or list(st.n.devices())[0] != devs[i % ndev]):
                 return None
         return MeshQueryExecutor(DistributedStore(self.mesh, shards))
 
@@ -344,9 +384,8 @@ class QueryEngine:
         shards = self.memstore.shards_of(self.dataset)
         if len(shards) < 2:
             return None
-        ex = self._mesh_executor(shards)
-        if ex is None:
-            return None
+        if self.mesh is None or len(shards) % self.mesh.devices.size:
+            return None          # cheap pre-checks before taking any locks
         step = max(inner.step_ms, 1)
         out_ts = np.arange(inner.start_ms, inner.end_ms + 1, step,
                            dtype=np.int64)
@@ -357,12 +396,18 @@ class QueryEngine:
         to_ms = raw.range_selector.to_ms
         uniq: dict[RangeVectorKey, int] = {}
         gids_list: list[np.ndarray] = []
-        # all shard locks held across gid construction AND kernel dispatch:
-        # a concurrent ingest flush donates (invalidates) any shard's store
-        # buffers mid-stream otherwise (same rule as the in-process leaf)
+        # all shard locks held across eligibility, gid construction AND
+        # kernel dispatch: a concurrent ingest flush donates (invalidates)
+        # any shard's store buffers mid-stream otherwise (same rule as the
+        # in-process leaf) — and a flush's compress_commit landing between
+        # an unlocked eligibility check and dispatch would swap the raw
+        # blocks for compressed state mid-plan (the 500s VERDICT flagged)
         with contextlib.ExitStack() as stack:
             for sh in shards:
                 stack.enter_context(sh.lock)
+            ex = self._mesh_executor(shards)
+            if ex is None:
+                return None      # residency/shape changed: host path
             for sh in shards:
                 pids = sh.part_ids_from_filters(filters, from_ms, to_ms)
                 if sh.needs_paging(pids, from_ms):
@@ -540,11 +585,46 @@ class QueryEngine:
         from urllib.parse import quote
         return "?match[]=" + quote(_filters_to_selector(filters))
 
+    def label_value_counts(self, label: str, filters=None, top_k=None,
+                           local_only: bool = False):
+        """value -> series count across local shards and (unless local_only)
+        peers — the substrate for cluster-wide top-k ranking. The peer leg
+        forwards ``top_k`` (each node prunes to its local top-k candidates)
+        and asks for counted pairs (``counts=1``), so the merge re-ranks by
+        SUMMED count instead of trusting any one node's ordering."""
+        from collections import Counter
+        counts: Counter = Counter()
+        # local shards contribute FULL counts — pruning per shard here would
+        # reintroduce the dominance bug this method fixes cross-node (a value
+        # ranked k+1 in every shard can be #1 by summed count); only the
+        # remote leg prunes, per NODE, where exact merge is not free
+        for shard in self.memstore.shards_of(self.dataset):
+            for v, c in shard.label_value_counts(label, filters):
+                counts[v] += c
+        if not local_only:
+            sfx = self._match_suffix(filters)
+            sep = "&" if sfx else "?"
+            path = f"/api/v1/label/{label}/values{sfx}{sep}counts=1"
+            if top_k is not None:
+                path += f"&top_k={int(top_k)}"
+            for row in self._peer_metadata(path):
+                if isinstance(row, (list, tuple)) and len(row) == 2:
+                    counts[str(row[0])] += int(row[1])
+                elif isinstance(row, str):   # uncounted peer: presence only
+                    counts[row] += 1
+        return counts
+
     def label_values(self, label: str, filters=None, top_k=None,
                      local_only: bool = False) -> list[str]:
+        if top_k is not None:
+            # the k limit re-applies AFTER the cross-node merge: per-node
+            # top-k lists are candidates, the summed counts decide
+            counts = self.label_value_counts(label, filters, top_k=top_k,
+                                             local_only=local_only)
+            return [v for v, _ in counts.most_common(top_k)]
         vals: dict[str, None] = {}
         for shard in self.memstore.shards_of(self.dataset):
-            for v in shard.label_values(label, filters, top_k=top_k):
+            for v in shard.label_values(label, filters):
                 vals[v] = None
         if not local_only:
             for v in self._peer_metadata(

@@ -74,6 +74,12 @@ class SeriesSelection:
     # HBM bytes. Rows whose mirror is not bit-exact are already folded into
     # grid_minority by the leaf. Wide selections only.
     narrow: tuple | None = None
+    # hist-resident twin: (dd, first_d, bad_rows) of the FULL [S, C, B]
+    # bucket block (ops/narrow.py build_narrow_hist) — the narrow hist grid
+    # kernels stream it so the whole-store f32 temp never materializes;
+    # ``bad_rows`` (store rows in the cohort pool) recompute via row-wise
+    # decode through the general kernels. Wide selections only.
+    hist_narrow: tuple | None = None
 
 
 @dataclass
@@ -184,12 +190,14 @@ class FusedWindowData:
 
 
 def _correct_minority_cohort(data, vals, out_ts, window, fn, a0, a1,
-                             hist: bool = False):
+                             hist: bool = False, rows=None):
     """Patch grid-kernel output for churned rows: series whose start cell
     differs from the majority cohort (the band matrices assume the majority
     start) are recomputed through the general searchsorted kernels — an
-    [M, C] row gather for a small M, scattered back into the [R, T] result."""
-    rows = np.asarray(data.grid_minority, np.int32)
+    [M, C] row gather for a small M, scattered back into the [R, T] result.
+    ``rows`` overrides the row set (e.g. churn minority merged with a
+    compressed store's cohort-pool rows)."""
+    rows = np.asarray(data.grid_minority if rows is None else rows, np.int32)
     M = len(rows)
     sub_ts, sub_val, sub_n, _ = _gather_rows_padded(data.ts, data.val, data.n, rows)
     if hist:
@@ -257,16 +265,31 @@ class PeriodicSamplesMapper(Transformer):
                 raise QueryError(f"function {fn} not supported on histogram series")
             if grid_usable and fn in gridfns.HIST_GRID_FNS:
                 base_ts, interval_ms = data.grid
-                vals = gridfns.periodic_samples_grid_hist(
-                    data.val, data.n, out_eval, window, fn, base_ts, interval_ms,
-                    stale_ms=ctx.stale_ms)
+                if data.hist_narrow is not None:
+                    # hist-resident store: stream the i8/i16 2D-delta block;
+                    # cohort-pool rows join the minority set and recompute
+                    # through the general kernels (row-wise decode)
+                    dd, first_d, bad = data.hist_narrow
+                    if len(bad):
+                        minority = (bad if minority is None
+                                    or not len(minority)
+                                    else np.union1d(np.asarray(minority), bad))
+                    vals = gridfns.periodic_samples_grid_hist_narrow(
+                        dd, first_d, data.n, out_eval, window, fn, base_ts,
+                        interval_ms, stale_ms=ctx.stale_ms)
+                else:
+                    vals = gridfns.periodic_samples_grid_hist(
+                        _dval(data.val), data.n, out_eval, window, fn,
+                        base_ts, interval_ms, stale_ms=ctx.stale_ms)
                 if minority is not None and len(minority):
                     vals = _correct_minority_cohort(data, vals, out_eval, window,
-                                                    fn, a0, a1, hist=True)
+                                                    fn, a0, a1, hist=True,
+                                                    rows=minority)
             else:
                 # off-grid shard: general searchsorted hist path (ref:
                 # HistogramVector read through chunked range functions)
-                vals = rangefns.periodic_samples_hist(data.ts, data.val, data.n,
+                vals = rangefns.periodic_samples_hist(_dval(data.ts),
+                                                      _dval(data.val), data.n,
                                                       out_eval, window, fn, a0)
             if Tpad != T:
                 vals = vals[:, :T]
@@ -1219,8 +1242,17 @@ class SelectRawPartitionsExec(ExecPlan):
         total = len(shard.index)
         grid = store.grid_info()
         if len(pids) == 0:
-            return SeriesSelection(ts[:8], val[:8], jnp.zeros(8, jnp.int32), [], None,
-                                   grid, les)
+            # synthetic pad selection (the store-None branch's shape):
+            # slicing a compressed-resident store's deferred view here would
+            # decode the FULL block — a typo'd metric name must not cost a
+            # multi-GB transient. Pad rows have n=0, so every kernel yields
+            # the same empty result the real slice would.
+            vshape = ((8, 8, store.nbuckets)
+                      if getattr(val, "ndim", 2) == 3 else (8, 8))
+            return SeriesSelection(
+                jnp.full((8, 8), 1 << 62, jnp.int64),
+                jnp.zeros(vshape, store.dtype), jnp.zeros(8, jnp.int32),
+                [], None, None, les)
         # mixed start cohorts (churn): shift the grid base to the majority
         # cohort's start cell; the few minority rows are recorded so PSM can
         # recompute them generally. Too much churn => general path outright.
@@ -1281,8 +1313,19 @@ class SelectRawPartitionsExec(ExecPlan):
                 # mostly-inexact data: raw f32 is cheaper than correcting
                 if len(bad) <= 0.25 * max(len(pids), 1):
                     narrow = (q, vmin, scale, bad)
+        hist_narrow = None
+        if (grid is not None and les is not None
+                and getattr(val, "ndim", 2) == 3):
+            # hist-resident store: ship the 2D-delta operands so PSM/fused
+            # paths stream them — the deferred f32 view never materializes;
+            # cohort-pool rows recompute via row-wise decode
+            hd = store.hist_operands()
+            if hd is not None:
+                dd, first_d, ok_host = hd
+                hist_narrow = (dd, first_d,
+                               pids[~ok_host[pids]].astype(np.int32))
         return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les,
-                               g_min, narrow)
+                               g_min, narrow, hist_narrow)
 
 
 def _execute_children(children, ctx):

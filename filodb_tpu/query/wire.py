@@ -174,16 +174,26 @@ def _pack(tag: bytes, meta: dict, arrays: list[np.ndarray]) -> bytes:
 
 
 def _unpack(buf: bytes) -> tuple[bytes, dict, list[np.ndarray]]:
-    tag = buf[:1]
-    (mlen,) = struct.unpack_from("<I", buf, 1)
-    meta = json.loads(buf[5:5 + mlen])
-    off = 5 + mlen
-    arrays = []
-    for dtype, shape in meta["arrays"]:
-        n = int(np.prod(shape)) if shape else 1
-        a = np.frombuffer(buf, np.dtype(dtype), n, off).reshape(shape).copy()
-        arrays.append(a)
-        off += a.nbytes
+    """Decode a tagged-binary result. A truncated or corrupt payload (peer
+    died mid-write, proxy mangled the body) surfaces as QueryError — typed,
+    so the dispatch layer can classify it as a retryable peer failure
+    instead of a bare 500."""
+    try:
+        tag = buf[:1]
+        (mlen,) = struct.unpack_from("<I", buf, 1)
+        meta = json.loads(buf[5:5 + mlen])
+        off = 5 + mlen
+        arrays = []
+        for dtype, shape in meta["arrays"]:
+            n = int(np.prod(shape)) if shape else 1
+            a = np.frombuffer(buf, np.dtype(dtype), n, off).reshape(shape).copy()
+            arrays.append(a)
+            off += a.nbytes
+    except (struct.error, ValueError, KeyError, TypeError,
+            UnicodeDecodeError) as e:
+        raise QueryError(
+            f"truncated/corrupt remote result payload "
+            f"({len(buf)} bytes): {e}") from None
     return tag, meta, arrays
 
 
@@ -242,36 +252,45 @@ def serialize_result(data) -> bytes:
 
 
 def deserialize_result(buf: bytes):
-    tag = buf[:1]
-    if tag == b"M":
-        return deserialize_matrix(buf[1:])
-    tag, meta, arrays = _unpack(buf)
-    if tag == b"A":
-        out_ts = arrays[0]
-        i = 1
-        les = None
-        if meta["has_les"]:
-            les = arrays[i]
-            i += 1
-        parts = dict(zip(meta["names"], arrays[i:]))
-        return AggPartial(meta["op"], out_ts, parts,
-                          _dec_keys(meta["group_keys"]), meta["num_groups"],
-                          les)
-    if tag == b"T":
-        out_ts, values, key_ref = arrays
-        return TopKPartial(meta["k"], meta["bottom"], out_ts,
-                           _dec_keys(meta["group_keys"]), values, key_ref,
-                           _dec_keys(meta["key_table"]))
-    if tag == b"S":
-        out_ts, counts = arrays
-        return SketchPartial(meta["q"], out_ts,
-                             _dec_keys(meta["group_keys"]), counts)
-    if tag == b"C":
-        out_ts, rows = arrays
-        entries = {(gi, vstr): rows[i]
-                   for i, (gi, vstr) in enumerate(meta["entries"])}
-        return CountValuesPartial(meta["label"], out_ts,
-                                  _dec_keys(meta["group_keys"]), entries)
+    try:
+        tag = buf[:1]
+        if tag == b"M":
+            return deserialize_matrix(buf[1:])
+        tag, meta, arrays = _unpack(buf)
+        if tag == b"A":
+            out_ts = arrays[0]
+            i = 1
+            les = None
+            if meta["has_les"]:
+                les = arrays[i]
+                i += 1
+            parts = dict(zip(meta["names"], arrays[i:]))
+            return AggPartial(meta["op"], out_ts, parts,
+                              _dec_keys(meta["group_keys"]), meta["num_groups"],
+                              les)
+        if tag == b"T":
+            out_ts, values, key_ref = arrays
+            return TopKPartial(meta["k"], meta["bottom"], out_ts,
+                               _dec_keys(meta["group_keys"]), values, key_ref,
+                               _dec_keys(meta["key_table"]))
+        if tag == b"S":
+            out_ts, counts = arrays
+            return SketchPartial(meta["q"], out_ts,
+                                 _dec_keys(meta["group_keys"]), counts)
+        if tag == b"C":
+            out_ts, rows = arrays
+            entries = {(gi, vstr): rows[i]
+                       for i, (gi, vstr) in enumerate(meta["entries"])}
+            return CountValuesPartial(meta["label"], out_ts,
+                                      _dec_keys(meta["group_keys"]), entries)
+    except QueryError:
+        raise
+    except (struct.error, ValueError, KeyError, IndexError, TypeError,
+            UnicodeDecodeError) as e:
+        # malformed meta fields / short array lists — same class of fault as
+        # a torn payload: typed, retryable, never a bare 500
+        raise QueryError(
+            f"truncated/corrupt remote result payload: {e}") from None
     raise QueryError(f"unknown remote result tag {tag!r}")
 
 
@@ -324,7 +343,17 @@ class RemoteLeafExec(ExecPlan):
                 f"peer {self.endpoint} unreachable for shard {shard}: {e}; "
                 "the query is retryable once shards reassign",
                 endpoint=self.endpoint, shard=shard) from None
-        data = deserialize_result(payload)
+        try:
+            data = deserialize_result(payload)
+        except QueryError as e:
+            shard = int(getattr(self.inner, "shard", -1))
+            # a torn/corrupt result body means the peer (or its transport)
+            # failed mid-response: classify like unreachability so the
+            # engine's replan-retry can route around a reassigned shard
+            raise RemotePeerError(
+                f"peer {self.endpoint} returned an undecodable result for "
+                f"shard {shard}: {e}", endpoint=self.endpoint,
+                shard=shard) from None
         for t in local:
             data = t.apply(data, ctx)
         return data

@@ -593,9 +593,85 @@ def bench_narrow_resident(full: bool) -> None:
     emit("narrow_resident", "bit_parity", 1.0, "bool")
 
 
+def bench_hist_retention(full: bool) -> None:
+    """Compressed-resident HISTOGRAM store (compressed_residency="all"):
+    series-at-fixed-HBM retention vs the raw f32 [S, C, B] store, plus
+    quantile-of-sum-of-rate parity and ms between residencies. Ref:
+    doc/compression.md "Histograms" — the reference's in-memory histogram
+    vectors are 2D-delta compressed; this is the device-resident analog
+    (i8/i16 dd blocks + first-frame deltas, ops/narrow.build_narrow_hist)."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series, n_samples, B = (2000, 300, 64) if full else (64, 120, 32)
+    rng = np.random.default_rng(12)
+    les = np.concatenate([2.0 ** np.arange(B - 1), [np.inf]])
+    ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+    data = [np.cumsum(np.cumsum(rng.poisson(0.3, (n_samples, B)), axis=0),
+                      axis=1).astype(np.float64) for _ in range(n_series)]
+
+    def build(mode: str):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=n_series,
+                          samples_per_series=n_samples + 8,
+                          flush_batch_size=10**9, dtype="float32",
+                          compressed_residency=mode)
+        sh = ms.setup("bench", PROM_HISTOGRAM, 0, cfg)
+        for s in range(n_series):
+            b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+            b.add_batch({"_metric_": "req_latency", "host": f"h{s}"},
+                        ts_arr, data[s])
+            ms.ingest("bench", 0, b.build())
+        ms.flush_all()
+        return ms, sh
+
+    start, end = BASE + 600_000, BASE + (n_samples - 10) * IV
+    q = 'histogram_quantile(0.9, sum(rate(req_latency[5m])))'
+
+    def series_result(eng):
+        r = eng.query_range(q, start, end, 60_000)
+        (_k, _t, v), = list(r.matrix.iter_series())
+        return np.asarray(v).copy()
+
+    ms_raw, sh_raw = build("off")
+    e_raw = QueryEngine(ms_raw, "bench")
+    raw_bytes = sh_raw.store.resident_sample_bytes()
+    dt, it = timed(lambda: series_result(e_raw), max_iters=20)
+    raw_ms = dt / it * 1000
+    a = series_result(e_raw)
+    del ms_raw, sh_raw, e_raw
+
+    ms_c, sh_c = build("all")
+    st = sh_c.store
+    assert st.is_narrow_resident and st.val is None and st.ts is None, \
+        "hist store must adopt compressed residency"
+    e_c = QueryEngine(ms_c, "bench")
+    dt, it = timed(lambda: series_result(e_c), max_iters=20)
+    nr_ms = dt / it * 1000
+    b = series_result(e_c)
+    assert np.array_equal(a, b), "hist-resident quantile diverged"
+    nr_bytes = st.resident_sample_bytes()
+
+    retention = raw_bytes / max(nr_bytes, 1)
+    emit("hist_retention", "resident_bytes_f32", raw_bytes, "bytes")
+    emit("hist_retention", "resident_bytes_compressed", nr_bytes, "bytes")
+    emit("hist_retention", "retention_multiple_at_fixed_hbm", retention, "x")
+    emit("hist_retention", "series_at_fixed_hbm_multiple", retention, "x")
+    emit("hist_retention", "dd_dtype_bits",
+         st._nhist[0].dtype.itemsize * 8, "bits")
+    emit("hist_retention", "quantile_of_sum_rate_ms_f32", raw_ms, "ms")
+    emit("hist_retention", "quantile_of_sum_rate_ms_compressed", nr_ms, "ms")
+    emit("hist_retention", "fused_ratio_compressed_vs_f32",
+         nr_ms / max(raw_ms, 1e-9), "x")
+    emit("hist_retention", "bit_parity", 1.0, "bool")
+
+
 SUITES = {
     "ingestion": bench_ingestion,
     "narrow_resident": bench_narrow_resident,
+    "hist_retention": bench_hist_retention,
     "encoding": bench_encoding,
     "partkey_index": bench_partkey_index,
     "hist_ingest": bench_hist_ingest,

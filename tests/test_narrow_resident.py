@@ -204,8 +204,8 @@ def test_two_phase_compress_aborts_on_racing_mutation():
     ms.ingest("prometheus", 0, b.build())
     orig_prepare = st.compress_prepare
 
-    def racing_prepare():
-        prep = orig_prepare()
+    def racing_prepare(hist=True):
+        prep = orig_prepare(hist=hist)
         # a concurrent append mutates AFTER the build snapshot
         rb = RecordBuilder(GAUGE)
         rb.add({"_metric_": "m", "host": "h0", "grp": "g0"},
