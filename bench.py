@@ -200,10 +200,11 @@ def stream_probe(val):
 
 
 def session_floor_ms():
-    """Fixed per-synchronization cost of this rig's device tunnel: p50 of a
-    trivial (4KB in/out) jitted dispatch + fetch. On a directly-attached TPU
-    host this is sub-millisecond; through the session tunnel it is ~100ms and
-    bounds any single blocking query from below."""
+    """``session_rt_floor_ms`` (shared definition with bench_suite.py, see
+    BASELINE.md "Floor accounting"): p50 of a trivial (4KB in/out) jitted
+    dispatch + HOST FETCH — the request round-trip every blocking query pays
+    at least once. Sub-millisecond on a directly-attached TPU host; ~100ms
+    through the session tunnel."""
     import jax
     import jax.numpy as jnp
 
@@ -217,6 +218,27 @@ def session_floor_ms():
     for _ in range(7):
         t0 = time.perf_counter()
         np.asarray(triv(x))
+        lat.append((time.perf_counter() - t0) * 1000)
+    return float(np.percentile(lat, 50))
+
+
+def device_dispatch_floor_ms():
+    """``device_dispatch_floor_ms`` (shared definition with bench_suite.py):
+    p50 of an empty-kernel dispatch + completion with NO host fetch — the
+    enqueue cost pipelined queries pay per dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def triv(x):
+        return x + 1.0
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    triv(x).block_until_ready()
+    lat = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        triv(x).block_until_ready()
         lat.append((time.perf_counter() - t0) * 1000)
     return float(np.percentile(lat, 50))
 
@@ -358,6 +380,7 @@ def main():
             "per_query_ms_rounds": [round(x, 2) for x in rounds],
             "single_query_p50_ms": round(single_p50, 2),
             "session_rt_floor_ms": round(floor_ms, 2),
+            "device_dispatch_floor_ms": round(device_dispatch_floor_ms(), 2),
             "single_query_minus_floor_ms": round(single_p50 - floor_ms, 2),
             "device_marginal_ms_per_query": round(device_marginal, 2),
             "device_marginal_ms_subrange_30m": round(device_marginal_sub, 2),

@@ -942,18 +942,30 @@ class TimeSeriesShard:
                     col_off = off
                     break
         rows_ts, rows_val = [], []
-        # one decode for the whole batch when the store is compressed-
-        # resident: per-pid series_snapshot would re-decode per series
+        # ONE batched device->host transfer for the whole paged batch, and a
+        # compressed-resident store decodes/derives ONLY the selected rows
+        # (gather_rows — the whole-store f32/i64 temp never materializes).
+        # The previous per-pid slice (`np.asarray(tsrc[p, :cnt])`) cost one
+        # full tunnel round-trip per SERIES — the dominant term of a wide
+        # cold scan
         from .chunkstore import _Deferred
-        vsrc = self.store.column_array(column)
-        if isinstance(vsrc, _Deferred):
-            vsrc = vsrc.materialize()
-        tsrc = self.store.ts_block()
-        for p in pids:
+        tsrc, vsrc, _n = self.store.arrays(column)
+        if isinstance(tsrc, np.ndarray) and isinstance(vsrc, np.ndarray):
+            ts_host, val_host = tsrc[pids], vsrc[pids]
+        else:
+            import jax
+            import jax.numpy as jnp
+            rid = jnp.asarray(np.asarray(pids, np.int32))
+            ts_rows = (tsrc.gather_rows(rid) if isinstance(tsrc, _Deferred)
+                       else jnp.take(jnp.asarray(tsrc), rid, axis=0))
+            val_rows = (vsrc.gather_rows(rid) if isinstance(vsrc, _Deferred)
+                        else jnp.take(jnp.asarray(vsrc), rid, axis=0))
+            ts_host, val_host = jax.device_get((ts_rows, val_rows))
+        for i, p in enumerate(pids):
             p = int(p)
             cnt = int(self.store.n_host[p])
-            hot_t = np.asarray(tsrc[p, :cnt])
-            hot_v = np.asarray(vsrc[p, :cnt])
+            hot_t = np.asarray(ts_host[i, :cnt])
+            hot_v = np.asarray(val_host[i, :cnt])
             boundary = hot_t[0] if len(hot_t) else (1 << 62)
             if cold_ts[p]:
                 ct = np.concatenate(cold_ts[p])

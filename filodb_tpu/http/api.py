@@ -109,8 +109,15 @@ class FiloHttpServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                from ..query.wire import PeerCircuitOpen
                 try:
                     outer._route(self)
+                except PeerCircuitOpen as e:
+                    # a browned-out peer's breaker shed the dispatch fast:
+                    # unavailable (retryable), NOT a bad query
+                    self._send(503, {"status": "error",
+                                     "errorType": "unavailable",
+                                     "error": str(e)})
                 except (QueryError, ParseError) as e:
                     self._send(422, {"status": "error", "errorType": "bad_data",
                                      "error": str(e)})
@@ -364,9 +371,15 @@ class FiloHttpServer:
         # other root queries would deadlock two saturated nodes against each
         # other (every worker waiting on a peer whose workers all wait back)
         with self._leg_guard():
-            plan = wire.deserialize_plan(body)
-            data = plan.execute(engine._ctx())
-            payload = wire.serialize_result(data)
+            if body[:1] == b"[":
+                # batched dispatch: a JSON LIST of envelopes (all leaves a
+                # caller routed at this node) -> one multi-part tagged-binary
+                # response with per-envelope error classification
+                payload = wire.execute_batch(body, engine._ctx())
+            else:
+                plan = wire.deserialize_plan(body)
+                data = plan.execute(engine._ctx())
+                payload = wire.serialize_result(data)
         h.send_response(200)
         h.send_header("Content-Type", "application/octet-stream")
         h.send_header("Content-Length", str(len(payload)))

@@ -12,6 +12,15 @@ collective *is* the scatter-gather. No serialization, no per-shard dispatch.
 
 The same partial-aggregate format as the in-process path (ops/aggregators.py)
 crosses the collective, so single-chip and multi-chip execution share semantics.
+
+Deliberately NOT lowered here: count_values — its partial state is keyed by
+rendered value strings (no fixed-size device layout to all_gather), and the
+host merge it rides measures at 1.1% of total query time at bench scale
+(bench_suite `count_values`, BENCH_SUITE_r07.json), so a hashed-value-bucket
+device layout would optimize a rounding error. Cross-HOST peers (shards owned
+by other OS processes) take the HTTP data plane instead: query/wire.py ships
+per-peer batched envelopes and co-located reduces (see query/planner.py
+_collapse_remote) — the collectives below cover co-resident shards only.
 """
 
 from __future__ import annotations

@@ -34,7 +34,10 @@ MESH_OPS = frozenset({"sum", "avg", "count", "group", "stddev", "stdvar",
 # candidate blocks (parallel/distributed.dist_topk), quantile psums sketch
 # counts. count_values stays on the host merge: its partial state is keyed
 # by rendered value STRINGS — there is no fixed-size device layout to
-# gather, and only [distinct values] rows cross shards anyway.
+# gather, and only [distinct values] rows cross shards anyway. Measured, not
+# asserted: the host merge is 1.1% of total query time at 8192 series x 8
+# shards (bench_suite `count_values`, BENCH_SUITE_r07.json) — far under the
+# 5% bar that would justify a hashed-bucket device layout.
 MESH_ORDER_OPS = frozenset({"topk", "bottomk", "quantile"})
 # device-side per-group loops in dist_topk compile per group: cap G like the
 # in-process order-stat map does (exec.AggregateMapReduce.ORDER_STAT_MAX_GROUPS)
@@ -45,12 +48,13 @@ _EXCLUDED_GID = 1 << 30
 
 
 def _walk_plans(plan):
-    """Yield every node of an ExecPlan tree (children/lhs/rhs/inner links)."""
+    """Yield every node of an ExecPlan tree (children/lhs/rhs/inner/members
+    links)."""
     stack = [plan]
     while stack:
         p = stack.pop()
         yield p
-        for attr in ("children", "lhs", "rhs", "inner", "child"):
+        for attr in ("children", "lhs", "rhs", "inner", "child", "members"):
             v = getattr(p, attr, None)
             if isinstance(v, list):
                 stack.extend(v)
@@ -189,14 +193,17 @@ class QueryEngine:
                 raise
             # the peer died mid-query: re-materialize (the ShardManager may
             # already have reassigned its shards to a survivor) and retry
-            # ONCE — but only if the failed shard actually ROUTES differently
-            # now; re-dispatching the identical plan to the same dead
-            # endpoint would just double the timeout
+            # ONCE — but only if EVERY failed shard actually ROUTES
+            # differently now; re-dispatching an identical batch to the same
+            # dead endpoint would just double the timeout
+            from .wire import _plan_shards
+            failed = set(getattr(e, "shards", ()) or ((e.shard,)
+                                                      if e.shard >= 0 else ()))
             retry = self.planner.materialize(plan)
-            for leaf in _walk_plans(retry):
-                if (isinstance(leaf, RemoteLeafExec)
-                        and getattr(leaf.inner, "shard", None) == e.shard
-                        and leaf.endpoint == e.endpoint):
+            for node in _walk_plans(retry):
+                if (isinstance(node, RemoteLeafExec)
+                        and node.endpoint == e.endpoint
+                        and failed & set(_plan_shards(node.inner))):
                     raise
             self.last_exec_path = "local-replanned"
             try:

@@ -1333,15 +1333,36 @@ def _execute_children(children, ctx):
     round-trips overlap each other AND the local shards' device work (ref:
     NonLeafExecPlan dispatches children as parallel Observables). Local
     children stay on the calling thread — shard locks already serialize
-    device-buffer capture."""
+    device-buffer capture. A RemoteBatchExec child (one POST covering a
+    peer's K leaves) returns a result LIST; it splices in place so parents
+    keep seeing one result per original leaf."""
     remote = [c for c in children if getattr(c, "IS_REMOTE", False)]
     if len(remote) < 1 or len(children) == 1:
-        return [c.execute(ctx) for c in children]
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=min(len(remote), 16)) as pool:
-        futs = {id(c): pool.submit(c.execute, ctx) for c in remote}
-        return [futs[id(c)].result() if id(c) in futs else c.execute(ctx)
-                for c in children]
+        results = [c.execute(ctx) for c in children]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(len(remote), 16)) as pool:
+            futs = {id(c): pool.submit(c.execute, ctx) for c in remote}
+            results = [futs[id(c)].result() if id(c) in futs
+                       else c.execute(ctx) for c in children]
+    batches = [c for c in children if getattr(c, "IS_BATCH", False)]
+    if not batches:
+        return results
+    # splice batch results back into the members' ORIGINAL child positions:
+    # reduce/concat merge order (and so float accumulation order — bit-parity
+    # with the single-node oracle) must not depend on the batching rewrite
+    n_total = (len(children) - len(batches)
+               + sum(len(b.members) for b in batches))
+    taken = {s for b in batches for s in b.slots}
+    free = (i for i in range(n_total) if i not in taken)
+    out = [None] * n_total
+    for c, r in zip(children, results):
+        if getattr(c, "IS_BATCH", False):
+            for slot, res in zip(c.slots, r):
+                out[slot] = res
+        else:
+            out[next(free)] = r
+    return out
 
 
 @dataclass

@@ -55,7 +55,87 @@ class QueryPlanner:
     # -- materialization ------------------------------------------------------
 
     def materialize(self, plan: L.LogicalPlan) -> ExecPlan:
-        return self._walk(plan)
+        root = self._walk(plan)
+        if self.route_fn is not None:
+            root = self._collapse_remote(root)
+        return root
+
+    # -- per-peer dispatch shaping --------------------------------------------
+
+    def _collapse_remote(self, node: ExecPlan) -> ExecPlan:
+        """Collapse cross-node fan-out from per-shard to per-peer (ref:
+        ExecPlan.scala ``dispatchRemotePlan`` + the data-node reduce placement
+        in queryengine2/QueryEngine.scala:506). Two rewrites, applied bottom-
+        up over the materialized tree:
+
+        1. co-located reduce: when EVERY child of a ReduceAggregate/DistConcat
+           lives on one peer and the whole subtree is wire-able, the node
+           itself ships — the peer runs its own reduce (fused kernels and all)
+           and only the reduced partial/presented matrix returns.
+        2. batched dispatch: remaining same-endpoint sibling leaves group into
+           one RemoteBatchExec — a query spanning a peer's K shards costs one
+           ``/exec`` round-trip instead of K."""
+        from .exec import DistConcatExec, ReduceAggregateExec
+        from .wire import (NotWireable, RemoteBatchExec, RemoteLeafExec,
+                           serialize_plan)
+        from dataclasses import replace
+
+        # step-varying scalar operands hold their own materialized subplans
+        # (executed locally before dispatch): shape their fan-out too
+        for t in getattr(node, "transformers", ()):
+            if isinstance(getattr(t, "scalar", None), ExecPlan):
+                t.scalar = self._collapse_remote(t.scalar)
+        for attr in ("lhs", "rhs", "child"):
+            v = getattr(node, attr, None)
+            if isinstance(v, ExecPlan):
+                setattr(node, attr, self._collapse_remote(v))
+        if not isinstance(node, (DistConcatExec, ReduceAggregateExec)):
+            return node
+        node.children = [self._collapse_remote(c) for c in node.children]
+        ch = node.children
+        remotes = [c for c in ch if isinstance(c, RemoteLeafExec)]
+        endpoints = {c.endpoint for c in remotes}
+        if remotes and len(remotes) == len(ch) and len(endpoints) == 1:
+            # co-located reduce: fold each wrapper's transformer chain into
+            # its shipped subplan and ship the fan-in node itself; the node's
+            # own transformers (presenter etc.) ride on the new wrapper and
+            # ship as its wire-able prefix
+            inner = replace(
+                node,
+                transformers=[],
+                children=[replace(c.inner,
+                                  transformers=list(c.inner.transformers)
+                                  + list(c.transformers))
+                          for c in remotes])
+            try:
+                serialize_plan(inner)
+            except NotWireable:
+                pass          # e.g. a scalar-operand subplan: batch instead
+            else:
+                return RemoteLeafExec(
+                    transformers=list(node.transformers),
+                    endpoint=remotes[0].endpoint, dataset=self.dataset,
+                    inner=inner, timeout_s=self.remote_timeout_s)
+        # transport batching: one RemoteBatchExec per endpoint with >= 2
+        # leaves (a single leaf already costs exactly one round-trip)
+        groups: dict[str, list[int]] = {}
+        for i, c in enumerate(ch):
+            if isinstance(c, RemoteLeafExec):
+                groups.setdefault(c.endpoint, []).append(i)
+        batch_at: dict[int, ExecPlan] = {}
+        consumed: set[int] = set()
+        for ep, idxs in groups.items():
+            if len(idxs) < 2:
+                continue
+            batch_at[idxs[0]] = RemoteBatchExec(
+                endpoint=ep, dataset=self.dataset,
+                members=[ch[i] for i in idxs],
+                timeout_s=self.remote_timeout_s, slots=list(idxs))
+            consumed.update(idxs[1:])
+        if batch_at:
+            node.children = [batch_at.get(i, c) for i, c in enumerate(ch)
+                             if i not in consumed]
+        return node
 
     def _route(self, leaf: ExecPlan) -> ExecPlan:
         """Wrap a leaf for a peer-owned shard in a RemoteLeafExec; later

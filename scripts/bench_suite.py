@@ -668,8 +668,136 @@ def bench_hist_retention(full: bool) -> None:
     emit("hist_retention", "bit_parity", 1.0, "bool")
 
 
+def bench_odp(full: bool) -> None:
+    """Ref QueryOnDemandBenchmark: evict resident data, then query a COLD
+    range — every query merges sink chunks with the resident tail through
+    read_with_paging (one batched device upload per paged batch). Reports
+    first-touch latency (compile + page-in), steady cold-query page-in ms /
+    qps, and the resident-range baseline for contrast."""
+    import shutil
+    import tempfile
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.core.store import FileColumnStore
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series, n_samples = (2000, 240) if full else (400, 120)
+    tmp = tempfile.mkdtemp(prefix="filodb_odp_")
+    try:
+        cfg = StoreConfig(max_series_per_shard=n_series,
+                          samples_per_series=n_samples + 8,
+                          flush_batch_size=10**9, dtype="float32")
+        ms = TimeSeriesMemStore()
+        sh = ms.setup("bench", GAUGE, 0, cfg, sink=FileColumnStore(tmp))
+        ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+        rng = np.random.default_rng(9)
+        b = RecordBuilder(GAUGE)
+        for s in range(n_series):
+            b.add_batch({"_metric_": "m_odp", "host": f"h{s}"},
+                        ts_arr, np.cumsum(rng.exponential(2.0, n_samples)))
+        ms.ingest("bench", 0, b.build())
+        ms.flush_all()
+        # evict the early two thirds: resident data starts at `cut`, the
+        # cold range below it pages from the sink on every query
+        cut = BASE + (2 * n_samples // 3) * IV
+        sh.store.compact(cut)
+        eng = QueryEngine(ms, "bench")
+        cold_start, cold_end = BASE + 120_000, cut - IV
+        hot_start, hot_end = cut + 60_000, BASE + (n_samples - 1) * IV
+
+        def q_cold(_=None):
+            eng.query_range('sum(rate(m_odp[1m]))', cold_start, cold_end,
+                            60_000)
+
+        def q_hot(_=None):
+            eng.query_range('sum(rate(m_odp[1m]))', hot_start, hot_end,
+                            60_000)
+
+        t0 = time.perf_counter()
+        q_cold()
+        emit("odp", "cold_first_touch_ms",
+             (time.perf_counter() - t0) * 1000, "ms")   # compile + page-in
+        dt, it = timed(q_cold, max_iters=20)
+        emit("odp", "cold_query_page_in_ms", dt / it * 1000, "ms")
+        emit("odp", "cold_query_qps", it / dt, "queries/s")
+        emit("odp", "paged_series_per_s", n_series * it / dt, "series/s")
+        dt, it = timed(q_hot, max_iters=20)
+        emit("odp", "resident_query_ms", dt / it * 1000, "ms")
+        emit("odp", "series", n_series, "count")
+        emit("odp", "cold_samples_per_series",
+             (cold_end - BASE) // IV, "samples")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_count_values(full: bool) -> None:
+    """Mesh count_values closure (VERDICT weak 4 / item 7): count_values is
+    the one aggregation whose reduce stays a HOST merge (partial state keyed
+    by rendered value strings — no fixed-size device layout to gather).
+    Measure the host merge's share of total query time at bench scale over 8
+    shards; the mesh exclusion stands while the fraction is small."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series, n_samples, nshards = (8192, 120, 8) if full else (1024, 60, 8)
+    per = n_series // nshards
+    cfg = StoreConfig(max_series_per_shard=per,
+                      samples_per_series=n_samples + 8,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+    rng = np.random.default_rng(21)
+    for s in range(nshards):
+        ms.setup("bench", GAUGE, s, cfg)
+        b = RecordBuilder(GAUGE)
+        for i in range(per):
+            # small-int values: the realistic count_values shape (status
+            # codes, bucketed levels) — distinct-value count stays bounded
+            vals = rng.integers(0, 20, n_samples).astype(np.float64)
+            b.add_batch({"_metric_": "m_cv", "host": f"h{s}-{i}"},
+                        ts_arr, vals)
+        ms.ingest("bench", s, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "bench")
+    start, end = BASE + 120_000, BASE + (n_samples - 1) * IV
+
+    def q(_=None):
+        eng.query_range('count_values("v", m_cv)', start, end, 60_000)
+
+    dt, it = timed(q, max_iters=20)
+    total_ms = dt / it * 1000
+    emit("count_values", "query_ms", total_ms, "ms")
+
+    # isolate the host merge: per-shard map-phase partials captured once,
+    # then the reduce (merge + present) timed on its own
+    from filodb_tpu.promql import parser as promql
+    from filodb_tpu.query.exec import _merge_heterogeneous
+    plan = promql.query_to_logical_plan('count_values("v", m_cv)', start, end,
+                                        60_000)
+    ep = eng.planner.materialize(plan)
+    ctx = eng._ctx()
+    partials = [c.execute(ctx) for c in ep.children]
+    presenter = ep.transformers[0]
+
+    def merge(_=None):
+        presenter.apply(_merge_heterogeneous(
+            partials, "count_values", ("v",), (), ()), ctx)
+
+    dt, it = timed(merge, max_iters=50)
+    merge_ms = dt / it * 1000
+    emit("count_values", "host_merge_ms", merge_ms, "ms")
+    emit("count_values", "host_merge_fraction", merge_ms / total_ms, "x")
+    emit("count_values", "series", n_series, "count")
+
+
 SUITES = {
     "ingestion": bench_ingestion,
+    "odp": bench_odp,
+    "count_values": bench_count_values,
     "narrow_resident": bench_narrow_resident,
     "hist_retention": bench_hist_retention,
     "encoding": bench_encoding,
@@ -695,18 +823,30 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_enable_x64", True)
-    # session round-trip floor, recorded per run: every latency-shaped
-    # metric below rides this tunnel; the judge reads marginals against it
+    # per-run floors, ONE shared definition with bench.py (BASELINE.md
+    # "Floor accounting"): every latency-shaped metric below rides them
+    #   session_rt_floor_ms      = trivial jitted dispatch + HOST FETCH p50
+    #                              (the request round-trip every blocking
+    #                              query pays at least once)
+    #   device_dispatch_floor_ms = empty-kernel dispatch + completion p50,
+    #                              NO host fetch (the enqueue cost pipelined
+    #                              queries pay per dispatch)
     import jax
     import jax.numpy as jnp
     z = jnp.zeros(8)
     z.block_until_ready()
-    floors = []
+    np.asarray(z + 1)
+    rt, disp = [], []
     for _ in range(5):
         t0 = time.perf_counter()
+        np.asarray(z + 1)
+        rt.append((time.perf_counter() - t0) * 1000)
+        t0 = time.perf_counter()
         (z + 1).block_until_ready()
-        floors.append((time.perf_counter() - t0) * 1000)
-    emit("session", "rt_floor_ms", sorted(floors)[len(floors) // 2], "ms")
+        disp.append((time.perf_counter() - t0) * 1000)
+    emit("session", "rt_floor_ms", sorted(rt)[len(rt) // 2], "ms")
+    emit("session", "device_dispatch_floor_ms",
+         sorted(disp)[len(disp) // 2], "ms")
     emit("session", "backend", float(jax.default_backend() == "tpu"), "is_tpu")
     import gc
     for name in (args.suite or sorted(SUITES)):

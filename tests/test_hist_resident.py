@@ -376,7 +376,8 @@ def test_remote_leaf_classifies_torn_payload(monkeypatch):
     with pytest.raises(RemotePeerError) as ei:
         leaf.execute(None)
     assert ei.value.endpoint == "peer:1" and ei.value.shard == 3
-    assert "shard 3" in str(ei.value)
+    assert ei.value.shards == (3,)
+    assert "shards [3]" in str(ei.value)
 
 
 def test_label_values_topk_cross_node_ranking(monkeypatch):

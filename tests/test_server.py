@@ -141,9 +141,12 @@ def test_server_end_to_end_with_recovery(tmp_path):
                 break
             time.sleep(0.2)
         assert got, "no data after recovery"
-        # full continuity: samples from before AND after the restart
+        # full continuity: samples from before AND after the restart —
+        # snapshot under the shard lock: the server's consumer thread flushes
+        # concurrently and a flush DONATES the store buffers mid-read
         shard = server2.memstore.shard("prometheus", 0)
-        t0, _ = shard.store.series_snapshot(0)
+        with shard.lock:
+            t0, _ = shard.store.series_snapshot(0)
         assert len(t0) == 80                     # 8 batches x 10 samples
     finally:
         server2.shutdown()
