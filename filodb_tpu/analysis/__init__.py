@@ -1,0 +1,51 @@
+"""filolint: project-invariant static analysis for filodb_tpu.
+
+The port leans on conventions the language never checks, exactly like the
+reference FiloDB leans on its per-shard ingest threads + ChunkMap read locks
+(SURVEY §0). Three families of invariants are load-bearing here:
+
+  * **lock discipline** — ``*_locked`` methods must run under the owning
+    object's lock (core/memstore.py's shard ``TimedRLock``); state mutated by
+    ``*_locked`` methods must not be written from non-holders; and the three
+    lock classes (group-flush, sink, shard) have one global acquisition order
+    (utils/diagnostics.LOCK_ORDER) — a cycle is a potential deadlock.
+  * **JIT hygiene** — inside ``jax.jit``-compiled functions a stray
+    ``float()``/``.item()``/``np.asarray``/``jax.device_get`` is a silent
+    device→host sync, a Python branch on a traced value is a trace error (or
+    a retrace per value when made static), and a closure over mutable module
+    state bakes stale values into the compiled program. An unhashable or
+    float-typed static argument retraces per call / per distinct value —
+    a 100x perf cliff tier-1 latency tests cannot see.
+  * **wire exhaustiveness** — query/wire.py's tagged-binary result codec must
+    enumerate the same envelope tags on the encode and decode side, bound
+    plan nesting by ONE shared constant on both sides, and every typed query
+    error must be classified by the HTTP dispatch table (http/api.py) so a
+    peer failure maps to the right status code instead of a bare 500.
+
+Everything is pure ``ast`` — no jax import, no device, safe under
+``JAX_PLATFORMS=cpu`` and in CI. Findings are suppressible inline with
+``# filolint: ignore[rule]`` on the flagged line, or via the checked-in
+baseline file (``filolint_baseline.json`` at the repo root, one entry per
+intentionally-kept finding with a reason).
+
+Run it:
+
+    python -m filodb_tpu.analysis            # analyze filodb_tpu/, exit 1 on new findings
+    python scripts/filolint.py               # same, with per-rule summary
+    pytest tests/test_static_analysis.py     # tier-1 self-enforcement
+
+See ANALYSIS.md for each rule, the invariant behind it, and how to add one.
+"""
+
+from .findings import Baseline, Finding, load_suppressions
+from .runner import ALL_RULES, AnalysisReport, analyze_file, run_analysis
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisReport",
+    "Baseline",
+    "Finding",
+    "analyze_file",
+    "load_suppressions",
+    "run_analysis",
+]

@@ -1,0 +1,59 @@
+"""CLI: ``python -m filodb_tpu.analysis [paths...]``.
+
+Exit status: 0 when no NEW findings (inline-suppressed and baselined
+findings are reported but don't fail); 1 otherwise; 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .findings import Baseline
+from .runner import DEFAULT_BASELINE, run_analysis
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m filodb_tpu.analysis",
+        description="filolint: project-invariant static analysis "
+                    "(lock discipline, JIT hygiene, wire exhaustiveness)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the filodb_tpu "
+                         "package next to this module)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of the filodb_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current NEW findings to the baseline "
+                         "file (then hand-edit the reasons) and exit 0")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary only, no per-finding lines")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    baseline_path = Path(args.baseline) if args.baseline \
+        else root / DEFAULT_BASELINE
+
+    report = run_analysis(root, args.paths or None,
+                          baseline_path=baseline_path)
+
+    if args.write_baseline:
+        Baseline.write(baseline_path, report.new)
+        print(f"wrote {len(report.new)} entries to {baseline_path} — "
+              "fill in the reason for each")
+        return 0
+
+    if not args.quiet:
+        for f in sorted(report.new, key=lambda f: (f.path, f.line)):
+            print(f.render())
+    print(report.summary())
+    return 1 if report.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Orchestration: file discovery, checker dispatch, suppression + baseline.
+
+Pure stdlib + ast — importable with no jax/numpy on the path, so the tier-1
+test and CI hooks pay only parse time (~100ms for the whole package).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .findings import Baseline, Finding, is_suppressed, load_suppressions
+from .jitcheck import JitChecker
+from .lockcheck import LockChecker
+from .wirecheck import WireChecker
+
+# generated / vendored files never analyzed
+DEFAULT_EXCLUDES = ("remote_storage_pb2.py",)
+
+ALL_RULES = tuple(sorted(
+    set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)))
+
+DEFAULT_BASELINE = "filolint_baseline.json"
+
+
+@dataclass
+class AnalysisReport:
+    new: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    files_analyzed: int = 0
+
+    @property
+    def all_findings(self) -> list[Finding]:
+        return self.new + self.suppressed + self.baselined
+
+    def counts_by_rule(self, which: str = "new") -> dict[str, int]:
+        items = getattr(self, which)
+        return dict(Counter(f.rule for f in items))
+
+    def summary(self) -> str:
+        lines = [f"filolint: {self.files_analyzed} files analyzed, "
+                 f"{len(self.new)} new finding(s), "
+                 f"{len(self.suppressed)} suppressed inline, "
+                 f"{len(self.baselined)} baselined"]
+        per_rule = Counter(f.rule for f in self.all_findings)
+        for rule in ALL_RULES:
+            n_all = per_rule.get(rule, 0)
+            n_new = sum(1 for f in self.new if f.rule == rule)
+            if n_all or n_new:
+                lines.append(f"  {rule:<24} {n_all:>3} total, {n_new} new")
+        return "\n".join(lines)
+
+
+def _discover(root: Path, paths: list[str] | None) -> list[Path]:
+    if paths:
+        out: list[Path] = []
+        for p in paths:
+            pp = (root / p) if not Path(p).is_absolute() else Path(p)
+            if pp.is_dir():
+                out.extend(sorted(pp.rglob("*.py")))
+            else:
+                out.append(pp)
+    else:
+        out = sorted((root / "filodb_tpu").rglob("*.py"))
+    return [p for p in out if p.name not in DEFAULT_EXCLUDES]
+
+
+def analyze_file(path: Path, root: Path | None = None,
+                 checkers=None) -> list[Finding]:
+    """Analyze one file standalone (fixture self-tests use this). Cross-file
+    rules (lock-order graph, wire classification) still run via finalize over
+    just this file."""
+    root = root or path.parent
+    checkers = checkers if checkers is not None else _default_checkers()
+    rel = _relpath(path, root)
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    findings: list[Finding] = []
+    for c in checkers:
+        findings += c.check_module(rel, tree)
+    for c in checkers:
+        fin = getattr(c, "finalize", None)
+        if fin is not None:
+            findings += fin()
+    supp = load_suppressions(source)
+    return [f for f in findings if not is_suppressed(f, supp)]
+
+
+def _default_checkers(wire_spec: dict | None = None):
+    return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec)]
+
+
+def _relpath(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def run_analysis(root: Path | str, paths: list[str] | None = None,
+                 baseline_path: Path | str | None = "auto",
+                 wire_spec: dict | None = None) -> AnalysisReport:
+    """Analyze ``paths`` (default: the filodb_tpu package under ``root``).
+
+    ``baseline_path="auto"`` uses <root>/filolint_baseline.json when present.
+    Returns an AnalysisReport with findings split into new / inline-suppressed
+    / baselined."""
+    root = Path(root)
+    if baseline_path == "auto":
+        baseline_path = root / DEFAULT_BASELINE
+    baseline = Baseline.load(baseline_path)
+    checkers = _default_checkers(wire_spec)
+    report = AnalysisReport()
+    per_file_supp: dict[str, dict[int, set[str]]] = {}
+    findings: list[Finding] = []
+    for path in _discover(root, paths):
+        rel = _relpath(path, root)
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("parse-error", rel, 1, "<module>",
+                                    "parse", f"cannot analyze: {e}"))
+            continue
+        per_file_supp[rel] = load_suppressions(source)
+        report.files_analyzed += 1
+        for c in checkers:
+            findings += c.check_module(rel, tree)
+    for c in checkers:
+        fin = getattr(c, "finalize", None)
+        if fin is not None:
+            findings += fin()
+    for f in findings:
+        if is_suppressed(f, per_file_supp.get(f.path, {})):
+            report.suppressed.append(f)
+        elif baseline.covers(f):
+            report.baselined.append(f)
+        else:
+            report.new.append(f)
+    return report

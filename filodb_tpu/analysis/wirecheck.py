@@ -1,0 +1,291 @@
+"""Wire-protocol exhaustiveness checker (query/wire.py and its consumers).
+
+The tagged-binary result codec and the plan envelope are a convention pair:
+encoder and decoder live in the same file but nothing forces them to agree.
+PR 2's batched dispatch made the failure mode concrete — a tag encoded but
+not decoded surfaces as "unknown remote result tag" on the PEER'S caller,
+i.e. a cross-node incident, not a unit-test failure.
+
+  * ``wire-tag-parity`` — every single-byte tag literal the encode side
+    (serialize_result / pack_multipart) emits must be matched on the decode
+    side (deserialize_result / unpack_multipart), and vice versa.
+  * ``wire-nesting-bound`` — the plan envelope's nesting bound must be ONE
+    shared module constant compared on both _enc_plan and _dec_plan (a
+    literal on either side lets the sides drift: the planner would ship
+    plans the peer rejects).
+  * ``wire-error-classified`` — every typed error wire.py raises
+    (QueryError subclasses + QueryError itself) must be classified by the
+    HTTP dispatch table (the except-chain in http/api.py) either directly or
+    via a handled ancestor, and a subclass handler must come BEFORE its
+    ancestor's (Python takes the first matching except — a dead subclass
+    handler silently degrades a 503 to a 422).
+
+The function/file names checked are configured in ``WIRE_SPEC`` below —
+extend it when a new codec pair appears.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+WIRE_SPEC = {
+    "wire_module": "filodb_tpu/query/wire.py",
+    "classifier_module": "filodb_tpu/http/api.py",
+    "error_base_modules": ["filodb_tpu/query/rangevector.py"],
+    # (encode fn, decode fn) pairs whose 1-byte bytes literals must agree
+    "codec_pairs": [("serialize_result", "deserialize_result"),
+                    ("pack_multipart", "unpack_multipart")],
+    # functions that must share one named depth-bound constant
+    "depth_pair": ("_enc_plan", "_dec_plan"),
+    # the root of the typed-error hierarchy the HTTP layer classifies
+    "error_root": "QueryError",
+}
+
+
+def _functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _byte_tags(fn: ast.FunctionDef) -> dict[bytes, int]:
+    """All single-byte bytes literals in a function -> first line seen."""
+    out: dict[bytes, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and isinstance(node.value, bytes) \
+                and len(node.value) == 1:
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+class WireChecker:
+    rules = ("wire-tag-parity", "wire-nesting-bound", "wire-error-classified")
+
+    def __init__(self, spec: dict | None = None):
+        self.spec = spec or WIRE_SPEC
+        self._modules: dict[str, ast.Module] = {}
+
+    def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
+        # cross-file rule: stash and run in finalize
+        self._modules[path] = tree
+        return []
+
+    def finalize(self) -> list[Finding]:
+        wire_path = self.spec["wire_module"]
+        wire = self._modules.get(wire_path)
+        if wire is None:
+            return []
+        findings: list[Finding] = []
+        fns = _functions(wire)
+        findings += self._tag_parity(wire_path, fns)
+        findings += self._nesting_bound(wire_path, wire, fns)
+        findings += self._error_classified(wire_path, wire)
+        return findings
+
+    # -- tags --------------------------------------------------------------
+
+    def _tag_parity(self, path: str,
+                    fns: dict[str, ast.FunctionDef]) -> list[Finding]:
+        findings = []
+        for enc_name, dec_name in self.spec["codec_pairs"]:
+            enc, dec = fns.get(enc_name), fns.get(dec_name)
+            if enc is None or dec is None:
+                missing = enc_name if enc is None else dec_name
+                findings.append(Finding(
+                    "wire-tag-parity", path, 1, "<module>",
+                    f"missing-fn:{missing}",
+                    f"codec function {missing}() not found — update "
+                    "analysis/wirecheck.WIRE_SPEC if it moved"))
+                continue
+            etags, dtags = _byte_tags(enc), _byte_tags(dec)
+            for tag, line in sorted(etags.items()):
+                if tag not in dtags:
+                    findings.append(Finding(
+                        "wire-tag-parity", path, line, enc_name,
+                        f"undecoded:{tag!r}",
+                        f"envelope tag {tag!r} is encoded by {enc_name}() "
+                        f"but {dec_name}() has no branch for it — peers "
+                        "answer payloads this side cannot decode"))
+            for tag, line in sorted(dtags.items()):
+                if tag not in etags:
+                    findings.append(Finding(
+                        "wire-tag-parity", path, line, dec_name,
+                        f"unencoded:{tag!r}",
+                        f"decode branch for tag {tag!r} in {dec_name}() has "
+                        f"no encoder in {enc_name}() — dead protocol arm or "
+                        "a missing encode path"))
+        return findings
+
+    # -- nesting bound ------------------------------------------------------
+
+    def _nesting_bound(self, path: str, tree: ast.Module,
+                       fns: dict[str, ast.FunctionDef]) -> list[Finding]:
+        enc_name, dec_name = self.spec["depth_pair"]
+        findings: list[Finding] = []
+        bounds: dict[str, tuple[set, list]] = {}
+        for name in (enc_name, dec_name):
+            fn = fns.get(name)
+            if fn is None:
+                findings.append(Finding(
+                    "wire-nesting-bound", path, 1, "<module>",
+                    f"missing-fn:{name}",
+                    f"{name}() not found — update WIRE_SPEC if it moved"))
+                continue
+            names: set[str] = set()
+            literals: list[int] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Compare):
+                    continue
+                for side in [node.left, *node.comparators]:
+                    d = _depth_const(side)
+                    if d is None:
+                        continue
+                    if isinstance(d, str):
+                        names.add(d)
+                    else:
+                        literals.append(node.lineno)
+            bounds[name] = (names, literals)
+            for line in literals:
+                findings.append(Finding(
+                    "wire-nesting-bound", path, line, name,
+                    "literal-bound",
+                    f"{name}() compares depth against a numeric literal — "
+                    "use the shared module constant so encoder and decoder "
+                    "cannot drift"))
+            if not names and not literals:
+                findings.append(Finding(
+                    "wire-nesting-bound", path, fn.lineno, name,
+                    "no-bound",
+                    f"{name}() has no depth-bound comparison — unbounded "
+                    "recursion on hostile nested envelopes"))
+        if len(bounds) == 2:
+            (n1, _), (n2, _) = bounds.values()
+            if n1 and n2 and n1.isdisjoint(n2):
+                findings.append(Finding(
+                    "wire-nesting-bound", path, 1, "<module>",
+                    f"split-bound:{sorted(n1)[0]}!={sorted(n2)[0]}",
+                    f"{enc_name}() bounds depth by {sorted(n1)} but "
+                    f"{dec_name}() by {sorted(n2)} — the nesting bound must "
+                    "be one shared constant"))
+        return findings
+
+    # -- error classification ------------------------------------------------
+
+    def _error_classified(self, wire_path: str,
+                          wire: ast.Module) -> list[Finding]:
+        root = self.spec["error_root"]
+        # class -> direct base names, across wire.py + the base modules
+        bases: dict[str, list[str]] = {}
+        def_line: dict[str, int] = {}
+        mods = [(wire_path, wire)]
+        for p in self.spec["error_base_modules"]:
+            if p in self._modules:
+                mods.append((p, self._modules[p]))
+        wire_classes: list[str] = []
+        for p, tree in mods:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    bnames = [b.id for b in node.bases
+                              if isinstance(b, ast.Name)]
+                    bases[node.name] = bnames
+                    def_line.setdefault(node.name, node.lineno)
+                    if p == wire_path:
+                        wire_classes.append(node.name)
+
+        def ancestry(name: str) -> list[str]:
+            out, todo = [], [name]
+            while todo:
+                n = todo.pop(0)
+                for b in bases.get(n, ()):
+                    if b not in out:
+                        out.append(b)
+                        todo.append(b)
+            return out
+
+        typed = [c for c in wire_classes if root in ancestry(c)]
+        if root in bases:
+            typed.append(root)
+        if not typed:
+            return []
+
+        cl_path = self.spec["classifier_module"]
+        cl = self._modules.get(cl_path)
+        if cl is None:
+            return [Finding(
+                "wire-error-classified", wire_path, 1, "<module>",
+                f"missing-classifier:{cl_path}",
+                f"classifier module {cl_path} not analyzed — cannot verify "
+                "the typed-error dispatch table")]
+        handler_chains = self._handler_chains(cl)
+
+        findings: list[Finding] = []
+        for err in typed:
+            anc = set(ancestry(err))
+            covered = None
+            for chain in handler_chains:
+                names = [n for grp in chain for n in grp]
+                if err in names or anc & set(names):
+                    covered = chain
+                    break
+            if covered is None:
+                findings.append(Finding(
+                    "wire-error-classified", wire_path,
+                    def_line.get(err, 1), err, f"unclassified:{err}",
+                    f"typed error {err} (a {root} descendant) is never "
+                    f"classified by the dispatch table in {cl_path} — peers "
+                    "see a bare 500 instead of a typed, retryable status"))
+                continue
+            # subclass handler must precede ancestor handler
+            names_in_order = [n for grp in covered for n in grp]
+            if err in names_in_order:
+                ei = names_in_order.index(err)
+                for a in anc:
+                    if a in names_in_order and names_in_order.index(a) < ei:
+                        findings.append(Finding(
+                            "wire-error-classified", wire_path,
+                            def_line.get(err, 1), err, f"shadowed:{err}",
+                            f"{err} handler in {cl_path} comes AFTER its "
+                            f"ancestor {a}'s — Python takes the first match, "
+                            "so the specific classification is dead code"))
+        return findings
+
+    @staticmethod
+    def _handler_chains(tree: ast.Module) -> list[list[list[str]]]:
+        """Each Try's except chain as a list of per-handler name groups."""
+        chains = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            chain = []
+            for h in node.handlers:
+                t = h.type
+                if t is None:
+                    chain.append(["BaseException"])
+                elif isinstance(t, ast.Tuple):
+                    chain.append([_leaf_name(e) for e in t.elts])
+                else:
+                    chain.append([_leaf_name(t)])
+            chains.append(chain)
+        return chains
+
+
+def _leaf_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return "<?>"
+
+
+def _depth_const(node: ast.expr):
+    """A depth-bound operand: an UPPERCASE constant Name mentioning
+    DEPTH/NEST/MAX (returned as str — lowercase names are the counters, not
+    the bound) or an int literal >= 2 (returned as int); else None."""
+    if isinstance(node, ast.Name) and node.id == node.id.upper() and any(
+            k in node.id for k in ("DEPTH", "NEST", "MAX")):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool) and node.value >= 2:
+        return node.value
+    return None

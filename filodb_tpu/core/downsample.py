@@ -114,7 +114,17 @@ class InlineDownsampler:
         st = shard.store
         if st is None:
             return
-        self._seeded_last = np.full(st.S, -(1 << 62), np.int64)
+        # build the floors locally and publish once under the lock at the
+        # end: a purge running concurrently with seeding (queries — and their
+        # release paths — are admitted during recovery) calls drop_pids,
+        # whose per-slot floor resets under self._lock would interleave with
+        # unguarded incremental writes here. Snapshot the drop generation
+        # first: a slot released DURING the scan must not have the dead
+        # series' floor re-installed by the publish below (its reused slot's
+        # new owner would lose every sample below that floor).
+        with self._lock:
+            gen0 = self._drop_counter
+        seeded = np.full(st.S, -(1 << 62), np.int64)
         # one block materialization for the whole scan (a compressed-resident
         # store must not decode its full block once per pid)
         tsrc, vsrc = st.snapshot_arrays()
@@ -129,7 +139,12 @@ class InlineDownsampler:
                 self._ingest(shard, np.full(int(sel.sum()), pid, np.int32),
                              t[sel], np.asarray(v[sel], np.float64))
             if len(t):
-                self._seeded_last[pid] = int(t[-1])
+                seeded[pid] = int(t[-1])
+        with self._lock:
+            for p, g in self._drop_gen_of.items():
+                if g > gen0 and p < len(seeded):
+                    seeded[p] = -(1 << 62)   # released mid-scan: floor reset wins
+            self._seeded_last = seeded
 
     _seeded_last = None
 

@@ -34,6 +34,7 @@ from .partkey_index import PartKeyIndex
 from .record import RecordContainer
 from .schemas import Schema, Schemas, part_key_of
 from .store import ChunkSetRecord, ChunkSink
+from ..utils.diagnostics import TimedRLock, assert_owned
 
 
 @dataclass
@@ -140,8 +141,8 @@ class TimeSeriesShard:
         # scatter invalidates (donates) the old store buffers, so query leaves
         # must capture arrays AND dispatch their kernels under this lock
         # (ref analog: per-shard single ingest thread + ChunkMap read locks)
-        from ..utils.diagnostics import TimedRLock
-        self.lock = TimedRLock(f"shard-{shard_num}-lock")
+        self.lock = TimedRLock(f"shard-{shard_num}-lock", order_class="shard",
+                               order_index=shard_num)
         # per-slot release counters (purge/eviction): lazily materialized
         # query artifacts (LazyKeys) snapshot the epochs of THEIR pids and
         # detect slot reuse without being invalidated by unrelated releases
@@ -183,14 +184,23 @@ class TimeSeriesShard:
         # flush of g that had already snapshotted the pending chunks has
         # finished its sink write AND its inline-downsample publish — without
         # it, a caller could see an empty pending list, return immediately,
-        # and read the sink before the concurrent flusher published
-        self._group_flush_locks = [threading.Lock() for _ in range(G)]
+        # and read the sink before the concurrent flusher published.
+        # Ordered TimedRLocks (not bare threading.Lock): the global order
+        # group_flush < sink < shard is asserted under FILODB_LOCK_DEBUG=1
+        # (diagnostics.LOCK_ORDER) and checked statically by filolint.
+        self._group_flush_locks = [
+            TimedRLock(f"shard-{shard_num}-group-{g}-flush",
+                       order_class="group_flush", order_index=g)
+            for g in range(G)]
         # ordered part-key event log awaiting durable persist: creations
         # (pid, labels, start) and release tombstones (pid, {}, -1) in event
         # order, so recovery's last-entry-wins resolves slot reuse correctly
         # regardless of which thread drains the log
         self._partkey_log: list[tuple[int, dict, int]] = []
-        self._sink_lock = threading.Lock()   # serializes drain+write batches
+        # serializes drain+write batches (ordered: sink < shard)
+        self._sink_lock = TimedRLock(f"shard-{shard_num}-sink-lock",
+                                     order_class="sink",
+                                     order_index=shard_num)
         self._meta_written = False
         # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
         # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
@@ -494,15 +504,22 @@ class TimeSeriesShard:
         already persisted past ``offset`` are skipped (ref: TimeSeriesShard
         recovery skips rows below the group watermark, :180-184)."""
         if container.schema.schema_id != self.schema.schema_id:
-            self.stats.unknown_schema_dropped += len(container)
+            with self.lock:   # stats are shard state: writers race otherwise
+                self.stats.unknown_schema_dropped += len(container)
             return
         if self.store is None:
-            self.bucket_les = (np.asarray(container.bucket_les)
-                               if container.bucket_les is not None else None)
-            width = (container.values.shape[1]
-                     if container.values.ndim == 2 else 0)
-            self.store = self._make_store(width_hint=width)
-            self.store.owner_lock = self.lock
+            # double-checked under the shard lock: two writer threads racing
+            # the first container would each build a store and one's would be
+            # silently dropped (with its bucket scheme)
+            with self.lock:
+                if self.store is None:
+                    self.bucket_les = (np.asarray(container.bucket_les)
+                                       if container.bucket_les is not None
+                                       else None)
+                    width = (container.values.shape[1]
+                             if container.values.ndim == 2 else 0)
+                    self.store = self._make_store(width_hint=width)
+                    self.store.owner_lock = self.lock
         n_sets = len(container.label_sets)
         if n_sets == 0 or len(container) == 0:
             return
@@ -592,7 +609,8 @@ class TimeSeriesShard:
             self.store.narrow.refresh(self.store)
         if self.sink is None and self._pending_offset >= 0:
             # without a durable sink, device residency is the only watermark
-            self.group_watermarks[:] = self._pending_offset
+            with self.lock:
+                self.group_watermarks[:] = self._pending_offset
         # capacity pressure -> compact out data older than retention
         # (policy pluggable; ref: PartitionEvictionPolicy.scala)
         if self.eviction_policy.should_evict(self.store, self.config):
@@ -734,7 +752,8 @@ class TimeSeriesShard:
             # a checkpoint failure does NOT requeue: the chunks are durable,
             # the watermark merely lags and recommits on the next flush
             self.sink.write_checkpoint(self.dataset, self.shard_num, group, off)
-            self.group_watermarks[group] = off
+            with self.lock:
+                self.group_watermarks[group] = off
         return len(records)
 
     def _requeue_pending_locked(self, group, pending, pend_epochs) -> None:
@@ -770,10 +789,13 @@ class TimeSeriesShard:
             # flush) must stay None so bus replay recreates it with the
             # bucket scheme its first container carries
             if meta.get("bucket_les") or not self.schema.is_histogram:
-                self.bucket_les = (np.asarray(meta["bucket_les"])
-                                   if meta.get("bucket_les") else None)
-                self.store = self._make_store()
-                self.store.owner_lock = self.lock
+                # under the shard lock: queries are admitted while recovery
+                # streams in, and they read self.store
+                with self.lock:
+                    self.bucket_les = (np.asarray(meta["bucket_les"])
+                                       if meta.get("bucket_les") else None)
+                    self.store = self._make_store()
+                    self.store.owner_lock = self.lock
         # 1. part keys -> index (ids dense in creation order; a purged slot may
         #    have been re-persisted under a new series — the last entry wins)
         latest: dict[int, tuple[dict, int]] = {}
@@ -844,9 +866,10 @@ class TimeSeriesShard:
             on_chunks_loaded()
         # 3. checkpoints -> watermarks; replay the bus past them
         cps = self.sink.read_checkpoints(self.dataset, self.shard_num)
-        for g, off in cps.items():
-            self.group_watermarks[g] = off
-            self._pending_group_offset[g] = off
+        with self.lock:   # _pending_group_offset is ingest-staging state
+            for g, off in cps.items():
+                self.group_watermarks[g] = off
+                self._pending_group_offset[g] = off
         replayed = 0
         if bus is not None:
             wm = self.group_watermarks.copy()
@@ -891,8 +914,8 @@ class TimeSeriesShard:
             if len(purged) == 0:
                 return 0
             self._release_partitions_locked(purged)
+            self.stats.partitions_purged += len(purged)
         self._flush_partkey_log()   # durable write happens outside the shard lock
-        self.stats.partitions_purged += len(purged)
         return len(purged)
 
     # -- on-demand paging (ref: OnDemandPagingShard.scala:26,58 +
@@ -1000,6 +1023,7 @@ class TimeSeriesShard:
         """Memoized RangeVectorKey for a live pid (query-leaf hot path: avoids
         re-materializing the dict-encoded labels on every query). Call under
         the shard lock; purge drops cache entries for reused slots."""
+        assert_owned(self.lock, "rv_key_of")   # caller-holds-lock contract
         k = self._rv_keys.get(pid)
         if k is None:
             from ..query.rangevector import RangeVectorKey

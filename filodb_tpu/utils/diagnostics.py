@@ -22,6 +22,7 @@ All checks are off by default (zero overhead beyond an ``if``); enable with
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import traceback
@@ -32,10 +33,39 @@ enabled = False
 
 HOLD_WARN_S = 5.0      # ChunkMap-style "lock held too long" warning threshold
 
+# Global lock acquisition order (rank increases left to right): a thread may
+# only acquire a lock whose rank is STRICTLY greater than every ranked lock
+# it already holds (reentrant re-acquisition of the same object excepted).
+# Derived statically by filodb_tpu/analysis/lockcheck.py from the nested-with
+# graph (group_flush -> {sink, shard}, sink -> shard) and asserted at runtime
+# here when FILODB_LOCK_DEBUG=1. The static checker and this constant must
+# agree — tests/test_static_analysis.py cross-checks them.
+LOCK_ORDER = ("group_flush", "sink", "shard")
+
+_LOCK_RANK = {c: i for i, c in enumerate(LOCK_ORDER)}
+
+# opt-in runtime lock-order assertions (cheap thread-local bookkeeping, but
+# still off by default on hot ingest paths)
+lock_debug = os.environ.get("FILODB_LOCK_DEBUG", "") == "1"
+
+_tls = threading.local()
+
 
 def enable(on: bool = True) -> None:
     global enabled
     enabled = on
+
+
+def enable_lock_debug(on: bool = True) -> None:
+    global lock_debug
+    lock_debug = on
+
+
+def _held_locks() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
 
 
 class DiagnosticsError(AssertionError):
@@ -61,20 +91,69 @@ class TimedRLock:
 
     Drop-in for ``threading.RLock()`` (context manager + acquire/release +
     _is_owned); stats are cheap enough to keep even when diagnostics are off,
-    the long-hold stack capture only happens when on."""
+    the long-hold stack capture only happens when on.
 
-    def __init__(self, name: str = "lock"):
+    ``order_class`` names the lock's class in the global acquisition order
+    (LOCK_ORDER). Under FILODB_LOCK_DEBUG=1 every acquisition checks the
+    calling thread's held-lock set: taking a lock whose class rank is below
+    a held (different) lock's rank raises DiagnosticsError BEFORE blocking —
+    the would-be deadlock surfaces as a stack trace naming both locks instead
+    of a frozen process. WITHIN a class, ``order_index`` (the shard/group
+    number) must strictly ascend — the engine's multi-shard ExitStack
+    acquisition is deadlock-free precisely because it walks shards in
+    ascending shard_num; two indexed same-class locks taken descending are
+    the ABBA shape and raise too."""
+
+    def __init__(self, name: str = "lock", order_class: str | None = None,
+                 order_index: int | None = None):
         self._lock = threading.RLock()
         self.name = name
+        self.order_class = order_class
+        self.order_index = order_index
         self.contentions = 0
         self.long_holds = 0
         self._acquired_at = 0.0
         self._depth = 0
+        # serializes the contention/long-hold counter RMWs: contentions is
+        # bumped precisely when the main lock is NOT held, so `+= 1` there
+        # races every other contending thread (found by filolint's
+        # lock-guard-inconsistent family; diagnostics must not lie)
+        self._stats_lock = threading.Lock()
+
+    def _check_order(self) -> None:
+        held = _held_locks()
+        if self in held:
+            return                      # reentrant: always fine
+        my_rank = _LOCK_RANK.get(self.order_class)
+        if my_rank is None:
+            return
+        for lk in held:
+            r = _LOCK_RANK.get(lk.order_class)
+            if r is None:
+                continue
+            same_rank_ok = (r == my_rank
+                            and (lk.order_index is None
+                                 or self.order_index is None
+                                 or lk.order_index < self.order_index))
+            if r > my_rank or (r == my_rank and not same_rank_ok):
+                raise DiagnosticsError(
+                    f"lock-order violation: acquiring {self.name!r} "
+                    f"(class {self.order_class!r}, rank {my_rank}, index "
+                    f"{self.order_index}) while holding {lk.name!r} (class "
+                    f"{lk.order_class!r}, rank {r}, index {lk.order_index}); "
+                    f"the declared order is {LOCK_ORDER}, ascending index "
+                    "within a class — see ANALYSIS.md (lock-order) and "
+                    "analysis/lockcheck.py "
+                    f"(thread {threading.current_thread().name})")
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        debug = lock_debug
+        if debug:
+            self._check_order()
         got = self._lock.acquire(False)
         if not got:
-            self.contentions += 1
+            with self._stats_lock:
+                self.contentions += 1
             if not blocking:
                 return False
             got = self._lock.acquire(True, timeout)
@@ -83,19 +162,27 @@ class TimedRLock:
         self._depth += 1
         if self._depth == 1:
             self._acquired_at = time.monotonic()
+        if debug:
+            _held_locks().append(self)
         return True
 
     def release(self):
         if self._depth == 1:
             held = time.monotonic() - self._acquired_at
             if held > HOLD_WARN_S:
-                self.long_holds += 1
+                with self._stats_lock:
+                    self.long_holds += 1
                 if enabled:
                     log.warning("%s held %.1fs (> %.1fs) — possible lock leak:\n%s",
                                 self.name, held, HOLD_WARN_S,
                                 "".join(traceback.format_stack(limit=8)))
         self._depth -= 1
         self._lock.release()
+        held_list = _held_locks()
+        for i in range(len(held_list) - 1, -1, -1):
+            if held_list[i] is self:
+                del held_list[i]
+                break
 
     def __enter__(self):
         self.acquire()

@@ -31,11 +31,21 @@ class Counter:
 
 
 class Gauge:
+    """``update`` is a plain rebind (GIL-atomic — no lock needed); any
+    read-modify-write MUST go through ``increment`` instead of
+    ``g.value += x``, which loses updates under concurrent dispatch threads
+    (filolint's lock-guard-inconsistent rule flags the latter)."""
+
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def update(self, v: float):
         self.value = float(v)
+
+    def increment(self, by: float = 1.0):
+        with self._lock:
+            self.value += by
 
 
 class Histogram:

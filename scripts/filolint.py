@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""filolint CLI wrapper — the CI/pre-merge entry point.
+
+Same engine as ``python -m filodb_tpu.analysis`` (pure ast, no jax import,
+safe without a TPU); exits non-zero on NEW findings and prints the per-rule
+summary that bench/CHANGES entries quote. Run from anywhere:
+
+    python scripts/filolint.py              # analyze filodb_tpu/
+    python scripts/filolint.py --quiet
+    python scripts/filolint.py filodb_tpu/query   # narrower scope
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+# import the analysis package standalone (filodb_tpu/__init__ pulls jax;
+# the linter must run on jax-less CI boxes and start in milliseconds)
+sys.path.insert(0, str(REPO_ROOT / "filodb_tpu"))
+
+from analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--root", str(REPO_ROOT), *sys.argv[1:]]))

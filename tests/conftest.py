@@ -13,6 +13,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# runtime lock-order assertions (diagnostics.LOCK_ORDER, the statically
+# derived order filolint checks): every tier-1 run doubles as a deadlock
+# canary — must be set before filodb_tpu.utils.diagnostics first imports
+os.environ.setdefault("FILODB_LOCK_DEBUG", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

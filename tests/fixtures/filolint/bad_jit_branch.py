@@ -1,0 +1,17 @@
+"""MUST flag jit-traced-branch: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, lo):
+    if x > lo:                          # BAD: branch on traced value
+        return x
+    return jnp.zeros_like(x)
+
+
+@jax.jit
+def drain(v):
+    while v > 0:                        # BAD: while on traced value
+        v = v - 1
+    return v

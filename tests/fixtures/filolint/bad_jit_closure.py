@@ -1,0 +1,18 @@
+"""MUST flag jit-mutable-closure: mutable module state read/written under
+trace."""
+import jax
+
+_CACHE = {}
+_WEIGHTS = [1.0, 2.0]
+
+
+@jax.jit
+def lookup(x):
+    return x * _WEIGHTS[0]              # BAD: frozen at trace time
+
+
+@jax.jit
+def memoize(x):
+    global _CACHE                       # BAD: never lands in compiled code
+    _CACHE = {}
+    return x

@@ -1,0 +1,21 @@
+"""MUST flag jit-static-args: float-typed / unhashable static arguments."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("q",))
+def quantile(x, q=0.99):                # BAD: float static default retraces
+    return x * q
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def windowed(x, bounds):
+    return x
+
+
+def caller(x):
+    a = windowed(x, [1, 2, 3])          # BAD: unhashable static value
+    b = windowed(x, bounds=[4, 5])      # BAD: unhashable via keyword
+    c = quantile(x, q=0.5)              # BAD: float literal static
+    return a, b, c

@@ -1,0 +1,29 @@
+"""MUST flag jit-host-sync: device→host syncs inside jitted functions."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def mean_to_float(x):
+    return float(jnp.mean(x))           # BAD: float() on traced value
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def first_item(x, op):
+    v = x[0]
+    return v.item()                     # BAD: .item() syncs
+
+
+@jax.jit
+def host_round_trip(x):
+    h = np.asarray(x)                   # BAD: np.asarray on traced value
+    return jnp.asarray(h)
+
+
+def factory():
+    def inner(x):
+        return jax.device_get(x)        # BAD: device_get inside jit
+    return jax.jit(inner)

@@ -1,0 +1,19 @@
+"""MUST flag lock-unheld-call: _locked method called without the owner lock."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rows = 0
+
+    def _ingest_locked(self, n):
+        self.rows += n
+
+    def ingest(self, n):
+        self._ingest_locked(n)          # BAD: no `with self.lock:` around it
+
+    def ingest_late_lock(self, n):
+        self._ingest_locked(n)          # BAD: lock taken only after the call
+        with self.lock:
+            pass

@@ -1,0 +1,16 @@
+"""MUST flag lock-guard-inconsistent: guarded RMW in one method, unguarded in
+another (the metrics lost-update shape)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0.0
+
+    def increment(self, by):
+        with self._lock:
+            self.total += by
+
+    def fast_increment(self, by):
+        self.total += by                # BAD: loses updates vs increment()

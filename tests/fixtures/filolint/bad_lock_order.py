@@ -1,0 +1,26 @@
+"""MUST flag lock-order (declared-order violation) and lock-order-cycle."""
+import threading
+
+from filodb_tpu.utils.diagnostics import TimedRLock
+
+
+class Shard:
+    def __init__(self):
+        self.lock = TimedRLock("shard", order_class="shard")
+        self._sink_lock = TimedRLock("sink", order_class="sink")
+        self._group_flush_locks = [threading.Lock()]
+
+    def backwards(self):
+        with self._sink_lock:
+            with self._group_flush_locks[0]:   # BAD: sink -> group_flush
+                pass
+
+    def ab(self):
+        with self._sink_lock:
+            with self.lock:                    # sink -> shard (fine alone...)
+                pass
+
+    def ba(self):
+        with self.lock:
+            with self._sink_lock:              # BAD: shard -> sink => cycle
+                pass

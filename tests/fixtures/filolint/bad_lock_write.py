@@ -1,0 +1,20 @@
+"""MUST flag lock-unheld-write: locked-state written from a non-holder."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.staged = []
+        self.count = 0
+
+    def _stage_locked(self, x):
+        self.staged.append(x)
+        self.count += 1
+
+    def reset(self):
+        self.staged = []                # BAD: _locked-managed state, no lock
+        self.count = 0                  # BAD
+
+    def drop_one(self):
+        self.staged.pop()               # BAD: container mutator, no lock

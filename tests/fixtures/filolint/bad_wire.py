@@ -1,0 +1,62 @@
+"""MUST flag wire-tag-parity, wire-nesting-bound (literal), and
+wire-error-classified (shadowed subclass). Analyzed with a custom WIRE_SPEC
+pointing codec/classifier at this file."""
+import struct
+
+_MAX_DEPTH = 4
+
+
+class QueryError(Exception):
+    pass
+
+
+class PeerGone(QueryError):
+    pass
+
+
+def _pack(tag, meta, arrays):
+    return tag
+
+
+def serialize_result(data):
+    if data == "agg":
+        return _pack(b"A", {}, [])
+    return b"X" + bytes(data)           # BAD: tag X has no decode branch
+
+
+def deserialize_result(buf):
+    tag = buf[:1]
+    if tag == b"A":
+        return "agg"
+    raise QueryError("unknown tag")
+
+
+def pack_multipart(parts):
+    return b"B" + struct.pack("<I", len(parts))
+
+
+def unpack_multipart(buf):
+    if buf[:1] != b"P":                 # BAD: decoder checks a different tag
+        raise ValueError("bad multipart")
+    return []
+
+
+def _enc_plan(d, depth=0):
+    if depth > 4:                       # BAD: literal bound can drift
+        raise ValueError("too deep")
+    return d
+
+
+def _dec_plan(d, depth=0):
+    if depth > _MAX_DEPTH:
+        raise ValueError("too deep")
+    return d
+
+
+def handle(fn):
+    try:
+        fn()
+    except QueryError:
+        return 422
+    except PeerGone:                    # BAD: shadowed by the ancestor above
+        return 503

@@ -1,0 +1,16 @@
+"""Must NOT flag: static/shape/None tests and data-parallel selects."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def dispatch(x, y, op):
+    if op == "sum":                     # ok: static arg
+        return x + y
+    if x.shape[0] > 1:                  # ok: shapes are trace-time
+        return x
+    if y is None:                       # ok: identity test
+        return x
+    return jnp.where(x > 0, x, y)       # ok: device-side select

@@ -1,0 +1,17 @@
+"""Must NOT flag: immutable module constants and passed-in state."""
+import jax
+import jax.numpy as jnp
+
+WEIGHTS = (1.0, 2.0)                    # ok: tuple is immutable
+SCALE = 4.0
+
+
+@jax.jit
+def lookup(x, weights):
+    return x * weights[0] * SCALE       # ok: constant + argument
+
+
+def outside(x):
+    cache = {}                          # ok: not jitted
+    cache["y"] = jnp.asarray(x)
+    return cache

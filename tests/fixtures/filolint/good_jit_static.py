@@ -1,0 +1,16 @@
+"""Must NOT flag: hashable static args (strings, ints, tuples), floats traced."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("fn", "num_groups"))
+def aggregate(x, q, fn, num_groups=8):
+    return x
+
+
+def caller(x):
+    a = aggregate(x, jnp.float64(0.99), "sum", num_groups=16)  # ok
+    b = aggregate(x, x, fn="avg", num_groups=4)                # ok
+    return a, b

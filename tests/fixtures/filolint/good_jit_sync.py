@@ -1,0 +1,25 @@
+"""Must NOT flag: trace-time host math on constants, jnp ops on traced data,
+and host syncs OUTSIDE the jitted function."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GAMMA = 1.02
+
+
+@jax.jit
+def uses_constants(x):
+    lg = float(np.log(GAMMA))           # ok: module-constant, trace-time
+    return x * lg
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def static_float_ok(x, scale):
+    return x * float(scale)             # ok: static args are Python values
+
+
+def driver(x):
+    y = uses_constants(jnp.asarray(x))
+    return float(np.asarray(y))         # ok: sync outside jit

@@ -1,0 +1,31 @@
+"""Must NOT flag: every *_locked call runs under a holder context."""
+import contextlib
+import threading
+
+from filodb_tpu.utils.diagnostics import assert_owned
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.rows = 0
+
+    def _ingest_locked(self, n):
+        self.rows += n
+
+    def _resolve_locked(self, n):
+        self._ingest_locked(n)          # ok: caller is itself _locked
+
+    def ingest(self, n):
+        with self.lock:                 # ok: lexical with
+            self._ingest_locked(n)
+
+    def ingest_many(self, shards, n):
+        with contextlib.ExitStack() as stack:
+            for sh in shards:
+                stack.enter_context(sh.lock)   # ok: ExitStack acquisition
+            self._ingest_locked(n)
+
+    def ingest_contract(self, n):
+        assert_owned(self.lock, "ingest_contract")   # ok: runtime-checked
+        self._ingest_locked(n)

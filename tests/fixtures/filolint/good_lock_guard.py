@@ -1,0 +1,19 @@
+"""Must NOT flag: consistent guarding; plain rebinds stay exempt."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0.0
+        self.last = 0.0
+
+    def increment(self, by):
+        with self._lock:
+            self.total += by
+
+    def update_last(self, v):
+        self.last = float(v)            # ok: plain rebind is GIL-atomic
+
+    def set_total(self, v):
+        self.total = float(v)           # ok: rebind, not read-modify-write

@@ -1,0 +1,26 @@
+"""Must NOT flag: acquisitions follow group_flush < sink < shard, including
+through one level of self-call propagation."""
+import threading
+
+from filodb_tpu.utils.diagnostics import TimedRLock
+
+
+class Shard:
+    def __init__(self):
+        self.lock = TimedRLock("shard", order_class="shard")
+        self._sink_lock = TimedRLock("sink", order_class="sink")
+        self._group_flush_locks = [threading.Lock()]
+
+    def flush_group(self):
+        with self._group_flush_locks[0]:
+            self._serialized()                 # group_flush -> {sink, shard}
+
+    def _serialized(self):
+        self.drain()
+        with self.lock:
+            pass
+
+    def drain(self):
+        with self._sink_lock:
+            with self.lock:                    # sink -> shard: ordered
+                pass

@@ -1,0 +1,21 @@
+"""Must NOT flag: locked-state writes happen under the lock (or in __init__)."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.staged = []                # ok: construction is single-threaded
+        self.count = 0
+
+    def _stage_locked(self, x):
+        self.staged.append(x)
+        self.count += 1
+
+    def reset(self):
+        with self.lock:
+            self.staged = []
+            self.count = 0
+
+    def untracked(self):
+        self.other = 1                  # ok: not _locked-managed state

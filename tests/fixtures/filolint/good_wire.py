@@ -1,0 +1,63 @@
+"""Must NOT flag: tag parity, one shared nesting constant, subclass handler
+before its ancestor."""
+import struct
+
+_MAX_DEPTH = 4
+
+
+class QueryError(Exception):
+    pass
+
+
+class PeerGone(QueryError):
+    pass
+
+
+def _pack(tag, meta, arrays):
+    return tag
+
+
+def serialize_result(data):
+    if data == "agg":
+        return _pack(b"A", {}, [])
+    return b"M" + bytes(data)
+
+
+def deserialize_result(buf):
+    tag = buf[:1]
+    if tag == b"M":
+        return "matrix"
+    if tag == b"A":
+        return "agg"
+    raise QueryError("unknown tag")
+
+
+def pack_multipart(parts):
+    return b"B" + struct.pack("<I", len(parts))
+
+
+def unpack_multipart(buf):
+    if buf[:1] != b"B":
+        raise ValueError("bad multipart")
+    return []
+
+
+def _enc_plan(d, depth=0):
+    if depth > _MAX_DEPTH:
+        raise ValueError("too deep")
+    return d
+
+
+def _dec_plan(d, depth=0):
+    if depth > _MAX_DEPTH:
+        raise ValueError("too deep")
+    return d
+
+
+def handle(fn):
+    try:
+        fn()
+    except PeerGone:
+        return 503
+    except QueryError:
+        return 422

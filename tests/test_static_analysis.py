@@ -1,0 +1,228 @@
+"""filolint self-enforcement (tier-1, pure AST — no device, no TPU).
+
+Three layers:
+  1. fixture self-tests — every rule has a known-bad snippet it MUST flag and
+     a known-good twin it must NOT (guards the analyzer against rotting into
+     a no-op);
+  2. repo enforcement — the filodb_tpu package analyzes to ZERO new findings
+     (inline suppressions and the checked-in baseline are the only escape
+     hatches);
+  3. runtime hook parity — the statically declared lock order matches
+     diagnostics.LOCK_ORDER, and the FILODB_LOCK_DEBUG assertion actually
+     fires on an out-of-order acquisition.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+import pytest
+
+from filodb_tpu.analysis import Baseline, analyze_file, run_analysis
+from filodb_tpu.analysis.findings import Finding, is_suppressed, \
+    load_suppressions
+from filodb_tpu.analysis.lockcheck import LOCK_ORDER as STATIC_LOCK_ORDER
+from filodb_tpu.analysis.wirecheck import WireChecker
+from filodb_tpu.utils import diagnostics
+from filodb_tpu.utils.diagnostics import LOCK_ORDER as RUNTIME_LOCK_ORDER
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "filolint"
+
+# fixture -> the rule(s) its bad twin MUST trip
+BAD_FIXTURES = {
+    "bad_lock_call.py": {"lock-unheld-call"},
+    "bad_lock_write.py": {"lock-unheld-write"},
+    "bad_lock_guard.py": {"lock-guard-inconsistent"},
+    "bad_lock_order.py": {"lock-order", "lock-order-cycle"},
+    "bad_jit_sync.py": {"jit-host-sync"},
+    "bad_jit_branch.py": {"jit-traced-branch"},
+    "bad_jit_closure.py": {"jit-mutable-closure"},
+    "bad_jit_static.py": {"jit-static-args"},
+}
+
+
+# -- 1. fixture self-tests ---------------------------------------------------
+
+@pytest.mark.parametrize("name,rules", sorted(BAD_FIXTURES.items()))
+def test_bad_fixture_is_flagged(name, rules):
+    findings = analyze_file(FIXTURES / name, root=REPO)
+    got = {f.rule for f in findings}
+    assert rules <= got, (
+        f"{name} must trip {sorted(rules)}, got {sorted(got)}:\n"
+        + "\n".join(f.render() for f in findings))
+
+
+@pytest.mark.parametrize("name", sorted(BAD_FIXTURES))
+def test_good_twin_is_clean(name):
+    good = name.replace("bad_", "good_")
+    findings = analyze_file(FIXTURES / good, root=REPO)
+    assert findings == [], (
+        f"{good} must be clean:\n" + "\n".join(f.render() for f in findings))
+
+
+def _wire_findings(codec: str, classifier: str | None = None):
+    spec = {
+        "wire_module": codec,
+        "classifier_module": classifier or codec,
+        "error_base_modules": [],
+        "codec_pairs": [("serialize_result", "deserialize_result"),
+                        ("pack_multipart", "unpack_multipart")],
+        "depth_pair": ("_enc_plan", "_dec_plan"),
+        "error_root": "QueryError",
+    }
+    w = WireChecker(spec=spec)
+    for rel in {codec, spec["classifier_module"]}:
+        p = REPO / rel
+        if p.exists():
+            w.check_module(rel, ast.parse(p.read_text()))
+    return w.finalize()
+
+
+def test_bad_wire_fixture_is_flagged():
+    rel = "tests/fixtures/filolint/bad_wire.py"
+    findings = _wire_findings(rel)
+    by_rule = {f.rule: f for f in findings}
+    details = {f.detail for f in findings}
+    assert "wire-tag-parity" in by_rule
+    assert "undecoded:b'X'" in details          # result codec drift
+    assert "undecoded:b'B'" in details          # multipart drift (B vs P)
+    assert "unencoded:b'P'" in details
+    assert any(f.rule == "wire-nesting-bound" and f.detail == "literal-bound"
+               for f in findings)
+    assert any(f.rule == "wire-error-classified"
+               and f.detail == "shadowed:PeerGone" for f in findings)
+
+
+def test_bad_wire_unclassified_when_no_dispatch_table():
+    # classifier module with no try/except at all: every typed error is
+    # unclassified
+    rel = "tests/fixtures/filolint/bad_wire.py"
+    findings = _wire_findings(rel,
+                              classifier="tests/fixtures/filolint/good_jit_closure.py")
+    unclassified = {f.detail for f in findings
+                    if f.rule == "wire-error-classified"}
+    assert "unclassified:PeerGone" in unclassified
+    assert "unclassified:QueryError" in unclassified
+
+
+def test_good_wire_fixture_is_clean():
+    findings = _wire_findings("tests/fixtures/filolint/good_wire.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_real_wire_module_tags_are_exhaustive():
+    """The production codec pair itself (not just the repo-wide zero-findings
+    gate): both directions enumerate the same envelope tags today."""
+    from filodb_tpu.analysis.wirecheck import _byte_tags, _functions
+    tree = ast.parse((REPO / "filodb_tpu/query/wire.py").read_text())
+    fns = _functions(tree)
+    enc = set(_byte_tags(fns["serialize_result"]))
+    dec = set(_byte_tags(fns["deserialize_result"]))
+    assert enc == dec and {b"A", b"T", b"S", b"C", b"M"} <= enc
+
+
+# -- suppression / baseline mechanics ---------------------------------------
+
+def test_inline_suppression(tmp_path):
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.RLock()\n"
+        "    def _f_locked(self):\n"
+        "        pass\n"
+        "    def g(self):\n"
+        "        self._f_locked()  # filolint: ignore[lock-unheld-call]\n"
+    )
+    p = tmp_path / "supp.py"
+    p.write_text(src)
+    assert analyze_file(p, root=tmp_path) == []
+    # and without the comment it DOES flag
+    p.write_text(src.replace("  # filolint: ignore[lock-unheld-call]", ""))
+    assert [f.rule for f in analyze_file(p, root=tmp_path)] \
+        == ["lock-unheld-call"]
+
+
+def test_skip_file_suppression():
+    supp = load_suppressions("# filolint: skip-file\nx = 1\n")
+    f = Finding("lock-unheld-call", "x.py", 2, "m", "d", "msg")
+    assert is_suppressed(f, supp)
+
+
+def test_baseline_matches_by_fingerprint_not_line():
+    f = Finding("lock-unheld-call", "pkg/m.py", 10, "C.m", "call:_x_locked",
+                "msg")
+    b = Baseline([{"rule": "lock-unheld-call", "file": "pkg/m.py",
+                   "symbol": "C.m", "detail": "call:_x_locked",
+                   "reason": "caller holds by contract"}])
+    assert b.covers(f)
+    moved = Finding("lock-unheld-call", "pkg/m.py", 99, "C.m",
+                    "call:_x_locked", "msg")
+    assert b.covers(moved)      # line drift doesn't invalidate the entry
+    other = Finding("lock-unheld-call", "pkg/m.py", 10, "C.n",
+                    "call:_x_locked", "msg")
+    assert not b.covers(other)
+
+
+# -- 2. repo enforcement ------------------------------------------------------
+
+def test_repo_has_zero_unsuppressed_findings():
+    report = run_analysis(REPO)
+    assert report.files_analyzed > 50
+    assert report.new == [], (
+        "filolint found NEW violations — fix them, suppress inline with a "
+        "reason, or baseline them:\n"
+        + "\n".join(f.render() for f in report.new))
+
+
+def test_cli_exit_status():
+    from filodb_tpu.analysis.__main__ import main
+    assert main(["--root", str(REPO), "--quiet"]) == 0
+
+
+# -- 3. runtime hook parity ---------------------------------------------------
+
+def test_lock_order_declared_once():
+    assert STATIC_LOCK_ORDER == RUNTIME_LOCK_ORDER
+
+
+def test_runtime_lock_order_assert_fires():
+    was = diagnostics.lock_debug
+    diagnostics.enable_lock_debug(True)
+    try:
+        shard = diagnostics.TimedRLock("t-shard", order_class="shard",
+                                       order_index=0)
+        shard1 = diagnostics.TimedRLock("t-shard-1", order_class="shard",
+                                        order_index=1)
+        sink = diagnostics.TimedRLock("t-sink", order_class="sink")
+        grp = diagnostics.TimedRLock("t-grp", order_class="group_flush")
+        # declared order is fine, including reentrancy and ascending
+        # same-class indexes (the engine's multi-shard ExitStack shape)
+        with grp, sink, shard, shard, shard1:
+            pass
+        # out of order: shard then sink must raise BEFORE blocking
+        with shard:
+            with pytest.raises(diagnostics.DiagnosticsError):
+                sink.acquire()
+        # same class, DESCENDING index: the ABBA shape
+        with shard1:
+            with pytest.raises(diagnostics.DiagnosticsError):
+                shard.acquire()
+        # the failed acquisitions must not have left state behind
+        with grp, sink, shard:
+            pass
+    finally:
+        diagnostics.enable_lock_debug(was)
+
+
+def test_memstore_locks_are_ordered():
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("lintcheck", "gauge", 0,
+                  StoreConfig(max_series_per_shard=8, samples_per_series=16))
+    assert sh.lock.order_class == "shard"
+    assert sh._sink_lock.order_class == "sink"
+    assert all(lk.order_class == "group_flush"
+               for lk in sh._group_flush_locks)
