@@ -8,7 +8,12 @@ i.e. a cross-node incident, not a unit-test failure.
 
   * ``wire-tag-parity`` — every single-byte tag literal the encode side
     (serialize_result / pack_multipart) emits must be matched on the decode
-    side (deserialize_result / unpack_multipart), and vice versa.
+    side (deserialize_result / unpack_multipart), and vice versa. The same
+    rule also covers REQUEST-OP constants of the framework's TCP services
+    (``op_specs`` below): every ``OP_*`` constant a module defines must be
+    dispatched by the server function AND sent by the client class — a new
+    op wired on only one side is a live protocol desync — and two op names
+    must never share a value.
   * ``wire-nesting-bound`` — the plan envelope's nesting bound must be ONE
     shared module constant compared on both _enc_plan and _dec_plan (a
     literal on either side lets the sides drift: the planner would ship
@@ -41,6 +46,13 @@ WIRE_SPEC = {
     "depth_pair": ("_enc_plan", "_dec_plan"),
     # the root of the typed-error hierarchy the HTTP layer classifies
     "error_root": "QueryError",
+    # request-op constant parity for the framework's TCP services: every
+    # `prefix`-named module constant must be dispatched in `server_fn` and
+    # sent from `client_class`
+    "op_specs": [
+        {"module": "filodb_tpu/ingest/broker.py", "prefix": "OP_",
+         "server_fn": "_serve", "client_class": "BrokerBus"},
+    ],
 }
 
 
@@ -72,15 +84,18 @@ class WireChecker:
         return []
 
     def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
         wire_path = self.spec["wire_module"]
         wire = self._modules.get(wire_path)
-        if wire is None:
-            return []
-        findings: list[Finding] = []
-        fns = _functions(wire)
-        findings += self._tag_parity(wire_path, fns)
-        findings += self._nesting_bound(wire_path, wire, fns)
-        findings += self._error_classified(wire_path, wire)
+        if wire is not None:
+            fns = _functions(wire)
+            findings += self._tag_parity(wire_path, fns)
+            findings += self._nesting_bound(wire_path, wire, fns)
+            findings += self._error_classified(wire_path, wire)
+        for op_spec in self.spec.get("op_specs", ()):
+            tree = self._modules.get(op_spec["module"])
+            if tree is not None:
+                findings += self._op_parity(op_spec, tree)
         return findings
 
     # -- tags --------------------------------------------------------------
@@ -115,6 +130,81 @@ class WireChecker:
                         f"decode branch for tag {tag!r} in {dec_name}() has "
                         f"no encoder in {enc_name}() — dead protocol arm or "
                         "a missing encode path"))
+        return findings
+
+    # -- request-op constants -----------------------------------------------
+
+    def _op_parity(self, spec: dict, tree: ast.Module) -> list[Finding]:
+        """Every `prefix`-named module constant must be referenced by the
+        server dispatch function AND by the client class, and op values must
+        be distinct (two ops sharing a value silently route one to the
+        other's branch)."""
+        path, prefix = spec["module"], spec["prefix"]
+        consts: dict[str, tuple[int, object]] = {}   # name -> (line, value)
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.startswith(prefix):
+                    v = node.value
+                    consts[tgt.id] = (node.lineno,
+                                      v.value if isinstance(v, ast.Constant)
+                                      else None)
+                elif isinstance(tgt, ast.Tuple) \
+                        and isinstance(node.value, ast.Tuple) \
+                        and len(tgt.elts) == len(node.value.elts):
+                    for el, val in zip(tgt.elts, node.value.elts):
+                        if isinstance(el, ast.Name) \
+                                and el.id.startswith(prefix):
+                            consts[el.id] = (node.lineno,
+                                             val.value if isinstance(
+                                                 val, ast.Constant) else None)
+        if not consts:
+            return []
+        findings: list[Finding] = []
+        by_value: dict[object, str] = {}
+        for name, (line, value) in sorted(consts.items()):
+            if value is not None and value in by_value:
+                findings.append(Finding(
+                    "wire-tag-parity", path, line, "<module>",
+                    f"op-collision:{name}",
+                    f"op constant {name} shares value {value!r} with "
+                    f"{by_value[value]} — the server dispatches one of them "
+                    "as the other"))
+            elif value is not None:
+                by_value[value] = name
+        server = client = None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == spec["server_fn"] and server is None:
+                server = node
+            if isinstance(node, ast.ClassDef) \
+                    and node.name == spec["client_class"]:
+                client = node
+        for role, scope, missing_fn in ((
+                "server", server, spec["server_fn"]),
+                ("client", client, spec["client_class"])):
+            if scope is None:
+                findings.append(Finding(
+                    "wire-tag-parity", path, 1, "<module>",
+                    f"missing-{role}:{missing_fn}",
+                    f"op {role} {missing_fn} not found — update "
+                    "analysis/wirecheck.WIRE_SPEC op_specs if it moved"))
+                continue
+            used = {n.id for n in ast.walk(scope)
+                    if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+            for name, (line, _value) in sorted(consts.items()):
+                if name not in used:
+                    side = ("no dispatch branch in the server — clients "
+                            "sending it get 'unknown op'"
+                            if role == "server" else
+                            "never sent by the client — dead protocol arm "
+                            "or a missing send path")
+                    findings.append(Finding(
+                        "wire-tag-parity", path, line, missing_fn,
+                        f"op-un{'served' if role == 'server' else 'sent'}:"
+                        f"{name}",
+                        f"op constant {name} has {side}"))
         return findings
 
     # -- nesting bound ------------------------------------------------------

@@ -61,6 +61,22 @@ DEFAULTS: dict[str, Any] = {
         "resolutions": ["1m"],
         "cascade_interval": "6h",
     },
+    # ingest-plane pipeline knobs (gateway -> broker -> shard consumer):
+    #   publish_window          frames per broker PUBLISH_BATCH round trip /
+    #                           in-flight window of the windowed publisher
+    #   decode_ahead            containers decoded ahead of the device scatter
+    #                           (IngestionConsumer double buffering; 0 = serial)
+    #   gateway_port            enables the Influx line-protocol TCP gateway
+    #                           on the standalone server (None = off; 0 = any)
+    #   gateway_flush_lines     size bound per (connection, shard) batch
+    #   gateway_flush_interval  time bound so low-rate shards still land
+    "ingest": {
+        "publish_window": 64,
+        "decode_ahead": 2,
+        "gateway_port": None,
+        "gateway_flush_lines": 1000,
+        "gateway_flush_interval": "500ms",
+    },
     "http": {"host": "127.0.0.1", "port": 8080},
     "data_dir": None,            # enables the durable FileColumnStore when set
     "bus_dir": None,             # enables FileBus ingestion when set

@@ -567,10 +567,17 @@ class TimeSeriesShard:
         self._pending_offset = max(self._pending_offset, offset)
         self.stats.rows_ingested += len(ts)
         if self.sink is not None:
+            # one stable argsort + split instead of a full-array mask per
+            # group: the staging path runs per container on the ingest hot
+            # loop, and G masks are G passes over the batch
             groups = pids % self.config.groups_per_shard
-            for g in np.unique(groups):
-                sel = groups == g
-                self._pending_chunks[g].append((pids[sel], ts[sel], vals[sel]))
+            order = np.argsort(groups, kind="stable")
+            gs = groups[order]
+            for idx in np.split(order, np.flatnonzero(np.diff(gs)) + 1):
+                if not len(idx):
+                    continue
+                g = int(groups[idx[0]])
+                self._pending_chunks[g].append((pids[idx], ts[idx], vals[idx]))
                 self._pending_group_offset[g] = max(self._pending_group_offset[g], offset)
 
     def _flush_staged_locked(self) -> int:

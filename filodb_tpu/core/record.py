@@ -254,7 +254,9 @@ class RecordBuilder:
         (ref: BinaryRecords carry their part-key region; RecordBuilder
         sortAndComputeHashes batches the hash work)."""
         items = sorted(labels.items())
-        key = tuple(items)
+        return self._intern_key(tuple(items), items, labels)
+
+    def _intern_key(self, key: tuple, items: list, labels: dict) -> int:
         idx = self._label_key_to_idx.get(key)
         if idx is None:
             cached = self._hash_cache.get(key)
@@ -312,6 +314,23 @@ class RecordBuilder:
         self._batch_cols = None       # mixed container: no columnar shortcut
         self._to_list_labels()
         idx = self._intern(labels)
+        self._ts.append(ts_ms)
+        if self.schema.is_multi_column:
+            value = self._flatten_value(value)
+        self._vals.append(value)
+        self._pidx.append(idx)
+
+    def add_interned(self, key: tuple, labels: dict[str, str], ts_ms: int,
+                     value) -> None:
+        """``add`` with a caller-memoized canonical key (the sorted
+        ``labels.items()`` tuple): long-lived per-line ingest paths (the
+        gateway's route memo) skip the per-record sort + tuple build — the
+        hot-loop cost drops to one dict probe + three list appends."""
+        self._batch_cols = None       # mixed container: no columnar shortcut
+        self._to_list_labels()
+        idx = self._label_key_to_idx.get(key)
+        if idx is None:
+            idx = self._intern_key(key, list(key), labels)
         self._ts.append(ts_ms)
         if self.schema.is_multi_column:
             value = self._flatten_value(value)

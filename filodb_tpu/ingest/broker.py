@@ -20,9 +20,19 @@ Wire protocol (all little-endian, one request/response per round trip):
        FETCH   (offset=from_offset; payload_len field carries max_frames;
                 returns concatenated [offset:u64 len:u32 bytes] entries)
        END     (returns the partition's end offset)
+       PUBLISH_BATCH (payload=concatenated [pub_id:u64 len:u32 bytes] frames —
+                MANY publishes per round trip, the publish-side mirror of
+                FETCH's response batching; the offset field carries the frame
+                count. Per-frame publish ids keep retries duplicate-free
+                exactly like PUBLISH. Response payload: one u64 assigned
+                offset per frame, in request order)
 
 `BrokerBus` is a drop-in for FileBus (publish/consume/end_offset), so the
 standalone server's IngestionConsumer works unchanged against a remote broker.
+Its windowed publisher (`publish_async`/`publish_batch`/`flush_publishes`)
+pipelines PUBLISH_BATCH requests: F frames with window W cost at most
+ceil(F/W) round trips, and all of a drain's requests are on the wire before
+the first response is read.
 """
 
 from __future__ import annotations
@@ -41,10 +51,38 @@ _REQ = struct.Struct("<B I Q I")
 _RESP = struct.Struct("<B Q I")
 _ENTRY = struct.Struct("<Q I")
 
-OP_PUBLISH, OP_FETCH, OP_END = 1, 2, 3
+OP_PUBLISH, OP_FETCH, OP_END, OP_PUBLISH_BATCH = 1, 2, 3, 4
 ST_OK, ST_ERR = 0, 1
 
 _MAX_PAYLOAD = 64 << 20     # refuse absurd frames instead of OOMing
+_RECENT_IDS_MAX = 4096      # retry-able publish ids remembered per partition
+_MAX_BATCH_BYTES = 8 << 20  # per-PUBLISH_BATCH payload bound (well under
+                            # _MAX_PAYLOAD, so the broker never severs a
+                            # batched connection for size)
+# unacked frames per pipelined group: half the broker's id window, so a full
+# group replay after a lost connection still resolves every id (no silent
+# duplicates), and the unread-response backlog stays far below socket buffers
+_MAX_UNACKED_FRAMES = _RECENT_IDS_MAX // 2
+
+
+def _remember_id(recent: dict[int, int], pub_id: int, off: int,
+                 limit: int) -> None:
+    """Record a publish id -> offset (caller holds the partition's publish
+    lock). Eviction is strictly oldest-first, one at a time — dicts iterate
+    in insertion order and retry hits re-insert, so a recently retried id is
+    never the one evicted."""
+    recent[pub_id] = off
+    while len(recent) > limit:
+        recent.pop(next(iter(recent)))
+
+
+def _recall_id(recent: dict[int, int], pub_id: int) -> int | None:
+    """Offset of an already-seen publish id, refreshing its recency (caller
+    holds the partition's publish lock)."""
+    off = recent.pop(pub_id, None)
+    if off is not None:
+        recent[pub_id] = off
+    return off
 
 
 from ..utils.netio import recv_exact as _recv_exact  # noqa: E402 - shared framing helper
@@ -54,13 +92,20 @@ class BrokerServer:
     """Hosts partitions 0..num_partitions-1, each a durable FileBus log."""
 
     def __init__(self, data_dir: str, num_partitions: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 recent_ids_max: int = _RECENT_IDS_MAX):
+        """``recent_ids_max`` below the default weakens the windowed
+        publisher's replay idempotence: BrokerBus bounds a pipelined group to
+        ``_RECENT_IDS_MAX // 2`` unacked frames on the assumption the server
+        remembers at least the module default — shrink it only in tests that
+        exercise eviction itself."""
         os.makedirs(data_dir, exist_ok=True)
         self._parts = [FileBus(os.path.join(data_dir, f"partition{p}.log"))
                        for p in range(num_partitions)]
         # publish idempotence: recent publish-id -> offset per partition, so a
         # client retry after a lost response doesn't append a duplicate frame
         self._recent_ids: list[dict[int, int]] = [{} for _ in range(num_partitions)]
+        self._recent_ids_max = int(recent_ids_max)
         self._publish_locks = [threading.Lock() for _ in range(num_partitions)]
         # live client connections, so stop() actually severs them (handler
         # threads would otherwise keep serving a "stopped" broker)
@@ -79,7 +124,8 @@ class BrokerServer:
                         if plen > _MAX_PAYLOAD:
                             raise ValueError(f"frame too large: {plen}")
                         payload = _recv_exact(self.request, plen) \
-                            if op == OP_PUBLISH and plen else b""
+                            if op in (OP_PUBLISH, OP_PUBLISH_BATCH) and plen \
+                            else b""
                         self.request.sendall(outer._serve(op, part, offset,
                                                           plen, payload))
                 except (ConnectionError, OSError):
@@ -105,15 +151,51 @@ class BrokerServer:
                 pub_id = offset                 # request offset field = publish id
                 with self._publish_locks[part]:
                     recent = self._recent_ids[part]
-                    if pub_id and pub_id in recent:
-                        return _RESP.pack(ST_OK, recent[pub_id], 0)
-                    off = bus.publish_bytes(payload)
-                    if pub_id:
-                        recent[pub_id] = off
-                        if len(recent) > 4096:  # bounded window of retry-able ids
-                            for k in list(recent)[:2048]:
-                                del recent[k]
+                    off = _recall_id(recent, pub_id) if pub_id else None
+                    if off is None:
+                        off = bus.publish_bytes(payload)
+                        if pub_id:
+                            _remember_id(recent, pub_id, off,
+                                         self._recent_ids_max)
                 return _RESP.pack(ST_OK, off, 0)
+            if op == OP_PUBLISH_BATCH:
+                entries = []                    # (pub_id, frame bytes)
+                pos = 0
+                while pos < len(payload):
+                    pid, ln = _ENTRY.unpack_from(payload, pos)
+                    pos += _ENTRY.size
+                    entries.append((pid, payload[pos:pos + ln]))
+                    pos += ln
+                offs = [0] * len(entries)
+                with self._publish_locks[part]:
+                    recent = self._recent_ids[part]
+                    fresh: list[int] = []       # indexes needing an append
+                    first_idx: dict[int, int] = {}
+                    alias: dict[int, int] = {}  # in-batch duplicate ids
+                    for i, (pid, _frame) in enumerate(entries):
+                        off = _recall_id(recent, pid) if pid else None
+                        if off is not None:
+                            offs[i] = off
+                        elif pid and pid in first_idx:
+                            alias[i] = first_idx[pid]
+                        else:
+                            fresh.append(i)
+                            if pid:
+                                first_idx[pid] = i
+                    # one open+write for the whole batch — per-frame appends
+                    # would re-open the log file once per frame
+                    new_offs = bus.publish_many_bytes(
+                        [entries[i][1] for i in fresh])
+                    for i, off in zip(fresh, new_offs):
+                        offs[i] = off
+                        pid = entries[i][0]
+                        if pid:
+                            _remember_id(recent, pid, off,
+                                         self._recent_ids_max)
+                    for i, j in alias.items():
+                        offs[i] = offs[j]
+                body = struct.pack(f"<{len(offs)}Q", *offs)
+                return _RESP.pack(ST_OK, bus.end_offset, len(body)) + body
             if op == OP_FETCH:
                 max_frames = plen or 1024
                 out = bytearray()
@@ -160,46 +242,164 @@ class BrokerServer:
 
 
 class BrokerBus:
-    """Client for one broker partition; drop-in for FileBus."""
+    """Client for one broker partition; drop-in for FileBus.
 
-    def __init__(self, addr: str, partition: int):
+    ``publish`` is the one-frame-per-round-trip op. The windowed publisher
+    (``publish_async``/``publish_batch``/``flush_publishes``) buffers frames
+    and ships them as pipelined PUBLISH_BATCH requests of up to
+    ``publish_window`` frames each — F frames cost at most ceil(F/W) round
+    trips, and every frame keeps its own idempotent publish id so a replay
+    after a lost response (or a reconnect) never appends duplicates.
+    ``requests`` counts round trips for tests/benchmarks."""
+
+    def __init__(self, addr: str, partition: int, publish_window: int = 64):
         host, _, port = addr.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self.partition = partition
+        self.publish_window = max(1, int(publish_window))
         self._sock: socket.socket | None = None
-        self._lock = threading.Lock()   # one in-flight request per client
+        self._lock = threading.Lock()   # one in-flight exchange per client
+        self._pending: list[tuple[int, bytes]] = []   # (pub_id, frame)
+        self.requests = 0               # round-trip count (instrumentation)
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.create_connection(self._addr, timeout=30)
         return self._sock
 
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _read_resp_locked(self, s: socket.socket) -> tuple[int, int, bytes]:
+        st, off, rlen = _RESP.unpack(_recv_exact(s, _RESP.size))
+        return st, off, _recv_exact(s, rlen) if rlen else b""
+
+    def _exchange_locked(self, op: int, offset: int, plen: int,
+                         payload: bytes) -> tuple[int, int, bytes]:
+        for attempt in (0, 1):          # one reconnect on a stale connection
+            try:
+                s = self._conn_locked()
+                s.sendall(_REQ.pack(op, self.partition, offset, plen) + payload)
+                self.requests += 1
+                st, off, body = self._read_resp_locked(s)
+                return st, off, body
+            except (ConnectionError, OSError):
+                self._close_locked()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
     def _request(self, op: int, offset: int = 0, plen: int = 0,
                  payload: bytes = b"") -> tuple[int, bytes]:
         with self._lock:
-            for attempt in (0, 1):      # one reconnect on a stale connection
-                try:
-                    s = self._conn()
-                    s.sendall(_REQ.pack(op, self.partition, offset, plen) + payload)
-                    st, off, rlen = _RESP.unpack(_recv_exact(s, _RESP.size))
-                    body = _recv_exact(s, rlen) if rlen else b""
-                    break
-                except (ConnectionError, OSError):
-                    self.close()
-                    if attempt:
-                        raise
+            st, off, body = self._exchange_locked(op, offset, plen, payload)
         if st == ST_ERR:
             raise RuntimeError(f"broker error: {body.decode(errors='replace')}")
         return off, body
 
-    def publish(self, container: RecordContainer) -> int:
-        payload = container.to_bytes()
+    @staticmethod
+    def _pub_id() -> int:
         # stable random id across the internal reconnect retry: if the broker
         # committed the append but the response was lost, the retry is a no-op
-        pub_id = int.from_bytes(os.urandom(8), "little") | 1
-        off, _ = self._request(OP_PUBLISH, offset=pub_id,
+        return int.from_bytes(os.urandom(8), "little") | 1
+
+    def publish(self, container: RecordContainer) -> int:
+        payload = container.to_bytes()
+        off, _ = self._request(OP_PUBLISH, offset=self._pub_id(),
                                plen=len(payload), payload=payload)
         return off
+
+    def publish_async(self, container: RecordContainer) -> None:
+        """Queue one frame; a full window drains automatically (one
+        PUBLISH_BATCH round trip). Call ``flush_publishes`` to drain the
+        remainder — assigned offsets surface there."""
+        payload = container.to_bytes()
+        with self._lock:
+            self._pending.append((self._pub_id(), payload))
+            if len(self._pending) >= self.publish_window:
+                self._drain_pending_locked()
+
+    def publish_batch(self, containers) -> list[int]:
+        """Publish many containers in ceil(n/window) pipelined round trips;
+        returns their assigned offsets (plus any earlier async remainder's,
+        in queue order)."""
+        with self._lock:
+            for c in containers:
+                self._pending.append((self._pub_id(), c.to_bytes()))
+            return self._drain_pending_locked()
+
+    def flush_publishes(self) -> list[int]:
+        """Drain queued async publishes; returns their assigned offsets."""
+        with self._lock:
+            return self._drain_pending_locked()
+
+    def _next_group_locked(self) -> tuple[list[list], int]:
+        """Head of the pending queue as PUBLISH_BATCH chunks: each chunk at
+        most ``publish_window`` frames AND ``_MAX_BATCH_BYTES`` of payload;
+        the group at most ``_MAX_UNACKED_FRAMES`` frames total."""
+        chunks: list[list] = []
+        cur: list = []
+        cur_bytes = taken = 0
+        for pid, frame in self._pending:
+            if taken >= _MAX_UNACKED_FRAMES:
+                break
+            entry = _ENTRY.size + len(frame)
+            if cur and (len(cur) >= self.publish_window
+                        or cur_bytes + entry > _MAX_BATCH_BYTES):
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append((pid, frame))
+            cur_bytes += entry
+            taken += 1
+        if cur:
+            chunks.append(cur)
+        return chunks, taken
+
+    def _drain_pending_locked(self) -> list[int]:
+        offs: list[int] = []
+        while self._pending:
+            chunks, taken = self._next_group_locked()
+            # pipeline WITHIN a bounded group: all of the group's requests go
+            # on the wire before its first response is read (the broker
+            # serves one connection serially, so responses arrive in order),
+            # then the group commits and drops off the pending queue. A
+            # replay after a lost connection re-sends the SAME publish ids,
+            # which the broker resolves to the original offsets — and a
+            # group never exceeds half the broker's id window, so none of
+            # its ids can have been evicted by its own replay.
+            for attempt in (0, 1):
+                try:
+                    s = self._conn_locked()
+                    for ch in chunks:
+                        payload = b"".join(_ENTRY.pack(pid, len(f)) + f
+                                           for pid, f in ch)
+                        s.sendall(_REQ.pack(OP_PUBLISH_BATCH, self.partition,
+                                            len(ch), len(payload)) + payload)
+                        self.requests += 1
+                    group_offs: list[int] = []
+                    err: bytes | None = None
+                    for ch in chunks:   # drain EVERY response before raising
+                        st, _end, body = self._read_resp_locked(s)
+                        if st == ST_ERR:
+                            err = err or body
+                        else:
+                            group_offs.extend(
+                                struct.unpack(f"<{len(ch)}Q", body))
+                    if err is not None:
+                        raise RuntimeError(
+                            f"broker error: {err.decode(errors='replace')}")
+                    break
+                except (ConnectionError, OSError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+            del self._pending[:taken]   # commit per group: a later failure
+            offs.extend(group_offs)     # never replays acked frames
+        return offs
 
     def consume(self, schemas, from_offset: int = 0) -> Iterator[tuple[int, RecordContainer]]:
         """Replay containers from ``from_offset`` up to the end offset observed
@@ -233,8 +433,5 @@ class BrokerBus:
         return off
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        with self._lock:
+            self._close_locked()

@@ -8,7 +8,9 @@ checkpoints, then live consumption with status events).
 
 from __future__ import annotations
 
+import itertools
 import logging
+import queue
 import threading
 import time
 
@@ -27,12 +29,72 @@ from .utils.tracing import tracer
 log = logging.getLogger("filodb_tpu.server")
 
 
+class _DecodeAhead:
+    """Double-buffered container decode: a daemon thread pulls (offset,
+    container) pairs from the bus iterator into a bounded queue, so the
+    host-side decode (network read + ``RecordContainer.from_bytes``) of batch
+    N+1 overlaps the shard's device scatter of batch N. Offsets are committed
+    by the CONSUMER after ingest exactly as before — decoded-but-undelivered
+    containers are simply re-fetched after a fault, so checkpoint/durability
+    semantics are unchanged."""
+
+    _END = object()
+
+    def __init__(self, it, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._err: BaseException | None = None
+        self._closed = False
+        threading.Thread(target=self._fill, args=(it,), daemon=True,
+                         name="ingest-decode").start()
+
+    def _fill(self, it) -> None:
+        try:
+            for item in it:
+                while not self._closed:
+                    try:
+                        self._q.put(item, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+                if self._closed:
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            self._err = e
+        while not self._closed:
+            try:
+                self._q.put(self._END, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Unblock and retire the fill thread after an early exit."""
+        self._closed = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 class IngestionConsumer(threading.Thread):
     """Per-shard bus consumer (ref: IngestionActor drives memStore.ingestStream /
     recoverStream with RecoveryInProgress -> IngestionStarted events)."""
 
     def __init__(self, shard, bus: FileBus, schemas, manager: ShardManager,
-                 dataset: str, poll_s: float = 0.5, purge_interval_s: float = 600.0):
+                 dataset: str, poll_s: float = 0.5, purge_interval_s: float = 600.0,
+                 decode_ahead: int = 2):
         super().__init__(daemon=True, name=f"ingest-{dataset}-{shard.shard_num}")
         self.shard = shard
         self.bus = bus
@@ -41,6 +103,7 @@ class IngestionConsumer(threading.Thread):
         self.dataset = dataset
         self.poll_s = poll_s
         self.purge_interval_s = purge_interval_s
+        self.decode_ahead = decode_ahead
         self._stop_ev = threading.Event()
         self._offset = 0
 
@@ -102,10 +165,21 @@ class IngestionConsumer(threading.Thread):
                 # error (RuntimeError, e.g. bad partition) or an ingest fault
                 # is permanent and fails the shard loudly via the outer handler
                 try:
-                    for off, container in self.bus.consume(self.schemas, self._offset):
-                        sh.ingest(container, off)
-                        rows.increment(len(container))
-                        self._offset = off + 1
+                    src = self.bus.consume(self.schemas, self._offset)
+                    # peek before spinning up the decode thread: an idle
+                    # poll (the common case) must not create a thread
+                    first = next(src, None)
+                    if first is not None:
+                        if self.decode_ahead:
+                            src = _DecodeAhead(src, self.decode_ahead)
+                        try:
+                            for off, container in itertools.chain([first], src):
+                                sh.ingest(container, off)
+                                rows.increment(len(container))
+                                self._offset = off + 1
+                        finally:
+                            if isinstance(src, _DecodeAhead):
+                                src.close()
                 except (ConnectionError, OSError):
                     backoff = min(max(1.0, backoff * 2), 30.0)
                     log.warning("bus unavailable for shard %s; retrying in %.0fs",
@@ -145,6 +219,9 @@ class FiloServer:
         self.manager.add_node(node_name)
         self.consumers: list[IngestionConsumer] = []
         self.http: FiloHttpServer | None = None
+        self.gateway = None
+        self._gw_buses: dict[int, object] = {}
+        self._gw_flush_stop: threading.Event | None = None
         self.scheduler = None
         self.engines: dict[str, QueryEngine] = {}
         self.profiler = None
@@ -217,13 +294,16 @@ class FiloServer:
                 # remote broker: shard N == broker partition N (ref: Kafka
                 # PartitionStrategy, 1 shard == 1 partition)
                 from .ingest.broker import BrokerBus
-                bus = BrokerBus(cfg["bus_addr"], shard_num)
+                bus = BrokerBus(cfg["bus_addr"], shard_num,
+                                publish_window=cfg.get("ingest.publish_window",
+                                                       64))
             else:
                 bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
             c = IngestionConsumer(shard, bus, self.memstore.schemas,
                                   self.manager, dataset,
                                   purge_interval_s=parse_duration_ms(
-                                      cfg.get("store.purge_interval", "10m")) / 1000.0)
+                                      cfg.get("store.purge_interval", "10m")) / 1000.0,
+                                  decode_ahead=cfg.get("ingest.decode_ahead", 2))
             with self._shards_lock:
                 if self._quarantined:       # raced quarantine: do not start
                     self._running.discard(shard_num)
@@ -413,6 +493,57 @@ class FiloServer:
                                    port=cfg["http.port"], cluster=self.manager,
                                    writers={dataset: writer},
                                    scheduler=self.scheduler).start()
+        if cfg.get("ingest.gateway_port") is not None:
+            # Influx line-protocol gateway, config-wired: lines route to ALL
+            # broker partitions (owned or not — the broker is global), or
+            # straight into the local memstore when no bus is configured.
+            # Broker publishes ride the windowed PUBLISH_BATCH path; sub-
+            # window remainders drain on the gateway's flush cadence.
+            from .ingest.gateway import GatewayServer
+            if cfg.get("bus_addr"):
+                from .ingest.broker import BrokerBus
+                self._gw_buses = {
+                    s: BrokerBus(cfg["bus_addr"], s,
+                                 publish_window=cfg["ingest.publish_window"])
+                    for s in range(num_shards)}
+            elif cfg.get("bus_dir"):
+                self._gw_buses = {
+                    s: FileBus(f"{cfg['bus_dir']}/shard{s}.log")
+                    for s in range(num_shards)}
+
+            def gw_publish(shard, container, _ds=dataset):
+                bus = self._gw_buses.get(shard)
+                if bus is None:
+                    self.memstore.ingest(_ds, shard, container)
+                elif hasattr(bus, "publish_async"):
+                    bus.publish_async(container)
+                else:
+                    bus.publish(container)
+
+            gw_iv_ms = parse_duration_ms(cfg["ingest.gateway_flush_interval"])
+            self.gateway = GatewayServer(
+                gw_publish, num_shards=num_shards, spread=cfg["spread"],
+                schema=self.memstore.schemas[cfg["schema"]],
+                host=cfg["http.host"], port=cfg["ingest.gateway_port"],
+                flush_lines=cfg["ingest.gateway_flush_lines"],
+                flush_interval_ms=gw_iv_ms).start()
+            if gw_iv_ms > 0 and any(hasattr(b, "flush_publishes")
+                                    for b in self._gw_buses.values()):
+                # interval 0 disables the timed flusher — starting the bus
+                # drain loop anyway would busy-spin on wait(0)
+                self._gw_flush_stop = threading.Event()
+
+                def gw_bus_flush():
+                    while not self._gw_flush_stop.wait(gw_iv_ms / 1000.0):
+                        for b in list(self._gw_buses.values()):
+                            try:
+                                b.flush_publishes()
+                            except (ConnectionError, OSError, RuntimeError):
+                                log.warning("gateway publish flush failed",
+                                            exc_info=True)
+
+                threading.Thread(target=gw_bus_flush, daemon=True,
+                                 name="gw-bus-flush").start()
         if cfg.get("cluster.registrar"):
             # watch peers: a silent peer's shards are reassigned to survivors,
             # whose _on_shard_event resync starts the consumers
@@ -557,6 +688,20 @@ class FiloServer:
             self._cascade_stop.set()
         if self._ds_serve_stop is not None:
             self._ds_serve_stop.set()
+        if self._gw_flush_stop is not None:
+            self._gw_flush_stop.set()
+        if self.gateway is not None:
+            self.gateway.stop()
+            self.gateway.flush()        # pending builders -> publish path
+        for b in self._gw_buses.values():
+            try:
+                if hasattr(b, "flush_publishes"):
+                    b.flush_publishes()     # drain sub-window remainders
+                if hasattr(b, "close"):
+                    b.close()
+            except (ConnectionError, OSError, RuntimeError):
+                log.warning("gateway bus drain failed on shutdown",
+                            exc_info=True)
         for c in self.consumers:
             c.stop()
         for c in self.consumers:

@@ -465,6 +465,158 @@ def bench_query_ingest(full: bool) -> None:
          best[0] / idle_qps, "x")
 
 
+def bench_ingest(full: bool) -> None:
+    """Ingest-plane pipeline (ISSUE 4): end-to-end gateway lines/s (per-
+    connection builders + route memo + per-shard publish locks) vs the
+    serial per-line baseline (one global lock, per-line key hashing — the
+    pre-batching gateway hot path), broker publish rows/s with the windowed
+    PUBLISH_BATCH publisher vs one frame per round trip, and consume-side
+    replay rows/s. Bit-parity: per-shard row multisets of the two gateway
+    paths must match, and the batched-published partition must replay
+    byte-identical to the serial one."""
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    from collections import Counter
+
+    from filodb_tpu.core.record import RecordBuilder, fnv1a64
+    from filodb_tpu.core.schemas import GAUGE, Schemas, part_key_of, \
+        shard_key_of
+    from filodb_tpu.ingest.broker import BrokerBus, BrokerServer
+    from filodb_tpu.ingest.gateway import GatewayServer, parse_influx_line
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+
+    n_lines, n_conns = (100_000, 8) if full else (20_000, 4)
+    n_series = 500
+    lines = [f"cpu,host=h{i % n_series},dc=us-east usage={i % 97}.5 "
+             f"{(BASE + i) * 1_000_000}" for i in range(n_lines)]
+
+    # -- gateway: serial per-line baseline (the pre-PR-4 ingest_line shape:
+    # parse, rebuild labels, hash shard+part key PER LINE, one global lock)
+    mapper = ShardMapper(4, 0)
+    glock = threading.Lock()
+    builders: dict[int, RecordBuilder] = {}
+    serial_out: list[tuple[int, object]] = []
+
+    def serial_line(line: str) -> None:
+        measurement, tags, fields, ts_ns = parse_influx_line(line)
+        ts_ms = ts_ns // 1_000_000 if ts_ns else 0
+        with glock:
+            for fname, fval in fields.items():
+                metric = measurement if fname == "value" \
+                    else f"{measurement}_{fname}"
+                labels = dict(tags)
+                labels["_metric_"] = metric
+                labels.setdefault("_ws_", "default")
+                labels.setdefault("_ns_", "default")
+                opts = GAUGE.options
+                shard = mapper.shard_of(
+                    fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
+                    fnv1a64(part_key_of(labels, opts)))
+                b = builders.get(shard)
+                if b is None:
+                    b = builders[shard] = RecordBuilder(GAUGE)
+                b.add(labels, ts_ms, fval)
+
+    t0 = time.perf_counter()
+    for ln in lines:
+        serial_line(ln)
+    for shard, b in builders.items():
+        serial_out.append((shard, b.build()))
+    serial_s = time.perf_counter() - t0
+    emit("ingest", "gateway_lines_serial", n_lines / serial_s, "lines/s")
+
+    # -- gateway: batched/pipelined path, end to end over N TCP connections
+    got: list[tuple[int, object]] = []
+    gw = GatewayServer(lambda s, c: got.append((s, c)), num_shards=4,
+                       flush_lines=2048, flush_interval_ms=200, port=0).start()
+    slices = [lines[k::n_conns] for k in range(n_conns)]
+
+    def send(sl):
+        with socket.create_connection(("127.0.0.1", gw.port)) as s:
+            s.sendall(("\n".join(sl) + "\n").encode())
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=send, args=(sl,)) for sl in slices]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.time() + 120
+    while sum(len(c) for _, c in got) < n_lines and time.time() < deadline:
+        time.sleep(0.002)
+    gw_s = time.perf_counter() - t0
+    gw.stop()
+    assert sum(len(c) for _, c in got) == n_lines, "gateway lost lines"
+    emit("ingest", "gateway_lines_batched", n_lines / gw_s, "lines/s")
+    emit("ingest", "gateway_speedup", serial_s / gw_s, "x")
+    emit("ingest", "gateway_connections", n_conns, "count")
+
+    def multiset(pairs):
+        out: dict[int, Counter] = {}
+        for shard, c in pairs:
+            keys, _ = c.resolved_keys()
+            ms = out.setdefault(shard, Counter())
+            for i in range(len(c)):
+                ms[(keys[int(c.part_idx[i])], int(c.ts[i]),
+                    float(c.values[i]))] += 1
+        return out
+
+    assert multiset(got) == multiset(serial_out), \
+        "batched gateway diverged from the serial path"
+
+    # -- broker publish: one frame per round trip vs windowed PUBLISH_BATCH
+    rows_per, n_conts, window = (100, 400, 32) if full else (50, 200, 32)
+    conts = []
+    for i in range(n_conts):
+        b = RecordBuilder(GAUGE)
+        b.add_batch({"_metric_": "pub", "host": f"h{i}"},
+                    BASE + np.arange(rows_per, dtype=np.int64) * IV,
+                    np.arange(rows_per, dtype=np.float64))
+        conts.append(b.build())
+    total_rows = rows_per * n_conts
+    tmp = tempfile.mkdtemp(prefix="filodb_ingest_bench_")
+    try:
+        broker = BrokerServer(tmp, 2).start()
+        bus = BrokerBus(f"127.0.0.1:{broker.port}", 0, publish_window=window)
+        t0 = time.perf_counter()
+        for c in conts:
+            bus.publish(c)                     # serial: 1 round trip / frame
+        serial_pub_s = time.perf_counter() - t0
+        emit("ingest", "broker_publish_rows_serial",
+             total_rows / serial_pub_s, "rows/s")
+        bus2 = BrokerBus(f"127.0.0.1:{broker.port}", 1, publish_window=window)
+        before = bus2.requests
+        t0 = time.perf_counter()
+        bus2.publish_batch(conts)              # ceil(n/W) pipelined trips
+        batch_pub_s = time.perf_counter() - t0
+        emit("ingest", "broker_publish_rows_batched",
+             total_rows / batch_pub_s, "rows/s")
+        emit("ingest", "broker_publish_speedup",
+             serial_pub_s / batch_pub_s, "x")
+        emit("ingest", "broker_publish_round_trips",
+             bus2.requests - before, "count")
+        emit("ingest", "broker_publish_window", window, "count")
+        # replay: consume-side decode throughput (FETCH already batches)
+        t0 = time.perf_counter()
+        replayed = list(bus2.consume(Schemas()))
+        replay_s = time.perf_counter() - t0
+        emit("ingest", "replay_rows_per_s",
+             sum(len(c) for _, c in replayed) / replay_s, "rows/s")
+        # bit parity: the batched partition's log replays identical to the
+        # per-round-trip partition's
+        serial_frames = [c.to_bytes() for _, c in bus.consume(Schemas())]
+        batch_frames = [c.to_bytes() for _, c in replayed]
+        assert serial_frames == batch_frames, \
+            "batched publish log diverged from serial publish log"
+        bus.close(), bus2.close()
+        broker.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    emit("ingest", "bit_parity", 1.0, "bool")
+
+
 def bench_gateway(full: bool) -> None:
     """Ref GatewayBenchmark: Influx line-protocol parse + shard-hash rate."""
     from filodb_tpu.ingest.gateway import parse_influx_line
@@ -796,6 +948,7 @@ def bench_count_values(full: bool) -> None:
 
 SUITES = {
     "ingestion": bench_ingestion,
+    "ingest": bench_ingest,
     "odp": bench_odp,
     "count_values": bench_count_values,
     "narrow_resident": bench_narrow_resident,

@@ -1,0 +1,32 @@
+"""Bad twin for the wire-tag-parity op-constant check: OP_EVICT has no server
+dispatch branch, OP_STATS is never sent by the client, and OP_DUP collides
+with OP_PING's value."""
+
+OP_PING, OP_EVICT = 1, 2
+OP_STATS = 3
+OP_DUP = 1
+
+
+class Server:
+    def _serve(self, op):
+        if op == OP_PING:
+            return b"pong"
+        if op == OP_STATS:
+            return b"{}"
+        if op == OP_DUP:
+            return b"?"
+        raise ValueError(f"unknown op {op}")
+
+
+class Client:
+    def ping(self):
+        return self._request(OP_PING)
+
+    def evict(self):
+        return self._request(OP_EVICT)
+
+    def dup(self):
+        return self._request(OP_DUP)
+
+    def _request(self, op):
+        return op

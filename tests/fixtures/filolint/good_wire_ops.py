@@ -1,0 +1,30 @@
+"""Good twin for the wire-tag-parity op-constant check: every op constant is
+dispatched by the server and sent by the client, values distinct."""
+
+OP_PING, OP_EVICT = 1, 2
+OP_STATS = 3
+
+
+class Server:
+    def _serve(self, op):
+        if op == OP_PING:
+            return b"pong"
+        if op == OP_EVICT:
+            return b"ok"
+        if op == OP_STATS:
+            return b"{}"
+        raise ValueError(f"unknown op {op}")
+
+
+class Client:
+    def ping(self):
+        return self._request(OP_PING)
+
+    def evict(self):
+        return self._request(OP_EVICT)
+
+    def stats(self):
+        return self._request(OP_STATS)
+
+    def _request(self, op):
+        return op

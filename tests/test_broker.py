@@ -166,6 +166,100 @@ def test_publish_retry_is_idempotent(broker):
     bus.close()
 
 
+def test_publish_batch_window_and_parity(broker):
+    """publish_batch ships F frames in ceil(F/W) PUBLISH_BATCH round trips;
+    the replayed log is identical to per-round-trip publishes."""
+    import math
+    bus = BrokerBus(f"127.0.0.1:{broker.port}", partition=0, publish_window=7)
+    conts = [make_container(f"b{i}", n=3) for i in range(23)]
+    before = bus.requests
+    offs = bus.publish_batch(conts)
+    assert offs == list(range(23))
+    assert bus.requests - before == math.ceil(23 / 7)
+    got = list(bus.consume(Schemas()))
+    assert [o for o, _ in got] == list(range(23))
+    for (_, c), want in zip(got, conts):
+        assert c.label_sets == want.label_sets
+        np.testing.assert_array_equal(c.values, want.values)
+    # async publishes drain on flush_publishes, offsets continue densely
+    for i in range(5):
+        bus.publish_async(make_container(f"a{i}", n=2))
+    assert bus.flush_publishes() == [23, 24, 25, 26, 27]
+    assert bus.flush_publishes() == []            # idempotent when drained
+    assert bus.end_offset == 28
+    bus.close()
+
+
+def test_publish_batch_retry_is_idempotent(broker):
+    """Replaying a whole batch with the SAME publish ids (the lost-response
+    shape) returns the original offsets and appends nothing."""
+    import struct
+
+    from filodb_tpu.ingest.broker import _ENTRY, OP_PUBLISH_BATCH
+    bus = BrokerBus(f"127.0.0.1:{broker.port}", partition=1)
+
+    def send_batch(entries):
+        payload = b"".join(_ENTRY.pack(pid, len(f)) + f for pid, f in entries)
+        _, body = bus._request(OP_PUBLISH_BATCH, offset=len(entries),
+                               plen=len(payload), payload=payload)
+        return list(struct.unpack(f"<{len(entries)}Q", body))
+
+    entries = [(1000 + i, make_container(f"r{i}").to_bytes())
+               for i in range(6)]
+    first = send_batch(entries)
+    assert first == list(range(6))
+    assert send_batch(entries) == first           # full replay: no appends
+    assert send_batch(entries[3:]) == first[3:]   # partial replay too
+    assert bus.end_offset == 6
+    bus.close()
+
+
+def test_recent_ids_eviction_oldest_first_and_reconnect(tmp_path):
+    """Publish-retry idempotence survives BOTH eviction pressure (eviction is
+    oldest-first, and a retry hit refreshes recency) and a client reconnect
+    (ids live on the broker, not the connection)."""
+    import struct
+
+    from filodb_tpu.ingest.broker import _ENTRY, OP_PUBLISH_BATCH
+    srv = BrokerServer(str(tmp_path / "b"), num_partitions=1,
+                       recent_ids_max=16).start()
+    try:
+        bus = BrokerBus(f"127.0.0.1:{srv.port}", partition=0)
+
+        def send_batch(entries):
+            payload = b"".join(_ENTRY.pack(pid, len(f)) + f
+                               for pid, f in entries)
+            _, body = bus._request(OP_PUBLISH_BATCH, offset=len(entries),
+                                   plen=len(payload), payload=payload)
+            return list(struct.unpack(f"<{len(entries)}Q", body))
+
+        keep = make_container("keep").to_bytes()
+        (koff,) = send_batch([(7, keep)])
+        # fill the id window to capacity-1 with other ids, then RETRY the
+        # tracked id — the retry must hit (nothing evicted it yet) and
+        # refresh its recency
+        send_batch([(100 + i, make_container(f"f{i}").to_bytes())
+                    for i in range(15)])
+        assert send_batch([(7, keep)]) == [koff]
+        # now push MORE ids past capacity: eviction is oldest-first, so the
+        # just-refreshed id survives while ids 100.. are evicted
+        send_batch([(200 + i, make_container(f"g{i}").to_bytes())
+                    for i in range(12)])
+        assert send_batch([(7, keep)]) == [koff]
+        end_before = bus.end_offset
+        # reconnect: the retry still resolves to the original offset
+        bus.close()
+        assert send_batch([(7, keep)]) == [koff]
+        assert bus.end_offset == end_before
+        # an id that WAS evicted (oldest) re-appends — the documented bound
+        f0 = make_container("f0").to_bytes()
+        (off2,) = send_batch([(100, f0)])
+        assert off2 == end_before
+        bus.close()
+    finally:
+        srv.stop()
+
+
 def test_consumer_survives_broker_outage(tmp_path):
     """A broker restart must not kill shard ingestion: the consumer backs off,
     reports ERROR while disconnected, and resumes when the broker returns."""

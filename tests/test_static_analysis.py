@@ -112,6 +112,49 @@ def test_good_wire_fixture_is_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+def _op_findings(module_rel: str):
+    spec = {
+        "wire_module": "<none>",
+        "classifier_module": "<none>",
+        "error_base_modules": [],
+        "codec_pairs": [],
+        "depth_pair": ("_enc_plan", "_dec_plan"),
+        "error_root": "QueryError",
+        "op_specs": [{"module": module_rel, "prefix": "OP_",
+                      "server_fn": "_serve", "client_class": "Client"}],
+    }
+    w = WireChecker(spec=spec)
+    w.check_module(module_rel, ast.parse((REPO / module_rel).read_text()))
+    return w.finalize()
+
+
+def test_bad_wire_ops_fixture_is_flagged():
+    findings = _op_findings("tests/fixtures/filolint/bad_wire_ops.py")
+    details = {f.detail for f in findings}
+    assert "op-unserved:OP_EVICT" in details     # client sends, server drops
+    assert "op-unsent:OP_STATS" in details       # dead protocol arm
+    assert "op-collision:OP_PING" in details or "op-collision:OP_DUP" in details
+    assert all(f.rule == "wire-tag-parity" for f in findings)
+
+
+def test_good_wire_ops_fixture_is_clean():
+    findings = _op_findings("tests/fixtures/filolint/good_wire_ops.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_broker_op_tags_are_exhaustive():
+    """The production broker protocol itself: every OP_* constant is
+    dispatched by BrokerServer._serve and sent by BrokerBus (the PR-4
+    PUBLISH_BATCH satellite — a new op wired on one side only is a live
+    protocol desync, not a unit-test failure)."""
+    from filodb_tpu.analysis.wirecheck import WIRE_SPEC
+    rel = "filodb_tpu/ingest/broker.py"
+    assert any(s["module"] == rel for s in WIRE_SPEC["op_specs"])
+    w = WireChecker()
+    w.check_module(rel, ast.parse((REPO / rel).read_text()))
+    assert w.finalize() == []
+
+
 def test_real_wire_module_tags_are_exhaustive():
     """The production codec pair itself (not just the repo-wide zero-findings
     gate): both directions enumerate the same envelope tags today."""
