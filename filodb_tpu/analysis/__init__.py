@@ -2,7 +2,10 @@
 
 The port leans on conventions the language never checks, exactly like the
 reference FiloDB leans on its per-shard ingest threads + ChunkMap read locks
-(SURVEY §0). Three families of invariants are load-bearing here:
+(SURVEY §0). v2 is INTERPROCEDURAL: a per-module call graph plus
+per-function CFGs (analysis/callgraph.py, analysis/cfg.py) propagate
+holds-lock / owns-resource / may-raise facts through helper calls, and six
+rule families run on top. The original three:
 
   * **lock discipline** — ``*_locked`` methods must run under the owning
     object's lock (core/memstore.py's shard ``TimedRLock``); state mutated by
@@ -21,6 +24,21 @@ reference FiloDB leans on its per-shard ingest threads + ChunkMap read locks
     plan nesting by ONE shared constant on both sides, and every typed query
     error must be classified by the HTTP dispatch table (http/api.py) so a
     peer failure maps to the right status code instead of a bare 500.
+
+And the v2 families (PR 5 — the ingest plane is thread/socket-heavy):
+
+  * **resource lifecycle** — every acquired thread/server/socket/file needs
+    a shutdown story on ALL CFG paths: started threads are daemon or
+    joined, ``serve_forever`` servers get shutdown+join, worker loops fail
+    loud instead of dying silently (analysis/resourcecheck.py).
+  * **except-flow** — broad handlers must not silently swallow
+    (``filodb_swallowed_errors`` is the observable alternative), must not
+    degrade the typed QueryError protocol the HTTP layer classifies, and
+    must restore claimed two-phase-commit state (analysis/exceptcheck.py).
+  * **declared surface** — every dotted config key lives in
+    config.py::CONFIG_SPEC, every filodb_* metric name is a declared
+    constant in utils/metrics.py::METRICS_SPEC, and the README tables are
+    generated from those dicts (analysis/surfacecheck.py).
 
 Everything is pure ``ast`` — no jax import, no device, safe under
 ``JAX_PLATFORMS=cpu`` and in CI. Findings are suppressible inline with

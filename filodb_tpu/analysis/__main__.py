@@ -2,23 +2,76 @@
 
 Exit status: 0 when no NEW findings (inline-suppressed and baselined
 findings are reported but don't fail); 1 otherwise; 2 on usage errors.
+
+Output modes (``--format``): ``text`` (default, per-finding lines +
+summary), ``json`` (full report for CI annotators / the bench harness),
+``sarif`` (SARIF 2.1.0 for GitHub code scanning / editor viewers).
+
+``--changed-only`` analyzes only files ``git status`` reports as modified
+(plus the spec anchor modules the cross-file rules compare against) — the
+fast pre-commit mode. ``--update-baseline`` rewrites the baseline with the
+current NEW findings and REFUSES to run without ``--reason``: a baseline
+entry is a promise, not a TODO.
 """
 
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
-from .findings import Baseline
-from .runner import DEFAULT_BASELINE, run_analysis
+from .findings import Baseline, report_json, report_sarif
+from .runner import ALL_RULES, DEFAULT_BASELINE, run_analysis
+
+# modules the cross-file rules need in scope even when unchanged: the wire
+# codec + its HTTP classifier, the typed-error bases, the broker op spec,
+# and the two declared-surface dicts
+ANCHOR_MODULES = (
+    "filodb_tpu/config.py",
+    "filodb_tpu/utils/metrics.py",
+    "filodb_tpu/query/wire.py",
+    "filodb_tpu/query/rangevector.py",
+    "filodb_tpu/http/api.py",
+    "filodb_tpu/ingest/broker.py",
+)
+
+
+def _changed_files(root: Path) -> list[str] | None:
+    """Root-relative .py paths under filodb_tpu/ that git reports changed
+    (staged, unstaged or untracked). None on git failure. Porcelain paths
+    are TOPLEVEL-relative; when ``root`` sits below the git toplevel (a
+    vendored checkout), they are rebased via ``--show-prefix`` so a
+    changed-only run never silently analyzes nothing."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root, capture_output=True,
+            text=True, timeout=30, check=True).stdout
+        prefix = subprocess.run(
+            ["git", "rev-parse", "--show-prefix"], cwd=root,
+            capture_output=True, text=True, timeout=30,
+            check=True).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    paths: list[str] = []
+    for line in out.splitlines():
+        p = line[3:].split(" -> ")[-1].strip().strip('"')
+        if prefix:
+            if not p.startswith(prefix):
+                continue                    # outside the analysis root
+            p = p[len(prefix):]
+        if p.endswith(".py") and p.startswith("filodb_tpu/") \
+                and (root / p).exists():
+            paths.append(p)
+    return paths
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m filodb_tpu.analysis",
         description="filolint: project-invariant static analysis "
-                    "(lock discipline, JIT hygiene, wire exhaustiveness)")
+                    "(lock discipline, JIT hygiene, wire exhaustiveness, "
+                    "resource lifecycle, except-flow, declared surface)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the filodb_tpu "
                          "package next to this module)")
@@ -27,11 +80,21 @@ def main(argv: list[str] | None = None) -> int:
                          "package)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
-    ap.add_argument("--write-baseline", action="store_true",
-                    help="write all current NEW findings to the baseline "
-                         "file (then hand-edit the reasons) and exit 0")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text", help="output format (default: text)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="analyze only git-modified files under filodb_tpu/ "
+                         "(plus the cross-file anchor modules) — fast "
+                         "pre-commit mode; *-unused rules are skipped")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline: keep entries that still "
+                         "match, add the current NEW findings (requires "
+                         "--reason), then exit 0")
+    ap.add_argument("--reason", default=None,
+                    help="why the findings being baselined are intentional "
+                         "(required by --update-baseline)")
     ap.add_argument("--quiet", action="store_true",
-                    help="summary only, no per-finding lines")
+                    help="summary only, no per-finding lines (text format)")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else \
@@ -39,19 +102,56 @@ def main(argv: list[str] | None = None) -> int:
     baseline_path = Path(args.baseline) if args.baseline \
         else root / DEFAULT_BASELINE
 
-    report = run_analysis(root, args.paths or None,
-                          baseline_path=baseline_path)
+    paths = args.paths or None
+    if args.changed_only:
+        if paths:
+            ap.error("--changed-only and explicit paths are exclusive")
+        changed = _changed_files(root)
+        if changed is None:
+            print("filolint: git unavailable; falling back to a full run",
+                  file=sys.stderr)
+        elif not changed:
+            print("filolint: no changed files under filodb_tpu/ — nothing "
+                  "to analyze")
+            return 0
+        else:
+            anchors = [a for a in ANCHOR_MODULES if (root / a).exists()]
+            paths = sorted(set(changed) | set(anchors))
 
-    if args.write_baseline:
-        Baseline.write(baseline_path, report.new)
-        print(f"wrote {len(report.new)} entries to {baseline_path} — "
-              "fill in the reason for each")
+    report = run_analysis(root, paths, baseline_path=baseline_path)
+
+    if args.update_baseline:
+        if report.new and not (args.reason and args.reason.strip()):
+            print("filolint: --update-baseline refuses entries without a "
+                  "--reason (a baseline entry is a promise that the finding "
+                  "is intentional)", file=sys.stderr)
+            return 2
+        # keep existing entries that still correspond to a live finding, so
+        # stale promises age out of the file instead of accreting — but only
+        # judge entries for files THIS run analyzed: a narrow scope
+        # (--changed-only / explicit paths) must not delete out-of-scope
+        # promises it never re-checked
+        analyzed = set(report.analyzed_paths)
+        live = {f.fingerprint for f in report.baselined}
+        old = Baseline.load(baseline_path)
+        keep = [e for e in old.entries
+                if e["file"] not in analyzed
+                or (e["rule"], e["file"], e["symbol"], e["detail"]) in live]
+        Baseline.write(baseline_path, report.new, reason=args.reason,
+                       keep=keep)
+        print(f"baseline updated: {len(keep)} kept, {len(report.new)} added "
+              f"-> {baseline_path}")
         return 0
 
-    if not args.quiet:
-        for f in sorted(report.new, key=lambda f: (f.path, f.line)):
-            print(f.render())
-    print(report.summary())
+    if args.format == "json":
+        print(report_json(report))
+    elif args.format == "sarif":
+        print(report_sarif(report, ALL_RULES))
+    else:
+        if not args.quiet:
+            for f in sorted(report.new, key=lambda f: (f.path, f.line)):
+                print(f.render())
+        print(report.summary())
     return 1 if report.new else 0
 
 

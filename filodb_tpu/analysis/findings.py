@@ -85,13 +85,73 @@ class Baseline:
         return f.fingerprint in self._index
 
     @staticmethod
-    def write(path: Path | str, findings: list[Finding]) -> None:
-        entries = [{"rule": f.rule, "file": f.path, "symbol": f.symbol,
-                    "detail": f.detail,
-                    "reason": "TODO: why is this finding intentional?"}
-                   for f in findings]
+    def write(path: Path | str, findings: list[Finding],
+              reason: str | None = None,
+              keep: list[dict] | None = None) -> None:
+        """Write ``findings`` (appended to ``keep``) as baseline entries.
+
+        A baseline entry is a PROMISE that the finding is intentional, so a
+        reason is mandatory — callers without one are refused (the
+        ``--update-baseline`` CLI surfaces this as an error instead of
+        writing 'TODO' placeholders that nobody ever fills in)."""
+        if findings and not (reason and reason.strip()):
+            raise ValueError(
+                "baseline entries require a reason — pass --reason "
+                "'why these findings are intentional'")
+        entries = list(keep or [])
+        entries += [{"rule": f.rule, "file": f.path, "symbol": f.symbol,
+                     "detail": f.detail, "reason": reason}
+                    for f in findings]
         Path(path).write_text(json.dumps({"entries": entries}, indent=2) + "\n")
 
 
 def as_json(findings: list[Finding]) -> str:
     return json.dumps([asdict(f) for f in findings], indent=2)
+
+
+def report_json(report) -> str:
+    """Machine-readable report: per-bucket findings + counts (the shape CI
+    annotators and the bench harness consume)."""
+    return json.dumps({
+        "files_analyzed": report.files_analyzed,
+        "counts": {"new": len(report.new),
+                   "suppressed": len(report.suppressed),
+                   "baselined": len(report.baselined)},
+        "new": [asdict(f) for f in report.new],
+        "suppressed": [asdict(f) for f in report.suppressed],
+        "baselined": [asdict(f) for f in report.baselined],
+    }, indent=2)
+
+
+SARIF_RULE_HELP = "see ANALYSIS.md for the invariant behind each rule"
+
+
+def report_sarif(report, rule_ids: tuple) -> str:
+    """SARIF 2.1.0 — the interchange format GitHub code scanning, VS Code
+    SARIF viewers and most CI annotators ingest. Only NEW findings are
+    results (suppressed/baselined are accepted states, not alerts)."""
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "filolint",
+                "informationUri": "ANALYSIS.md",
+                "rules": [{"id": r,
+                           "shortDescription": {"text": SARIF_RULE_HELP}}
+                          for r in rule_ids],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f"{f.symbol}: {f.message}"},
+                "partialFingerprints": {
+                    "filolint/v1": "/".join(map(str, f.fingerprint))},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            } for f in report.new],
+        }],
+    }, indent=2)

@@ -27,12 +27,18 @@ device append against concurrent query capture/dispatch):
 
 Holder forms recognized: the ``_locked`` suffix, a lexical ``with <owner
 lock>:``, ``stack.enter_context(<owner lock>)`` (multi-shard ExitStack
-acquisition — treated as held for the rest of the function), and a
+acquisition — treated as held for the rest of the function), a
 ``diagnostics.assert_owned(self.lock, ...)`` call in the body (the contract
-is then runtime-checked instead). Pure-AST limits (documented in
-ANALYSIS.md): bare .acquire()/.release() pairs are not recognized — a method
-whose CALLER holds the lock by an unchecked convention must carry the
-``_locked`` suffix, add the runtime assert, or suppress inline.
+is then runtime-checked instead), and — new in v2 — the INHERITED holder: a
+private helper (leading underscore) every one of whose in-class call sites
+holds the owner lock inherits the fact, transitively through other inherited
+helpers (computed as a shrinking fixpoint).  That closes PR 3's documented
+lexical blind spot: ``def _bump(self)`` called only from inside ``with
+self.lock:`` no longer needs a rename or a suppression.  A helper with even
+ONE non-holder call site — or with no in-class call site at all (it may be
+called externally) — still must carry the suffix or the runtime assert.
+Remaining pure-AST limits (ANALYSIS.md): bare .acquire()/.release() pairs,
+and private helpers invoked from OUTSIDE their class, are not recognized.
 """
 
 from __future__ import annotations
@@ -134,6 +140,9 @@ class _FunctionScanner(ast.NodeVisitor):
         self.writes: list[tuple[ast.AST, str, bool, str | None, bool]] = []
         self.nested_edges: list[tuple[str, str, int]] = []
         self.calls_under: list[tuple[str, str, int]] = []  # (lockcls, callee, line)
+        # every self.X() site with the lexical holder state at the site —
+        # feeds the v2 inherited-holder fixpoint
+        self.self_call_sites: list[tuple[str, bool]] = []
         # set by enter_context(<owner lock>) / assert_owned(...): the rest of
         # the function counts as holding the owner lock
         self.asserted_owner = False
@@ -174,6 +183,7 @@ class _FunctionScanner(ast.NodeVisitor):
             callee = func.attr
             if isinstance(func.value, ast.Name) and func.value.id == "self":
                 self.info.calls.add(callee)
+                self.self_call_sites.append((callee, self._holding_owner()))
                 for h in self._held_classes():
                     self.calls_under.append((h, callee, node.lineno))
         elif isinstance(func, ast.Name):
@@ -285,6 +295,47 @@ class LockChecker:
             infos[name] = info
             scanners[name] = sc
 
+        # interprocedural holder inheritance (v2): a PRIVATE helper whose
+        # every in-class call site holds the owner lock inherits the holder
+        # fact, transitively. Shrinking fixpoint: start optimistic, demote a
+        # candidate when any site's caller neither holds lexically, is
+        # *_locked, nor (still) inherits.
+        sites: dict[str, list[tuple[str, bool]]] = {}
+        for caller, sc in scanners.items():
+            for callee, held in sc.self_call_sites:
+                if callee in methods:
+                    sites.setdefault(callee, []).append((caller, held))
+        # a method whose REFERENCE escapes (Thread(target=self._m), a stored
+        # callback) can run from anywhere — the call-site census is
+        # incomplete for it, so it must never inherit the holder fact
+        escaped_refs: set[str] = set()
+        for fn in methods.values():
+            call_funcs = {id(n.func) for n in ast.walk(fn)
+                          if isinstance(n, ast.Call)}
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self" \
+                        and isinstance(n.ctx, ast.Load) \
+                        and id(n) not in call_funcs and n.attr in methods:
+                    escaped_refs.add(n.attr)
+        inherited = {m: True for m in methods
+                     if m.startswith("_") and not m.startswith("__")
+                     and m not in escaped_refs and sites.get(m)}
+        changed = True
+        while changed:
+            changed = False
+            for m in inherited:
+                if not inherited[m]:
+                    continue
+                for caller, held in sites[m]:
+                    if not (held or infos[caller].is_locked
+                            or inherited.get(caller, False)):
+                        inherited[m] = False
+                        changed = True
+                        break
+        holder_inherited = {m for m, ok in inherited.items() if ok}
+
         # protected state: attrs written by *_locked methods
         protected: set[str] = set()
         for name, sc in scanners.items():
@@ -303,12 +354,14 @@ class LockChecker:
 
         for name, sc in scanners.items():
             qual = infos[name].qualname
-            findings += self._call_findings(path, qual, sc)
+            is_inherited = name in holder_inherited
+            findings += self._call_findings(path, qual, sc,
+                                            exempt=is_inherited)
             if name == "__init__":
                 continue
             for node, attr, holder, guard, rmw in sc.writes:
                 if attr in protected and not holder \
-                        and not infos[name].is_locked:
+                        and not infos[name].is_locked and not is_inherited:
                     findings.append(Finding(
                         "lock-unheld-write", path, node.lineno, qual,
                         f"write:{attr}",
@@ -348,8 +401,10 @@ class LockChecker:
                         self._edges.append((lockcls, acquired, path, line))
         return findings
 
-    def _call_findings(self, path: str, qual: str,
-                       sc: _FunctionScanner) -> list[Finding]:
+    def _call_findings(self, path: str, qual: str, sc: _FunctionScanner,
+                       exempt: bool = False) -> list[Finding]:
+        if exempt:      # inherited holder: every in-class call site holds
+            return []
         out = []
         for node, callee, holder in sc.locked_calls:
             if not holder:
@@ -358,7 +413,8 @@ class LockChecker:
                     f"call:{callee}",
                     f"calls {callee}() without holding the owner lock — "
                     "*_locked methods must run under `with <owner>.lock:` "
-                    "(or from another *_locked method)"))
+                    "(or from another *_locked method, or — v2 — be a "
+                    "private helper whose every in-class call site holds)"))
         return out
 
     def finalize(self) -> list[Finding]:

@@ -11,16 +11,22 @@ from collections import Counter
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .callgraph import PackageIndex
+from .exceptcheck import ExceptChecker
 from .findings import Baseline, Finding, is_suppressed, load_suppressions
 from .jitcheck import JitChecker
 from .lockcheck import LockChecker
+from .resourcecheck import ResourceChecker
+from .surfacecheck import SurfaceChecker
 from .wirecheck import WireChecker
 
 # generated / vendored files never analyzed
 DEFAULT_EXCLUDES = ("remote_storage_pb2.py",)
 
 ALL_RULES = tuple(sorted(
-    set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)))
+    set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)
+    | set(ResourceChecker.rules) | set(ExceptChecker.rules)
+    | set(SurfaceChecker.rules)))
 
 DEFAULT_BASELINE = "filolint_baseline.json"
 
@@ -31,6 +37,10 @@ class AnalysisReport:
     suppressed: list[Finding] = field(default_factory=list)
     baselined: list[Finding] = field(default_factory=list)
     files_analyzed: int = 0
+    # repo-relative paths actually analyzed — narrow-scope tooling
+    # (--changed-only --update-baseline) must not touch baseline entries
+    # for files outside this set
+    analyzed_paths: list[str] = field(default_factory=list)
 
     @property
     def all_findings(self) -> list[Finding]:
@@ -81,16 +91,31 @@ def analyze_file(path: Path, root: Path | None = None,
     findings: list[Finding] = []
     for c in checkers:
         findings += c.check_module(rel, tree)
-    for c in checkers:
-        fin = getattr(c, "finalize", None)
-        if fin is not None:
-            findings += fin()
+    findings += _finalize(checkers, {rel: tree})
     supp = load_suppressions(source)
     return [f for f in findings if not is_suppressed(f, supp)]
 
 
-def _default_checkers(wire_spec: dict | None = None):
-    return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec)]
+def _default_checkers(wire_spec: dict | None = None, full_scope: bool = True):
+    surface = SurfaceChecker()
+    surface.full_scope = full_scope
+    return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec),
+            ResourceChecker(), ExceptChecker(), surface]
+
+
+def _finalize(checkers, modules: dict) -> list[Finding]:
+    """Run every checker's finalize with ONE shared interprocedural index —
+    the call graph / may-raise / thread-entry facts are built once and the
+    resource/except/lock checkers all consume them."""
+    project = PackageIndex(modules)
+    findings: list[Finding] = []
+    for c in checkers:
+        if hasattr(c, "project"):
+            c.project = project
+        fin = getattr(c, "finalize", None)
+        if fin is not None:
+            findings += fin()
+    return findings
 
 
 def _relpath(path: Path, root: Path) -> str:
@@ -112,9 +137,10 @@ def run_analysis(root: Path | str, paths: list[str] | None = None,
     if baseline_path == "auto":
         baseline_path = root / DEFAULT_BASELINE
     baseline = Baseline.load(baseline_path)
-    checkers = _default_checkers(wire_spec)
+    checkers = _default_checkers(wire_spec, full_scope=paths is None)
     report = AnalysisReport()
     per_file_supp: dict[str, dict[int, set[str]]] = {}
+    modules: dict[str, ast.Module] = {}
     findings: list[Finding] = []
     for path in _discover(root, paths):
         rel = _relpath(path, root)
@@ -126,13 +152,12 @@ def run_analysis(root: Path | str, paths: list[str] | None = None,
                                     "parse", f"cannot analyze: {e}"))
             continue
         per_file_supp[rel] = load_suppressions(source)
+        modules[rel] = tree
         report.files_analyzed += 1
+        report.analyzed_paths.append(rel)
         for c in checkers:
             findings += c.check_module(rel, tree)
-    for c in checkers:
-        fin = getattr(c, "finalize", None)
-        if fin is not None:
-            findings += fin()
+    findings += _finalize(checkers, modules)
     for f in findings:
         if is_suppressed(f, per_file_supp.get(f.path, {})):
             report.suppressed.append(f)

@@ -176,7 +176,11 @@ def _status(args) -> int:
                         m = _re.search(r'shard="(\d+)"', labels)
                         if m:
                             counts[m.group(1)] = val.strip()
-    except Exception:  # noqa: BLE001 — metrics endpoint optional
+    except Exception:  # noqa: BLE001  # filolint: ignore[except-swallow]
+        # metrics endpoint optional: older servers don't expose /metrics and
+        # the status table just omits the live series counts. This is a
+        # short-lived CLI process with no metrics export of its own, so a
+        # counter here would be dead telemetry — degrade silently by design.
         pass
     if isinstance(shards, dict):
         rows = sorted(shards.items(), key=lambda kv: int(kv[0]))

@@ -17,90 +17,172 @@ import json
 import re
 from typing import Any
 
-DEFAULTS: dict[str, Any] = {
-    "dataset": "prometheus",
-    "schema": "gauge",
-    "num_shards": 1,
-    "spread": 0,
-    "store": {
-        "max_series_per_shard": 1 << 20,
-        "samples_per_series": 1024,
-        "flush_batch_size": 65536,
-        "groups_per_shard": 16,
-        "retention": "3h",
-        "dtype": "float32",
-        # periodic purge of series that went quiet > retention ago, measured in
-        # *data time* (max ingested ts), so backfilled workloads behave the same
-        # as live ones (ref: TimeSeriesShard.purgeExpiredPartitions cadence)
-        "purge_interval": "10m",
-        # compressed-resident store shapes (the reference keeps everything
-        # compressed in memory — doc/compression.md): "off" keeps raw
-        # f32/i64 blocks; "gauge" adopts i16 quantized values + grid-derived
-        # timestamps on scalar f32 stores; "all" extends to [S, C, B]
-        # histogram stores (i8/i16 2D-delta bucket blocks)
-        "compressed_residency": "off",
-        # keep an i16 mirror ALONGSIDE raw f32 (bandwidth, not capacity);
-        # ignored when compressed_residency is active
-        "narrow_mirror": False,
-    },
-    "query": {
-        "stale_sample_after": "5m",
-        "sample_limit": 1_000_000,
-        # priority query scheduler (ref: QueryActor priority mailbox +
-        # dedicated query scheduler, filodb-defaults.conf query thread pools;
-        # timeout ref: query ask-timeout)
-        "num_threads": 4,
-        "queue_size": 64,
-        "timeout": "60s",
-    },
-    # inline downsampling at flush into durable per-aggregate datasets
-    # ({ds}:ds_{res}:{agg}); additional resolutions cascade periodically from
-    # the previous one (ref: ShardDownsampler inline + DownsamplerMain 6h cron)
-    "downsample": {
-        "enabled": False,
-        "resolutions": ["1m"],
-        "cascade_interval": "6h",
-    },
-    # ingest-plane pipeline knobs (gateway -> broker -> shard consumer):
-    #   publish_window          frames per broker PUBLISH_BATCH round trip /
-    #                           in-flight window of the windowed publisher
-    #   decode_ahead            containers decoded ahead of the device scatter
-    #                           (IngestionConsumer double buffering; 0 = serial)
-    #   gateway_port            enables the Influx line-protocol TCP gateway
-    #                           on the standalone server (None = off; 0 = any)
-    #   gateway_flush_lines     size bound per (connection, shard) batch
-    #   gateway_flush_interval  time bound so low-rate shards still land
-    "ingest": {
-        "publish_window": 64,
-        "decode_ahead": 2,
-        "gateway_port": None,
-        "gateway_flush_lines": 1000,
-        "gateway_flush_interval": "500ms",
-    },
-    "http": {"host": "127.0.0.1", "port": 8080},
-    "data_dir": None,            # enables the durable FileColumnStore when set
-    "bus_dir": None,             # enables FileBus ingestion when set
-    "bus_addr": None,            # "host:port" of a BrokerServer (overrides bus_dir):
-                                 # shard N consumes broker partition N
-    "profiler": {"enabled": False, "interval": "100ms"},
-    "tracing": {"log_spans": False},
-    # runtime concurrency assertions: lock-discipline checks on donating store
-    # mutations, long-hold lock warnings, donation provenance (ref:
-    # scheduler.enable-assertions, filodb-defaults.conf:117-119)
-    "diagnostics": {"enabled": False},
-    # remote storage nodes ("host:port" StoreServers) with replication — the
-    # Cassandra-layer deployment shape; data_dir is the single-node form
-    "store_nodes": [],
-    "store_replication": 2,
-    # multi-host membership (ref: akka-bootstrapper + Akka gossip deathwatch):
-    # registrar = shared member file; self_addr defaults to the HTTP address
-    "cluster": {"registrar": None, "self_addr": None,
-                "heartbeat_interval": "5s", "stale_after": "30s",
-                # wait for this many members before assigning shards, so every
-                # node computes the same assignment (akka-bootstrapper
-                # expected-contact-points analog)
-                "min_members": 1, "join_timeout": "30s"},
+# ---------------------------------------------------------------------------
+# Declared config surface.
+#
+# Every dotted key this process reads is declared HERE, once, with its type,
+# default and a one-line doc — DEFAULTS below is DERIVED from this spec, so
+# a key cannot exist without documentation and a documented key cannot have
+# a divergent default.  filolint's surface-check family enforces the read
+# side (an undeclared ``cfg[...]`` / ``cfg.get(...)`` key and an unread
+# declared key both fail tier-1), and the README "Configuration" table is
+# generated from this dict (tests/test_static_analysis.py keeps them equal).
+# Reference: Typesafe filodb-defaults.conf — 367 lines of documented
+# defaults the reference treats as the deployment contract.
+# ---------------------------------------------------------------------------
+
+CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
+    "dataset": ("str", "prometheus",
+                "Dataset created, ingested and served at startup."),
+    "schema": ("str", "gauge",
+               "Ingest schema of the dataset (gauge / prom-counter / "
+               "histogram / ...)."),
+    "num_shards": ("int", 1,
+                   "Shard count; rounded UP to a power of two so hash "
+                   "routing covers the id space."),
+    "spread": ("int", 0,
+               "Shard-key spread bits (2^spread shards per shard key)."),
+    "store.max_series_per_shard": ("int", 1 << 20,
+                                   "Series capacity per shard store."),
+    "store.samples_per_series": ("int", 1024,
+                                 "In-memory sample window per series."),
+    "store.flush_batch_size": ("int", 65536,
+                               "Rows per chunk-flush batch to the sink."),
+    "store.groups_per_shard": ("int", 16,
+                               "Flush groups per shard (checkpoint "
+                               "granularity; ref: GroupFlush)."),
+    "store.retention": ("duration", "3h",
+                        "In-memory retention, measured in data time."),
+    "store.dtype": ("str", "float32", "Value dtype of the shard store."),
+    "store.purge_interval": (
+        "duration", "10m",
+        "Cadence of the expired-series purge; data-time based so "
+        "backfilled workloads behave like live ones."),
+    "store.compressed_residency": (
+        "str", "off",
+        "Compressed-resident store shape: off (raw f32/i64), gauge (i16 "
+        "quantized scalars), all (+ i8/i16 2D-delta histogram blocks)."),
+    "store.narrow_mirror": (
+        "bool", False,
+        "Keep an i16 mirror ALONGSIDE raw f32 (bandwidth, not capacity); "
+        "ignored when compressed_residency is active."),
+    "query.stale_sample_after": ("duration", "5m",
+                                 "Prometheus staleness window."),
+    "query.sample_limit": ("int", 1_000_000,
+                           "Max samples one query may touch."),
+    "query.num_threads": ("int", 4,
+                          "Query-scheduler worker threads (ref: QueryActor "
+                          "dedicated scheduler)."),
+    "query.queue_size": ("int", 64,
+                         "Bounded query queue; overflow sheds as 503."),
+    "query.timeout": ("duration", "60s",
+                      "Per-query timeout (maps to HTTP 504)."),
+    "downsample.enabled": ("bool", False,
+                           "Inline downsampling at flush into durable "
+                           "per-aggregate datasets ({ds}:ds_{res})."),
+    "downsample.resolutions": (
+        "list[duration]", ["1m"],
+        "Ascending resolutions; the first publishes inline at flush, "
+        "coarser ones cascade from the previous."),
+    "downsample.cascade_interval": (
+        "duration", "6h",
+        "Cadence of the coarse-resolution cascade job (ref: "
+        "DownsamplerMain 6h cron)."),
+    "downsample.serve_interval": (
+        "duration", "30s",
+        "Refresh cadence of the downsample serving views "
+        "(/promql/{ds}:ds_1m/...)."),
+    "ingest.publish_window": (
+        "int", 64,
+        "Frames per broker PUBLISH_BATCH round trip — the in-flight "
+        "window of the pipelined publisher."),
+    "ingest.decode_ahead": (
+        "int", 2,
+        "Containers decoded ahead of the device scatter "
+        "(IngestionConsumer double buffering; 0 = serial)."),
+    "ingest.gateway_port": (
+        "int|null", None,
+        "Enables the Influx line-protocol TCP gateway on the standalone "
+        "server (null = off; 0 = any free port)."),
+    "ingest.gateway_flush_lines": (
+        "int", 1000, "Size bound per (connection, shard) gateway batch."),
+    "ingest.gateway_flush_interval": (
+        "duration", "500ms",
+        "Time bound so low-rate shards still land promptly (0 disables "
+        "the timed flusher)."),
+    "http.host": ("str", "127.0.0.1", "HTTP bind address."),
+    "http.port": ("int", 8080, "HTTP port (0 = any free port)."),
+    "http.advertise": (
+        "str|null", None,
+        "Endpoint advertised to peers for /exec dispatch (overrides the "
+        "bind host for NAT/multi-homed nodes)."),
+    "data_dir": ("str|null", None,
+                 "Enables the durable FileColumnStore when set."),
+    "bus_dir": ("str|null", None,
+                "Enables FileBus ingestion consumers when set."),
+    "bus_addr": ("str|null", None,
+                 "host:port of a BrokerServer (overrides bus_dir); shard N "
+                 "consumes broker partition N."),
+    "profiler.enabled": ("bool", False,
+                         "Always-on sampling profiler (ref: "
+                         "SimpleProfiler)."),
+    "profiler.interval": ("duration", "100ms", "Profiler sample cadence."),
+    "tracing.log_spans": ("bool", False, "Log tracer spans."),
+    "diagnostics.enabled": (
+        "bool", False,
+        "Runtime concurrency assertions: donation provenance, lock "
+        "discipline, long-hold warnings (ref: "
+        "scheduler.enable-assertions)."),
+    "store_nodes": ("list[str]", [],
+                    "Remote StoreServer host:port list — the "
+                    "Cassandra-layer deployment shape; data_dir is the "
+                    "single-node form."),
+    "store_replication": ("int", 2,
+                          "Replication factor across store_nodes."),
+    "cluster.registrar": ("str|null", None,
+                          "Shared registrar directory enabling multi-host "
+                          "membership (ref: akka-bootstrapper)."),
+    "cluster.self_addr": ("str|null", None,
+                          "This node's cluster identity; defaults to the "
+                          "HTTP address."),
+    "cluster.heartbeat_interval": ("duration", "5s",
+                                   "Registrar heartbeat cadence."),
+    "cluster.stale_after": ("duration", "30s",
+                            "Heartbeat age after which a peer is declared "
+                            "down (and we self-quarantine)."),
+    "cluster.min_members": (
+        "int", 1,
+        "Members to wait for before assigning shards, so every node "
+        "computes the identical assignment."),
+    "cluster.join_timeout": ("duration", "30s",
+                             "Max wait for min_members at startup."),
 }
+
+
+def _nest(flat: dict[str, Any]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for dotted, v in flat.items():
+        cur = out
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out
+
+
+# the runtime default tree is DERIVED from the spec — one source of truth
+DEFAULTS: dict[str, Any] = _nest({k: v[1] for k, v in CONFIG_SPEC.items()})
+
+
+def config_markdown_table() -> str:
+    """The README 'Configuration' table, generated from CONFIG_SPEC
+    (verified against the checked-in README by
+    tests/test_static_analysis.py)."""
+    lines = ["| key | type | default | meaning |", "|---|---|---|---|"]
+    for key, (typ, default, doc) in sorted(CONFIG_SPEC.items()):
+        shown = "null" if default is None else repr(default)
+        lines.append(f"| `{key}` | {typ} | `{shown}` | {doc} |")
+    return "\n".join(lines)
 
 _DUR = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000}
 

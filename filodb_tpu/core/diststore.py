@@ -137,6 +137,7 @@ class StoreServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        self._thread.join(timeout=3)
 
 
 class RemoteStore(ChunkSink):

@@ -157,5 +157,7 @@ class NativePartSet:
     def __del__(self):
         try:
             self._lib.ps_free(self._h)
-        except Exception:
+        except Exception:  # noqa: BLE001  # filolint: ignore[except-swallow]
+            # interpreter shutdown: ctypes globals may already be torn down,
+            # and running ANY further code (even a counter) can itself fail
             pass

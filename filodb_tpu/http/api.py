@@ -147,27 +147,37 @@ class FiloHttpServer:
         return self
 
     def stop(self):
+        """Deterministic teardown: stop the acceptor, release the listening
+        socket, and join the serve thread with a timeout."""
         self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
 
     def _sync_shard_stats(self) -> None:
         """Refresh per-shard ingest/eviction gauges on each scrape (ref:
         TimeSeriesShardStats Kamon counters, TimeSeriesShard.scala:36-97)."""
         from dataclasses import asdict
 
-        from ..utils.metrics import registry
+        from ..utils.metrics import (FILODB_SHARD_LOCK_CONTENTIONS,
+                                     FILODB_SHARD_LOCK_LONG_HOLDS,
+                                     FILODB_SHARD_NUM_SERIES, registry)
         # snapshot: a downsample serving refresh adds family engines
         # concurrently (standalone ds_serve_loop)
         for ds, e in list(self.engines.items()):
             for s in e.memstore.shards_of(ds):
                 tags = {"dataset": ds, "shard": str(s.shard_num)}
                 for k, v in asdict(s.stats).items():
+                    # dynamic family, declared as filodb_shard_* in
+                    # METRICS_SPEC (one gauge per IngestStats field)
                     registry.gauge(f"filodb_shard_{k}", tags).update(float(v))
-                registry.gauge("filodb_shard_num_series", tags).update(
+                registry.gauge(FILODB_SHARD_NUM_SERIES, tags).update(
                     float(s.num_series))
                 if hasattr(s.lock, "contentions"):   # TimedRLock diagnostics
-                    registry.gauge("filodb_shard_lock_contentions", tags) \
+                    registry.gauge(FILODB_SHARD_LOCK_CONTENTIONS, tags) \
                         .update(float(s.lock.contentions))
-                    registry.gauge("filodb_shard_lock_long_holds", tags) \
+                    registry.gauge(FILODB_SHARD_LOCK_LONG_HOLDS, tags) \
                         .update(float(s.lock.long_holds))
 
     @contextmanager

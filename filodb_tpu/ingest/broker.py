@@ -229,6 +229,10 @@ class BrokerServer:
         return self
 
     def stop(self) -> None:
+        """Deterministic teardown: stop the acceptor, release the listening
+        socket, sever live client connections (handler threads would
+        otherwise keep serving a 'stopped' broker), and join the serve
+        thread with a timeout."""
         self._server.shutdown()
         self._server.server_close()
         with self._conns_lock:
@@ -237,8 +241,11 @@ class BrokerServer:
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
-                pass
+                pass    # racing close: the connection is already gone
             c.close()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
 
 
 class BrokerBus:

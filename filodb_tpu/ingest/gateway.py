@@ -30,7 +30,9 @@ import time
 from ..core.record import RecordBuilder, fnv1a64
 from ..core.schemas import GAUGE, Schema, part_key_of, shard_key_of
 from ..parallel.shardmapper import ShardMapper
-from ..utils.metrics import registry
+from ..utils.metrics import (FILODB_GATEWAY_INGESTED_ROWS,
+                             FILODB_GATEWAY_PARSE_ERRORS,
+                             FILODB_SWALLOWED_ERRORS, registry)
 
 log = logging.getLogger("filodb_tpu.gateway")
 
@@ -194,9 +196,10 @@ class GatewayServer:
         self._states_lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._flusher: threading.Thread | None = None
-        self._parse_errors = registry.counter("filodb_gateway_parse_errors")
+        self._serve_thread: threading.Thread | None = None
+        self._parse_errors = registry.counter(FILODB_GATEWAY_PARSE_ERRORS)
         # rows, not lines: a line with F fields contributes F samples
-        self._rows = registry.counter("filodb_gateway_ingested_rows")
+        self._rows = registry.counter(FILODB_GATEWAY_INGESTED_ROWS)
         self.last_parse_error: str | None = None
         outer = self
 
@@ -223,7 +226,11 @@ class GatewayServer:
                     if pending.strip():
                         outer.ingest_line(pending.decode(errors="replace"), st)
                 except InfluxParseError:
-                    pass    # strict mode: the bad line drops the connection
+                    # strict mode: the bad line drops the connection — count
+                    # the severed connection so operators see the drop rate
+                    registry.counter(FILODB_SWALLOWED_ERRORS,
+                                     {"site": "gateway-strict-abort"}) \
+                        .increment()
                 finally:
                     with outer._states_lock:
                         outer._conn_states.discard(st)
@@ -237,7 +244,9 @@ class GatewayServer:
         return self._server.server_address[1]
 
     def start(self):
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="gw-serve")
+        self._serve_thread.start()
         if self.flush_interval_ms and self._flusher is None:
             self._flusher = threading.Thread(target=self._flush_loop,
                                              daemon=True, name="gw-flusher")
@@ -245,8 +254,18 @@ class GatewayServer:
         return self
 
     def stop(self):
+        """Deterministic teardown: stop accepting, release the listening
+        socket, and JOIN both threads (bounded) so a caller that restarts a
+        gateway on the same port never races the old acceptor."""
         self._stop_ev.set()
         self._server.shutdown()
+        self._server.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=3)
+            self._serve_thread = None
+        if self._flusher is not None:
+            self._flusher.join(timeout=3)
+            self._flusher = None
 
     def _all_states(self) -> list[_ConnState]:
         with self._states_lock:

@@ -17,11 +17,14 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from enum import IntEnum
 
-from ..utils.metrics import registry
+from ..utils.metrics import FILODB_SCHEDULER_WORKER_ERRORS, registry
+
+log = logging.getLogger("filodb_tpu.scheduler")
 
 
 class Priority(IntEnum):
@@ -85,27 +88,52 @@ class QueryScheduler:
             timeout=self.timeout_s if timeout_s is None else timeout_s)
 
     def _worker(self) -> None:
+        # the outer guard surfaces faults in the LOOP MACHINERY itself
+        # (heap/future/metrics bookkeeping): a silently-dead worker shrinks
+        # the pool until the queue backs up with nothing in the logs, so any
+        # such fault is logged + counted and the worker keeps serving
+        # (filolint: resource-worker-silent-death)
         while True:
-            with self._cv:
-                while not self._heap and not self._shutdown:
-                    self._cv.wait()
-                if self._shutdown and not self._heap:
-                    return
-                _, _, fut, fn = heapq.heappop(self._heap)
-                self._queued.update(len(self._heap))
-                self._n_active += 1
-                self._active.update(self._n_active)
+            fut = None
+            claimed = released = False
             try:
-                if fut.set_running_or_notify_cancel():
-                    try:
-                        fut.set_result(fn())
-                    except BaseException as e:  # noqa: BLE001 — delivered to caller
-                        fut.set_exception(e)
-            finally:
                 with self._cv:
-                    self._n_active -= 1
+                    while not self._heap and not self._shutdown:
+                        self._cv.wait()
+                    if self._shutdown and not self._heap:
+                        return
+                    _, _, fut, fn = heapq.heappop(self._heap)
+                    self._queued.update(len(self._heap))
+                    self._n_active += 1
+                    claimed = True
                     self._active.update(self._n_active)
-                self._completed.increment()
+                try:
+                    if fut.set_running_or_notify_cancel():
+                        try:
+                            fut.set_result(fn())
+                        except BaseException as e:  # noqa: BLE001 — delivered to caller
+                            fut.set_exception(e)
+                finally:
+                    with self._cv:
+                        self._n_active -= 1
+                        released = True
+                        self._active.update(self._n_active)
+                    self._completed.increment()
+            except Exception as e:  # noqa: BLE001 — worker survives, fault counted
+                log.exception("query-scheduler worker-loop fault (worker "
+                              "kept alive)")
+                registry.counter(FILODB_SCHEDULER_WORKER_ERRORS).increment()
+                # never strand the submitter on a bookkeeping fault: the
+                # popped future must complete, and a claimed-but-unreleased
+                # active slot must be returned or stats()/shedding skew
+                if fut is not None and not fut.done():
+                    try:
+                        fut.set_exception(e)
+                    except InvalidStateError:
+                        pass    # racing completion: the caller has a result
+                if claimed and not released:
+                    with self._cv:
+                        self._n_active -= 1
 
     def stats(self) -> dict:
         with self._cv:

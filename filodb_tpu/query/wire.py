@@ -192,9 +192,11 @@ def _dispatch_post(endpoint: str, dataset: str, body: bytes, timeout_s: float,
                    shards: tuple) -> bytes:
     """The ONE cross-node POST path: breaker admission, request counting,
     per-peer latency gauge, and transport-vs-peer error classification."""
-    from ..utils.metrics import registry
+    from ..utils.metrics import (FILODB_PEER_BREAKER_OPEN,
+                                 FILODB_PEER_EXEC_LATENCY_MS,
+                                 FILODB_PEER_EXEC_REQUESTS, registry)
     br = breakers.for_endpoint(endpoint)
-    gauge_open = registry.gauge("filodb_peer_breaker_open",
+    gauge_open = registry.gauge(FILODB_PEER_BREAKER_OPEN,
                                 {"endpoint": endpoint})
     if not br.admit():
         gauge_open.update(1.0)
@@ -202,7 +204,7 @@ def _dispatch_post(endpoint: str, dataset: str, body: bytes, timeout_s: float,
             f"peer {endpoint} circuit open (browned out); shedding fast for "
             f"shards {list(shards)}", endpoint=endpoint, shards=shards)
     breakers.note_request(endpoint)
-    registry.counter("filodb_peer_exec_requests",
+    registry.counter(FILODB_PEER_EXEC_REQUESTS,
                      {"endpoint": endpoint}).increment()
     url = f"http://{endpoint}/exec/{dataset}"
     req = urllib.request.Request(
@@ -239,7 +241,7 @@ def _dispatch_post(endpoint: str, dataset: str, body: bytes, timeout_s: float,
             endpoint=endpoint, shards=shards) from None
     br.record_success()
     gauge_open.update(0.0)
-    registry.gauge("filodb_peer_exec_latency_ms", {"endpoint": endpoint}) \
+    registry.gauge(FILODB_PEER_EXEC_LATENCY_MS, {"endpoint": endpoint}) \
         .update((time.perf_counter() - t0) * 1000.0)
     return payload
 

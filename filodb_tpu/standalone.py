@@ -23,7 +23,9 @@ from .parallel.cluster import ShardManager, ShardStatus
 from .parallel.shardmapper import ShardMapper
 from .query.engine import QueryEngine
 from .query.rangevector import QueryError
-from .utils.metrics import ShardHealthStats, registry
+from .utils.metrics import (FILODB_INGEST_DECODE_ERRORS,
+                            FILODB_INGESTED_ROWS, FILODB_SWALLOWED_ERRORS,
+                            ShardHealthStats, registry)
 from .utils.tracing import tracer
 
 log = logging.getLogger("filodb_tpu.server")
@@ -59,7 +61,11 @@ class _DecodeAhead:
                 if self._closed:
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            # fail LOUD: the consumer re-raises on its next __next__, and the
+            # counter makes a recurring decode fault visible even when the
+            # consumer's retry loop keeps absorbing it
             self._err = e
+            registry.counter(FILODB_INGEST_DECODE_ERRORS).increment()
         while not self._closed:
             try:
                 self._q.put(self._END, timeout=0.5)
@@ -153,7 +159,7 @@ class IngestionConsumer(threading.Thread):
                     if self._stop_ev.wait(backoff):
                         return
             self.manager.set_status(self.dataset, sh.shard_num, ShardStatus.ACTIVE)
-            rows = registry.counter("filodb_ingested_rows",
+            rows = registry.counter(FILODB_INGESTED_ROWS,
                                     {"dataset": self.dataset, "shard": str(sh.shard_num)})
             last_purge = time.monotonic()
             backoff = 0.0
@@ -271,7 +277,11 @@ class FiloServer:
         try:
             import jax
             devs = jax.devices()
-        except Exception:
+        except Exception:  # noqa: BLE001 — no usable backend: single-device
+            # placement is the correct fallback, but count the probe failure
+            # so a mis-provisioned multi-chip node is visible in /metrics
+            registry.counter(FILODB_SWALLOWED_ERRORS,
+                             {"site": "shard-device-probe"}).increment()
             return None
         return devs[shard_num % len(devs)] if len(devs) > 1 else None
 
@@ -534,11 +544,15 @@ class FiloServer:
                 self._gw_flush_stop = threading.Event()
 
                 def gw_bus_flush():
+                    # broad on purpose: ANY fault must not kill the drain
+                    # loop for the server's lifetime — sub-window remainders
+                    # would never flush again (filolint:
+                    # resource-worker-silent-death)
                     while not self._gw_flush_stop.wait(gw_iv_ms / 1000.0):
                         for b in list(self._gw_buses.values()):
                             try:
                                 b.flush_publishes()
-                            except (ConnectionError, OSError, RuntimeError):
+                            except Exception:  # noqa: BLE001
                                 log.warning("gateway publish flush failed",
                                             exc_info=True)
 

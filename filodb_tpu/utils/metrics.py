@@ -15,6 +15,83 @@ import time
 from bisect import bisect_right
 from collections import defaultdict
 
+# ---------------------------------------------------------------------------
+# Declared metric surface.
+#
+# Every ``filodb_*`` series this process exports is named by ONE constant
+# below and documented in METRICS_SPEC — filolint's surface-check family
+# enforces it (a literal name at a registration site, an undeclared
+# constant, a kind mismatch, or two constants sharing a name all fail
+# tier-1), and the README "Metrics" table is generated from this dict so
+# docs cannot drift from code.  A ``*`` suffix declares a dynamic family
+# (names built with an f-string prefix).
+# ---------------------------------------------------------------------------
+
+FILODB_INGESTED_ROWS = "filodb_ingested_rows"
+FILODB_GATEWAY_INGESTED_ROWS = "filodb_gateway_ingested_rows"
+FILODB_GATEWAY_PARSE_ERRORS = "filodb_gateway_parse_errors"
+FILODB_INGEST_DECODE_ERRORS = "filodb_ingest_decode_errors"
+FILODB_SWALLOWED_ERRORS = "filodb_swallowed_errors"
+FILODB_SCHEDULER_WORKER_ERRORS = "filodb_scheduler_worker_errors"
+FILODB_PEER_EXEC_REQUESTS = "filodb_peer_exec_requests"
+FILODB_PEER_EXEC_LATENCY_MS = "filodb_peer_exec_latency_ms"
+FILODB_PEER_BREAKER_OPEN = "filodb_peer_breaker_open"
+FILODB_SHARD_STATUS = "filodb_shard_status"
+FILODB_SHARD_NUM_SERIES = "filodb_shard_num_series"
+FILODB_SHARD_LOCK_CONTENTIONS = "filodb_shard_lock_contentions"
+FILODB_SHARD_LOCK_LONG_HOLDS = "filodb_shard_lock_long_holds"
+
+METRICS_SPEC: dict[str, tuple[str, str]] = {
+    FILODB_INGESTED_ROWS: (
+        "counter", "Rows ingested per dataset/shard by the bus consumers."),
+    FILODB_GATEWAY_INGESTED_ROWS: (
+        "counter", "Samples accepted by the line-protocol gateway "
+                   "(a line with F fields contributes F)."),
+    FILODB_GATEWAY_PARSE_ERRORS: (
+        "counter", "Malformed line-protocol lines dropped by the gateway "
+                   "(latest offender sampled in last_parse_error)."),
+    FILODB_INGEST_DECODE_ERRORS: (
+        "counter", "Decode-ahead worker faults surfaced to the consumer "
+                   "(the batch is re-fetched; a rising rate means a "
+                   "corrupt bus segment)."),
+    FILODB_SWALLOWED_ERRORS: (
+        "counter", "Errors intentionally dropped on non-critical paths, "
+                   "tagged by site= — the observability replacement for "
+                   "`except: pass` (filolint except-swallow)."),
+    FILODB_SCHEDULER_WORKER_ERRORS: (
+        "counter", "Query-scheduler worker-loop faults outside task "
+                   "execution; the worker survives and the fault is "
+                   "counted instead of killing the thread."),
+    FILODB_PEER_EXEC_REQUESTS: (
+        "counter", "Cross-node /exec dispatches per endpoint."),
+    FILODB_PEER_EXEC_LATENCY_MS: (
+        "gauge", "Last cross-node /exec round-trip latency per endpoint."),
+    FILODB_PEER_BREAKER_OPEN: (
+        "gauge", "1 while the per-peer circuit breaker is open (dispatches "
+                 "shed fast as 503)."),
+    FILODB_SHARD_STATUS: (
+        "gauge", "Shard count per dataset and status "
+                 "(Active/Assigned/Recovery/Down/Unassigned)."),
+    FILODB_SHARD_NUM_SERIES: (
+        "gauge", "Live series per shard."),
+    FILODB_SHARD_LOCK_CONTENTIONS: (
+        "gauge", "TimedRLock contention count per shard (diagnostics)."),
+    FILODB_SHARD_LOCK_LONG_HOLDS: (
+        "gauge", "TimedRLock long-hold count per shard (diagnostics)."),
+    "filodb_shard_*": (
+        "gauge", "Per-shard ingest/eviction stats exported from the shard's "
+                 "IngestStats dataclass fields on each /metrics scrape."),
+}
+
+
+def metrics_markdown_table() -> str:
+    """The README 'Metrics' table, generated from METRICS_SPEC (verified
+    against the checked-in README by tests/test_static_analysis.py)."""
+    lines = ["| metric | kind | meaning |", "|---|---|---|"]
+    for name, (kind, doc) in sorted(METRICS_SPEC.items()):
+        lines.append(f"| `{name}` | {kind} | {doc} |")
+    return "\n".join(lines)
+
 
 class Counter:
     def __init__(self):
@@ -128,6 +205,6 @@ class ShardHealthStats:
         for info in snapshot.values():
             counts[info["status"]] += 1
         for status in ("Active", "Assigned", "Recovery", "Down", "Unassigned"):
-            self.reg.gauge("filodb_shard_status",
+            self.reg.gauge(FILODB_SHARD_STATUS,
                            {"dataset": self.dataset, "status": status}
                            ).update(counts.get(status, 0))

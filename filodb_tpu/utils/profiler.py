@@ -39,13 +39,20 @@ class SimpleProfiler:
     def _run(self) -> None:
         me = threading.get_ident()
         while not self._stop.wait(self.interval_s):
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                stack = traceback.extract_stack(frame, limit=12)
-                key = tuple(f"{f.filename.rsplit('/', 1)[-1]}:{f.name}:{f.lineno}"
-                            for f in stack[-6:])
-                self._samples[key] += 1
+            try:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = traceback.extract_stack(frame, limit=12)
+                    key = tuple(f"{f.filename.rsplit('/', 1)[-1]}:{f.name}:{f.lineno}"
+                                for f in stack[-6:])
+                    self._samples[key] += 1
+            except Exception:  # noqa: BLE001 — a torn frame from a racing
+                # thread exit must not kill the sampler for the process
+                # lifetime; count it and keep sampling
+                from .metrics import FILODB_SWALLOWED_ERRORS, registry
+                registry.counter(FILODB_SWALLOWED_ERRORS,
+                                 {"site": "profiler-sample"}).increment()
 
     def report(self) -> str:
         total = sum(self._samples.values()) or 1

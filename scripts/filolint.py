@@ -5,9 +5,11 @@ Same engine as ``python -m filodb_tpu.analysis`` (pure ast, no jax import,
 safe without a TPU); exits non-zero on NEW findings and prints the per-rule
 summary that bench/CHANGES entries quote. Run from anywhere:
 
-    python scripts/filolint.py              # analyze filodb_tpu/
-    python scripts/filolint.py --quiet
+    python scripts/filolint.py                    # analyze filodb_tpu/
+    python scripts/filolint.py --changed-only     # fast git-scoped pre-commit
+    python scripts/filolint.py --format json      # CI report (also: sarif)
     python scripts/filolint.py filodb_tpu/query   # narrower scope
+    python scripts/filolint.py --update-baseline --reason "why"
 """
 
 import sys

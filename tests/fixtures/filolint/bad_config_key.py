@@ -1,0 +1,17 @@
+"""surface-config-undeclared + surface-config-unused: a read of a key the
+spec never declared, and a declared key nothing reads."""
+
+CONFIG_SPEC = {
+    "ingest.window": ("int", 64, "Frames per round trip."),
+    "ingest.retired_knob": ("int", 0, "Removed feature, never read."),
+    # top-level (undotted) dead key: the spec's own literal must not count
+    # as usage, or this shape could never be flagged
+    "retired_flag": ("bool", False, "Removed feature, never read."),
+}
+
+
+def start(cfg):
+    w = cfg.get("ingest.window")
+    # typo'd key: not declared (and would KeyError on strict access)
+    d = cfg.get("ingest.decode_ahed", 2)
+    return w, d

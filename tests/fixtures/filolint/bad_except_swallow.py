@@ -1,0 +1,48 @@
+"""except-swallow + except-overbroad-typed + except-state-leak: the three
+broad-handler failure shapes."""
+import threading
+
+
+class QueryError(Exception):
+    pass
+
+
+class PeerGone(QueryError):
+    pass
+
+
+def fetch_remote(endpoint):
+    raise PeerGone(endpoint)
+
+
+def dispatch(endpoint):
+    # overbroad: fetch_remote may raise PeerGone (typed, interprocedural)
+    # and nothing before this handler names it — classification is lost
+    try:
+        return fetch_remote(endpoint)
+    except Exception:
+        return None
+
+
+def probe(endpoint):
+    # swallow: broad handler, no observable action at all
+    try:
+        return fetch_remote(endpoint)
+    except Exception:
+        pass
+
+
+class Emitter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc = {}
+
+    def emit(self, publish):
+        with self._lock:
+            claimed = {k: self._acc.pop(k) for k in list(self._acc)}
+        try:
+            publish(claimed)
+        except Exception:
+            # state-leak: the claim dies here — neither restored nor
+            # re-raised; `claimed` rows are silently gone
+            return None

@@ -1,0 +1,25 @@
+"""Bad twin of the inherited-holder case: the SAME private helper, but one
+of its in-class call sites does not hold the owner lock — the inheritance
+must not apply and the unheld *_locked call inside the helper is flagged."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.count = 0
+
+    def _incr_locked(self):
+        self.count += 1
+
+    def _bump(self):
+        self._incr_locked()
+
+    def ingest(self, rows):
+        with self.lock:
+            for _ in rows:
+                self._bump()
+
+    def stats_probe(self):
+        # non-holder call site: _bump cannot inherit the holder fact
+        self._bump()

@@ -1,0 +1,20 @@
+"""surface-metric-duplicate + surface-metric-undeclared + surface-metric-
+kind: two constants sharing one series name, a literal registration, and a
+kind mismatch."""
+
+FILODB_ROWS_IN = "filodb_rows_total"
+FILODB_ROWS_OUT = "filodb_rows_total"      # duplicate: same series name
+FILODB_LAG = "filodb_lag"
+
+METRICS_SPEC = {
+    FILODB_ROWS_IN: ("counter", "Rows in."),
+    FILODB_ROWS_OUT: ("counter", "Rows out."),
+    FILODB_LAG: ("gauge", "Consumer lag."),
+}
+
+
+def wire(registry):
+    registry.counter(FILODB_ROWS_IN).increment()
+    registry.counter(FILODB_ROWS_OUT).increment()
+    registry.counter(FILODB_LAG).increment()         # declared as gauge
+    registry.counter("filodb_adhoc_errors").increment()  # literal, undeclared

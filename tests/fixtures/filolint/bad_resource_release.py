@@ -1,0 +1,10 @@
+"""resource-no-release: a file handle that leaks on the exceptional path —
+parse() can raise between open and close, and nothing closes the handle on
+that path."""
+
+
+def load_index(path, parse):
+    f = open(path, "rb")
+    data = parse(f.read())      # a raise here leaks f
+    f.close()
+    return data

@@ -1,0 +1,21 @@
+"""resource-thread-no-stop + resource-server-no-stop: threads and servers
+with no shutdown story."""
+import socketserver
+import threading
+
+
+class LeakyServer:
+    def __init__(self):
+        self._server = socketserver.TCPServer(("127.0.0.1", 0), None)
+        # non-daemon thread stored but never joined anywhere in the class
+        self._worker = threading.Thread(target=self._work)
+
+    def start(self):
+        self._worker.start()
+        # anonymous serve_forever thread: never joinable, and no
+        # self._server.shutdown() exists in the class
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def _work(self):
+        pass

@@ -1,0 +1,27 @@
+"""resource-worker-silent-death: a Thread-subclass run loop and a target
+worker loop with no broad handler — one exception and the thread dies with
+nothing in the logs."""
+import threading
+
+
+class Consumer(threading.Thread):
+    def __init__(self, bus):
+        super().__init__(daemon=True)
+        self.bus = bus
+
+    def run(self):
+        while True:
+            batch = self.bus.poll()     # one raise here kills the consumer
+            self.bus.commit(batch)
+
+
+class Owner:
+    def __init__(self, q):
+        self.q = q
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self.q.get()                # same silent-death shape

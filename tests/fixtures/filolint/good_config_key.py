@@ -1,0 +1,12 @@
+"""Good twin: every read key is declared, every declared key is read."""
+
+CONFIG_SPEC = {
+    "ingest.window": ("int", 64, "Frames per round trip."),
+    "ingest.decode_ahead": ("int", 2, "Containers decoded ahead."),
+}
+
+
+def start(cfg):
+    w = cfg.get("ingest.window")
+    d = cfg["ingest.decode_ahead"]
+    return w, d

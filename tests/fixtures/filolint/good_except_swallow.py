@@ -1,0 +1,53 @@
+"""Good twin: typed handler precedes the broad one, swallows leave a
+trace, and a failed publish restores the claimed state."""
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+class QueryError(Exception):
+    pass
+
+
+class PeerGone(QueryError):
+    pass
+
+
+def fetch_remote(endpoint):
+    raise PeerGone(endpoint)
+
+
+def dispatch(endpoint):
+    try:
+        return fetch_remote(endpoint)
+    except QueryError:              # typed first: classification preserved
+        raise
+    except Exception:  # noqa: BLE001
+        log.exception("dispatch failed on %s", endpoint)
+        return None
+
+
+def probe(endpoint, swallowed):
+    try:
+        return fetch_remote(endpoint)
+    except QueryError:
+        return None                 # typed, narrow: not a swallow
+    except Exception:  # noqa: BLE001 — counted, not silent
+        swallowed.increment()
+        return None
+
+
+class Emitter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc = {}
+
+    def emit(self, publish):
+        with self._lock:
+            claimed = {k: self._acc.pop(k) for k in list(self._acc)}
+        try:
+            publish(claimed)
+        except Exception:  # noqa: BLE001 — claim restored for retry
+            with self._lock:
+                self._acc.update(claimed)

@@ -1,0 +1,33 @@
+"""Good twin (the PR 3 lexical blind spot, closed by v2): a private helper
+with NO _locked suffix calls a *_locked method — legal, because its EVERY
+in-class call site holds the owner lock (one lexically, one transitively
+through another inherited helper). The PR 3 lexical pass flagged exactly
+this shape (lock-unheld-call in _bump/_bump_twice); the v2 inherited-holder
+fixpoint proves the lock is always held."""
+import threading
+
+
+class Shard:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.count = 0
+
+    def _incr_locked(self):
+        self.count += 1
+
+    def _bump(self):
+        # no suffix, no lexical `with` — holder is INHERITED from callers
+        self._incr_locked()
+
+    def _bump_twice(self):
+        self._bump()
+        self._bump()
+
+    def ingest(self, rows):
+        with self.lock:
+            for _ in rows:
+                self._bump()
+
+    def flush(self):
+        with self.lock:
+            self._bump_twice()

@@ -1,0 +1,21 @@
+"""Good twin: unique names, constants at call sites, kinds match the spec,
+and a wildcard family covers the dynamic names."""
+
+FILODB_ROWS_IN = "filodb_rows_in_total"
+FILODB_ROWS_OUT = "filodb_rows_out_total"
+FILODB_LAG = "filodb_lag"
+
+METRICS_SPEC = {
+    FILODB_ROWS_IN: ("counter", "Rows in."),
+    FILODB_ROWS_OUT: ("counter", "Rows out."),
+    FILODB_LAG: ("gauge", "Consumer lag."),
+    "filodb_stage_*": ("gauge", "Per-stage stats family."),
+}
+
+
+def wire(registry, stages):
+    registry.counter(FILODB_ROWS_IN).increment()
+    registry.counter(FILODB_ROWS_OUT).increment()
+    registry.gauge(FILODB_LAG).update(0.0)
+    for s in stages:
+        registry.gauge(f"filodb_stage_{s}").update(1.0)

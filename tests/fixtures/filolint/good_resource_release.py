@@ -1,0 +1,15 @@
+"""Good twin: release on ALL paths — try/finally for the explicit handle,
+`with` for the second."""
+
+
+def load_index(path, parse):
+    f = open(path, "rb")
+    try:
+        return parse(f.read())
+    finally:
+        f.close()
+
+
+def load_meta(path, parse):
+    with open(path, "rb") as f:
+        return parse(f.read())

@@ -1,0 +1,28 @@
+"""Good twin: every thread has a shutdown story — daemon flag, or a stop()
+that shuts the server down and joins with a timeout (via a helper: the
+interprocedural class closure must credit it)."""
+import socketserver
+import threading
+
+
+class CleanServer:
+    def __init__(self):
+        self._server = socketserver.TCPServer(("127.0.0.1", 0), None)
+        self._worker = threading.Thread(target=self._work, daemon=True)
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._worker.start()
+        self._serve_thread.start()
+
+    def stop(self):
+        self._teardown()
+
+    def _teardown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._serve_thread.join(timeout=3)
+
+    def _work(self):
+        pass

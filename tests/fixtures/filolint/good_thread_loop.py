@@ -1,0 +1,38 @@
+"""Good twin: worker loops fail loud — the run loop is wrapped in a broad
+handler that records the fault, and the drain loop counts per iteration."""
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+class Consumer(threading.Thread):
+    def __init__(self, bus):
+        super().__init__(daemon=True)
+        self.bus = bus
+        self.last_error = None
+
+    def run(self):
+        try:
+            while True:
+                batch = self.bus.poll()
+                self.bus.commit(batch)
+        except Exception as e:  # noqa: BLE001 — surfaced to the owner
+            self.last_error = e
+            log.exception("consumer died")
+
+
+class Owner:
+    def __init__(self, q, errors):
+        self.q = q
+        self.errors = errors
+
+    def start(self):
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            try:
+                self.q.get()
+            except Exception:  # noqa: BLE001 — loop survives, fault counted
+                self.errors.increment()

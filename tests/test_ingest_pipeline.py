@@ -330,3 +330,50 @@ def test_windowed_producer_to_consumer_end_to_end(tmp_path):
         if srv:
             srv.shutdown()
         broker.stop()
+
+
+def _assert_port_released(host, port, timeout_s=5.0):
+    """The LISTENER must be gone: a live listen socket fails this bind for
+    the whole window, while transient teardown states of severed
+    connections (TIME_WAIT/CLOSE_WAIT under suite load) clear within it."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        probe = socket.socket()
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            probe.bind((host, port))
+            return
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+        finally:
+            probe.close()
+
+
+def test_gateway_and_broker_deterministic_stop(tmp_path):
+    """PR-5 lifecycle satellite: stop() shuts the server down, releases the
+    listening socket, and JOINS the serve/flusher threads — the port is
+    immediately rebindable and no thread outlives the stop."""
+    published = []
+    gw = GatewayServer(lambda s, c: published.append((s, c)), num_shards=2,
+                       flush_interval_ms=50).start()
+    host, port = "127.0.0.1", gw.port
+    with socket.create_connection((host, port), timeout=5) as s:
+        s.sendall(_lines(3)[0].encode() + b"\n")
+    gw.flush()
+    gw.stop()
+    assert gw._serve_thread is None and gw._flusher is None
+    _assert_port_released(host, port)
+
+    brk = BrokerServer(str(tmp_path / "broker"), num_partitions=1).start()
+    bport = brk.port
+    serve_thread = brk._thread
+    bus = BrokerBus(f"127.0.0.1:{bport}", 0)
+    b = RecordBuilder(GAUGE)
+    b.add({"_metric_": "m", "host": "h0"}, BASE * 1000, 1.0)
+    bus.publish(b.build())
+    brk.stop()
+    assert brk._thread is None and not serve_thread.is_alive()
+    _assert_port_released("127.0.0.1", bport)
+    bus.close()

@@ -129,3 +129,29 @@ def test_slow_query_returns_504():
     finally:
         srv.stop()
         sched.shutdown()
+
+
+def test_worker_bookkeeping_fault_completes_future_and_survives():
+    """PR-5 review fix: a fault in the worker's own bookkeeping (between
+    heappop and task execution) must complete the popped future — not
+    strand the submitter until timeout — return the claimed active slot,
+    and leave the worker serving."""
+    from filodb_tpu.query.scheduler import QueryScheduler
+    s = QueryScheduler(num_threads=1, max_queue=4, name="bkfault-sched")
+    state = {"armed": True}
+    orig = s._active.update
+
+    def flaky(v):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("metrics backend down")
+        orig(v)
+
+    s._active.update = flaky
+    fut = s.submit(lambda: 42)
+    with pytest.raises(RuntimeError, match="metrics backend down"):
+        fut.result(timeout=5)
+    # the worker survived the fault and the active slot was returned
+    assert s.run(lambda: 7, timeout_s=5) == 7
+    assert s.stats()["active"] == 0
+    s.shutdown()

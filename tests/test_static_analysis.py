@@ -40,6 +40,20 @@ BAD_FIXTURES = {
     "bad_jit_branch.py": {"jit-traced-branch"},
     "bad_jit_closure.py": {"jit-mutable-closure"},
     "bad_jit_static.py": {"jit-static-args"},
+    # v2 interprocedural families (resource lifecycle / except-flow /
+    # declared surface / inherited-holder lockcheck)
+    "bad_thread_leak.py": {"resource-thread-no-stop",
+                           "resource-server-no-stop"},
+    "bad_thread_loop.py": {"resource-worker-silent-death"},
+    "bad_resource_release.py": {"resource-no-release"},
+    "bad_except_swallow.py": {"except-swallow", "except-overbroad-typed",
+                              "except-state-leak"},
+    "bad_config_key.py": {"surface-config-undeclared",
+                          "surface-config-unused"},
+    "bad_metric_dup.py": {"surface-metric-duplicate",
+                          "surface-metric-undeclared",
+                          "surface-metric-kind"},
+    "bad_lock_helper.py": {"lock-unheld-call"},
 }
 
 
@@ -207,6 +221,349 @@ def test_baseline_matches_by_fingerprint_not_line():
     other = Finding("lock-unheld-call", "pkg/m.py", 10, "C.n",
                     "call:_x_locked", "msg")
     assert not b.covers(other)
+
+
+# -- interprocedural engine mechanics -----------------------------------------
+
+def test_helper_held_lock_closes_pr3_blind_spot():
+    """The acceptance fixture: a private helper whose every in-class call
+    site holds the owner lock. PR 3's lexical pass flagged the helper's
+    *_locked call (holder-ness was per-function); the v2 inherited-holder
+    fixpoint proves the lock is always held — and the bad twin (one
+    non-holder call site) is still flagged."""
+    good = analyze_file(FIXTURES / "good_lock_helper.py", root=REPO)
+    assert good == [], "\n".join(f.render() for f in good)
+    bad = analyze_file(FIXTURES / "bad_lock_helper.py", root=REPO)
+    assert any(f.rule == "lock-unheld-call" and f.symbol == "Shard._bump"
+               for f in bad)
+
+
+def test_may_raise_propagates_through_helpers():
+    """except-overbroad-typed depends on interprocedural may-raise: the
+    typed raise lives two calls below the broad handler."""
+    import textwrap
+    from filodb_tpu.analysis.callgraph import PackageIndex
+    src = textwrap.dedent("""
+        class QueryError(Exception):
+            pass
+        def a():
+            raise QueryError("x")
+        def b():
+            return a()
+        def c():
+            try:
+                return b()
+            except QueryError:
+                return None
+        def d():
+            return c()
+    """)
+    idx = PackageIndex({"m.py": ast.parse(src)})
+    mr = idx.may_raise(typed_only={"QueryError"})
+    assert "QueryError" in mr["m.py::a"]
+    assert "QueryError" in mr["m.py::b"]          # propagated up
+    assert "QueryError" not in mr["m.py::c"]      # caught at the call site
+    assert "QueryError" not in mr["m.py::d"]
+
+
+def test_cfg_release_analysis_sees_exceptional_paths():
+    from filodb_tpu.analysis import analyze_file as _af
+    bad = _af(FIXTURES / "bad_resource_release.py", root=REPO)
+    assert [f.rule for f in bad] == ["resource-no-release"]
+    good = _af(FIXTURES / "good_resource_release.py", root=REPO)
+    assert good == []
+
+
+def test_overbroad_typed_respects_nested_handlers(tmp_path):
+    """A defensive INNER `except QueryError` fully consumes the typed raise;
+    the outer broad handler must stay clean (nested-frame filtering)."""
+    src = (
+        "class QueryError(Exception):\n"
+        "    pass\n"
+        "def helper():\n"
+        "    raise QueryError('x')\n"
+        "def outer(log):\n"
+        "    try:\n"
+        "        try:\n"
+        "            return helper()\n"
+        "        except QueryError:\n"
+        "            return None\n"
+        "    except Exception:\n"
+        "        log('unexpected')\n"
+        "        return None\n"
+    )
+    p = tmp_path / "nested.py"
+    p.write_text(src)
+    findings = analyze_file(p, root=tmp_path)
+    assert not any(f.rule == "except-overbroad-typed" for f in findings), \
+        "\n".join(f.render() for f in findings)
+    # and WITHOUT the inner typed handler it does flag
+    p.write_text(src.replace("        except QueryError:\n"
+                             "            return None\n",
+                             "        finally:\n"
+                             "            pass\n"))
+    findings = analyze_file(p, root=tmp_path)
+    assert any(f.rule == "except-overbroad-typed" for f in findings)
+
+
+def test_escaped_method_reference_defeats_holder_inheritance(tmp_path):
+    """A private helper passed as a Thread target can run WITHOUT the lock
+    even if its only direct call site holds it — the reference escape must
+    block holder inheritance and keep PR 3's finding."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.RLock()\n"
+        "    def _bump_locked(self):\n"
+        "        pass\n"
+        "    def _bump(self):\n"
+        "        self._bump_locked()\n"
+        "    def kick(self):\n"
+        "        with self.lock:\n"
+        "            self._bump()\n"
+        "        threading.Thread(target=self._bump, daemon=True).start()\n"
+    )
+    p = tmp_path / "escape.py"
+    p.write_text(src)
+    findings = analyze_file(p, root=tmp_path)
+    assert any(f.rule == "lock-unheld-call" and f.symbol == "C._bump"
+               for f in findings), "\n".join(f.render() for f in findings)
+
+
+def test_may_raise_survives_log_and_reraise():
+    """`except QueryError: raise` observes but does not terminate — the
+    typed class must keep propagating so a downstream broad swallow is
+    still flagged."""
+    import textwrap
+    from filodb_tpu.analysis.callgraph import PackageIndex
+    src = textwrap.dedent("""
+        class QueryError(Exception):
+            pass
+        def a():
+            raise QueryError("x")
+        def b(log):
+            try:
+                return a()
+            except QueryError:
+                log("typed failure")
+                raise
+    """)
+    idx = PackageIndex({"m.py": ast.parse(src)})
+    mr = idx.may_raise(typed_only={"QueryError"})
+    assert "QueryError" in mr["m.py::b"]
+
+
+def test_release_leak_through_nonmatching_handler(tmp_path):
+    """An exception of a type the handler does NOT catch still escapes —
+    the CFG must route it past non-terminal handler frames to EXIT."""
+    bad = ("def f(p, use):\n"
+           "    fh = open(p)\n"
+           "    try:\n"
+           "        use(fh)\n"
+           "    except ValueError:\n"
+           "        pass\n"
+           "    fh.close()\n")
+    p = tmp_path / "leak.py"
+    p.write_text(bad)
+    findings = analyze_file(p, root=tmp_path)
+    assert any(f.rule == "resource-no-release" for f in findings), \
+        "\n".join(f.render() for f in findings)
+    # adding a finally makes every path (matched, unmatched, normal) release
+    p.write_text(bad.replace("        pass\n    fh.close()\n",
+                             "        pass\n    finally:\n"
+                             "        fh.close()\n"))
+    assert analyze_file(p, root=tmp_path) == []
+
+
+def test_changed_only_rebases_paths_below_git_toplevel(tmp_path):
+    """Porcelain paths are toplevel-relative; a vendored analysis root must
+    still see its changed files instead of silently analyzing nothing."""
+    import subprocess
+    from filodb_tpu.analysis.__main__ import _changed_files
+    sub = tmp_path / "vendor" / "repo"
+    (sub / "filodb_tpu").mkdir(parents=True)
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    f = sub / "filodb_tpu" / "x.py"
+    f.write_text("x = 1\n")
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    assert _changed_files(sub) == ["filodb_tpu/x.py"]
+
+
+def test_nested_def_trys_analyzed_once_with_own_sink_status(tmp_path):
+    """A try inside a closure belongs to the closure's unit only: no
+    duplicate findings from the enclosing method's walk, and a
+    thread-target closure keeps its sink exemption."""
+    src = (
+        "import threading\n"
+        "class QueryError(Exception):\n"
+        "    pass\n"
+        "def helper():\n"
+        "    raise QueryError('x')\n"
+        "class C:\n"
+        "    def start(self, log):\n"
+        "        def worker():\n"
+        "            while True:\n"
+        "                try:\n"
+        "                    helper()\n"
+        "                except Exception:\n"
+        "                    log('fault; loop survives')\n"
+        "        threading.Thread(target=worker, daemon=True).start()\n"
+    )
+    p = tmp_path / "closure.py"
+    p.write_text(src)
+    findings = analyze_file(p, root=tmp_path)
+    overbroad = [f for f in findings if f.rule == "except-overbroad-typed"]
+    assert overbroad == [], "\n".join(f.render() for f in findings)
+    # and a swallow in a closure is reported exactly once (closure's unit)
+    src2 = ("def outer(x):\n"
+            "    def worker():\n"
+            "        try:\n"
+            "            return x()\n"
+            "        except Exception:\n"
+            "            pass\n"
+            "    return worker\n")
+    p.write_text(src2)
+    swallows = [f for f in analyze_file(p, root=tmp_path)
+                if f.rule == "except-swallow"]
+    assert len(swallows) == 1 and swallows[0].symbol == "outer.worker"
+
+
+def test_close_after_try_finally_is_clean(tmp_path):
+    """The normal path through a try/finally continues to the code AFTER
+    the try — no phantom function-exit edge may bypass a later release."""
+    src = ("def f(p, use, log):\n"
+           "    fh = open(p)\n"
+           "    try:\n"
+           "        use(fh)\n"
+           "    finally:\n"
+           "        log('done')\n"
+           "    fh.close()\n")
+    p = tmp_path / "after.py"
+    p.write_text(src)
+    findings = analyze_file(p, root=tmp_path)
+    # close-after-the-try IS leaky on the exceptional path (use may raise;
+    # the trailing close never runs) — that finding must stay...
+    assert any(f.rule == "resource-no-release" for f in findings)
+    # ...but moving the close INTO the finally covers every path, and the
+    # normal-flow finally copy must not grow a phantom EXIT edge
+    src_ok = src.replace("        log('done')\n    fh.close()\n",
+                         "        log('done')\n        fh.close()\n")
+    p.write_text(src_ok)
+    assert analyze_file(p, root=tmp_path) == []
+
+
+def test_bad_config_fixture_flags_dead_toplevel_key():
+    findings = analyze_file(FIXTURES / "bad_config_key.py", root=REPO)
+    details = {f.detail for f in findings
+               if f.rule == "surface-config-unused"}
+    assert {"key:ingest.retired_knob", "key:retired_flag"} <= details
+
+
+def test_update_baseline_narrow_scope_preserves_out_of_scope_entries(tmp_path):
+    """--update-baseline on a narrowed path set must not delete baseline
+    promises for files it never re-analyzed."""
+    import json as _json
+    from filodb_tpu.analysis.__main__ import main
+    swallow = ("def f(x):\n"
+               "    try:\n"
+               "        return x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(swallow)
+    b.write_text(swallow)
+    bl = tmp_path / "bl.json"
+    # baseline BOTH files' findings via a full-scope pass
+    assert main(["--root", str(tmp_path), str(a), str(b), "--baseline",
+                 str(bl), "--update-baseline", "--reason", "fixture"]) == 0
+    entries = _json.loads(bl.read_text())["entries"]
+    assert {e["file"] for e in entries} == {"a.py", "b.py"}
+    # narrow re-baseline of a.py only: b.py's promise must survive
+    assert main(["--root", str(tmp_path), str(a), "--baseline", str(bl),
+                 "--update-baseline", "--reason", "fixture"]) == 0
+    entries = _json.loads(bl.read_text())["entries"]
+    assert {e["file"] for e in entries} == {"a.py", "b.py"}
+
+
+# -- tooling: output formats, baseline discipline -----------------------------
+
+def test_baseline_write_refuses_missing_reason(tmp_path):
+    f = Finding("except-swallow", "m.py", 3, "f", "swallow:1", "msg")
+    with pytest.raises(ValueError):
+        Baseline.write(tmp_path / "b.json", [f])
+    Baseline.write(tmp_path / "b.json", [f], reason="intentional: probe")
+    b = Baseline.load(tmp_path / "b.json")
+    assert b.covers(f) and b.entries[0]["reason"] == "intentional: probe"
+
+
+def test_update_baseline_cli_refuses_without_reason(tmp_path):
+    """--update-baseline with new findings and no --reason exits 2 and does
+    not write."""
+    from filodb_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad_swallow.py"
+    bad.write_text("def f(x):\n"
+                   "    try:\n"
+                   "        return x()\n"
+                   "    except Exception:\n"
+                   "        pass\n")
+    bl = tmp_path / "bl.json"
+    rc = main(["--root", str(tmp_path), str(bad), "--baseline", str(bl),
+               "--update-baseline", "--quiet"])
+    assert rc == 2 and not bl.exists()
+    rc = main(["--root", str(tmp_path), str(bad), "--baseline", str(bl),
+               "--update-baseline", "--reason", "fixture: deliberate"])
+    assert rc == 0 and bl.exists()
+    # baselined now: a plain run is clean against the updated baseline
+    assert main(["--root", str(tmp_path), str(bad), "--baseline", str(bl),
+                 "--quiet"]) == 0
+
+
+def test_output_formats_are_machine_readable(capsys):
+    import json as _json
+    from filodb_tpu.analysis.__main__ import main
+    assert main(["--root", str(REPO), "--format", "json"]) == 0
+    report = _json.loads(capsys.readouterr().out)
+    assert report["counts"]["new"] == 0 and report["files_analyzed"] > 50
+    assert main(["--root", str(REPO), "--format", "sarif"]) == 0
+    sarif = _json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "filolint"
+    assert run["results"] == []          # zero NEW findings repo-wide
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"resource-no-release", "except-overbroad-typed",
+            "surface-config-undeclared"} <= rule_ids
+
+
+# -- declared surfaces: spec <-> docs parity ----------------------------------
+
+def test_readme_config_table_matches_spec():
+    from filodb_tpu.config import CONFIG_SPEC, config_markdown_table
+    readme = (REPO / "README.md").read_text()
+    assert config_markdown_table() in readme, (
+        "README Configuration table drifted from config.py CONFIG_SPEC — "
+        "regenerate it with filodb_tpu.config.config_markdown_table()")
+    assert len(CONFIG_SPEC) >= 40
+
+
+def test_readme_metrics_table_matches_spec():
+    from filodb_tpu.utils.metrics import METRICS_SPEC, metrics_markdown_table
+    readme = (REPO / "README.md").read_text()
+    assert metrics_markdown_table() in readme, (
+        "README Metrics table drifted from utils/metrics.py METRICS_SPEC — "
+        "regenerate it with filodb_tpu.utils.metrics.metrics_markdown_table()")
+    assert "filodb_swallowed_errors" in METRICS_SPEC
+
+
+def test_defaults_derive_from_config_spec():
+    """One source of truth: the DEFAULTS tree is exactly the nested form of
+    CONFIG_SPEC's defaults, and Config resolves every declared key."""
+    from filodb_tpu.config import CONFIG_SPEC, Config
+    cfg = Config()
+    for key, (_typ, default, _doc) in CONFIG_SPEC.items():
+        assert cfg[key] == default, key
 
 
 # -- 2. repo enforcement ------------------------------------------------------
