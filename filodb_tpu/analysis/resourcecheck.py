@@ -30,7 +30,15 @@ outage, not a test failure:
     must be released on ALL CFG paths (``with`` / ``try: ... finally:
     close()``), unless it is returned or stored on ``self`` (then the
     class-level rules own it).  Path analysis comes from analysis/cfg.py,
-    including the exceptional edges.
+    including the exceptional edges.  The same rule covers TRANSITIVE
+    socket ownership (the replicated-ingest tier's shape): an in-package
+    class that stores a raw socket on ``self`` is a *socket owner*
+    (BrokerBus, FollowerLink), a class storing an instance of an owner is
+    transitively one (Replicator holds FollowerLinks, FiloServer holds
+    BrokerBuses), and every class that INSTANTIATES an owner into a self
+    attribute must have a ``close()``/``stop()`` for that attribute
+    reachable in the class — a replication link pool with no teardown is
+    a socket leak per failover, invisible until the fd limit.
 
 The class-level rules use the shared PackageIndex (analysis/callgraph.py)
 so a release that lives in a helper (``stop()`` -> ``_teardown()``) still
@@ -82,6 +90,10 @@ class _ClassResources:
         self.threads: list[tuple] = []    # (attr|None, line, call, qual)
         self.serves: list[tuple] = []     # (attr|None, line, server_root, qual)
         self.sockets: list[tuple] = []    # (attr, line, qual)
+        # in-package class instantiations stored on self: candidates for
+        # the transitive socket-owner closure (filtered in finalize once
+        # the owner set is known)
+        self.owned: list[tuple] = []      # (attr, line, class leaf, qual)
         # per-method direct release effects
         self.joined: dict[str, set] = {}      # method -> attr roots joined
         self.closed: dict[str, set] = {}
@@ -185,6 +197,13 @@ class _ClassResources:
             attr = self._store_attr(call, method)
             if attr:
                 self.sockets.append((attr, call.lineno, qual))
+        elif leaf and leaf[0].isupper() and leaf in self.index.class_by_name:
+            # instantiation of an in-package class stored on self — a
+            # candidate owned resource (meaningful once the socket-owner
+            # closure says the class owns sockets)
+            attr = self._store_attr(call, method)
+            if attr:
+                self.owned.append((attr, call.lineno, leaf, qual))
 
     def _is_pkg_thread_subclass(self, fname: str) -> bool:
         leaf = fname.rsplit(".", 1)[-1]
@@ -272,20 +291,42 @@ class ResourceChecker:
     def finalize(self) -> list[Finding]:
         index = self.project or PackageIndex(self._modules)
         findings: list[Finding] = []
+        class_res: list[tuple[str, _ClassResources]] = []
         for path, tree in self._modules.items():
             for node in tree.body:
                 if isinstance(node, ast.ClassDef):
-                    findings += self._check_class(path, node, index)
+                    class_res.append((path,
+                                      _ClassResources(path, node, index)))
             findings += self._check_module_threads(path, tree, index)
+        owners = self._socket_owner_closure(class_res)
+        for path, res in class_res:
+            findings += self._check_class(path, res, owners)
         findings += self._check_worker_loops(index)
         findings += self._check_local_releases(index)
         return findings
 
     # -- class-level thread/server/socket lifecycle --------------------------
 
-    def _check_class(self, path: str, cls: ast.ClassDef,
-                     index: PackageIndex) -> list[Finding]:
-        res = _ClassResources(path, cls, index)
+    @staticmethod
+    def _socket_owner_closure(
+            class_res: list[tuple[str, "_ClassResources"]]) -> set[str]:
+        """Class names that own sockets, directly (self-stored
+        SOCKET_CTORS) or transitively (self-stored instantiation of an
+        owner class) — the replicated-ingest link/bus shape."""
+        owners = {res.cls.name for _p, res in class_res if res.sockets}
+        changed = True
+        while changed:
+            changed = False
+            for _p, res in class_res:
+                if res.cls.name in owners:
+                    continue
+                if any(leaf in owners for _a, _l, leaf, _q in res.owned):
+                    owners.add(res.cls.name)
+                    changed = True
+        return owners
+
+    def _check_class(self, path: str, res: "_ClassResources",
+                     owners: set[str]) -> list[Finding]:
         findings: list[Finding] = []
         joined, closed, shut = (res.all_joined(), res.all_closed(),
                                 res.all_shutdown())
@@ -327,6 +368,19 @@ class ResourceChecker:
                     f"socket:{attr}",
                     f"socket stored in self.{attr} has no close() reachable "
                     "from this class — a close()/stop() must release it"))
+        seen: set[tuple[str, str]] = set()
+        for attr, line, leaf, qual in res.owned:
+            if leaf not in owners or attr in closed \
+                    or (attr, leaf) in seen:
+                continue
+            seen.add((attr, leaf))
+            findings.append(Finding(
+                "resource-no-release", path, line, qual,
+                f"owned:{attr}",
+                f"socket-owning {leaf} stored in self.{attr} has no "
+                "close()/stop() reachable from this class — every "
+                "instantiated link/bus needs a teardown path or its "
+                "sockets leak per reconnect"))
         return findings
 
     def _check_module_threads(self, path: str, tree: ast.Module,

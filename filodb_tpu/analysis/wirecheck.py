@@ -52,6 +52,11 @@ WIRE_SPEC = {
     "op_specs": [
         {"module": "filodb_tpu/ingest/broker.py", "prefix": "OP_",
          "server_fn": "_serve", "client_class": "BrokerBus"},
+        # the replication stream: OP_REPLICATE lives in replication.py with
+        # both its sender (FollowerLink) and its dispatch (serve_replication,
+        # delegated to from BrokerServer._serve)
+        {"module": "filodb_tpu/ingest/replication.py", "prefix": "OP_",
+         "server_fn": "serve_replication", "client_class": "FollowerLink"},
     ],
 }
 

@@ -79,6 +79,21 @@ def main(argv=None) -> int:
     ic.add_argument("csv")
     ic.add_argument("--bus", required=True, help="file-bus path to publish to")
 
+    bk = sub.add_parser("broker", help="start one broker node of the "
+                                       "replicated ingest tier (partitions, "
+                                       "quorum acks, failover)")
+    bk.add_argument("--config", default=None,
+                    help="server config json (bus_addrs is the shared peers "
+                         "list; ingest.* keys size the tier)")
+    bk.add_argument("--data-dir", required=True,
+                    help="partition log + pub-id journal directory")
+    bk.add_argument("--node-index", type=int, default=0,
+                    help="this node's index in bus_addrs")
+    bk.add_argument("--host", default="127.0.0.1")
+    bk.add_argument("--port", type=int, default=0,
+                    help="bind port (0 = any; must match bus_addrs entry "
+                         "for replicated tiers)")
+
     args = p.parse_args(argv)
     if args.cmd == "serve":
         return _serve(args)
@@ -111,7 +126,41 @@ def main(argv=None) -> int:
             total += len(container)
         print(f"published {total} samples to {args.bus}")
         return 0
+    if args.cmd == "broker":
+        return _broker(args)
     return 2
+
+
+def _broker(args) -> int:
+    """One node of the replicated broker tier (ingest/broker.py +
+    ingest/replication.py), sized from the declared ingest.* config."""
+    from .config import Config
+    from .ingest.broker import BrokerServer
+    from .ingest.faults import plan_from_config
+    from .standalone import _pow2
+
+    cfg = Config.load(args.config)
+    peers = list(cfg.get("bus_addrs") or [])
+    partitions = int(cfg.get("ingest.partitions")
+                     or _pow2(cfg["num_shards"]))
+    srv = BrokerServer(
+        args.data_dir, partitions, host=args.host, port=args.port,
+        peers=peers, node_index=args.node_index,
+        replication=cfg["ingest.replication"],
+        min_insync=cfg["ingest.min_insync"],
+        max_queue=cfg["ingest.max_partition_queue"],
+        fault_plan=plan_from_config(cfg)).start()
+    role = "replicated" if len(peers) > 1 and cfg["ingest.replication"] > 1 \
+        else "single"
+    print(f"filodb_tpu broker ({role}) node {args.node_index} serving "
+          f"{partitions} partition(s) on :{srv.port}")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
 
 
 def _serve(args) -> int:

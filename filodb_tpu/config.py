@@ -96,6 +96,38 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "int", 64,
         "Frames per broker PUBLISH_BATCH round trip — the in-flight "
         "window of the pipelined publisher."),
+    "ingest.partitions": (
+        "int|null", None,
+        "Broker partition count; shard s publishes to and consumes "
+        "partition s mod partitions (null = one partition per shard)."),
+    "ingest.replication": (
+        "int", 1,
+        "Replicas per partition across the bus_addrs broker nodes "
+        "(1 = unreplicated; replica set of partition p = peers "
+        "p..p+R-1 mod N, leader first)."),
+    "ingest.min_insync": (
+        "int", 1,
+        "In-sync replicas (leader included) required to ack a publish; "
+        "below it the broker sheds with RETRY (quorum-stall "
+        "backpressure)."),
+    "ingest.max_partition_queue": (
+        "int", 256,
+        "Concurrent in-flight publishes admitted per partition; overload "
+        "sheds with RETRY (and 429 + Retry-After at the HTTP write "
+        "path)."),
+    "ingest.retry_backoff": (
+        "duration", "50ms",
+        "Base client backoff after a RETRY shed or reconnect "
+        "(exponential with jitter, capped at 32x)."),
+    "ingest.publish_retries": (
+        "int", 8,
+        "Max client re-sends of an unacked publish window before the "
+        "typed BrokerRetry/transport error surfaces."),
+    "ingest.faults": (
+        "list[dict]", [],
+        "Deterministic FaultPlan rules for the broker (site/action/nth/"
+        "partition/at_offset...; fault-injection tests and soak runs "
+        "only)."),
     "ingest.decode_ahead": (
         "int", 2,
         "Containers decoded ahead of the device scatter "
@@ -122,7 +154,11 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
                 "Enables FileBus ingestion consumers when set."),
     "bus_addr": ("str|null", None,
                  "host:port of a BrokerServer (overrides bus_dir); shard N "
-                 "consumes broker partition N."),
+                 "consumes broker partition N mod ingest.partitions."),
+    "bus_addrs": ("list[str]", [],
+                  "Broker replica addresses (host:port, the shared peers "
+                  "list of every broker node); overrides bus_addr — "
+                  "clients fail over across them by watermark rank."),
     "profiler.enabled": ("bool", False,
                          "Always-on sampling profiler (ref: "
                          "SimpleProfiler)."),

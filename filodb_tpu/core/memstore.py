@@ -782,10 +782,12 @@ class TimeSeriesShard:
             self.flush_group(g)
 
     def recover(self, bus=None, schemas: Schemas | None = None,
-                on_chunks_loaded=None) -> int:
+                on_chunks_loaded=None, accept=None) -> int:
         """Restore shard state from the sink + replay the bus from the minimum
         checkpointed offset (ref: TimeSeriesShard.recoverIndex :483 +
-        TimeSeriesMemStore.recoverStream :148). Returns rows replayed."""
+        TimeSeriesMemStore.recoverStream :148). Returns rows replayed.
+        ``accept(container)`` filters replayed containers when several
+        shards share one broker partition (IngestionConsumer demux)."""
         assert self.sink is not None and len(self.index) == 0
         if self.store is None and (self.schema.is_histogram
                                    or self.schema.is_multi_column):
@@ -882,6 +884,8 @@ class TimeSeriesShard:
             wm = self.group_watermarks.copy()
             start_off = int(wm[wm >= 0].min()) if (wm >= 0).any() else 0
             for off, container in bus.consume(schemas or Schemas(), start_off):
+                if accept is not None and not accept(container):
+                    continue
                 before = self.stats.rows_ingested
                 self.ingest(container, off, recovery_watermarks=wm)
                 replayed += self.stats.rows_ingested - before

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..core import filters as F
+from ..ingest.broker import BrokerRetry
 from ..promql.parser import ParseError
 from ..query.engine import QueryEngine
 from ..query.rangevector import QueryError
@@ -100,11 +101,13 @@ class FiloHttpServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict, headers: dict | None = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -121,6 +124,14 @@ class FiloHttpServer:
                 except (QueryError, ParseError) as e:
                     self._send(422, {"status": "error", "errorType": "bad_data",
                                      "error": str(e)})
+                except BrokerRetry as e:
+                    # ingest backpressure (quorum stall / queue overload):
+                    # retryable, with the broker's hint as Retry-After —
+                    # remote-write clients re-send the batch after it
+                    self._send(429, {"status": "error", "errorType": "busy",
+                                     "error": str(e)},
+                               headers={"Retry-After": str(max(
+                                   1, int(e.retry_after_s + 0.999)))})
                 except SchedulerBusy as e:
                     self._send(503, {"status": "error", "errorType": "unavailable",
                                      "error": str(e)})

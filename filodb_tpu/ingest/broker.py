@@ -26,6 +26,12 @@ Wire protocol (all little-endian, one request/response per round trip):
                 count. Per-frame publish ids keep retries duplicate-free
                 exactly like PUBLISH. Response payload: one u64 assigned
                 offset per frame, in request order)
+       ops >= 16 are the replication stream (ingest/replication.py:
+                OP_REPLICATE — leader->follower CRC-checked frame batches).
+
+  statuses: OK, ERR (payload = error message), RETRY (backpressure shed:
+  quorum stall or per-partition queue overload; the offset field carries a
+  retry-after hint in ms that clients honor as their backoff floor).
 
 `BrokerBus` is a drop-in for FileBus (publish/consume/end_offset), so the
 standalone server's IngestionConsumer works unchanged against a remote broker.
@@ -33,26 +39,58 @@ Its windowed publisher (`publish_async`/`publish_batch`/`flush_publishes`)
 pipelines PUBLISH_BATCH requests: F frames with window W cost at most
 ceil(F/W) round trips, and all of a drain's requests are on the wire before
 the first response is read.
+
+Replication + failure handling (ingest/replication.py): a BrokerServer
+given a ``peers`` list replicates each partition to R nodes and acks
+publishes only when >= ``min_insync`` replicas hold the frames (ST_RETRY
+sheds otherwise — quorum-stall backpressure; the per-partition admission
+cap sheds overload the same way). BrokerBus accepts the whole replica
+address LIST: on a dead leader it re-ranks survivors by replication
+watermark (highest wins, lowest index breaks ties — every publisher picks
+the same survivor) and replays the unacked window with the SAME publish
+ids, which the new leader resolves from its replicated id journal — the
+handoff is duplicate-free end to end. Client retries use jittered
+exponential backoff (``filodb_ingest_retries``); a persistently-dead
+partition trips the PR-2 PeerBreaker so publishers shed fast instead of
+paying connect timeouts forever.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Iterator
 
 from ..core.record import RecordContainer
+from ..utils.metrics import (FILODB_INGEST_FAILOVERS, FILODB_INGEST_RETRIES,
+                             FILODB_INGEST_PUBLISH_SHED, registry)
 from .bus import FileBus
+
+log = logging.getLogger("filodb_tpu.broker")
 
 _REQ = struct.Struct("<B I Q I")
 _RESP = struct.Struct("<B Q I")
 _ENTRY = struct.Struct("<Q I")
 
 OP_PUBLISH, OP_FETCH, OP_END, OP_PUBLISH_BATCH = 1, 2, 3, 4
-ST_OK, ST_ERR = 0, 1
+ST_OK, ST_ERR, ST_RETRY = 0, 1, 2
+
+
+class BrokerRetry(RuntimeError):
+    """The broker shed the publish (quorum stall or queue-depth overload)
+    and the client exhausted its backoff budget. Carries the server's
+    retry-after hint; the HTTP layer maps this to 429 + Retry-After."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__(
+            f"broker backpressure: retry after {retry_after_s:.3f}s")
+        self.retry_after_s = float(retry_after_s)
 
 _MAX_PAYLOAD = 64 << 20     # refuse absurd frames instead of OOMing
 _RECENT_IDS_MAX = 4096      # retry-able publish ids remembered per partition
@@ -93,12 +131,23 @@ class BrokerServer:
 
     def __init__(self, data_dir: str, num_partitions: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 recent_ids_max: int = _RECENT_IDS_MAX):
+                 recent_ids_max: int = _RECENT_IDS_MAX,
+                 peers: list[str] | None = None, node_index: int = 0,
+                 replication: int = 1, min_insync: int = 1,
+                 max_queue: int = 256, fault_plan=None):
         """``recent_ids_max`` below the default weakens the windowed
         publisher's replay idempotence: BrokerBus bounds a pipelined group to
         ``_RECENT_IDS_MAX // 2`` unacked frames on the assumption the server
         remembers at least the module default — shrink it only in tests that
-        exercise eviction itself."""
+        exercise eviction itself.
+
+        ``peers``/``node_index``/``replication``/``min_insync`` enable the
+        replicated tier (ingest/replication.py): partitions replicate to R
+        of the peer nodes and publishes ack only at >= min_insync in-sync
+        replicas. ``max_queue`` caps concurrent in-flight publishes per
+        partition (overload sheds ST_RETRY). ``fault_plan`` wires the
+        deterministic fault-injection hooks (ingest/faults.py)."""
+        from .replication import PubIdJournal, Replicator
         os.makedirs(data_dir, exist_ok=True)
         self._parts = [FileBus(os.path.join(data_dir, f"partition{p}.log"))
                        for p in range(num_partitions)]
@@ -107,6 +156,27 @@ class BrokerServer:
         self._recent_ids: list[dict[int, int]] = [{} for _ in range(num_partitions)]
         self._recent_ids_max = int(recent_ids_max)
         self._publish_locks = [threading.Lock() for _ in range(num_partitions)]
+        # durable offset -> pub-id journal per partition: restart-proof
+        # idempotence, replication id carry-over, and the soak audit surface
+        self._journals = [PubIdJournal(os.path.join(data_dir,
+                                                    f"partition{p}.pubids"))
+                          for p in range(num_partitions)]
+        for p in range(num_partitions):
+            self._journals[p].seed_recent(self._recent_ids[p],
+                                          self._recent_ids_max)
+        self.fault_plan = fault_plan
+        self._repl: Replicator | None = None
+        if peers and len(peers) > 1 and replication > 1:
+            self._repl = Replicator(self, peers, node_index, replication,
+                                    min_insync=min_insync,
+                                    fault_plan=fault_plan)
+        # per-partition admission: concurrent in-flight publishes above
+        # max_queue shed with ST_RETRY instead of queueing unboundedly
+        self._max_queue = max(1, int(max_queue))
+        self._inflight = [0] * num_partitions
+        self._admit_lock = threading.Lock()
+        self._shed = registry.counter(FILODB_INGEST_PUBLISH_SHED)
+        self._stopped = False
         # live client connections, so stop() actually severs them (handler
         # threads would otherwise keep serving a "stopped" broker)
         self._conns: set[socket.socket] = set()
@@ -121,13 +191,23 @@ class BrokerServer:
                     while True:
                         hdr = _recv_exact(self.request, _REQ.size)
                         op, part, offset, plen = _REQ.unpack(hdr)
-                        if plen > _MAX_PAYLOAD:
+                        # replication ops (>= 16) get header headroom: a
+                        # max-size accepted publish frame must still fit
+                        # its OP_REPLICATE envelope (24B/frame; batches
+                        # are byte-chunked leader-side)
+                        limit = _MAX_PAYLOAD + (64 << 10) if op >= 16 \
+                            else _MAX_PAYLOAD
+                        if plen > limit:
                             raise ValueError(f"frame too large: {plen}")
+                        # FETCH/END overload the length field as a count —
+                        # every other op carries a real payload
                         payload = _recv_exact(self.request, plen) \
-                            if op in (OP_PUBLISH, OP_PUBLISH_BATCH) and plen \
+                            if op not in (OP_FETCH, OP_END) and plen \
                             else b""
-                        self.request.sendall(outer._serve(op, part, offset,
-                                                          plen, payload))
+                        resp = outer._serve(op, part, offset, plen, payload)
+                        if resp is None:
+                            break       # fault injection: sever, no reply
+                        self.request.sendall(resp)
                 except (ConnectionError, OSError):
                     pass    # client went away or the broker is stopping
                 finally:
@@ -142,60 +222,26 @@ class BrokerServer:
         self._thread: threading.Thread | None = None
 
     def _serve(self, op: int, part: int, offset: int, plen: int,
-               payload: bytes) -> bytes:
+               payload: bytes) -> bytes | None:
+        from .replication import OP_REPLICATE, serve_replication
         try:
             if not 0 <= part < len(self._parts):
                 raise ValueError(f"no partition {part}")
             bus = self._parts[part]
-            if op == OP_PUBLISH:
-                pub_id = offset                 # request offset field = publish id
-                with self._publish_locks[part]:
-                    recent = self._recent_ids[part]
-                    off = _recall_id(recent, pub_id) if pub_id else None
-                    if off is None:
-                        off = bus.publish_bytes(payload)
-                        if pub_id:
-                            _remember_id(recent, pub_id, off,
-                                         self._recent_ids_max)
-                return _RESP.pack(ST_OK, off, 0)
-            if op == OP_PUBLISH_BATCH:
-                entries = []                    # (pub_id, frame bytes)
-                pos = 0
-                while pos < len(payload):
-                    pid, ln = _ENTRY.unpack_from(payload, pos)
-                    pos += _ENTRY.size
-                    entries.append((pid, payload[pos:pos + ln]))
-                    pos += ln
-                offs = [0] * len(entries)
-                with self._publish_locks[part]:
-                    recent = self._recent_ids[part]
-                    fresh: list[int] = []       # indexes needing an append
-                    first_idx: dict[int, int] = {}
-                    alias: dict[int, int] = {}  # in-batch duplicate ids
-                    for i, (pid, _frame) in enumerate(entries):
-                        off = _recall_id(recent, pid) if pid else None
-                        if off is not None:
-                            offs[i] = off
-                        elif pid and pid in first_idx:
-                            alias[i] = first_idx[pid]
-                        else:
-                            fresh.append(i)
-                            if pid:
-                                first_idx[pid] = i
-                    # one open+write for the whole batch — per-frame appends
-                    # would re-open the log file once per frame
-                    new_offs = bus.publish_many_bytes(
-                        [entries[i][1] for i in fresh])
-                    for i, off in zip(fresh, new_offs):
-                        offs[i] = off
-                        pid = entries[i][0]
-                        if pid:
-                            _remember_id(recent, pid, off,
-                                         self._recent_ids_max)
-                    for i, j in alias.items():
-                        offs[i] = offs[j]
-                body = struct.pack(f"<{len(offs)}Q", *offs)
-                return _RESP.pack(ST_OK, bus.end_offset, len(body)) + body
+            if op in (OP_PUBLISH, OP_PUBLISH_BATCH):
+                if not self._admit(part):
+                    self._shed.increment()
+                    return _RESP.pack(ST_RETRY, 100, 0)   # retry hint (ms)
+                try:
+                    resp = self._serve_publish(op, part, offset, payload, bus)
+                    # fault hook INSIDE the admission slot: a delayed
+                    # response occupies partition capacity exactly like a
+                    # slow disk/replica would
+                    return self._fault_response(op, part, resp)
+                finally:
+                    self._release(part)
+            if op == OP_REPLICATE:
+                return serve_replication(self, op, part, payload)
             if op == OP_FETCH:
                 max_frames = plen or 1024
                 out = bytearray()
@@ -213,6 +259,144 @@ class BrokerServer:
         except Exception as e:  # noqa: BLE001 — delivered to the client
             msg = str(e).encode()[:1024]
             return _RESP.pack(ST_ERR, 0, len(msg)) + msg
+
+    def _serve_publish(self, op: int, part: int, offset: int,
+                       payload: bytes, bus: FileBus) -> bytes:
+        """PUBLISH / PUBLISH_BATCH under the partition publish lock:
+        recall-or-append with idempotent ids, journal fresh pub-ids, then
+        replicate to quorum before acking."""
+        jrnl = self._journals[part]
+        with self._publish_locks[part]:
+            recent = self._recent_ids[part]
+            if op == OP_PUBLISH:
+                pub_id = offset             # request offset field = publish id
+                off = _recall_id(recent, pub_id) if pub_id else None
+                appended = []
+                if off is None:
+                    off = bus.publish_bytes(payload)
+                    if pub_id:
+                        jrnl.append(off, pub_id)
+                        _remember_id(recent, pub_id, off,
+                                     self._recent_ids_max)
+                    appended = [(off, pub_id, payload)]
+                resp = _RESP.pack(ST_OK, off, 0)
+            else:
+                entries = []                # (pub_id, frame bytes)
+                pos = 0
+                while pos < len(payload):
+                    pid, ln = _ENTRY.unpack_from(payload, pos)
+                    pos += _ENTRY.size
+                    entries.append((pid, payload[pos:pos + ln]))
+                    pos += ln
+                offs = [0] * len(entries)
+                fresh: list[int] = []       # indexes needing an append
+                first_idx: dict[int, int] = {}
+                alias: dict[int, int] = {}  # in-batch duplicate ids
+                for i, (pid, _frame) in enumerate(entries):
+                    off = _recall_id(recent, pid) if pid else None
+                    if off is not None:
+                        offs[i] = off
+                    elif pid and pid in first_idx:
+                        alias[i] = first_idx[pid]
+                    else:
+                        fresh.append(i)
+                        if pid:
+                            first_idx[pid] = i
+                # one open+write for the whole batch — per-frame appends
+                # would re-open the log file once per frame
+                new_offs = bus.publish_many_bytes(
+                    [entries[i][1] for i in fresh])
+                appended = []
+                for i, off in zip(fresh, new_offs):
+                    offs[i] = off
+                    pid = entries[i][0]
+                    appended.append((off, pid, entries[i][1]))
+                    if pid:
+                        _remember_id(recent, pid, off, self._recent_ids_max)
+                # one journal open+write per batch (hot-path parity with
+                # publish_many_bytes)
+                jrnl.append_many([(off, pid) for off, pid, _f in appended
+                                  if pid])
+                for i, j in alias.items():
+                    offs[i] = offs[j]
+                body = struct.pack(f"<{len(offs)}Q", *offs)
+                resp = _RESP.pack(ST_OK, bus.end_offset, len(body)) + body
+            # kill-at-offset fault (leader death mid-stream) fires BEFORE
+            # the ack: the client never learns the frames' offsets and must
+            # replay them at the survivor
+            if self.fault_plan is not None and appended:
+                act = self.fault_plan.decide("append", partition=part,
+                                             offset=bus.end_offset)
+                if act is not None and act.action == "kill_server":
+                    self._kill_async()
+                    return None
+            # quorum: ack only once >= min_insync replicas hold the log up
+            # to end (the just-appended frames ride along so the steady
+            # state skips the log re-read); on a stall the frames stay
+            # appended and the client's idempotent replay re-drives this
+            if self._repl is not None:
+                ok, hint = self._repl.ensure(part, bus.end_offset,
+                                             fresh=appended or None)
+                if not ok:
+                    self._shed.increment()
+                    return _RESP.pack(ST_RETRY, hint, 0)
+            return resp
+
+    def _admit(self, part: int) -> bool:
+        with self._admit_lock:
+            if self._inflight[part] >= self._max_queue:
+                return False
+            self._inflight[part] += 1
+            return True
+
+    def _release(self, part: int) -> None:
+        with self._admit_lock:
+            self._inflight[part] -= 1
+
+    def _fault_response(self, op: int, part: int,
+                        resp: bytes | None) -> bytes | None:
+        """serve-site fault hook: drop_response severs without replying
+        (the lost-response shape); delay holds the ack."""
+        if resp is None or self.fault_plan is None:
+            return resp
+        act = self.fault_plan.decide("serve", partition=part, op=op)
+        if act is None:
+            return resp
+        if act.action == "drop_response":
+            return None
+        if act.action == "delay" and act.delay_s > 0:
+            time.sleep(act.delay_s)
+        return resp
+
+    def _frames_with_ids(self, part: int, lo: int, hi: int,
+                         max_bytes: int) -> list[tuple[int, int, bytes]]:
+        """Log tail [lo, hi) with journaled pub-ids — the replication
+        catch-up read (caller holds the partition's publish lock)."""
+        out: list[tuple[int, int, bytes]] = []
+        total = 0
+        jrnl = self._journals[part]
+        for off, frame in self._parts[part].frames_from(lo):
+            if off >= hi:
+                break
+            out.append((off, jrnl.get(off), frame))
+            total += len(frame)
+            if total >= max_bytes:
+                break
+        return out
+
+    def _kill_async(self) -> None:
+        """Fault injection: die like a crashed node — sever every client
+        and stop serving, from a side thread (stop() joins the serve
+        thread, so it cannot run on the handler thread itself)."""
+        def die():
+            try:
+                self.stop()
+            except Exception:  # noqa: BLE001 — a fault-injected death must
+                # still tear the server down visibly, not hang half-dead
+                log.exception("fault-injected broker kill failed")
+
+        threading.Thread(target=die, daemon=True,
+                         name="filo-broker-kill").start()
 
     @property
     def port(self) -> int:
@@ -232,7 +416,14 @@ class BrokerServer:
         """Deterministic teardown: stop the acceptor, release the listening
         socket, sever live client connections (handler threads would
         otherwise keep serving a 'stopped' broker), and join the serve
-        thread with a timeout."""
+        thread with a timeout. Idempotent — the fault-injection kill path
+        and a test's finally may both call it."""
+        with self._conns_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        if self._repl is not None:
+            self._repl.close()
         self._server.shutdown()
         self._server.server_close()
         with self._conns_lock:
@@ -259,20 +450,121 @@ class BrokerBus:
     after a lost response (or a reconnect) never appends duplicates.
     ``requests`` counts round trips for tests/benchmarks."""
 
-    def __init__(self, addr: str, partition: int, publish_window: int = 64):
-        host, _, port = addr.rpartition(":")
-        self._addr = (host or "127.0.0.1", int(port))
+    def __init__(self, addr, partition: int, publish_window: int = 64,
+                 retry_backoff_ms: float = 50.0, max_retries: int = 8,
+                 seed: int | None = None, track_acks: bool = False,
+                 fault_plan=None):
+        """``addr``: one ``host:port`` string, or the partition's whole
+        replica address list — with >1 address the bus fails over to the
+        most-caught-up survivor on connection loss. ``retry_backoff_ms`` /
+        ``max_retries`` bound the jittered exponential backoff after
+        RETRY sheds and reconnects (``seed`` pins the jitter for tests).
+        ``track_acks=True`` records every acked publish id in
+        ``acked_ids`` — the soak audit's client-side ledger."""
+        addrs = [addr] if isinstance(addr, str) else list(addr)
+        self._addrs = []
+        for a in addrs:
+            host, _, port = a.rpartition(":")
+            self._addrs.append((host or "127.0.0.1", int(port)))
         self.partition = partition
+        # static leader: peers[p % N] — matches the server's replica map
+        self._cur = partition % len(self._addrs)
         self.publish_window = max(1, int(publish_window))
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.max_retries = max(1, int(max_retries))
+        self.track_acks = bool(track_acks)
+        self.acked_ids: list[int] = []
+        self.fault_plan = fault_plan
+        self._rng = random.Random(
+            seed if seed is not None
+            else int.from_bytes(os.urandom(8), "little"))
+        self._sleep = time.sleep        # injectable: tests run sleep-free
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()   # one in-flight exchange per client
         self._pending: list[tuple[int, bytes]] = []   # (pub_id, frame)
         self.requests = 0               # round-trip count (instrumentation)
+        self._ok_since_rank = 0         # successes since the last re-rank
+        self._retries = registry.counter(FILODB_INGEST_RETRIES)
+        self._failovers = registry.counter(FILODB_INGEST_FAILOVERS)
+        # persistently-dead partition -> shed fast (PR 2 breaker machinery)
+        from ..query.wire import PeerBreaker
+        self._breaker = PeerBreaker(threshold=3, cooldown_s=5.0)
 
     def _conn_locked(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(self._addr, timeout=30)
+            self._sock = socket.create_connection(self._addrs[self._cur],
+                                                  timeout=30)
         return self._sock
+
+    def _transport_attempts(self) -> int:
+        # single-address buses keep the historical fast-fail shape (one
+        # reconnect); replicated buses spend the retry budget on failover
+        return 2 if len(self._addrs) == 1 else max(2, self.max_retries)
+
+    def _backoff_ms(self, k: int, floor_ms: float = 0.0) -> float:
+        """Jittered exponential backoff for the k-th retry (k=0 -> no
+        wait: the first replay is immediate, like the PR-4 reconnect)."""
+        if k <= 0 and floor_ms <= 0:
+            return 0.0
+        base = self.retry_backoff_ms * (2 ** max(0, k - 1)) if k > 0 else 0.0
+        base = min(base, self.retry_backoff_ms * 32)
+        jittered = base * (0.5 + self._rng.random())
+        return max(floor_ms, jittered)
+
+    def _note_retry_locked(self, k: int, floor_ms: float = 0.0) -> None:
+        self._retries.increment()
+        wait = self._backoff_ms(k, floor_ms)
+        if wait > 0:
+            self._sleep(wait / 1000.0)
+
+    def _failover_locked(self) -> None:
+        """Re-rank the replica set by replication watermark (OP_END over a
+        transient probe connection): highest watermark wins; ties prefer
+        the STATIC leader, then the lowest index. The key is GLOBALLY
+        shared — no term depends on this client's own state — so every
+        publisher lands on the same survivor (one writer per partition),
+        and once a recovered static leader has caught up the tie-break
+        converges everyone back onto it instead of leaving the fleet
+        split across writers forever. Probe connects are bounded well
+        below the stream timeout: ranking runs under the bus lock."""
+        if len(self._addrs) == 1:
+            return
+        static = self.partition % len(self._addrs)
+        best: tuple[int, int, int] | None = None
+        for i, a in enumerate(self._addrs):
+            try:
+                with socket.create_connection(a, timeout=0.75) as s:
+                    s.sendall(_REQ.pack(OP_END, self.partition, 0, 0))
+                    st, off, rlen = _RESP.unpack(_recv_exact(s, _RESP.size))
+                    if rlen:
+                        _recv_exact(s, rlen)
+                if st != ST_OK:
+                    continue
+                cand = (-off, 0 if i == static else 1, i)
+                if best is None or cand < best:
+                    best = cand
+            except (ConnectionError, OSError):
+                continue
+        if best is not None and best[2] != self._cur:
+            self._cur = best[2]
+            self._failovers.increment()
+
+    _RERANK_EVERY = 256
+
+    def _maybe_rerank_locked(self) -> None:
+        """Failed-over clients re-rank every _RERANK_EVERY successful
+        exchanges: when the static leader returns AND catches up, the
+        tie-break moves everyone home — without this, a transient outage
+        would split publishers across writers permanently."""
+        if self._cur == self.partition % len(self._addrs):
+            return
+        self._ok_since_rank += 1
+        if self._ok_since_rank >= self._RERANK_EVERY:
+            self._ok_since_rank = 0
+            was = self._cur
+            self._failover_locked()
+            if self._cur != was:
+                self._close_locked()    # next exchange dials the new pick
 
     def _close_locked(self) -> None:
         if self._sock is not None:
@@ -287,26 +579,49 @@ class BrokerBus:
 
     def _exchange_locked(self, op: int, offset: int, plen: int,
                          payload: bytes) -> tuple[int, int, bytes]:
-        for attempt in (0, 1):          # one reconnect on a stale connection
+        if not self._breaker.admit():
+            raise ConnectionError(
+                f"partition {self.partition} breaker open (replica set down)")
+        attempts = self._transport_attempts()
+        last: Exception | None = None
+        for attempt in range(attempts):
+            if attempt:
+                self._note_retry_locked(attempt - 1)
+                self._failover_locked()     # dead leader -> ranked survivor
             try:
                 s = self._conn_locked()
                 s.sendall(_REQ.pack(op, self.partition, offset, plen) + payload)
                 self.requests += 1
+                if self.fault_plan is not None and self.fault_plan.decide(
+                        "client_recv", partition=self.partition, op=op):
+                    self._close_locked()
+                    raise ConnectionError("fault: response dropped")
                 st, off, body = self._read_resp_locked(s)
+                self._breaker.record_success()
+                self._maybe_rerank_locked()
                 return st, off, body
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
                 self._close_locked()
-                if attempt:
-                    raise
-        raise AssertionError("unreachable")
+                last = e
+        self._breaker.record_failure()
+        raise last if last is not None else ConnectionError("unreachable")
 
     def _request(self, op: int, offset: int = 0, plen: int = 0,
                  payload: bytes = b"") -> tuple[int, bytes]:
-        with self._lock:
-            st, off, body = self._exchange_locked(op, offset, plen, payload)
-        if st == ST_ERR:
-            raise RuntimeError(f"broker error: {body.decode(errors='replace')}")
-        return off, body
+        hint_ms = 0
+        for k in range(self.max_retries + 1):
+            with self._lock:
+                if k:
+                    self._note_retry_locked(k - 1, floor_ms=hint_ms)
+                st, off, body = self._exchange_locked(op, offset, plen,
+                                                      payload)
+            if st == ST_ERR:
+                raise RuntimeError(
+                    f"broker error: {body.decode(errors='replace')}")
+            if st != ST_RETRY:
+                return off, body
+            hint_ms = off or 100    # RETRY carries the server's ms hint
+        raise BrokerRetry(hint_ms / 1000.0)
 
     @staticmethod
     def _pub_id() -> int:
@@ -316,8 +631,12 @@ class BrokerBus:
 
     def publish(self, container: RecordContainer) -> int:
         payload = container.to_bytes()
-        off, _ = self._request(OP_PUBLISH, offset=self._pub_id(),
+        pub_id = self._pub_id()
+        off, _ = self._request(OP_PUBLISH, offset=pub_id,
                                plen=len(payload), payload=payload)
+        if self.track_acks:
+            with self._lock:
+                self.acked_ids.append(pub_id)
         return off
 
     def publish_async(self, container: RecordContainer) -> None:
@@ -370,43 +689,76 @@ class BrokerBus:
         offs: list[int] = []
         while self._pending:
             chunks, taken = self._next_group_locked()
-            # pipeline WITHIN a bounded group: all of the group's requests go
-            # on the wire before its first response is read (the broker
-            # serves one connection serially, so responses arrive in order),
-            # then the group commits and drops off the pending queue. A
-            # replay after a lost connection re-sends the SAME publish ids,
-            # which the broker resolves to the original offsets — and a
-            # group never exceeds half the broker's id window, so none of
-            # its ids can have been evicted by its own replay.
-            for attempt in (0, 1):
-                try:
-                    s = self._conn_locked()
-                    for ch in chunks:
-                        payload = b"".join(_ENTRY.pack(pid, len(f)) + f
-                                           for pid, f in ch)
-                        s.sendall(_REQ.pack(OP_PUBLISH_BATCH, self.partition,
-                                            len(ch), len(payload)) + payload)
-                        self.requests += 1
-                    group_offs: list[int] = []
-                    err: bytes | None = None
-                    for ch in chunks:   # drain EVERY response before raising
-                        st, _end, body = self._read_resp_locked(s)
-                        if st == ST_ERR:
-                            err = err or body
-                        else:
-                            group_offs.extend(
-                                struct.unpack(f"<{len(ch)}Q", body))
-                    if err is not None:
-                        raise RuntimeError(
-                            f"broker error: {err.decode(errors='replace')}")
-                    break
-                except (ConnectionError, OSError):
-                    self._close_locked()
-                    if attempt:
-                        raise
+            offs.extend(self._send_group_locked(chunks))
             del self._pending[:taken]   # commit per group: a later failure
-            offs.extend(group_offs)     # never replays acked frames
+            if self.track_acks:         # never replays acked frames
+                self.acked_ids.extend(pid for ch in chunks for pid, _ in ch)
         return offs
+
+    def _send_group_locked(self, chunks: list[list]) -> list[int]:
+        # pipeline WITHIN a bounded group: all of the group's requests go
+        # on the wire before its first response is read (the broker
+        # serves one connection serially, so responses arrive in order),
+        # then the group commits and drops off the pending queue. A
+        # replay after a lost connection OR a RETRY shed re-sends the SAME
+        # publish ids, which the (possibly failed-over) broker resolves to
+        # the original offsets — and a group never exceeds half the
+        # broker's id window, so none of its ids can have been evicted by
+        # its own replay.
+        if not self._breaker.admit():
+            raise ConnectionError(
+                f"partition {self.partition} breaker open (replica set down)")
+        transport = self._transport_attempts()
+        t_fail = r_shed = 0
+        while True:
+            try:
+                s = self._conn_locked()
+                for ch in chunks:
+                    payload = b"".join(_ENTRY.pack(pid, len(f)) + f
+                                       for pid, f in ch)
+                    s.sendall(_REQ.pack(OP_PUBLISH_BATCH, self.partition,
+                                        len(ch), len(payload)) + payload)
+                    self.requests += 1
+                if self.fault_plan is not None and self.fault_plan.decide(
+                        "client_recv", partition=self.partition,
+                        op=OP_PUBLISH_BATCH):
+                    self._close_locked()
+                    raise ConnectionError("fault: response dropped")
+                group_offs: list[int] = []
+                err: bytes | None = None
+                retry_hint = 0
+                for ch in chunks:   # drain EVERY response before raising
+                    st, _end, body = self._read_resp_locked(s)
+                    if st == ST_ERR:
+                        err = err or body
+                    elif st == ST_RETRY:
+                        retry_hint = max(retry_hint, _end or 100)
+                    else:
+                        group_offs.extend(
+                            struct.unpack(f"<{len(ch)}Q", body))
+                if err is not None:
+                    raise RuntimeError(
+                        f"broker error: {err.decode(errors='replace')}")
+                if retry_hint:
+                    # backpressure shed: back off (honoring the server's
+                    # hint) and replay the whole group — OK'd chunks
+                    # resolve by id, shed chunks get their append
+                    r_shed += 1
+                    if r_shed > self.max_retries:
+                        raise BrokerRetry(retry_hint / 1000.0)
+                    self._note_retry_locked(r_shed - 1, floor_ms=retry_hint)
+                    continue
+                self._breaker.record_success()
+                self._maybe_rerank_locked()
+                return group_offs
+            except (ConnectionError, OSError):
+                self._close_locked()
+                t_fail += 1
+                if t_fail >= transport:
+                    self._breaker.record_failure()
+                    raise
+                self._note_retry_locked(t_fail - 1)
+                self._failover_locked()
 
     def consume(self, schemas, from_offset: int = 0) -> Iterator[tuple[int, RecordContainer]]:
         """Replay containers from ``from_offset`` up to the end offset observed
@@ -440,5 +792,15 @@ class BrokerBus:
         return off
 
     def close(self) -> None:
+        # sever FIRST, without the exchange lock: a consumer blocked in a
+        # 30s recv HOLDS that lock, and closing the socket out from under
+        # it is exactly what unblocks it (teardown would otherwise stall
+        # behind the full socket timeout)
+        s = self._sock
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass    # racing close: already severed
         with self._lock:
             self._close_locked()

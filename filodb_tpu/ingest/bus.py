@@ -122,3 +122,8 @@ class FileBus:
     @property
     def end_offset(self) -> int:
         return len(self._positions)
+
+    def close(self) -> None:
+        """Bus-interface parity with BrokerBus: FileBus opens its log per
+        operation, so there is nothing to release — owners can close any
+        bus unconditionally."""

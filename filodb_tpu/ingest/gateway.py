@@ -23,6 +23,7 @@ out-of-order. Flush is driven by size (``flush_lines``) OR a time bound
 from __future__ import annotations
 
 import logging
+import socket
 import socketserver
 import threading
 import time
@@ -183,6 +184,11 @@ class GatewayServer:
         self.flush_lines = flush_lines
         self.flush_interval_ms = flush_interval_ms
         self.strict = strict
+        # optional shutdown hook: stop() calls it after the final builder
+        # flush so windowed bus publishers drain their sub-window remainder
+        # (no acked-but-unflushed lines on shutdown); owners wire it to
+        # e.g. ``lambda: [b.flush_publishes() for b in buses]``
+        self.bus_drain = None
         # (measurement+tags line prefix) -> {field name -> (shard, labels,
         # canonical key tuple)}: the hash/dict work dominates the per-line
         # cost, and real scrape traffic repeats series — bounded, reset
@@ -193,6 +199,7 @@ class GatewayServer:
         self._publish_locks = [threading.Lock() for _ in range(num_shards)]
         self._state = _ConnState()          # direct ingest_line() callers
         self._conn_states: set[_ConnState] = set()
+        self._conns: set = set()            # live client sockets (stop sever)
         self._states_lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._flusher: threading.Thread | None = None
@@ -208,6 +215,7 @@ class GatewayServer:
                 st = _ConnState()
                 with outer._states_lock:
                     outer._conn_states.add(st)
+                    outer._conns.add(self.request)
                 try:
                     # chunked reads + ONE decode per block: per-line
                     # readline/decode overhead is measurable at 100k lines/s
@@ -234,6 +242,7 @@ class GatewayServer:
                 finally:
                     with outer._states_lock:
                         outer._conn_states.discard(st)
+                        outer._conns.discard(self.request)
                     outer.flush_state(st)
 
         self._server = socketserver.ThreadingTCPServer((host, port), Handler)
@@ -255,8 +264,11 @@ class GatewayServer:
 
     def stop(self):
         """Deterministic teardown: stop accepting, release the listening
-        socket, and JOIN both threads (bounded) so a caller that restarts a
-        gateway on the same port never races the old acceptor."""
+        socket, JOIN both threads (bounded) so a caller that restarts a
+        gateway on the same port never races the old acceptor, then FLUSH —
+        every connection's pending builders publish, and ``bus_drain``
+        drains the windowed publisher — so a stopped gateway holds no
+        accepted-but-unpublished lines."""
         self._stop_ev.set()
         self._server.shutdown()
         self._server.server_close()
@@ -266,6 +278,36 @@ class GatewayServer:
         if self._flusher is not None:
             self._flusher.join(timeout=3)
             self._flusher = None
+        # connection handlers flush their own state on exit: give in-flight
+        # bursts a short grace, then SEVER lingering client sockets (an
+        # idle keep-alive connection would otherwise hold its handler in
+        # read() forever — nothing may ingest after stop() returns) and
+        # wait for the unblocked handlers to run their exit flush
+        self._wait_states_drained(1.0)
+        with self._states_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass    # racing close: the connection is already gone
+        self._wait_states_drained(3.0)
+        self.flush()
+        if self.bus_drain is not None:
+            try:
+                self.bus_drain()
+            except Exception:  # noqa: BLE001 — shutdown must complete; the
+                # drain fault is logged, not fatal (the bus owner's own
+                # close path retries)
+                log.warning("gateway bus drain failed on stop", exc_info=True)
+
+    def _wait_states_drained(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._states_lock:
+                if not self._conn_states:
+                    return
+            time.sleep(0.01)
 
     def _all_states(self) -> list[_ConnState]:
         with self._states_lock:

@@ -100,7 +100,7 @@ class IngestionConsumer(threading.Thread):
 
     def __init__(self, shard, bus: FileBus, schemas, manager: ShardManager,
                  dataset: str, poll_s: float = 0.5, purge_interval_s: float = 600.0,
-                 decode_ahead: int = 2):
+                 decode_ahead: int = 2, accept=None):
         super().__init__(daemon=True, name=f"ingest-{dataset}-{shard.shard_num}")
         self.shard = shard
         self.bus = bus
@@ -110,6 +110,10 @@ class IngestionConsumer(threading.Thread):
         self.poll_s = poll_s
         self.purge_interval_s = purge_interval_s
         self.decode_ahead = decode_ahead
+        # shared-partition demux: with fewer broker partitions than shards
+        # several shards replay one partition; ``accept(container)`` keeps
+        # only this shard's containers (offsets still advance past skips)
+        self.accept = accept
         self._stop_ev = threading.Event()
         self._offset = 0
 
@@ -147,7 +151,8 @@ class IngestionConsumer(threading.Thread):
                         self.manager.set_status(self.dataset, sh.shard_num,
                                                 ShardStatus.RECOVERY)
                         sh.recover(self.bus, self.schemas,
-                                   on_chunks_loaded=lambda: self._seed_downsampler(sh))
+                                   on_chunks_loaded=lambda: self._seed_downsampler(sh),
+                                   accept=self.accept)
                         self._offset = int(self.bus.end_offset)
                     break
                 except (ConnectionError, OSError):
@@ -180,8 +185,10 @@ class IngestionConsumer(threading.Thread):
                             src = _DecodeAhead(src, self.decode_ahead)
                         try:
                             for off, container in itertools.chain([first], src):
-                                sh.ingest(container, off)
-                                rows.increment(len(container))
+                                if self.accept is None or \
+                                        self.accept(container):
+                                    sh.ingest(container, off)
+                                    rows.increment(len(container))
                                 self._offset = off + 1
                         finally:
                             if isinstance(src, _DecodeAhead):
@@ -299,21 +306,33 @@ class FiloServer:
             shard.downsample = (self._ds_res[0],
                                 InlineDownsampler(self._ds_res[0],
                                                   self._ds_publish))
-        if cfg.get("bus_addr") or cfg.get("bus_dir"):
-            if cfg.get("bus_addr"):
-                # remote broker: shard N == broker partition N (ref: Kafka
-                # PartitionStrategy, 1 shard == 1 partition)
+        if self._bus_addrs() or cfg.get("bus_dir"):
+            accept = None
+            if self._bus_addrs():
+                # remote broker: shard N consumes partition N mod
+                # ingest.partitions (ref: Kafka PartitionStrategy; the
+                # default keeps 1 shard == 1 partition). With shared
+                # partitions each consumer keeps only its own shard's
+                # containers, re-deriving the shard from the container's
+                # hashes (gateway containers are single-shard by build).
                 from .ingest.broker import BrokerBus
-                bus = BrokerBus(cfg["bus_addr"], shard_num,
+                parts = self._num_partitions()
+                bus = BrokerBus(self._bus_addrs(), shard_num % parts,
                                 publish_window=cfg.get("ingest.publish_window",
-                                                       64))
+                                                       64),
+                                retry_backoff_ms=parse_duration_ms(
+                                    cfg["ingest.retry_backoff"]),
+                                max_retries=cfg["ingest.publish_retries"])
+                if parts < len(self.manager.map[dataset]):
+                    accept = self._shard_accept(shard_num)
             else:
                 bus = FileBus(f"{cfg['bus_dir']}/shard{shard_num}.log")
             c = IngestionConsumer(shard, bus, self.memstore.schemas,
                                   self.manager, dataset,
                                   purge_interval_s=parse_duration_ms(
                                       cfg.get("store.purge_interval", "10m")) / 1000.0,
-                                  decode_ahead=cfg.get("ingest.decode_ahead", 2))
+                                  decode_ahead=cfg.get("ingest.decode_ahead", 2),
+                                  accept=accept)
             with self._shards_lock:
                 if self._quarantined:       # raced quarantine: do not start
                     self._running.discard(shard_num)
@@ -323,6 +342,34 @@ class FiloServer:
             c.start()
         else:
             self.manager.set_status(dataset, shard_num, ShardStatus.ACTIVE)
+
+    def _bus_addrs(self) -> list[str]:
+        """Broker replica addresses: ``bus_addrs`` (the replicated tier) or
+        the single legacy ``bus_addr``."""
+        cfg = self.config
+        addrs = cfg.get("bus_addrs")
+        if addrs:
+            return list(addrs)
+        return [cfg["bus_addr"]] if cfg.get("bus_addr") else []
+
+    def _num_partitions(self) -> int:
+        cfg = self.config
+        return int(cfg.get("ingest.partitions")
+                   or _pow2(cfg["num_shards"]))
+
+    def _shard_accept(self, shard_num: int):
+        """Demux predicate for shared broker partitions: keep containers
+        whose (single-shard, by gateway build) records route to this
+        shard."""
+        cfg = self.config
+        mapper = ShardMapper(_pow2(cfg["num_shards"]), cfg["spread"])
+
+        def accept(container, _s=shard_num, _m=mapper):
+            if not len(container.ts):
+                return False
+            return _m.shard_of(int(container.shard_hash[0]),
+                               int(container.part_hash[0])) == _s
+        return accept
 
     def _resolve_endpoint(self, node: str) -> str | None:
         """HTTP endpoint of a peer node, from registrar heartbeats (each node
@@ -351,9 +398,26 @@ class FiloServer:
             consumers = list(self.consumers)
             stopped = sorted(self._running)
             self._running.clear()
+            buses = list(self._buses.values())
             self._buses.clear()
         for c in consumers:
-            c.stop()
+            c.stop()                # flag FIRST: a woken consumer exits
+        for b in buses:
+            try:
+                b.close()           # unblocks any consumer mid-recv
+            except OSError:
+                log.warning("bus close failed during quarantine",
+                            exc_info=True)
+        for c in consumers:
+            c.join(timeout=3)
+        for b in buses:
+            try:
+                b.close()           # re-sever: a consumer that raced the
+                                    # first close and reconnected is now
+                                    # joined, so this one sticks
+            except OSError:
+                log.warning("bus close failed during quarantine",
+                            exc_info=True)
         for ds in list(self.engines):
             if ds not in self.manager.map:
                 continue       # downsample-family serving view, not a dataset
@@ -510,11 +574,15 @@ class FiloServer:
             # Broker publishes ride the windowed PUBLISH_BATCH path; sub-
             # window remainders drain on the gateway's flush cadence.
             from .ingest.gateway import GatewayServer
-            if cfg.get("bus_addr"):
+            if self._bus_addrs():
                 from .ingest.broker import BrokerBus
+                parts = self._num_partitions()
                 self._gw_buses = {
-                    s: BrokerBus(cfg["bus_addr"], s,
-                                 publish_window=cfg["ingest.publish_window"])
+                    s: BrokerBus(self._bus_addrs(), s % parts,
+                                 publish_window=cfg["ingest.publish_window"],
+                                 retry_backoff_ms=parse_duration_ms(
+                                     cfg["ingest.retry_backoff"]),
+                                 max_retries=cfg["ingest.publish_retries"])
                     for s in range(num_shards)}
             elif cfg.get("bus_dir"):
                 self._gw_buses = {
@@ -537,6 +605,15 @@ class FiloServer:
                 host=cfg["http.host"], port=cfg["ingest.gateway_port"],
                 flush_lines=cfg["ingest.gateway_flush_lines"],
                 flush_interval_ms=gw_iv_ms).start()
+
+            def gw_drain():
+                # gateway.stop() parity: the windowed publishers' sub-window
+                # remainders drain with the final builder flush
+                for b in list(self._gw_buses.values()):
+                    if hasattr(b, "flush_publishes"):
+                        b.flush_publishes()
+
+            self.gateway.bus_drain = gw_drain
             if gw_iv_ms > 0 and any(hasattr(b, "flush_publishes")
                                     for b in self._gw_buses.values()):
                 # interval 0 disables the timed flusher — starting the bus
@@ -705,21 +782,40 @@ class FiloServer:
         if self._gw_flush_stop is not None:
             self._gw_flush_stop.set()
         if self.gateway is not None:
+            # stop() owns the whole drain contract: it flushes every
+            # pending builder and runs bus_drain (the windowed publishers'
+            # sub-window remainders) before returning
             self.gateway.stop()
-            self.gateway.flush()        # pending builders -> publish path
         for b in self._gw_buses.values():
             try:
-                if hasattr(b, "flush_publishes"):
-                    b.flush_publishes()     # drain sub-window remainders
                 if hasattr(b, "close"):
                     b.close()
             except (ConnectionError, OSError, RuntimeError):
-                log.warning("gateway bus drain failed on shutdown",
+                log.warning("gateway bus close failed on shutdown",
                             exc_info=True)
+        # stop flags first, then SEVER the buses (unblocks a consumer stuck
+        # in a broker recv — joining first would stall behind the socket
+        # timeout), join, and re-sever to catch a reconnect that raced the
+        # first close (same ordering as _quarantine)
         for c in self.consumers:
             c.stop()
+        with self._shards_lock:
+            for b in self._buses.values():
+                try:
+                    b.close()
+                except OSError:
+                    log.warning("bus close failed on shutdown",
+                                exc_info=True)
         for c in self.consumers:
             c.join(timeout=3)
+        with self._shards_lock:
+            for b in self._buses.values():
+                try:
+                    b.close()
+                except OSError:
+                    log.warning("bus close failed on shutdown",
+                                exc_info=True)
+            self._buses.clear()
         if self.http:
             self.http.stop()
         if self.scheduler:

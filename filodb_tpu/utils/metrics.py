@@ -31,6 +31,10 @@ FILODB_INGESTED_ROWS = "filodb_ingested_rows"
 FILODB_GATEWAY_INGESTED_ROWS = "filodb_gateway_ingested_rows"
 FILODB_GATEWAY_PARSE_ERRORS = "filodb_gateway_parse_errors"
 FILODB_INGEST_DECODE_ERRORS = "filodb_ingest_decode_errors"
+FILODB_INGEST_RETRIES = "filodb_ingest_retries"
+FILODB_INGEST_FAILOVERS = "filodb_ingest_failovers"
+FILODB_INGEST_REPLICATION_LAG = "filodb_ingest_replication_lag"
+FILODB_INGEST_PUBLISH_SHED = "filodb_ingest_publish_shed"
 FILODB_SWALLOWED_ERRORS = "filodb_swallowed_errors"
 FILODB_SCHEDULER_WORKER_ERRORS = "filodb_scheduler_worker_errors"
 FILODB_PEER_EXEC_REQUESTS = "filodb_peer_exec_requests"
@@ -54,6 +58,21 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "Decode-ahead worker faults surfaced to the consumer "
                    "(the batch is re-fetched; a rising rate means a "
                    "corrupt bus segment)."),
+    FILODB_INGEST_RETRIES: (
+        "counter", "BrokerBus publish re-sends: reconnect replays of the "
+                   "unacked window plus RETRY-shed backoffs (jittered "
+                   "exponential, capped)."),
+    FILODB_INGEST_FAILOVERS: (
+        "counter", "BrokerBus leader re-resolutions: the client re-ranked "
+                   "the replica set by watermark and switched brokers."),
+    FILODB_INGEST_REPLICATION_LAG: (
+        "gauge", "Frames the follower trails the leader, per partition and "
+                 "peer (0 when fully replicated; grows while a follower "
+                 "is down or out of the in-sync set)."),
+    FILODB_INGEST_PUBLISH_SHED: (
+        "counter", "Publishes the broker shed with RETRY: per-partition "
+                   "queue-depth overload or a below-min_insync quorum "
+                   "stall (clients back off and replay idempotently)."),
     FILODB_SWALLOWED_ERRORS: (
         "counter", "Errors intentionally dropped on non-critical paths, "
                    "tagged by site= — the observability replacement for "

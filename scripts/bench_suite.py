@@ -617,6 +617,170 @@ def bench_ingest(full: bool) -> None:
     emit("ingest", "bit_parity", 1.0, "bool")
 
 
+def bench_ingest_soak(full: bool) -> None:
+    """Replicated multi-partition ingest soak (ISSUE 6): 2 gateways x 3
+    partitions x replication 2 over two broker nodes. The leader of
+    partition 1 is KILLED mid-stream (deterministic kill-at-offset fault);
+    gateways fail over to the survivor and replay their unacked windows.
+    Audit: pub-id reconciliation of every gateway's acked-id ledger against
+    the survivor's journals — zero lost, zero duplicated — plus end-to-end
+    row-count parity. Overload phase: queue cap 1 + response-delay faults
+    shed RETRY at the wire while client backoff lands every publish."""
+    import shutil
+    import socket as socketmod
+    import tempfile
+    import threading
+
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE, Schemas
+    from filodb_tpu.ingest.broker import BrokerBus, BrokerServer
+    from filodb_tpu.ingest.faults import FaultPlan, FaultRule
+    from filodb_tpu.ingest.gateway import GatewayServer
+    from filodb_tpu.utils.metrics import (FILODB_INGEST_FAILOVERS,
+                                          FILODB_INGEST_PUBLISH_SHED,
+                                          FILODB_INGEST_RETRIES, registry)
+
+    n_lines = 30_000 if full else 6_000          # per gateway
+    n_parts, n_shards, kill_at = 3, 4, 10
+
+    def reserve():
+        with socketmod.socket() as s:
+            s.setsockopt(socketmod.SOL_SOCKET, socketmod.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    pa, pb = reserve(), reserve()
+    peers = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+    tmp = tempfile.mkdtemp(prefix="filodb_soak_")
+    retries0 = registry.counter(FILODB_INGEST_RETRIES).value
+    failovers0 = registry.counter(FILODB_INGEST_FAILOVERS).value
+    try:
+        # leader(p) = peers[p % 2]: partition 1 leads on node B — the kill
+        # target; A survives and leads/follows everything afterwards
+        a = BrokerServer(f"{tmp}/a", n_parts, port=pa, peers=peers,
+                         node_index=0, replication=2).start()
+        plan = FaultPlan([FaultRule("append", "kill_server", partition=1,
+                                    at_offset=kill_at)])
+        b = BrokerServer(f"{tmp}/b", n_parts, port=pb, peers=peers,
+                         node_index=1, replication=2, fault_plan=plan).start()
+
+        gateways = []
+        for g in range(2):
+            buses = {s: BrokerBus(peers, s % n_parts, publish_window=16,
+                                  retry_backoff_ms=5, max_retries=12,
+                                  seed=100 + g, track_acks=True)
+                     for s in range(n_shards)}
+            gw = GatewayServer(
+                lambda s, c, _bs=buses: _bs[s].publish_async(c),
+                num_shards=n_shards, flush_lines=64, flush_interval_ms=100,
+                port=0).start()
+            gw.bus_drain = (lambda _bs=buses:
+                            [bus.flush_publishes() for bus in _bs.values()])
+            gateways.append((gw, buses))
+
+        def send(gw_idx):
+            gw, _ = gateways[gw_idx]
+            lines = [f"cpu,host=g{gw_idx}h{i % 400},dc=east usage={i % 97}.5 "
+                     f"{(BASE + i) * 1_000_000}" for i in range(n_lines)]
+            with socketmod.create_connection(("127.0.0.1", gw.port)) as s:
+                s.sendall(("\n".join(lines) + "\n").encode())
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=send, args=(g,)) for g in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for gw, _ in gateways:
+            gw.stop()           # flush builders + drain publish windows
+        soak_s = time.perf_counter() - t0
+        assert plan.fired, "leader kill never fired"
+
+        # -- pub-id reconciliation against the SURVIVOR (node A) ----------
+        acked: dict[int, set] = {p: set() for p in range(n_parts)}
+        for _gw, buses in gateways:
+            for s, bus in buses.items():
+                acked[s % n_parts].update(bus.acked_ids)
+        lost = dup = frames = rows = 0
+        for p in range(n_parts):
+            items = a._journals[p].items()
+            offsets = [o for o, _pid in items]
+            pids = [pid for _o, pid in items]
+            assert offsets == list(range(len(offsets))), "journal not dense"
+            dup += len(pids) - len(set(pids))
+            lost += len(acked[p] - set(pids))
+            # every logged frame was acked to SOME gateway (drain completed)
+            dup += len(set(pids) - acked[p])
+            frames += len(pids)
+            rows += sum(len(c) for _off, c in
+                        BrokerBus([peers[0]], p).consume(Schemas()))
+        emit("ingest_soak", "soak_lines_per_s", 2 * n_lines / soak_s,
+             "lines/s")
+        emit("ingest_soak", "frames_on_survivor", frames, "count")
+        emit("ingest_soak", "rows_on_survivor", rows, "rows")
+        emit("ingest_soak", "rows_expected", 2 * n_lines, "rows")
+        emit("ingest_soak", "pubid_lost", lost, "count")
+        emit("ingest_soak", "pubid_duplicated", dup, "count")
+        emit("ingest_soak", "row_parity",
+             float(rows == 2 * n_lines), "bool")
+        emit("ingest_soak", "kill_offset", kill_at, "offset")
+        emit("ingest_soak", "client_retries",
+             registry.counter(FILODB_INGEST_RETRIES).value - retries0,
+             "count")
+        emit("ingest_soak", "client_failovers",
+             registry.counter(FILODB_INGEST_FAILOVERS).value - failovers0,
+             "count")
+        assert lost == 0 and dup == 0 and rows == 2 * n_lines
+        for _gw, buses in gateways:
+            for bus in buses.values():
+                bus.close()
+        a.stop()
+        with __import__("contextlib").suppress(Exception):
+            b.stop()
+
+        # -- overload: queue cap 1 + delayed responses -> RETRY shed, then
+        # client backoff lands every publish (bounded in-flight by design:
+        # client windows <= _MAX_UNACKED_FRAMES, server admits <= max_queue)
+        shed0 = registry.counter(FILODB_INGEST_PUBLISH_SHED).value
+        oplan = FaultPlan([FaultRule("serve", "delay", nth=1, count=40,
+                                     delay_s=0.02)])
+        o = BrokerServer(f"{tmp}/o", 1, max_queue=1, fault_plan=oplan).start()
+        n_pub, n_threads = (400, 8) if full else (120, 6)
+
+        def hammer(k):
+            bus = BrokerBus([f"127.0.0.1:{o.port}"], 0, retry_backoff_ms=10,
+                            max_retries=16, seed=k)
+            for i in range(n_pub // n_threads):
+                bld = RecordBuilder(GAUGE)
+                bld.add({"_metric_": "ov", "t": f"{k}-{i}"}, BASE, 1.0)
+                bus.publish(bld.build())
+            bus.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=hammer, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        odt = time.perf_counter() - t0
+        n_expected = (n_pub // n_threads) * n_threads
+        end = o._parts[0].end_offset
+        sheds = registry.counter(FILODB_INGEST_PUBLISH_SHED).value - shed0
+        emit("ingest_soak", "overload_publishes", n_expected, "count")
+        emit("ingest_soak", "overload_landed", end, "count")
+        emit("ingest_soak", "overload_sheds", sheds, "count")
+        emit("ingest_soak", "overload_publish_rate", n_expected / odt,
+             "frames/s")
+        emit("ingest_soak", "overload_queue_cap", 1, "count")
+        emit("ingest_soak", "overload_zero_loss",
+             float(end == n_expected), "bool")
+        assert end == n_expected and sheds > 0
+        o.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_gateway(full: bool) -> None:
     """Ref GatewayBenchmark: Influx line-protocol parse + shard-hash rate."""
     from filodb_tpu.ingest.gateway import parse_influx_line
@@ -949,6 +1113,7 @@ def bench_count_values(full: bool) -> None:
 SUITES = {
     "ingestion": bench_ingestion,
     "ingest": bench_ingest,
+    "ingest_soak": bench_ingest_soak,
     "odp": bench_odp,
     "count_values": bench_count_values,
     "narrow_resident": bench_narrow_resident,

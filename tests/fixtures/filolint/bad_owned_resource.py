@@ -1,0 +1,28 @@
+"""BAD: a class instantiating socket-owning links into a self attribute
+with no close()/stop() reachable — the replication-link-pool leak shape
+(resource-no-release, transitive socket ownership)."""
+
+import socket
+
+
+class Link:
+    """Direct socket owner (clean on its own: close releases the socket)."""
+
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)
+
+    def close(self):
+        self._sock.close()
+
+
+class Pool:
+    """Stores Link instances but never closes them — every reconnect
+    leaks a socket."""
+
+    def __init__(self, addrs):
+        self._links = {}
+        for a in addrs:
+            self._links[a] = Link(a)
+
+    def send(self, a, data):
+        self._links[a]._sock.sendall(data)

@@ -1,0 +1,27 @@
+"""GOOD twin: the pool closes every stored link (iterated-collection
+release credits the attribute, incl. through a helper)."""
+
+import socket
+
+
+class Link:
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)
+
+    def close(self):
+        self._sock.close()
+
+
+class Pool:
+    def __init__(self, addrs):
+        self._links = {}
+        for a in addrs:
+            self._links[a] = Link(a)
+
+    def send(self, a, data):
+        self._links[a]._sock.sendall(data)
+
+    def close(self):
+        for link in self._links.values():
+            link.close()
+        self._links.clear()

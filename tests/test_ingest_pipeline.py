@@ -332,6 +332,34 @@ def test_windowed_producer_to_consumer_end_to_end(tmp_path):
         broker.stop()
 
 
+def test_gateway_stop_drains_builders_and_publish_window(tmp_path):
+    """Shutdown parity (ISSUE 6 satellite): lines accepted before stop()
+    must ALL be on the broker log after stop() returns — stop flushes
+    pending per-connection builders AND drains the windowed publisher's
+    sub-window remainder (no acked-but-unflushed lines)."""
+    srv = BrokerServer(str(tmp_path / "b"), 1).start()
+    try:
+        bus = BrokerBus(f"127.0.0.1:{srv.port}", 0, publish_window=64)
+        # size/time flushes disabled: ONLY the stop() path may deliver
+        gw = GatewayServer(lambda s, c: bus.publish_async(c), num_shards=1,
+                           flush_lines=10**9, flush_interval_ms=0,
+                           port=0).start()
+        gw.bus_drain = bus.flush_publishes
+        n = 57
+        with socket.create_connection(("127.0.0.1", gw.port)) as s:
+            for i in range(n):
+                s.sendall(f"mem,host=h{i % 9} value={i}.0 "
+                          f"{(BASE + i) * 1_000_000_000}\n".encode())
+        gw.stop()
+        # every line is durably on the broker before stop() returned
+        rows = sum(len(c) for _, c in bus.consume(Schemas()))
+        assert rows == n
+        assert srv._parts[0].end_offset > 0
+        bus.close()
+    finally:
+        srv.stop()
+
+
 def _assert_port_released(host, port, timeout_s=5.0):
     """The LISTENER must be gone: a live listen socket fails this bind for
     the whole window, while transient teardown states of severed

@@ -46,6 +46,10 @@ BAD_FIXTURES = {
                            "resource-server-no-stop"},
     "bad_thread_loop.py": {"resource-worker-silent-death"},
     "bad_resource_release.py": {"resource-no-release"},
+    # PR 6: transitive socket ownership (replication link pools) — an
+    # instantiated owner-class instance stored on self needs a reachable
+    # close()/stop()
+    "bad_owned_resource.py": {"resource-no-release"},
     "bad_except_swallow.py": {"except-swallow", "except-overbroad-typed",
                               "except-state-leak"},
     "bad_config_key.py": {"surface-config-undeclared",
