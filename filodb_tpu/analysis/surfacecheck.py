@@ -28,8 +28,14 @@ surface, and these rules make drift impossible:
     metric-name string: both sites export under the same series name and
     their values interleave meaninglessly.
   * ``surface-metric-unused`` — a declared metric no code registers.
+  * ``surface-trace-undeclared`` — every span name at a ``span(...)`` /
+    ``tracer.span(...)`` call site must be one of the declared ``SPAN_*``
+    constants in utils/tracing.py's ``TRACE_SPEC`` (a raw string literal
+    is flagged even when the name matches — the taxonomy has exactly one
+    spelling per span).
+  * ``surface-trace-unused`` — a declared span no code opens.
 
-Both surfaces are verified against the docs tables by
+All three surfaces are verified against the docs tables by
 tests/test_static_analysis.py (README tables are generated from the same
 dicts), so docs cannot drift either. When an analysis run's module set
 contains no spec (narrow ``--changed-only`` scopes, fixture self-tests
@@ -74,7 +80,8 @@ def _fstring_prefix(node: ast.JoinedStr) -> str | None:
 class SurfaceChecker:
     rules = ("surface-config-undeclared", "surface-config-unused",
              "surface-metric-undeclared", "surface-metric-kind",
-             "surface-metric-duplicate", "surface-metric-unused")
+             "surface-metric-duplicate", "surface-metric-unused",
+             "surface-trace-undeclared", "surface-trace-unused")
 
     def __init__(self):
         self._modules: dict[str, ast.Module] = {}
@@ -92,6 +99,7 @@ class SurfaceChecker:
         findings: list[Finding] = []
         findings += self._check_config()
         findings += self._check_metrics()
+        findings += self._check_traces()
         return findings
 
     # -- config ---------------------------------------------------------------
@@ -303,6 +311,104 @@ class SurfaceChecker:
                     f"declared metric {name!r} is never registered in the "
                     "analyzed set — dead surface; remove the entry or wire "
                     "it up"))
+        return findings
+
+    # -- traces ---------------------------------------------------------------
+
+    SPAN_CONST_PREFIX = "SPAN_"
+
+    def _trace_constants(self) -> tuple[str, dict, dict] | None:
+        """(spec path, constant name -> span name, span name -> (const,
+        line)) from the module declaring TRACE_SPEC (utils/tracing.py in
+        production; fixtures declare their own)."""
+        spec = self._find_spec_dict("TRACE_SPEC")
+        if spec is None:
+            return None
+        path, spec_dict = spec
+        tree = self._modules[path]
+        consts: dict[str, str] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id.startswith(self.SPAN_CONST_PREFIX):
+                v = _const_str(node.value)
+                if v is not None:
+                    consts[node.targets[0].id] = v
+        entries: dict[str, tuple[str, int]] = {}   # span name -> (const, line)
+        for k in spec_dict.keys:
+            if isinstance(k, ast.Name):
+                name = consts.get(k.id)
+                if name is not None:
+                    entries[name] = (k.id, k.lineno)
+            else:
+                s = _const_str(k) if k is not None else None
+                if s is not None:
+                    entries[s] = (s, k.lineno)
+        return path, consts, entries
+
+    @staticmethod
+    def _is_span_call(node: ast.Call) -> bool:
+        """A ``span(...)`` / ``<tracer>.span(...)`` call site with a
+        positional name argument (re.Match.span() and friends take none)."""
+        if not node.args:
+            return False
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id == "span"
+        return isinstance(f, ast.Attribute) and f.attr == "span"
+
+    def _check_traces(self) -> list[Finding]:
+        meta = self._trace_constants()
+        if meta is None:
+            return []              # narrow scope: nothing to check against
+        spec_path, consts, entries = meta
+        findings: list[Finding] = []
+        used: set[str] = set()
+        for path, tree in self._modules.items():
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and self._is_span_call(node)):
+                    continue
+                arg = node.args[0]
+                qual = self._enclosing(tree, node)
+                lit = _const_str(arg)
+                if lit is not None:
+                    findings.append(Finding(
+                        "surface-trace-undeclared", path, node.lineno, qual,
+                        f"literal:{lit}",
+                        f"span {lit!r} opened from a string literal — use "
+                        "the declared SPAN_* constant from utils/tracing.py "
+                        "TRACE_SPEC so the taxonomy has exactly one "
+                        "spelling"))
+                    continue
+                cname = None
+                if isinstance(arg, ast.Name):
+                    cname = arg.id
+                elif isinstance(arg, ast.Attribute):
+                    cname = arg.attr
+                if cname is None or \
+                        not cname.startswith(self.SPAN_CONST_PREFIX):
+                    continue       # a non-SPAN_ expression: not our surface
+                value = consts.get(cname)
+                if value is None or value not in entries:
+                    findings.append(Finding(
+                        "surface-trace-undeclared", path, node.lineno, qual,
+                        f"const:{cname}",
+                        f"span constant {cname} is not declared in "
+                        f"TRACE_SPEC ({spec_path}) — declare it with a "
+                        "one-line doc"))
+                    continue
+                used.add(value)
+        for name, (const, line) in sorted(entries.items()):
+            if not self.full_scope:
+                break
+            if name not in used:
+                findings.append(Finding(
+                    "surface-trace-unused", spec_path, line, "TRACE_SPEC",
+                    f"unused:{name}",
+                    f"declared span {name!r} ({const}) is never opened in "
+                    "the analyzed set — dead surface; remove the entry or "
+                    "wire it up"))
         return findings
 
     # -- shared ---------------------------------------------------------------

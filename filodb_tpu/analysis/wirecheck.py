@@ -24,6 +24,12 @@ i.e. a cross-node incident, not a unit-test failure.
     via a handled ancestor, and a subclass handler must come BEFORE its
     ancestor's (Python takes the first matching except — a dead subclass
     handler silently degrades a 503 to a 422).
+  * ``wire-trace-parity`` — the trace-context carriers (the /exec
+    ``TRACE_HEADER`` and the broker/replication ``pack_trace_hdr`` /
+    ``unpack_trace_hdr`` payload blocks) must be referenced on EVERY side
+    listed in ``trace_specs``: an inject without its extract (or vice
+    versa) silently severs cross-node traces — or worse, leaves the
+    receiver parsing a payload whose first bytes it no longer strips.
 
 The function/file names checked are configured in ``WIRE_SPEC`` below —
 extend it when a new codec pair appears.
@@ -58,6 +64,22 @@ WIRE_SPEC = {
         {"module": "filodb_tpu/ingest/replication.py", "prefix": "OP_",
          "server_fn": "serve_replication", "client_class": "FollowerLink"},
     ],
+    # trace-context carrier parity: every (module, scope) side must
+    # reference the symbol — scopes are function OR class names, so the
+    # sender may be a whole client class (BrokerBus packs inside its group
+    # sender) while the receiver is one dispatch function
+    "trace_specs": [
+        {"symbol": "TRACE_HEADER",
+         "sides": [["filodb_tpu/query/wire.py", "_dispatch_post_traced"],
+                   ["filodb_tpu/http/api.py", "_trace_ctx"]]},
+        {"symbol": "pack_trace_hdr",
+         "sides": [["filodb_tpu/ingest/broker.py", "BrokerBus"],
+                   ["filodb_tpu/ingest/replication.py", "FollowerLink"]]},
+        {"symbol": "unpack_trace_hdr",
+         "sides": [["filodb_tpu/ingest/broker.py", "_serve"],
+                   ["filodb_tpu/ingest/replication.py",
+                    "serve_replication"]]},
+    ],
 }
 
 
@@ -77,7 +99,8 @@ def _byte_tags(fn: ast.FunctionDef) -> dict[bytes, int]:
 
 
 class WireChecker:
-    rules = ("wire-tag-parity", "wire-nesting-bound", "wire-error-classified")
+    rules = ("wire-tag-parity", "wire-nesting-bound", "wire-error-classified",
+             "wire-trace-parity")
 
     def __init__(self, spec: dict | None = None):
         self.spec = spec or WIRE_SPEC
@@ -101,6 +124,8 @@ class WireChecker:
             tree = self._modules.get(op_spec["module"])
             if tree is not None:
                 findings += self._op_parity(op_spec, tree)
+        for t_spec in self.spec.get("trace_specs", ()):
+            findings += self._trace_parity(t_spec)
         return findings
 
     # -- tags --------------------------------------------------------------
@@ -210,6 +235,48 @@ class WireChecker:
                         f"op-un{'served' if role == 'server' else 'sent'}:"
                         f"{name}",
                         f"op constant {name} has {side}"))
+        return findings
+
+    # -- trace-context carriers ---------------------------------------------
+
+    def _trace_parity(self, spec: dict) -> list[Finding]:
+        """Every (module, scope) side of a trace-context carrier must
+        reference ``symbol`` (by Name or attribute). Sides whose module is
+        outside the analyzed set are skipped — narrow --changed-only runs
+        must not invent cross-file findings."""
+        symbol = spec["symbol"]
+        findings: list[Finding] = []
+        for module, scope_name in spec.get("sides", ()):
+            tree = self._modules.get(module)
+            if tree is None:
+                continue
+            scope = None
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)) \
+                        and node.name == scope_name:
+                    scope = node
+                    break
+            if scope is None:
+                findings.append(Finding(
+                    "wire-trace-parity", module, 1, "<module>",
+                    f"missing-scope:{scope_name}",
+                    f"trace-carrier scope {scope_name} not found in "
+                    f"{module} — update analysis/wirecheck.WIRE_SPEC "
+                    "trace_specs if it moved"))
+                continue
+            referenced = any(
+                (isinstance(n, ast.Name) and n.id == symbol)
+                or (isinstance(n, ast.Attribute) and n.attr == symbol)
+                for n in ast.walk(scope))
+            if not referenced:
+                findings.append(Finding(
+                    "wire-trace-parity", module, scope.lineno, scope_name,
+                    f"one-sided:{symbol}",
+                    f"{scope_name} no longer references trace carrier "
+                    f"{symbol} — the other side still speaks it, so "
+                    "cross-node traces sever (or the receiver misparses "
+                    "the payload head)"))
         return findings
 
     # -- nesting bound ------------------------------------------------------

@@ -77,6 +77,13 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
                          "Bounded query queue; overflow sheds as 503."),
     "query.timeout": ("duration", "60s",
                       "Per-query timeout (maps to HTTP 504)."),
+    "query.slow_log_threshold_ms": (
+        "int|null", 1000,
+        "Queries at or over this wall duration (ms) enter the slow-query "
+        "ring served at /api/v1/debug/slow_queries (with plan summary, "
+        "per-query stats, and trace id); null disables the log."),
+    "query.slow_log_size": (
+        "int", 128, "Capacity of the slow-query ring buffer."),
     "downsample.enabled": ("bool", False,
                            "Inline downsampling at flush into durable "
                            "per-aggregate datasets ({ds}:ds_{res})."),
@@ -164,6 +171,19 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
                          "SimpleProfiler)."),
     "profiler.interval": ("duration", "100ms", "Profiler sample cadence."),
     "tracing.log_spans": ("bool", False, "Log tracer spans."),
+    "trace.enabled": (
+        "bool", True,
+        "Distributed tracing: spans on the query and ingest hot paths, "
+        "context propagated across /exec, remote-write/read, and broker "
+        "wires (off = trace roots pay a single flag check)."),
+    "trace.sample_rate": (
+        "float", 1.0,
+        "Fraction of trace ROOTS recorded; the decision rides the trace "
+        "context, so a trace is recorded on every node or none."),
+    "trace.zipkin_endpoint": (
+        "str|null", None,
+        "Zipkin v2 collector URL (e.g. http://host:9411/api/v2/spans); "
+        "when set a background reporter drains the span ring to it."),
     "diagnostics.enabled": (
         "bool", False,
         "Runtime concurrency assertions: donation provenance, lock "
@@ -289,7 +309,9 @@ class Config:
     def query_config(self):
         from .query.engine import QueryConfig
         q = self.data["query"]
+        thr = q["slow_log_threshold_ms"]
         return QueryConfig(
             stale_sample_after_ms=parse_duration_ms(q["stale_sample_after"]),
             sample_limit=q["sample_limit"],
+            slow_log_threshold_ms=None if thr is None else float(thr),
         )

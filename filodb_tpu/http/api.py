@@ -20,9 +20,11 @@ from urllib.parse import parse_qs, urlparse
 from ..core import filters as F
 from ..ingest.broker import BrokerRetry
 from ..promql.parser import ParseError
-from ..query.engine import QueryEngine
+from ..query.engine import QueryEngine, slow_query_log
 from ..query.rangevector import QueryError
 from ..query.scheduler import Priority, SchedulerBusy
+from ..utils.tracing import (SPAN_QUERY_SERVE, SPAN_REMOTE_WRITE, span,
+                             tracer)
 
 
 from ..query.rangevector import fmt_value as _fmt  # shared full-precision renderer
@@ -89,6 +91,10 @@ class FiloHttpServer:
         self.cluster = cluster
         self.writers = writers or {}
         self.scheduler = scheduler
+        # debug-plane profiler slot (/api/v1/debug/profile start/stop/
+        # report); FiloServer hands over its config-started SimpleProfiler
+        self.profiler = None
+        self._profiler_lock = threading.Lock()
         # admission control for peer fan-out legs (/exec, read?local=1):
         # they must NOT queue behind the scheduler's QUERY lane (the root
         # request holds a QUERY worker blocked on this response — two
@@ -159,12 +165,20 @@ class FiloHttpServer:
 
     def stop(self):
         """Deterministic teardown: stop the acceptor, release the listening
-        socket, and join the serve thread with a timeout."""
+        socket, join the serve thread with a timeout, and stop the debug
+        plane's profiler — a sampler started via /api/v1/debug/profile
+        lives only on this server and must not outlive it (stop() is
+        idempotent, so a config-started profiler the FiloServer also stops
+        is fine)."""
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=3)
             self._thread = None
+        with self._profiler_lock:
+            prof, self.profiler = self.profiler, None
+        if prof is not None:
+            prof.stop()
 
     def _sync_shard_stats(self) -> None:
         """Refresh per-shard ingest/eviction gauges on each scrape (ref:
@@ -261,6 +275,9 @@ class FiloHttpServer:
         if path == "/api/v1/cluster/status" or path.startswith("/api/v1/cluster/"):
             h._send(200, {"status": "success", "data": self._cluster_status(path)})
             return
+        if path.startswith("/api/v1/debug/"):
+            self._debug(h, path.removeprefix("/api/v1/debug/"), q)
+            return
 
         m = re.fullmatch(r"/promql/([^/]+)/api/v1/(query_range|query)", path)
         if m:
@@ -278,7 +295,12 @@ class FiloHttpServer:
                 res = self._run(
                     lambda: engine.query_instant(q["query"], _parse_time(q["time"])),
                     Priority.QUERY)
-            h._send(200, {"status": "success", "data": matrix_to_prom_json(res)})
+            body = {"status": "success", "data": matrix_to_prom_json(res)}
+            if res.stats is not None:
+                # per-query resource accounting, aggregated across every
+                # participating shard and peer (reference QueryStats shape)
+                body["stats"] = res.stats.to_dict()
+            h._send(200, body)
             return
 
         # local=1 (strictly) marks a peer's metadata fan-out request: answer
@@ -369,7 +391,80 @@ class FiloHttpServer:
             return
         h._send(404, {"status": "error", "error": f"unknown path {path}"})
 
+    # -- debug introspection plane (traces / slow queries / profiler) ---------
+
+    def _debug(self, h, which: str, q: dict) -> None:
+        """``/api/v1/debug/{traces,slow_queries,profile}`` — the read
+        surface of the observability layer (ref: the reference's Zipkin
+        reporter + SimpleProfiler report files; here both are queryable
+        in-process)."""
+        if which == "traces":
+            limit = int(q.get("limit") or 50)
+            trace_id = q.get("trace_id")
+            if q.get("format") == "zipkin":
+                body = tracer.export_zipkin_json(trace_id=trace_id).encode()
+                h.send_response(200)
+                h.send_header("Content-Type", "application/json")
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+                return
+            h._send(200, {"status": "success",
+                          "data": tracer.traces(limit=limit,
+                                                trace_id=trace_id)})
+            return
+        if which == "slow_queries":
+            limit = int(q.get("limit") or 0) or None
+            h._send(200, {"status": "success",
+                          "data": slow_query_log.entries(limit)})
+            return
+        if which == "profile":
+            action = q.get("action", "report")
+            with self._profiler_lock:
+                prof = self.profiler
+                if action == "start":
+                    if prof is None:
+                        from ..utils.profiler import SimpleProfiler
+                        iv = float(q.get("interval_s") or 0.1)
+                        prof = self.profiler = SimpleProfiler(iv).start()
+                    h._send(200, {"status": "success",
+                                  "data": {"running": True}})
+                    return
+                if action == "stop":
+                    report = None
+                    if prof is not None:
+                        prof.stop()
+                        report = prof.report()
+                        self.profiler = None
+                    h._send(200, {"status": "success",
+                                  "data": {"running": False,
+                                           "report": report}})
+                    return
+                h._send(200, {"status": "success",
+                              "data": {"running": prof is not None,
+                                       "report": prof.report()
+                                       if prof is not None else None}})
+            return
+        h._send(404, {"status": "error",
+                      "error": f"unknown debug endpoint {which}"})
+
     # -- cross-node plan execution (ref: PlanDispatcher receiving side) -------
+
+    @staticmethod
+    def _trace_ctx(h):
+        """Extract the cross-node trace-context header (the one constant
+        query/wire.py TRACE_HEADER — filolint's wire-trace-parity rule keeps
+        this receiver and the _dispatch_post sender in lockstep); None when
+        absent or malformed (the peer roots its own trace)."""
+        from ..query import wire
+        raw = h.headers.get(wire.TRACE_HEADER)
+        if not raw:
+            return None
+        try:
+            ctx = json.loads(raw)
+        except ValueError:
+            return None
+        return ctx if isinstance(ctx, dict) else None
 
     def _exec_plan(self, h, dataset: str) -> None:
         engine = self.engines.get(dataset)
@@ -391,16 +486,20 @@ class FiloHttpServer:
         # and its worker blocks on this response — queueing subtrees behind
         # other root queries would deadlock two saturated nodes against each
         # other (every worker waiting on a peer whose workers all wait back)
-        with self._leg_guard():
+        with self._leg_guard(), tracer.activate(self._trace_ctx(h)), \
+                span(SPAN_QUERY_SERVE, node=engine.node or "local",
+                     dataset=dataset):
             if body[:1] == b"[":
                 # batched dispatch: a JSON LIST of envelopes (all leaves a
                 # caller routed at this node) -> one multi-part tagged-binary
                 # response with per-envelope error classification
                 payload = wire.execute_batch(body, engine._ctx())
             else:
+                ctx = engine._ctx()
                 plan = wire.deserialize_plan(body)
-                data = plan.execute(engine._ctx())
-                payload = wire.serialize_result(data)
+                with ctx.stats.stage("peer_exec"):
+                    data = plan.execute(ctx)
+                payload = wire.serialize_result(data, stats=ctx.stats)
         h.send_response(200)
         h.send_header("Content-Type", "application/octet-stream")
         h.send_header("Content-Length", str(len(payload)))
@@ -438,7 +537,7 @@ class FiloHttpServer:
             # leg behind other root queries would deadlock saturated nodes,
             # same rule as /exec)
             if local:
-                with self._leg_guard():
+                with self._leg_guard(), tracer.activate(self._trace_ctx(h)):
                     payload = remote.read_request(body, engine,
                                                   local_only=True)
             else:
@@ -457,8 +556,14 @@ class FiloHttpServer:
                           "error": f"no remote-write sink configured for {dataset}"})
             return
         schema = engine.memstore._dataset_schema[dataset]
-        per_shard = remote.write_request_to_containers(body, schema, engine.mapper)
-        writer(per_shard)
+        # the remote-write edge joins the sender's trace when the request
+        # carries the trace header; the publish path below (bus/broker)
+        # propagates it onward over PUBLISH_BATCH
+        with tracer.activate(self._trace_ctx(h)), \
+                span(SPAN_REMOTE_WRITE, dataset=dataset):
+            per_shard = remote.write_request_to_containers(body, schema,
+                                                           engine.mapper)
+            writer(per_shard)
         h.send_response(204)
         h.send_header("Content-Length", "0")
         h.end_headers()

@@ -69,7 +69,10 @@ from typing import Iterator
 
 from ..core.record import RecordContainer
 from ..utils.metrics import (FILODB_INGEST_FAILOVERS, FILODB_INGEST_RETRIES,
+                             FILODB_INGEST_PUBLISH_LATENCY_MS,
                              FILODB_INGEST_PUBLISH_SHED, registry)
+from ..utils.tracing import (SPAN_BROKER_APPEND, SPAN_INGEST_PUBLISH, span,
+                             tracer)
 from .bus import FileBus
 
 log = logging.getLogger("filodb_tpu.broker")
@@ -80,6 +83,34 @@ _ENTRY = struct.Struct("<Q I")
 
 OP_PUBLISH, OP_FETCH, OP_END, OP_PUBLISH_BATCH = 1, 2, 3, 4
 ST_OK, ST_ERR, ST_RETRY = 0, 1, 2
+
+# trace-context block riding PUBLISH_BATCH (and OP_REPLICATE) payloads:
+# ``u16 len + JSON context``, stripped server-side BEFORE frame parsing —
+# durable log frames never carry it. pack/unpack are the one encode/decode
+# pair; filolint's wire-trace-parity rule fails tier-1 when either the
+# BrokerBus sender or the _serve receiver stops calling its side.
+_TRACE_HDR = struct.Struct("<H")
+
+
+def pack_trace_hdr(ctx: dict | None) -> bytes:
+    import json
+    blob = json.dumps(ctx, separators=(",", ":")).encode() if ctx else b""
+    return _TRACE_HDR.pack(len(blob)) + blob
+
+
+def unpack_trace_hdr(payload: bytes) -> tuple[dict | None, bytes]:
+    """(context or None, payload with the block stripped). Malformed blocks
+    degrade to no-context — a trace must never fail a publish."""
+    import json
+    try:
+        (ln,) = _TRACE_HDR.unpack_from(payload, 0)
+        body = payload[_TRACE_HDR.size:]
+        if ln > len(body):
+            return None, payload        # not a trace block: pass through
+        ctx = json.loads(body[:ln]) if ln else None
+        return (ctx if isinstance(ctx, dict) else None), body[ln:]
+    except (struct.error, ValueError):
+        return None, payload
 
 
 class BrokerRetry(RuntimeError):
@@ -229,11 +260,21 @@ class BrokerServer:
                 raise ValueError(f"no partition {part}")
             bus = self._parts[part]
             if op in (OP_PUBLISH, OP_PUBLISH_BATCH):
+                tctx = None
+                if op == OP_PUBLISH_BATCH:
+                    # trace block stripped BEFORE frame parsing: the spans
+                    # this append records join the publisher's trace, and
+                    # the durable log never sees the block
+                    tctx, payload = unpack_trace_hdr(payload)
                 if not self._admit(part):
                     self._shed.increment()
                     return _RESP.pack(ST_RETRY, 100, 0)   # retry hint (ms)
                 try:
-                    resp = self._serve_publish(op, part, offset, payload, bus)
+                    with tracer.activate(tctx), \
+                            span(SPAN_BROKER_APPEND, partition=part,
+                                 broker=self.port):
+                        resp = self._serve_publish(op, part, offset,
+                                                   payload, bus)
                     # fault hook INSIDE the admission slot: a delayed
                     # response occupies partition capacity exactly like a
                     # slow disk/replica would
@@ -486,6 +527,10 @@ class BrokerBus:
         self._ok_since_rank = 0         # successes since the last re-rank
         self._retries = registry.counter(FILODB_INGEST_RETRIES)
         self._failovers = registry.counter(FILODB_INGEST_FAILOVERS)
+        self._publish_hist = registry.histogram(
+            FILODB_INGEST_PUBLISH_LATENCY_MS,
+            {"partition": str(partition)})
+        self.failover_count = 0         # this bus only (span failover tag)
         # persistently-dead partition -> shed fast (PR 2 breaker machinery)
         from ..query.wire import PeerBreaker
         self._breaker = PeerBreaker(threshold=3, cooldown_s=5.0)
@@ -547,6 +592,7 @@ class BrokerBus:
                 continue
         if best is not None and best[2] != self._cur:
             self._cur = best[2]
+            self.failover_count += 1
             self._failovers.increment()
 
     _RERANK_EVERY = 256
@@ -696,6 +742,32 @@ class BrokerBus:
         return offs
 
     def _send_group_locked(self, chunks: list[list]) -> list[int]:
+        # one span per pipelined group: the SAME trace context rides every
+        # request of the group — including replays after a leader failover,
+        # so the survivor's append spans join the original publish trace
+        # and the failover itself is tagged on the client span
+        with span(SPAN_INGEST_PUBLISH, partition=self.partition,
+                  frames=sum(len(c) for c in chunks)) as tags:
+            fo0 = self.failover_count
+            t0 = time.perf_counter_ns()
+            try:
+                offs = self._send_group_traced_locked(chunks)
+            finally:
+                if self.failover_count > fo0:
+                    tags["failovers"] = self.failover_count - fo0
+            # SUCCESSFUL groups only: a breaker-open shed raises within
+            # microseconds and a timed-out group never completed — either
+            # would poison the round-trip histogram's percentiles. The
+            # exemplar carries the id only for SAMPLED traces (an id
+            # nothing recorded dead-ends at /api/v1/debug/traces).
+            tctx = tracer.current_context()
+            self._publish_hist.record(
+                (time.perf_counter_ns() - t0) / 1e6,
+                trace_id=(tctx["trace_id"]
+                          if tctx and tctx.get("sampled") else None))
+            return offs
+
+    def _send_group_traced_locked(self, chunks: list[list]) -> list[int]:
         # pipeline WITHIN a bounded group: all of the group's requests go
         # on the wire before its first response is read (the broker
         # serves one connection serially, so responses arrive in order),
@@ -709,13 +781,16 @@ class BrokerBus:
             raise ConnectionError(
                 f"partition {self.partition} breaker open (replica set down)")
         transport = self._transport_attempts()
+        # the trace block is identical across replays (same publish span):
+        # a failed-over broker's spans join the original trace
+        thdr = pack_trace_hdr(tracer.current_context())
         t_fail = r_shed = 0
         while True:
             try:
                 s = self._conn_locked()
                 for ch in chunks:
-                    payload = b"".join(_ENTRY.pack(pid, len(f)) + f
-                                       for pid, f in ch)
+                    payload = thdr + b"".join(_ENTRY.pack(pid, len(f)) + f
+                                              for pid, f in ch)
                     s.sendall(_REQ.pack(OP_PUBLISH_BATCH, self.partition,
                                         len(ch), len(payload)) + payload)
                     self.requests += 1

@@ -34,6 +34,7 @@ from ..parallel.shardmapper import ShardMapper
 from ..utils.metrics import (FILODB_GATEWAY_INGESTED_ROWS,
                              FILODB_GATEWAY_PARSE_ERRORS,
                              FILODB_SWALLOWED_ERRORS, registry)
+from ..utils.tracing import SPAN_GATEWAY_PUBLISH, span
 
 log = logging.getLogger("filodb_tpu.gateway")
 
@@ -353,9 +354,14 @@ class GatewayServer:
 
     def _publish(self, shard: int, container) -> None:
         # publish serializes per shard (and per connection via the caller's
-        # state lock) — parse/batch of other connections proceeds concurrently
-        with self._publish_locks[shard]:
-            self.publish(shard, container)
+        # state lock) — parse/batch of other connections proceeds
+        # concurrently. The span is per built CONTAINER (≤ flush_lines
+        # rows), never per line: it roots the ingest trace that the
+        # windowed broker publish continues over PUBLISH_BATCH when the
+        # window fills inside this call
+        with span(SPAN_GATEWAY_PUBLISH, shard=shard, rows=len(container)):
+            with self._publish_locks[shard]:
+                self.publish(shard, container)
         self._rows.increment(len(container))
 
     def _resolve_route(self, head: str | None, measurement: str | None,

@@ -47,7 +47,10 @@ import zlib
 
 from ..utils.metrics import (FILODB_INGEST_REPLICATION_LAG, registry)
 from ..utils.netio import recv_exact as _recv_exact
-from .broker import _REQ, _RESP, ST_ERR, ST_OK, _remember_id
+from ..utils.tracing import (SPAN_REPLICATE, SPAN_REPLICATE_SERVE, span,
+                             tracer)
+from .broker import (_REQ, _RESP, ST_ERR, ST_OK, _remember_id,
+                     pack_trace_hdr, unpack_trace_hdr)
 
 log = logging.getLogger("filodb_tpu.replication")
 
@@ -158,6 +161,16 @@ def serve_replication(server, op: int, part: int, payload: bytes) -> bytes:
     follower's end offset — its replication watermark."""
     if op != OP_REPLICATE:
         raise ValueError(f"unknown replication op {op}")
+    # the leader's trace block rides ahead of the frames (stripped before
+    # CRC/frame parsing, never appended to the log): the follower's append
+    # span joins the original publish trace
+    tctx, payload = unpack_trace_hdr(payload)
+    with tracer.activate(tctx), \
+            span(SPAN_REPLICATE_SERVE, partition=part, broker=server.port):
+        return _serve_replication_traced(server, part, payload)
+
+
+def _serve_replication_traced(server, part: int, payload: bytes) -> bytes:
     bus = server._parts[part]
     with server._publish_locks[part]:
         end = bus.end_offset
@@ -232,7 +245,13 @@ class FollowerLink:
         """Stream [(offset, pub_id, frame)] to the follower; returns (and
         caches) its watermark. Raises ConnectionError/ReplicationError on
         transport faults / rejection."""
-        payload = pack_entries(entries)
+        with span(SPAN_REPLICATE, partition=self.partition, peer=self.addr,
+                  frames=len(entries)):
+            return self._replicate_traced(entries)
+
+    def _replicate_traced(self, entries) -> int:
+        payload = pack_trace_hdr(tracer.current_context()) \
+            + pack_entries(entries)
         base = entries[0][0] if entries else 0
         try:
             s = self._conn()

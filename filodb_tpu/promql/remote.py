@@ -76,19 +76,29 @@ def read_request(body: bytes, engine, local_only: bool = False) -> bytes:
 
 def _peer_read_fetch(body: bytes, engine):
     """fetch(ep) forwarding the raw ReadRequest verbatim to a peer's
-    local-only read endpoint and parsing its ReadResponse."""
+    local-only read endpoint and parsing its ReadResponse (trace context
+    rides the shared /exec header so the peer's spans join this trace)."""
+    import json
     import urllib.request
 
+    from ..query import wire
+    from ..utils.tracing import SPAN_REMOTE_READ, span, tracer
+
     def fetch(ep: str):
-        url = f"http://{ep}/promql/{engine.dataset}/api/v1/read?local=1"
-        rq = urllib.request.Request(
-            url, data=body, method="POST",
-            headers={"Content-Type": "application/x-protobuf",
-                     "Content-Encoding": "snappy"})
-        with urllib.request.urlopen(rq, timeout=30.0) as r:
-            peer = pb.ReadResponse()
-            peer.ParseFromString(snappy.decompress(r.read()))
-            return peer
+        with span(SPAN_REMOTE_READ, endpoint=ep):
+            headers = {"Content-Type": "application/x-protobuf",
+                       "Content-Encoding": "snappy"}
+            tctx = tracer.current_context()
+            if tctx is not None:
+                headers[wire.TRACE_HEADER] = json.dumps(
+                    tctx, separators=(",", ":"))
+            url = f"http://{ep}/promql/{engine.dataset}/api/v1/read?local=1"
+            rq = urllib.request.Request(url, data=body, method="POST",
+                                        headers=headers)
+            with urllib.request.urlopen(rq, timeout=30.0) as r:
+                peer = pb.ReadResponse()
+                peer.ParseFromString(snappy.decompress(r.read()))
+                return peer
     return fetch
 
 

@@ -12,7 +12,10 @@ in-process scatter-gather ExecPlan tree.
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 
+from collections import deque
 from dataclasses import dataclass
 
 import numpy as np
@@ -20,6 +23,10 @@ import numpy as np
 from ..core.memstore import TimeSeriesMemStore
 from ..parallel.shardmapper import ShardMapper
 from ..promql import parser as promql
+from ..utils.metrics import (FILODB_QUERY_LATENCY_MS, FILODB_QUERY_SLOW,
+                             registry)
+from ..utils.tracing import (SPAN_QUERY, SPAN_QUERY_EXECUTE,
+                             SPAN_QUERY_PARSE, SPAN_QUERY_PLAN, span, tracer)
 from . import logical as L
 from .exec import QueryContext, group_keys_of
 from .planner import QueryPlanner
@@ -100,12 +107,49 @@ class QueryConfig:
     """Ref: query/.../QueryConfig.scala (stale-sample-after, sample limits)."""
     stale_sample_after_ms: int = 5 * 60 * 1000
     sample_limit: int = 1_000_000
+    # queries at or over this wall duration enter the slow-query ring
+    # (served at /api/v1/debug/slow_queries); None disables the log
+    slow_log_threshold_ms: float | None = 1000.0
+
+
+class SlowQueryLog:
+    """Bounded ring of slow-query records: promql text, duration, plan
+    summary (the engine's exec path), per-query stats, and the trace id —
+    the pivot from "this dashboard is slow" to the exact trace
+    (/api/v1/debug/traces?trace_id=...). One process-global ring, like the
+    tracer and the metrics registry."""
+
+    def __init__(self, capacity: int = 128):
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._ring.append(entry)
+
+    def entries(self, limit: int | None = None) -> list[dict]:
+        """Newest first."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def resize(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+slow_query_log = SlowQueryLog()
 
 
 class QueryEngine:
     def __init__(self, memstore: TimeSeriesMemStore, dataset: str,
                  shard_mapper: ShardMapper | None = None,
-                 config: QueryConfig = QueryConfig(), mesh=None,
+                 config: QueryConfig | None = None, mesh=None,
                  cluster=None, node: str | None = None,
                  endpoint_resolver=None, route_dataset: str | None = None):
         """``cluster``/``node``: the ShardManager's shard->node view and this
@@ -121,7 +165,10 @@ class QueryEngine:
         while pow2 < num_shards:
             pow2 *= 2
         self.mapper = shard_mapper or ShardMapper(pow2)
-        self.config = config
+        # fresh per engine: a shared default instance would let one
+        # engine's tuning (slow-log threshold, sample limit) leak into
+        # every other engine constructed without an explicit config
+        self.config = config if config is not None else QueryConfig()
         # jax.sharding.Mesh with one device per shard: aggregate queries
         # execute via shard_map + psum instead of the host scatter-gather
         self.mesh = mesh
@@ -133,6 +180,8 @@ class QueryEngine:
         self.route_dataset = route_dataset or dataset
         # route taken by the last query:
         # "mesh-fused" | "mesh-twostep" | "mesh-empty" | "local"
+        # (engine-shared — diagnostics/tests only; per-query consumers read
+        # ctx.exec_path, which _set_path records alongside)
         self.last_exec_path: str | None = None
         schema = memstore._dataset_schema.get(dataset)
         opts = schema.options if schema else None
@@ -164,29 +213,109 @@ class QueryEngine:
                             sample_limit=self.config.sample_limit,
                             stale_ms=self.config.stale_sample_after_ms)
 
+    def _set_path(self, ctx: QueryContext | None, path: str) -> None:
+        """Record the exec route both per-query (ctx — what the slow log
+        reports) and on the engine (last_exec_path — diagnostics/tests;
+        racy under concurrent queries by nature)."""
+        self.last_exec_path = path
+        if ctx is not None:
+            ctx.exec_path = path
+
     def query_range(self, promql_text: str, start_ms: int, end_ms: int,
                     step_ms: int) -> QueryResult:
-        plan = promql.query_to_logical_plan(promql_text, start_ms, end_ms, step_ms)
-        return self.exec_logical(plan)
+        return self._query_traced(
+            promql_text,
+            lambda: promql.query_to_logical_plan(promql_text, start_ms,
+                                                 end_ms, step_ms))
 
     def query_instant(self, promql_text: str, time_ms: int) -> QueryResult:
-        plan = promql.query_to_logical_plan(promql_text, time_ms, time_ms, 1)
-        res = self.exec_logical(plan)
+        res = self._query_traced(
+            promql_text,
+            lambda: promql.query_to_logical_plan(promql_text, time_ms,
+                                                 time_ms, 1))
         res.result_type = "vector"
         return res
 
-    def exec_logical(self, plan: L.LogicalPlan) -> QueryResult:
+    def _query_traced(self, promql_text: str, to_plan) -> QueryResult:
+        """Shared query entry: ONE root span per query (every stage and
+        every participating node's spans hang off its trace id), the
+        end-to-end latency histogram (exemplar-tagged with that trace id),
+        and the slow-query ring. Accounting runs in a FINALLY: the 30s
+        query that then raises is exactly the one an operator opens the
+        slow-query log to find, and tail latency must not under-report
+        during incidents."""
+        ctx = self._ctx()
+        t0 = time.perf_counter_ns()
+        tctx = None
+        err: BaseException | None = None
+        try:
+            with span(SPAN_QUERY, dataset=self.dataset,
+                      promql=promql_text[:200]):
+                tctx = tracer.current_context()
+                with span(SPAN_QUERY_PARSE), ctx.stats.stage("parse"):
+                    plan = to_plan()
+                return self.exec_logical(plan, ctx)
+        except BaseException as e:
+            err = e                     # noted below, then re-raised
+            raise
+        finally:
+            self._note_query_done(promql_text, ctx,
+                                  (time.perf_counter_ns() - t0) / 1e6,
+                                  tctx, err)
+
+    def _note_query_done(self, promql_text: str, ctx: QueryContext,
+                         dur_ms: float, tctx: dict | None,
+                         error: BaseException | None) -> None:
+        # only SAMPLED traces are recorded: an exemplar/slow-log entry
+        # pointing at a sampled-out trace id would dead-end at
+        # /api/v1/debug/traces
+        trace_id = (tctx.get("trace_id")
+                    if tctx and tctx.get("sampled") else None)
+        registry.histogram(FILODB_QUERY_LATENCY_MS,
+                           {"dataset": self.dataset}) \
+            .record(dur_ms, trace_id=trace_id)
+        thr = self.config.slow_log_threshold_ms
+        if thr is not None and dur_ms >= thr:
+            registry.counter(FILODB_QUERY_SLOW,
+                             {"dataset": self.dataset}).increment()
+            entry = {
+                "promql": promql_text, "dataset": self.dataset,
+                "duration_ms": round(dur_ms, 3),
+                "plan": ctx.exec_path, "trace_id": trace_id,
+                "stats": ctx.stats.to_dict(),
+                # wall timestamp for operator display only — durations above
+                # all come from the monotonic clock
+                "ts": time.time(),
+            }
+            if error is not None:
+                entry["error"] = f"{type(error).__name__}: {error}"
+            slow_query_log.record(entry)
+
+    def exec_logical(self, plan: L.LogicalPlan,
+                     ctx: QueryContext | None = None) -> QueryResult:
+        ctx = ctx if ctx is not None else self._ctx()
+        with span(SPAN_QUERY_EXECUTE, dataset=self.dataset), \
+                ctx.stats.stage("execute"):
+            res = self._exec_logical(plan, ctx)
+        m = res.matrix
+        ctx.stats.add("result_cells", m.num_series * len(m.out_ts))
+        res.stats = ctx.stats
+        return res
+
+    def _exec_logical(self, plan: L.LogicalPlan,
+                      ctx: QueryContext) -> QueryResult:
         if self.mesh is not None:
-            res = self._try_mesh(plan)
+            res = self._try_mesh(plan, ctx)
             if res is not None:
                 return res
-        res = self._try_fused_hist(plan)
+        res = self._try_fused_hist(plan, ctx)
         if res is not None:
             return res
-        self.last_exec_path = "local"
-        exec_plan = self.planner.materialize(plan)
+        self._set_path(ctx, "local")
+        with span(SPAN_QUERY_PLAN), ctx.stats.stage("plan"):
+            exec_plan = self.planner.materialize(plan)
         try:
-            return exec_plan.run(self._ctx())
+            return exec_plan.run(ctx)
         except Exception as e:
             from .wire import RemoteLeafExec, RemotePeerError
             if not isinstance(e, RemotePeerError) or self.cluster is None:
@@ -205,9 +334,13 @@ class QueryEngine:
                         and node.endpoint == e.endpoint
                         and failed & set(_plan_shards(node.inner))):
                     raise
-            self.last_exec_path = "local-replanned"
+            self._set_path(ctx, "local-replanned")
+            # the retry re-executes every leg, the already-merged successful
+            # ones included — drop the first attempt's counts so the
+            # response stats stay cluster-total, not attempt-total
+            ctx.stats.reset_counters()
             try:
-                return retry.run(self._ctx())
+                return retry.run(ctx)
             except QueryError as e2:
                 # e.g. the reassigned shard's takeover recovery still lags
                 # the map update: name both failures, stay retryable
@@ -215,7 +348,8 @@ class QueryEngine:
                     f"retry after peer failure also failed: {e2} "
                     f"(first failure: {e})") from e2
 
-    def _try_fused_hist(self, plan: L.LogicalPlan) -> QueryResult | None:
+    def _try_fused_hist(self, plan: L.LogicalPlan,
+                        ctx: QueryContext | None = None) -> QueryResult | None:
         """histogram_quantile(q, sum by(...) (fn(m[w]))) on a single
         grid-aligned native-histogram shard runs as ONE device program
         (ops/gridfns.fused_hist_quantile_grid) — per-bucket rates, bucket-wise
@@ -257,12 +391,20 @@ class QueryEngine:
             shard=sh.shard_num, filters=tuple(raw.filters),
             start_ms=raw.range_selector.from_ms,
             end_ms=raw.range_selector.to_ms)
-        ctx = self._ctx()
+        from dataclasses import replace as _dc_replace
+
+        from .rangevector import QueryStats
+        ctx = ctx if ctx is not None else self._ctx()
+        # probe accounting: the leaf select below counts series/blocks, but
+        # an off-pattern outcome re-runs the SAME leaf on the general path
+        # — commit the probe's stats only when the fused route serves (the
+        # same only-when-committed rule as the mesh path)
+        pctx = _dc_replace(ctx, stats=QueryStats())
         with sh.lock:
             # rare off-pattern outcomes below (cold data, churn minority)
             # re-run the leaf on the general path — acceptable on the slow
             # path; the common aligned case pays it once
-            data = leaf.do_execute(ctx)
+            data = leaf.do_execute(pctx)
             if (not isinstance(data, SeriesSelection) or data.grid is None
                     or data.bucket_les is None
                     or (data.grid_minority is not None
@@ -277,7 +419,8 @@ class QueryEngine:
             gids, uniq, G = _group_ids_for(data.keys, data.rows, R,
                                            agg.by, agg.without)
             if not uniq:
-                self.last_exec_path = "fused-hist"
+                self._set_path(ctx, "fused-hist")
+                ctx.stats.merge(pctx.stats)     # committed: fused serves
                 return QueryResult(ResultMatrix(
                     out_ts, np.zeros((0, len(out_ts))), []))
             base_ts, interval_ms = data.grid
@@ -316,7 +459,8 @@ class QueryEngine:
                     q, np.asarray(data.bucket_les, np.float64), data.val,
                     data.n, gids, _pow2(G), out_eval, window, fn,
                     base_ts, interval_ms, stale_ms=ctx.stale_ms)
-        self.last_exec_path = "fused-hist"
+        self._set_path(ctx, "fused-hist")
+        ctx.stats.merge(pctx.stats)             # committed: fused serves
         vals = np.asarray(out)[:G, :T]
         m = ResultMatrix(out_ts, vals, list(uniq))
         check_sample_limit(m.num_series, T, self.config.sample_limit)
@@ -359,7 +503,8 @@ class QueryEngine:
                 return None
         return MeshQueryExecutor(DistributedStore(self.mesh, shards))
 
-    def _try_mesh(self, plan: L.LogicalPlan) -> QueryResult | None:
+    def _try_mesh(self, plan: L.LogicalPlan,
+                  ctx: QueryContext | None = None) -> QueryResult | None:
         """Execute ``op(fn(selector[w]))`` via the mesh when the plan shape,
         operator, and store layout allow; None => caller falls back. Basic
         aggregates reduce via psum; topk/bottomk all_gather candidate blocks
@@ -415,10 +560,13 @@ class QueryEngine:
             ex = self._mesh_executor(shards)
             if ex is None:
                 return None      # residency/shape changed: host path
-            for sh in shards:
+            matched_total = 0    # committed to ctx.stats only when the mesh
+            for sh in shards:    # path actually serves (a later fallback to
+                # the host path must not double-count its own leaf counts)
                 pids = sh.part_ids_from_filters(filters, from_ms, to_ms)
                 if sh.needs_paging(pids, from_ms):
                     return None          # cold data: host ODP path handles it
+                matched_total += len(pids)
                 g = np.full(sh.store.S, _EXCLUDED_GID, np.int32)
                 if len(pids):
                     if not plan.by and not plan.without:
@@ -431,7 +579,7 @@ class QueryEngine:
                             g[p] = uniq.setdefault(gk, len(uniq))
                 gids_list.append(g)
             if not uniq:
-                self.last_exec_path = "mesh-empty"
+                self._set_path(ctx, "mesh-empty")
                 return QueryResult(ResultMatrix(
                     out_ts, np.zeros((0, len(out_ts))), []))
             G = len(uniq)
@@ -468,7 +616,9 @@ class QueryEngine:
             else:
                 lazy = ex.aggregate(fn, op, out_ts, window, gids_list,
                                     G, args=(a0, a1), fetch=False)
-        self.last_exec_path = f"mesh-{ex.last_path}"
+            if ctx is not None:     # committed: the mesh path serves this
+                ctx.stats.add("series_matched", matched_total)
+        self._set_path(ctx, f"mesh-{ex.last_path}")
         if op in ("topk", "bottomk"):
             m = self._present_mesh_topk(lazy, shards, epochs, out_ts,
                                         list(uniq))
@@ -539,8 +689,11 @@ class QueryEngine:
         eps = self._peer_endpoints()
         if not eps:
             return None
+        # scatter legs run on pool threads: adopt the caller's trace context
+        # so their spans (and anything the peer records) join its trace
+        run = tracer.wrap(fetch)
         pool = ThreadPoolExecutor(max_workers=min(len(eps), 16))
-        futs = [(ep, pool.submit(fetch, ep)) for ep in eps]
+        futs = [(ep, pool.submit(run, ep)) for ep in eps]
         return (pool, futs)
 
     @staticmethod

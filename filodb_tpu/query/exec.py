@@ -29,8 +29,10 @@ import numpy as np
 
 from ..core.filters import Filter
 from ..ops import aggregators, binop, instantfns, rangefns
-from .rangevector import (QueryError, QueryResult, RangeVectorKey,
-                          ResultMatrix, fmt_value)
+from ..utils.tracing import (SPAN_QUERY_LEAF, SPAN_QUERY_ODP,
+                             SPAN_QUERY_REDUCE, span)
+from .rangevector import (QueryError, QueryResult, QueryStats,
+                          RangeVectorKey, ResultMatrix, fmt_value)
 
 DEFAULT_SAMPLE_LIMIT = 1_000_000
 GATHER_THRESHOLD = 8192      # selections narrower than this gather rows up front
@@ -43,6 +45,13 @@ class QueryContext:
     dataset: str
     sample_limit: int = DEFAULT_SAMPLE_LIMIT
     stale_ms: int = 5 * 60 * 1000
+    # per-query accounting: every leaf/ODP/remote hop feeds this one
+    # accumulator (thread-safe; remote legs merge peer stats into it)
+    stats: QueryStats = field(default_factory=QueryStats)
+    # exec route taken for THIS query ("local"/"mesh-*"/"fused-hist"/...):
+    # the engine's last_exec_path is engine-shared and racy under the
+    # scheduler's concurrent workers — the slow-query log reads this one
+    exec_path: str | None = None
 
 
 @dataclass
@@ -1111,6 +1120,10 @@ class SelectRawPartitionsExec(ExecPlan):
         return _shard_of_ctx(ctx, self.shard, self.column)
 
     def execute(self, ctx: QueryContext):
+        with span(SPAN_QUERY_LEAF, shard=self.shard):
+            return self._execute_leaf(ctx)
+
+    def _execute_leaf(self, ctx: QueryContext):
         # hold the shard lock across array capture AND the transformer chain's
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
@@ -1144,9 +1157,10 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def _paged_selection(self, shard, pids, keys, cold=None,
                          column=None) -> SeriesSelection:
-        ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms,
-                                                  self.end_ms, cold=cold,
-                                                  column=column)
+        with span(SPAN_QUERY_ODP, shard=self.shard, series=len(pids)):
+            ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms,
+                                                      self.end_ms, cold=cold,
+                                                      column=column)
         return SeriesSelection(jnp.asarray(ts_h), jnp.asarray(val_h),
                                jnp.asarray(n_h), keys, None, None)
 
@@ -1177,6 +1191,7 @@ class SelectRawPartitionsExec(ExecPlan):
         outs = []
         for i in range(0, len(pids), ODP_BATCH):
             sub = pids[i:i + ODP_BATCH]
+            ctx.stats.add("rows_paged_in", len(sub))
             # the sink disk scan runs lock-free (append-only logs); only the
             # resident-store snapshot + key materialization need the lock
             cold = shard.read_cold_for(sub, self.start_ms, self.end_ms)
@@ -1215,6 +1230,7 @@ class SelectRawPartitionsExec(ExecPlan):
             return SeriesSelection(jnp.full((8, 8), 1 << 62, jnp.int64), z,
                                    jnp.zeros(8, jnp.int32), [], None, None)
         pids = shard.part_ids_from_filters(list(self.filters), self.start_ms, self.end_ms)
+        ctx.stats.add("series_matched", len(pids))
         store = shard.store
         # bucket boundaries ride only when the SELECTED column is the
         # histogram one (``{__col__="sum"}`` on prom-histogram is scalar)
@@ -1229,6 +1245,7 @@ class SelectRawPartitionsExec(ExecPlan):
         if les is None and shard.needs_paging(pids, self.start_ms):
             if len(pids) > ODP_BATCH:
                 return _WideODP(pids)
+            ctx.stats.add("rows_paged_in", len(pids))
             return self._paged_selection(
                 shard, pids, [shard.rv_key_of(int(p)) for p in pids],
                 column=col)
@@ -1279,6 +1296,7 @@ class SelectRawPartitionsExec(ExecPlan):
                             minority_sel = mins
         if len(pids) <= GATHER_THRESHOLD and len(pids) < 0.5 * max(total, 1):
             # narrow selection: gather rows once, padded to a power of two
+            ctx.stats.add("blocks_raw")
             sel_ts, sel_val, sel_n, P = _gather_rows_padded(ts, val, n, pids)
             # P > len(pids): arrays carry pad rows beyond the keys — expose the
             # identity row map so downstream compaction/group-scatter skips them
@@ -1324,6 +1342,9 @@ class SelectRawPartitionsExec(ExecPlan):
                 dd, first_d, ok_host = hd
                 hist_narrow = (dd, first_d,
                                pids[~ok_host[pids]].astype(np.int32))
+        ctx.stats.add("blocks_narrow"
+                      if (narrow is not None or hist_narrow is not None)
+                      else "blocks_raw")
         return SeriesSelection(ts, val, n_eff, keys, pids.astype(np.int32), grid, les,
                                g_min, narrow, hist_narrow)
 
@@ -1341,8 +1362,13 @@ def _execute_children(children, ctx):
         results = [c.execute(ctx) for c in children]
     else:
         from concurrent.futures import ThreadPoolExecutor
+        from ..utils.tracing import tracer
+
+        # remote legs run on pool threads: hand them the query's trace
+        # context so their dispatch spans join the one trace
+        run_remote = tracer.wrap(lambda c: c.execute(ctx))
         with ThreadPoolExecutor(max_workers=min(len(remote), 16)) as pool:
-            futs = {id(c): pool.submit(c.execute, ctx) for c in remote}
+            futs = {id(c): pool.submit(run_remote, c) for c in remote}
             results = [futs[id(c)].result() if id(c) in futs
                        else c.execute(ctx) for c in children]
     batches = [c for c in children if getattr(c, "IS_BATCH", False)]
@@ -1397,20 +1423,26 @@ class ReduceAggregateExec(ExecPlan):
 
     def do_execute(self, ctx):
         results = _execute_children(self.children, ctx)
-        # the per-shard group cap is data-dependent, so a sibling shard may
-        # have fallen back to a full matrix: normalization happens inside
-        # (the matrix has full information; the reverse is impossible)
-        merged = _merge_heterogeneous(results, self.operator, self.params,
-                                      self.by, self.without)
-        if merged is not None:
-            return merged
-        mats = [_as_matrix(r).to_host() for r in results]
-        mats = [m for m in mats if m.num_series]
-        if not mats:
-            return ResultMatrix(np.zeros(0, np.int64), np.zeros((0, 0)), [])
-        vals = np.concatenate([np.asarray(m.values) for m in mats], axis=0)
-        keys = [k for m in mats for k in m.keys]
-        return ResultMatrix(mats[0].out_ts, vals, keys)
+        with span(SPAN_QUERY_REDUCE, op=self.operator,
+                  children=len(self.children)), \
+                ctx.stats.stage("reduce"):
+            # the per-shard group cap is data-dependent, so a sibling shard
+            # may have fallen back to a full matrix: normalization happens
+            # inside (the matrix has full information; the reverse is
+            # impossible)
+            merged = _merge_heterogeneous(results, self.operator, self.params,
+                                          self.by, self.without)
+            if merged is not None:
+                return merged
+            mats = [_as_matrix(r).to_host() for r in results]
+            mats = [m for m in mats if m.num_series]
+            if not mats:
+                return ResultMatrix(np.zeros(0, np.int64),
+                                    np.zeros((0, 0)), [])
+            vals = np.concatenate([np.asarray(m.values) for m in mats],
+                                  axis=0)
+            keys = [k for m in mats for k in m.keys]
+            return ResultMatrix(mats[0].out_ts, vals, keys)
 
 
 def _merge_partials(op: str, partials: list[AggPartial]) -> AggPartial:

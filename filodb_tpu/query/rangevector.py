@@ -10,6 +10,9 @@ host. NaN marks absent points; presenters drop them at the edge.
 from __future__ import annotations
 
 import struct
+import threading
+import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -104,12 +107,83 @@ class ResultMatrix:
                 yield key, self.out_ts[present], vals[p][present]
 
 
+class QueryStats:
+    """Per-query resource accounting threaded through exec via QueryContext
+    (ref: the reference's QueryStats aggregated across ExecPlans and
+    returned in query responses). Counters sum across shards AND across
+    peers: the /exec wire wraps every result payload with the serving
+    node's stats (query/wire.py tag b"W") and the caller merges them into
+    its own, so the response's ``stats`` is cluster-total by construction.
+
+    Thread-safe: remote legs fan out on threads and batched envelopes run
+    concurrently on the peer, all mutating one query's accumulator.
+    ``stage_ms`` sums WALL time per stage across participants — stages
+    overlap across nodes, so totals exceed end-to-end latency by design
+    (they measure work, not critical path)."""
+
+    FIELDS = ("series_matched", "blocks_narrow", "blocks_raw",
+              "rows_paged_in", "result_cells")
+
+    def __init__(self):
+        self.series_matched = 0        # series selected by leaf filters
+        self.blocks_narrow = 0         # compressed-resident blocks streamed
+        self.blocks_raw = 0            # raw f32/f64 store blocks read
+        self.rows_paged_in = 0         # series paged in via ODP
+        self.result_cells = 0          # final matrix series x steps
+        self.stage_ms: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, field_name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field_name, getattr(self, field_name) + int(n))
+
+    @contextmanager
+    def stage(self, name: str):
+        """Accumulate one stage's wall time (monotonic clock only)."""
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            ms = (time.perf_counter_ns() - t0) / 1e6
+            with self._lock:
+                self.stage_ms[name] = self.stage_ms.get(name, 0.0) + ms
+
+    def reset_counters(self) -> None:
+        """Zero the counter fields, keep stage times. The replan-once
+        retry after a peer failure re-executes EVERY leg (including the
+        ones that succeeded and already merged their peer stats), so the
+        first attempt's partial counts must be discarded or the response
+        double-counts; stage times stay — they measure work done, across
+        attempts."""
+        with self._lock:
+            for f in self.FIELDS:
+                setattr(self, f, 0)
+
+    def merge(self, other: "QueryStats | dict") -> None:
+        d = other.to_dict() if isinstance(other, QueryStats) else other
+        with self._lock:
+            for f in self.FIELDS:
+                setattr(self, f, getattr(self, f) + int(d.get(f, 0)))
+            for k, v in (d.get("stage_ms") or {}).items():
+                self.stage_ms[k] = self.stage_ms.get(k, 0.0) + float(v)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            out = {f: getattr(self, f) for f in self.FIELDS}
+            out["stage_ms"] = {k: round(v, 3)
+                               for k, v in self.stage_ms.items()}
+        return out
+
+
 @dataclass
 class QueryResult:
     """Ref: query/QueryResults (QueryResult with result schema + RVs)."""
     matrix: ResultMatrix
     result_type: str = "matrix"        # matrix | vector | scalar
     warnings: list[str] = field(default_factory=list)
+    # per-query accounting, aggregated across shards and peers (None only
+    # for results built outside an engine, e.g. unit-test fixtures)
+    stats: "QueryStats | None" = None
 
 
 class QueryError(Exception):

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field, fields, replace
 import numpy as np
 
 from ..core import filters as F
+from ..utils.tracing import SPAN_QUERY_DISPATCH, span, tracer
 from .exec import (AggPartial, AggregateMapReduce, AggregatePresenter,
                    CountValuesPartial, DistConcatExec, ExecPlan,
                    InstantVectorFunctionMapper, MatrixView,
@@ -39,8 +40,8 @@ from .exec import (AggPartial, AggregateMapReduce, AggregatePresenter,
                    ReduceAggregateExec, ScalarOperationMapper,
                    SelectChunkInfosExec, SelectRawPartitionsExec,
                    SketchPartial, SortFunctionMapper, TopKPartial, _as_matrix)
-from .rangevector import (QueryError, RangeVectorKey, ResultMatrix,
-                          deserialize_matrix, serialize_matrix)
+from .rangevector import (QueryError, QueryStats, RangeVectorKey,
+                          ResultMatrix, deserialize_matrix, serialize_matrix)
 
 # -- plan envelope (JSON, whitelisted types) ---------------------------------
 
@@ -62,6 +63,12 @@ _FILTER_TYPES = {c.__name__: c for c in
                  (F.Equals, F.NotEquals, F.In, F.EqualsRegex, F.NotEqualsRegex)}
 
 _SCALARS = (bool, int, float, str, type(None))
+
+# trace-context header on every cross-node /exec POST: ONE constant shared
+# by the sender (_dispatch_post) and the receiver (http/api._exec_plan) —
+# filolint's wire-trace-parity rule fails tier-1 if either side stops
+# referencing it (a one-sided change silently severs cross-node traces)
+TRACE_HEADER = "X-Filo-Trace"
 
 
 class NotWireable(Exception):
@@ -191,7 +198,16 @@ breakers = PeerBreakerRegistry()
 def _dispatch_post(endpoint: str, dataset: str, body: bytes, timeout_s: float,
                    shards: tuple) -> bytes:
     """The ONE cross-node POST path: breaker admission, request counting,
-    per-peer latency gauge, and transport-vs-peer error classification."""
+    per-peer latency gauge, transport-vs-peer error classification, and
+    trace-context injection (the dispatch span parents the peer's serve
+    span — one trace id across every participating node)."""
+    with span(SPAN_QUERY_DISPATCH, endpoint=endpoint, shards=len(shards)):
+        return _dispatch_post_traced(endpoint, dataset, body, timeout_s,
+                                     shards)
+
+
+def _dispatch_post_traced(endpoint: str, dataset: str, body: bytes,
+                          timeout_s: float, shards: tuple) -> bytes:
     from ..utils.metrics import (FILODB_PEER_BREAKER_OPEN,
                                  FILODB_PEER_EXEC_LATENCY_MS,
                                  FILODB_PEER_EXEC_REQUESTS, registry)
@@ -207,9 +223,12 @@ def _dispatch_post(endpoint: str, dataset: str, body: bytes, timeout_s: float,
     registry.counter(FILODB_PEER_EXEC_REQUESTS,
                      {"endpoint": endpoint}).increment()
     url = f"http://{endpoint}/exec/{dataset}"
-    req = urllib.request.Request(
-        url, data=body, method="POST",
-        headers={"Content-Type": "application/octet-stream"})
+    headers = {"Content-Type": "application/octet-stream"}
+    tctx = tracer.current_context()
+    if tctx is not None:
+        headers[TRACE_HEADER] = json.dumps(tctx, separators=(",", ":"))
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers)
     t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
@@ -437,7 +456,14 @@ def _resolved_parts(parts) -> dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in parts.items()}
 
 
-def serialize_result(data) -> bytes:
+def serialize_result(data, stats=None) -> bytes:
+    if stats is not None:
+        # stats wrapper: the serving node's QueryStats ride every /exec
+        # result payload (tag b"W"); the caller merges them into its own
+        # accumulator, so query responses carry cluster-total accounting
+        inner = serialize_result(data)
+        return _pack(b"W", {"stats": stats.to_dict()},
+                     [np.frombuffer(inner, np.uint8)])
     if isinstance(data, MatrixView):
         data = data.compact()
     if isinstance(data, AggPartial):
@@ -474,9 +500,20 @@ def serialize_result(data) -> bytes:
     return b"M" + serialize_matrix(m)
 
 
-def deserialize_result(buf: bytes):
+def deserialize_result(buf: bytes, stats=None):
+    """``stats``: an optional QueryStats accumulator — a b"W"-wrapped
+    payload's peer stats merge into it (and the wrapper unwraps either
+    way, so stats-blind callers stay compatible)."""
     try:
         tag = buf[:1]
+        if tag == b"W":
+            _t, meta, arrays = _unpack(buf)
+            inner = arrays[0].tobytes()
+            if inner[:1] == b"W":
+                raise QueryError("nested stats wrapper")
+            if stats is not None and isinstance(meta.get("stats"), dict):
+                stats.merge(meta["stats"])
+            return deserialize_result(inner)
         if tag == b"M":
             return deserialize_matrix(buf[1:])
         tag, meta, arrays = _unpack(buf)
@@ -568,12 +605,17 @@ def execute_batch(body: bytes, ctx) -> bytes:
     except ValueError as e:
         raise QueryError(f"malformed exec batch: {e}") from None
 
-    def run_env(d) -> tuple[int, bytes]:
+    def _run_env(d) -> tuple[int, bytes]:
         try:
             if not isinstance(d, dict):
                 raise QueryError("batch envelope is not an object")
+            # per-envelope stats: envelopes run concurrently and each part's
+            # payload carries exactly its own subtree's accounting
+            ectx = replace(ctx, stats=QueryStats())
             plan = _dec_plan(dict(d))
-            return (0, serialize_result(plan.execute(ctx)))
+            with ectx.stats.stage("peer_exec"):
+                data = plan.execute(ectx)
+            return (0, serialize_result(data, stats=ectx.stats))
         except QueryError as e:
             return (1, json.dumps(
                 {"error": str(e), "kind": "query"}).encode())
@@ -586,6 +628,10 @@ def execute_batch(body: bytes, ctx) -> bytes:
                 {"error": f"{type(e).__name__}: {e}",
                  "kind": "internal"}).encode())
 
+    # envelopes run on pool threads: bind the handler thread's trace
+    # context (the caller's dispatch span) so leaf spans join the query's
+    # trace instead of rooting fresh ones
+    run_env = tracer.wrap(_run_env)
     if len(envs) > 1:
         # 16-wide: the width the pre-batching transport had (the client
         # fanned out up to 16 concurrent POSTs, the leg semaphore admits 16)
@@ -635,7 +681,10 @@ class RemoteLeafExec(ExecPlan):
         payload = _dispatch_post(self.endpoint, self.dataset,
                                  serialize_plan(plan), self.timeout_s, shards)
         try:
-            data = deserialize_result(payload)
+            # ctx-less execution (unit harnesses) still unwraps; the peer's
+            # stats merge only when there is an accumulator to merge into
+            data = deserialize_result(payload,
+                                      stats=getattr(ctx, "stats", None))
         except QueryError as e:
             # a torn/corrupt result body means the peer (or its transport)
             # failed mid-response: classify like unreachability so the
@@ -714,7 +763,8 @@ class RemoteBatchExec(ExecPlan):
                     f"remote exec on {self.endpoint} for shards "
                     f"{list(pshards)} failed: {err.get('error', '?')}")
             try:
-                data = deserialize_result(blob)
+                data = deserialize_result(blob,
+                                          stats=getattr(ctx, "stats", None))
             except QueryError as e:
                 raise RemotePeerError(
                     f"peer {self.endpoint} returned an undecodable result "

@@ -26,7 +26,7 @@ from .query.rangevector import QueryError
 from .utils.metrics import (FILODB_INGEST_DECODE_ERRORS,
                             FILODB_INGESTED_ROWS, FILODB_SWALLOWED_ERRORS,
                             ShardHealthStats, registry)
-from .utils.tracing import tracer
+from .utils.tracing import SPAN_INGEST_CONSUME, span, tracer
 
 log = logging.getLogger("filodb_tpu.server")
 
@@ -183,13 +183,23 @@ class IngestionConsumer(threading.Thread):
                     if first is not None:
                         if self.decode_ahead:
                             src = _DecodeAhead(src, self.decode_ahead)
+                        # one span per consumer DRAIN (not per container):
+                        # the scatter leg of the ingest path, tagged with
+                        # how much it moved
+                        n_rows = 0
                         try:
-                            for off, container in itertools.chain([first], src):
-                                if self.accept is None or \
-                                        self.accept(container):
-                                    sh.ingest(container, off)
-                                    rows.increment(len(container))
-                                self._offset = off + 1
+                            with span(SPAN_INGEST_CONSUME,
+                                      dataset=self.dataset,
+                                      shard=sh.shard_num) as tags:
+                                for off, container in itertools.chain(
+                                        [first], src):
+                                    if self.accept is None or \
+                                            self.accept(container):
+                                        sh.ingest(container, off)
+                                        rows.increment(len(container))
+                                        n_rows += len(container)
+                                    self._offset = off + 1
+                                tags["rows"] = n_rows
                         finally:
                             if isinstance(src, _DecodeAhead):
                                 src.close()
@@ -256,6 +266,7 @@ class FiloServer:
         self._ds_serve_stop = None
         self._endpoints: dict[str, str] = {}
         self._endpoints_at = 0.0
+        self._zipkin = None
 
     def _start_shard(self, dataset: str, shard_num: int) -> None:
         """Bring up one owned shard: store + (optionally) its bus consumer
@@ -769,7 +780,21 @@ class FiloServer:
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(
                 parse_duration_ms(cfg["profiler.interval"]) / 1000.0).start()
+        # hand the profiler to the HTTP debug plane: /api/v1/debug/profile
+        # start/stop/report drives this one instance (or lazily creates its
+        # own when the config didn't start one)
+        self.http.profiler = self.profiler
         tracer.log_spans = bool(cfg.get("tracing.log_spans"))
+        # distributed tracing: sampling decided at trace roots on THIS node;
+        # the decision propagates to peers in the trace context
+        tracer.enabled = bool(cfg.get("trace.enabled", True))
+        tracer.sample_rate = float(cfg.get("trace.sample_rate", 1.0))
+        from .query.engine import slow_query_log
+        slow_query_log.resize(int(cfg["query.slow_log_size"]))
+        zep = cfg.get("trace.zipkin_endpoint")
+        if zep:
+            from .utils.tracing import ZipkinReporter
+            self._zipkin = ZipkinReporter(tracer, zep).start()
         log.info("FiloServer up: dataset=%s shards=%s port=%s",
                  dataset, num_shards, self.http.port)
         return self
@@ -824,6 +849,8 @@ class FiloServer:
             self.membership.stop()
         if self.profiler:
             self.profiler.stop()
+        if self._zipkin is not None:
+            self._zipkin.stop()
 
 
 def _pow2(n: int) -> int:

@@ -44,6 +44,10 @@ FILODB_SHARD_STATUS = "filodb_shard_status"
 FILODB_SHARD_NUM_SERIES = "filodb_shard_num_series"
 FILODB_SHARD_LOCK_CONTENTIONS = "filodb_shard_lock_contentions"
 FILODB_SHARD_LOCK_LONG_HOLDS = "filodb_shard_lock_long_holds"
+FILODB_QUERY_LATENCY_MS = "filodb_query_latency_ms"
+FILODB_QUERY_SLOW = "filodb_query_slow"
+FILODB_INGEST_PUBLISH_LATENCY_MS = "filodb_ingest_publish_latency_ms"
+FILODB_TRACE_SPANS = "filodb_trace_spans"
 
 METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_INGESTED_ROWS: (
@@ -97,6 +101,21 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "gauge", "TimedRLock contention count per shard (diagnostics)."),
     FILODB_SHARD_LOCK_LONG_HOLDS: (
         "gauge", "TimedRLock long-hold count per shard (diagnostics)."),
+    FILODB_QUERY_LATENCY_MS: (
+        "histogram", "End-to-end PromQL latency per dataset; the /metrics "
+                     "rendering carries the last query's trace id as an "
+                     "exemplar-style companion series."),
+    FILODB_QUERY_SLOW: (
+        "counter", "Queries that crossed query.slow_log_threshold_ms and "
+                   "entered the slow-query ring "
+                   "(/api/v1/debug/slow_queries)."),
+    FILODB_INGEST_PUBLISH_LATENCY_MS: (
+        "histogram", "BrokerBus pipelined publish-group round trip per "
+                     "partition, exemplar-tagged with the publish trace "
+                     "id."),
+    FILODB_TRACE_SPANS: (
+        "counter", "Spans recorded into the tracer ring buffer (sampled-in "
+                   "only; sampled-out spans cost no clock reads)."),
     "filodb_shard_*": (
         "gauge", "Per-shard ingest/eviction stats exported from the shard's "
                  "IngestStats dataclass fields on each /metrics scrape."),
@@ -145,7 +164,14 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-boundary histogram (ms-scale latencies by default)."""
+    """Fixed-boundary histogram (ms-scale latencies by default).
+
+    ``record(v, trace_id=...)`` keeps the LAST recorded observation's trace
+    id as an exemplar: /metrics renders it as a companion
+    ``<name>_exemplar{trace_id="..."}`` series carrying the exemplar value,
+    so an operator can jump from a latency bucket straight to the trace in
+    /api/v1/debug/traces (the 0.0.4 text format has no native exemplar
+    syntax; a labeled companion series is the compatible encoding)."""
 
     DEFAULT_BOUNDS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000)
 
@@ -154,13 +180,18 @@ class Histogram:
         self.buckets = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
+        self.last_trace_id: str | None = None
+        self.last_value = 0.0
         self._lock = threading.Lock()
 
-    def record(self, v: float):
+    def record(self, v: float, trace_id: str | None = None):
         with self._lock:
             self.buckets[bisect_right(self.bounds, v)] += 1
             self.sum += v
             self.count += 1
+            if trace_id:
+                self.last_trace_id = trace_id
+                self.last_value = v
 
 
 class MetricsRegistry:
@@ -205,6 +236,12 @@ class MetricsRegistry:
                 lines.append(f"{name}_bucket{lt} {m.count}")
                 lines.append(f"{name}_sum{tag_s} {m.sum:g}")
                 lines.append(f"{name}_count{tag_s} {m.count}")
+                if m.last_trace_id:
+                    # exemplar-style companion series: the last observation's
+                    # trace id as a label, its value as the sample
+                    et = (tag_s[:-1] + "," if tag_s else "{") \
+                        + f'trace_id="{m.last_trace_id}"' + "}"
+                    lines.append(f"{name}_exemplar{et} {m.last_value:g}")
         return "\n".join(lines) + "\n"
 
 

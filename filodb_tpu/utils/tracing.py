@@ -1,11 +1,27 @@
-"""Tracing: lightweight spans with an in-process collector + log/JSON reporters.
+"""Tracing: distributed spans with context propagation, sampling, and an
+in-process collector + Zipkin v2 exporter.
 
 Reference: Kamon spans on hot paths (ODP span OnDemandPagingShard.scala:47-50,
 query spans queryengine2/QueryEngine.scala:62-66) exported to Zipkin via the
 custom reporter (core/.../zipkin/Zipkin.scala:24) and span log reporters
-(KamonLogger.scala). Here: ``with span("query.execute", tags)`` records timing
-into a ring buffer; reporters drain it (logging by default; a Zipkin v2 JSON
-exporter can POST the same records when an endpoint is configured).
+(KamonLogger.scala). Here: ``with span(SPAN_QUERY_EXECUTE, tags)`` records
+timing into a ring buffer; context crosses threads via ``activate`` and
+crosses the wire via ``current_context``/``activate`` pairs (the /exec HTTP
+header and the broker PUBLISH_BATCH / OP_REPLICATE trace-header blocks), so
+one query or one publish yields ONE trace id with spans from every
+participating node.
+
+Clock discipline: ``time.time()`` is read ONCE per span, for the start
+timestamp only (Zipkin needs an epoch anchor); every DURATION comes from
+``time.perf_counter_ns()`` — the same no-wall-clock rule the fault plans and
+broker follow (a stepped system clock must never produce negative or
+million-second spans).
+
+Sampling: the decision is made once at the trace ROOT (``sample_rate``) and
+rides the context, so either every participating node records a trace or
+none does — a half-sampled cross-node trace is useless. A remote context
+that arrives sampled is recorded even on a node whose own tracer is
+disabled (the root decided).
 """
 
 from __future__ import annotations
@@ -13,13 +29,88 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
+import random
 import threading
 import time
-import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
+from .metrics import FILODB_SWALLOWED_ERRORS, FILODB_TRACE_SPANS, registry
+
 log = logging.getLogger("filodb_tpu.trace")
+
+# ---------------------------------------------------------------------------
+# Declared span surface.
+#
+# Every span name this process records is named by ONE constant below and
+# documented in TRACE_SPEC — filolint's surface-check family enforces it
+# exactly like CONFIG_SPEC / METRICS_SPEC (a literal name at a span() call
+# site, an undeclared constant, and a declared-but-unused span all fail
+# tier-1), and the ARCHITECTURE span-taxonomy table is generated from this
+# dict so docs cannot drift from code.
+# ---------------------------------------------------------------------------
+
+SPAN_QUERY = "query"
+SPAN_QUERY_PARSE = "query.parse"
+SPAN_QUERY_PLAN = "query.plan"
+SPAN_QUERY_EXECUTE = "query.execute"
+SPAN_QUERY_LEAF = "query.exec.leaf"
+SPAN_QUERY_REDUCE = "query.exec.reduce"
+SPAN_QUERY_DISPATCH = "query.exec.dispatch"
+SPAN_QUERY_SERVE = "query.exec.serve"
+SPAN_QUERY_ODP = "query.odp"
+SPAN_REMOTE_READ = "query.remote_read"
+SPAN_REMOTE_WRITE = "ingest.remote_write"
+SPAN_GATEWAY_PUBLISH = "ingest.gateway.publish"
+SPAN_INGEST_PUBLISH = "ingest.publish"
+SPAN_BROKER_APPEND = "ingest.broker.append"
+SPAN_REPLICATE = "ingest.replicate"
+SPAN_REPLICATE_SERVE = "ingest.replicate.serve"
+SPAN_INGEST_CONSUME = "ingest.consume"
+
+TRACE_SPEC: dict[str, str] = {
+    SPAN_QUERY: "Root span of one PromQL query (tags: dataset, promql).",
+    SPAN_QUERY_PARSE: "PromQL text -> LogicalPlan.",
+    SPAN_QUERY_PLAN: "LogicalPlan -> ExecPlan materialization + remote "
+                     "collapse.",
+    SPAN_QUERY_EXECUTE: "ExecPlan execution (mesh, fused, or scatter-gather "
+                        "path; tags: path).",
+    SPAN_QUERY_LEAF: "One data-reading leaf under its shard lock "
+                     "(tags: shard).",
+    SPAN_QUERY_REDUCE: "Cross-shard reduce merge of child partials.",
+    SPAN_QUERY_DISPATCH: "One cross-node /exec POST (tags: endpoint, "
+                         "shards).",
+    SPAN_QUERY_SERVE: "Peer side of /exec: subtree execution on the "
+                      "shard-owning node (tags: node).",
+    SPAN_QUERY_ODP: "On-demand page-in of cold chunks for one leaf batch "
+                    "(tags: shard, series).",
+    SPAN_REMOTE_READ: "Remote-read fan-out leg to one peer (tags: "
+                      "endpoint).",
+    SPAN_REMOTE_WRITE: "Remote-write batch accepted at the HTTP edge.",
+    SPAN_GATEWAY_PUBLISH: "One built gateway container published to its "
+                          "shard's bus (tags: shard).",
+    SPAN_INGEST_PUBLISH: "One pipelined PUBLISH_BATCH group on the client "
+                         "(tags: partition, failovers on a leader switch).",
+    SPAN_BROKER_APPEND: "Broker-side publish append + quorum wait "
+                        "(tags: partition, broker).",
+    SPAN_REPLICATE: "Leader->follower replication push for one publish "
+                    "(tags: partition, peer).",
+    SPAN_REPLICATE_SERVE: "Follower side of OP_REPLICATE: CRC check + "
+                          "append (tags: partition, broker).",
+    SPAN_INGEST_CONSUME: "One consumer drain: bus containers scattered "
+                         "into the shard store (tags: dataset, shard).",
+}
+
+
+def trace_markdown_table() -> str:
+    """The ARCHITECTURE 'Span taxonomy' table, generated from TRACE_SPEC
+    (verified against the checked-in ARCHITECTURE.md by
+    tests/test_static_analysis.py)."""
+    lines = ["| span | meaning |", "|---|---|"]
+    for name, doc in sorted(TRACE_SPEC.items()):
+        lines.append(f"| `{name}` | {doc} |")
+    return "\n".join(lines)
 
 
 @dataclass
@@ -31,6 +122,15 @@ class SpanRecord:
     start_us: int
     duration_us: int
     tags: dict = field(default_factory=dict)
+    # monotonic record sequence (per tracer): exporters keep a watermark
+    # against it instead of draining the shared ring
+    seq: int = 0
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start_us": self.start_us, "duration_us": self.duration_us,
+                "tags": {k: str(v) for k, v in self.tags.items()}}
 
     def to_zipkin(self) -> dict:
         """Zipkin v2 JSON shape (ref: Zipkin.scala converts Kamon spans)."""
@@ -41,43 +141,273 @@ class SpanRecord:
 
 
 class Tracer:
+    """Process-global span recorder.
+
+    The per-thread context stack holds ``(trace_id, span_id, sampled)``
+    frames; ``span()`` parents under the innermost frame. ``activate``
+    adopts a REMOTE (or cross-thread) parent frame; ``current_context`` is
+    its wire-able counterpart — together they are the context-propagation
+    pair every transport uses.
+    """
+
     def __init__(self, capacity: int = 4096):
         self.spans: deque[SpanRecord] = deque(maxlen=capacity)
         self._local = threading.local()
+        self._lock = threading.Lock()
+        self._seq = 0
         self.log_spans = False
+        self.enabled = True
+        self.sample_rate = 1.0
+        self._span_counter = registry.counter(FILODB_TRACE_SPANS)
 
-    def _stack(self):
+    # -- context ------------------------------------------------------------
+
+    def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
         return st
 
+    def _new_id(self) -> str:
+        """16-hex-char id from a per-thread PRNG seeded ONCE from
+        os.urandom (never wall clock). uuid4 would syscall urandom per id —
+        tens of µs on older kernels, which dominates a span; trace ids need
+        uniqueness, not cryptographic strength."""
+        rng = getattr(self._local, "rng", None)
+        if rng is None:
+            rng = self._local.rng = random.Random(
+                int.from_bytes(os.urandom(16), "little"))
+        return f"{rng.getrandbits(64):016x}"
+
+    def current_context(self) -> dict | None:
+        """The innermost active frame as a wire-able dict (None outside any
+        span). The receiving side feeds it back through ``activate``."""
+        st = self._stack()
+        if not st:
+            return None
+        trace_id, span_id, sampled = st[-1]
+        return {"trace_id": trace_id, "span_id": span_id,
+                "sampled": bool(sampled)}
+
+    def wrap(self, fn):
+        """Bind the CURRENT thread's innermost context to ``fn``: the
+        returned callable activates it wherever it runs. THE way to hand
+        work to a thread pool without severing its spans from the trace
+        (every fan-out site uses this one helper instead of hand-rolling
+        capture + activate)."""
+        ctx = self.current_context()
+
+        def bound(*args, **kwargs):
+            with self.activate(ctx):
+                return fn(*args, **kwargs)
+        return bound
+
+    _ID_CHARS = frozenset("0123456789abcdef")
+
+    @classmethod
+    def _valid_id(cls, v) -> bool:
+        """Wire-supplied ids must be lowercase hex, bounded length: they end
+        up in span records, debug JSON, and /metrics exemplar LABELS — an
+        unvalidated id with quotes/braces would corrupt the whole metrics
+        exposition for every scraper."""
+        return (isinstance(v, str) and 0 < len(v) <= 32
+                and set(v) <= cls._ID_CHARS)
+
     @contextlib.contextmanager
-    def span(self, name: str, **tags):
-        stack = self._stack()
-        trace_id = stack[0][0] if stack else uuid.uuid4().hex[:16]
-        parent_id = stack[-1][1] if stack else None
-        span_id = uuid.uuid4().hex[:16]
-        stack.append((trace_id, span_id))
-        t0 = time.time()
+    def activate(self, ctx: dict | None):
+        """Adopt a remote/cross-thread parent frame on THIS thread: spans
+        opened inside parent under ``ctx`` and join its trace. A None or
+        malformed context — including non-hex ids from a hostile peer — is
+        a no-op (the span() below it roots a fresh trace), so transports
+        can pass whatever they extracted."""
+        if not isinstance(ctx, dict) or not self._valid_id(
+                ctx.get("trace_id")) or not self._valid_id(
+                ctx.get("span_id")):
+            yield
+            return
+        st = self._stack()
+        st.append((ctx["trace_id"], ctx["span_id"],
+                   bool(ctx.get("sampled", True))))
         try:
             yield
         finally:
+            st.pop()
+
+    # -- spans --------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags):
+        """Record one span. Yields the TAGS dict so callers can attach
+        outcome tags discovered mid-span (e.g. a publish that failed over
+        leaders) — mutations land in the recorded span."""
+        stack = self._stack()
+        if stack:
+            trace_id, parent_id, sampled = stack[-1]
+        elif not self.enabled:
+            # no active context and tracing off: stay out of the clocks
+            yield tags
+            return
+        else:
+            trace_id = self._new_id()
+            parent_id = None
+            sampled = (self.sample_rate >= 1.0
+                       or self._local.rng.random() < self.sample_rate)
+        # sampled-out spans skip id generation too: the frame still
+        # propagates (children and peers must inherit the decision) but
+        # nothing will ever reference its span id
+        span_id = self._new_id() if sampled else "0"
+        stack.append((trace_id, span_id, sampled))
+        if sampled:
+            # wall clock ONCE, for the epoch anchor; duration is monotonic
+            t0_wall_us = int(time.time() * 1e6)
+            t0 = time.perf_counter_ns()
+        try:
+            yield tags
+        finally:
             stack.pop()
-            dur = int((time.time() - t0) * 1e6)
-            rec = SpanRecord(trace_id, span_id, parent_id, name,
-                             int(t0 * 1e6), dur, tags)
-            self.spans.append(rec)
-            if self.log_spans:
-                log.info("span %s %.1fms %s", name, dur / 1000, tags)
+            if sampled:
+                dur_us = (time.perf_counter_ns() - t0) // 1000
+                rec = SpanRecord(trace_id, span_id, parent_id, name,
+                                 t0_wall_us, int(dur_us), tags)
+                with self._lock:
+                    self._seq += 1
+                    rec.seq = self._seq
+                    self.spans.append(rec)
+                self._span_counter.increment()
+                if self.log_spans:
+                    log.info("span %s %.1fms %s", name, dur_us / 1000, tags)
+
+    def last_trace_id(self) -> str | None:
+        with self._lock:
+            return self.spans[-1].trace_id if self.spans else None
+
+    # -- assembly / export --------------------------------------------------
+
+    def snapshot(self) -> list[SpanRecord]:
+        with self._lock:
+            return list(self.spans)
 
     def drain(self) -> list[SpanRecord]:
-        out = list(self.spans)
-        self.spans.clear()
+        with self._lock:
+            out = list(self.spans)
+            self.spans.clear()
         return out
 
-    def export_zipkin_json(self) -> str:
-        return json.dumps([s.to_zipkin() for s in self.spans])
+    def traces(self, limit: int = 50,
+               trace_id: str | None = None) -> list[dict]:
+        """Recent traces assembled parent -> child: newest trace first, each
+        trace's spans ordered roots-first then DFS by parent links (orphans
+        — parent span evicted from the ring — follow their trace's tree)."""
+        spans = self.snapshot()
+        by_trace: dict[str, list[SpanRecord]] = {}
+        order: list[str] = []
+        for s in spans:
+            if trace_id is not None and s.trace_id != trace_id:
+                continue
+            if s.trace_id not in by_trace:
+                order.append(s.trace_id)
+            by_trace.setdefault(s.trace_id, []).append(s)
+        out = []
+        for tid in reversed(order[-limit:] if trace_id is None else order):
+            members = by_trace[tid]
+            ids = {s.span_id for s in members}
+            children: dict[str | None, list[SpanRecord]] = {}
+            roots = []
+            for s in members:
+                if s.parent_id in ids:
+                    children.setdefault(s.parent_id, []).append(s)
+                else:
+                    roots.append(s)
+            ordered: list[SpanRecord] = []
+            stack = list(reversed(sorted(roots, key=lambda s: s.start_us)))
+            while stack:
+                s = stack.pop()
+                ordered.append(s)
+                kids = sorted(children.get(s.span_id, ()),
+                              key=lambda c: c.start_us)
+                stack.extend(reversed(kids))
+            out.append({"trace_id": tid,
+                        "duration_us": max((s.duration_us for s in roots),
+                                           default=0),
+                        "spans": [s.to_dict() for s in ordered]})
+        return out
+
+    def export_zipkin_json(self, trace_id: str | None = None) -> str:
+        return json.dumps([s.to_zipkin() for s in self.snapshot()
+                           if trace_id is None or s.trace_id == trace_id])
+
+    def post_zipkin(self, endpoint: str,
+                    spans: list[SpanRecord] | None = None) -> int:
+        """POST spans (default: a non-destructive snapshot) to a Zipkin v2
+        collector; returns the span count shipped (ref: the custom
+        Zipkin.scala reporter). Never drains the ring — the debug plane
+        (/api/v1/debug/traces, the slow-query trace pivot) reads the same
+        ring and must keep working alongside an exporter."""
+        import urllib.request
+        spans = self.snapshot() if spans is None else spans
+        if not spans:
+            return 0
+        body = json.dumps([s.to_zipkin() for s in spans]).encode()
+        req = urllib.request.Request(
+            endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            r.read()
+        return len(spans)
+
+
+class ZipkinReporter:
+    """Periodic Zipkin shipper (``trace.zipkin_endpoint``): snapshots the
+    tracer's ring on a cadence and POSTs the spans newer than its seq
+    watermark — the ring itself stays intact for the debug plane. A failed
+    POST leaves the watermark, so those spans retry next tick (they can
+    still age out of the bounded ring under pressure — bounded loss, never
+    unbounded memory). Export faults are counted and logged, never fatal
+    (the loop survives; filolint: resource-worker-silent-death)."""
+
+    def __init__(self, tracer_: "Tracer", endpoint: str,
+                 interval_s: float = 5.0):
+        self.tracer = tracer_
+        self.endpoint = endpoint
+        self.interval_s = interval_s
+        self._watermark = 0
+        self._stop_ev = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ZipkinReporter":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="zipkin-reporter")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+
+    def tick(self) -> int:
+        """One export pass: ship spans newer than the watermark, advance it
+        only on success. Returns the count shipped."""
+        fresh = [s for s in self.tracer.snapshot()
+                 if s.seq > self._watermark]
+        if not fresh:
+            return 0
+        n = self.tracer.post_zipkin(self.endpoint, fresh)
+        self._watermark = fresh[-1].seq
+        return n
+
+    def _run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — a dead collector must not
+                # kill the reporter for the process lifetime; counted so a
+                # persistently failing export is visible in /metrics
+                registry.counter(FILODB_SWALLOWED_ERRORS,
+                                 {"site": "zipkin-export"}).increment()
+                log.warning("zipkin export to %s failed", self.endpoint,
+                            exc_info=True)
 
 
 tracer = Tracer()

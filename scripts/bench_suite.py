@@ -1110,8 +1110,104 @@ def bench_count_values(full: bool) -> None:
     emit("count_values", "series", n_series, "count")
 
 
+def bench_observability(full: bool) -> None:
+    """PR 7: tracing + per-query-stats overhead on the query hot path.
+    Exactly the query_hicard workload (same fixture, same query), measured
+    with tracing OFF (one flag check per root span; QueryStats accounting
+    is always on), SAMPLED at 0.01, and FULL — so ``query_p50_off`` is
+    directly comparable to ``query_hicard.sum_rate_p50`` of the previous
+    round's BENCH_SUITE (the <2% tracing-off acceptance bar)."""
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.utils.tracing import tracer
+
+    n_series = 8000 if full else 2000
+    n_samples = 90                       # 15 minutes @ 10s
+    rng = np.random.default_rng(11)
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ms.setup("bench", PROM_COUNTER, 0, cfg)
+    per_job = 4
+    for s in range(n_series):
+        b = RecordBuilder(PROM_COUNTER)
+        vals = np.cumsum(rng.exponential(5.0, n_samples))
+        for t in range(n_samples):
+            b.add({"_metric_": "request_total", "job": f"J{s % per_job}",
+                   "instance": f"i{s}"}, BASE + t * IV, float(vals[t]))
+        ms.ingest("bench", 0, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "bench")
+    start, end = BASE + 300_000, BASE + (n_samples - 1) * IV
+
+    def q():
+        eng.query_range('sum(rate(request_total{job="J0"}[1m]))',
+                        start, end, 60_000)
+
+    modes = (("off", False, 1.0), ("sampled_1pct", True, 0.01),
+             ("full", True, 1.0))
+    was = (tracer.enabled, tracer.sample_rate)
+    runs: dict[str, list[float]] = {m: [] for m, _, _ in modes}
+    spans_full = iters_full = 0
+    try:
+        for _ in range(5):
+            q()                          # warm: compile + caches settled
+        # INTERLEAVE modes across rounds and take each mode's best run:
+        # machine noise between rounds would otherwise swamp a few-percent
+        # overhead (the thing this suite exists to measure)
+        for _ in range(3):
+            for mode, enabled, rate in modes:
+                tracer.enabled, tracer.sample_rate = enabled, rate
+                tracer.drain()
+                dt, it = timed(q, max_iters=30)
+                runs[mode].append(dt / it * 1000)
+                if mode == "full":
+                    # +1: timed() runs one warmup call before the clock
+                    spans_full, iters_full = len(tracer.drain()), it + 1
+    finally:
+        tracer.enabled, tracer.sample_rate = was
+    p50 = {m: min(v) for m, v in runs.items()}
+    for mode in p50:
+        emit("observability", f"query_p50_{mode}", p50[mode], "ms")
+    spans_per_query = spans_full / max(iters_full, 1)
+    emit("observability", "spans_per_query_full", spans_per_query, "spans")
+    emit("observability", "overhead_sampled_vs_off",
+         p50["sampled_1pct"] / p50["off"] - 1, "x")
+    emit("observability", "overhead_full_vs_off",
+         p50["full"] / p50["off"] - 1, "x")
+
+    # tight-loop span cost: the wall-clock A/B above carries the box's
+    # multi-percent run-to-run noise, so also publish the noise-immune
+    # per-span cost and the overhead it implies at this query shape
+    def span_cost_us(n: int = 20000) -> float:
+        with tracer.span("query"):      # warm the per-thread rng
+            pass
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with tracer.span("query"):
+                pass
+        return (time.perf_counter_ns() - t0) / n / 1000.0
+    try:
+        tracer.enabled = False
+        off_us = span_cost_us()
+        emit("observability", "span_cost_us_off", off_us, "us")
+        tracer.enabled, tracer.sample_rate = True, 1.0
+        full_us = span_cost_us()
+        emit("observability", "span_cost_us_full", full_us, "us")
+    finally:
+        tracer.enabled, tracer.sample_rate = was
+        tracer.drain()
+    emit("observability", "est_overhead_off_pct",
+         spans_per_query * off_us / (p50["off"] * 1000) * 100, "%")
+    emit("observability", "est_overhead_full_pct",
+         spans_per_query * full_us / (p50["off"] * 1000) * 100, "%")
+
+
 SUITES = {
     "ingestion": bench_ingestion,
+    "observability": bench_observability,
     "ingest": bench_ingest,
     "ingest_soak": bench_ingest_soak,
     "odp": bench_odp,

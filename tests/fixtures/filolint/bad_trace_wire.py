@@ -1,0 +1,27 @@
+"""Fixture: one-sided trace-carrier wiring. The client packs the trace
+block into every request, but the server dispatch never strips it — the
+receiver misparses the payload head (wire-trace-parity must flag _serve)."""
+
+import struct
+
+_TRACE_HDR = struct.Struct("<H")
+
+
+def pack_trace_hdr(ctx):
+    blob = b"{}" if ctx else b""
+    return _TRACE_HDR.pack(len(blob)) + blob
+
+
+def unpack_trace_hdr(payload):
+    (ln,) = _TRACE_HDR.unpack_from(payload, 0)
+    return None, payload[_TRACE_HDR.size + ln:]
+
+
+def _serve(op, payload):
+    # BUG: payload still carries the trace block the client packed
+    return payload
+
+
+class Client:
+    def send(self, sock, ctx, frame):
+        sock.sendall(pack_trace_hdr(ctx) + frame)

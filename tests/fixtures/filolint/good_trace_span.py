@@ -1,0 +1,22 @@
+"""Fixture twin: every opened span is a declared TRACE_SPEC constant and
+every declared span is opened somewhere."""
+
+SPAN_GOOD = "fixture.good"
+SPAN_OTHER = "fixture.other"
+
+TRACE_SPEC = {
+    SPAN_GOOD: "a span the code opens",
+    SPAN_OTHER: "opened by the tracer-attribute call form",
+}
+
+
+class _T:
+    def span(self, name, **tags):
+        return name
+
+
+def work(span):
+    with span(SPAN_GOOD):
+        pass
+    t = _T()
+    t.span(SPAN_OTHER)

@@ -1,0 +1,26 @@
+"""Fixture twin: both sides of the trace carrier wired — the client packs
+the block, the server strips it before touching the frames."""
+
+import struct
+
+_TRACE_HDR = struct.Struct("<H")
+
+
+def pack_trace_hdr(ctx):
+    blob = b"{}" if ctx else b""
+    return _TRACE_HDR.pack(len(blob)) + blob
+
+
+def unpack_trace_hdr(payload):
+    (ln,) = _TRACE_HDR.unpack_from(payload, 0)
+    return None, payload[_TRACE_HDR.size + ln:]
+
+
+def _serve(op, payload):
+    _ctx, payload = unpack_trace_hdr(payload)
+    return payload
+
+
+class Client:
+    def send(self, sock, ctx, frame):
+        sock.sendall(pack_trace_hdr(ctx) + frame)

@@ -424,8 +424,30 @@ def test_fused_hist_quantile_route_and_parity(hist_engine):
     np.testing.assert_allclose(v1, v2, rtol=1e-12, equal_nan=True)
     # general-path oracle: identical engine with the fused route disabled
     eng2 = QueryEngine(eng.memstore, eng.dataset)
-    eng2._try_fused_hist = lambda plan: None
+    eng2._try_fused_hist = lambda plan, ctx=None: None
     r3 = eng2.query_range(q, start, end, step)
     assert eng2.last_exec_path == "local"
     (_k, _t, v3), = list(r3.matrix.iter_series())
     np.testing.assert_allclose(v1, v3, rtol=1e-12, equal_nan=True)
+
+
+def test_fused_bail_after_leaf_does_not_double_count_stats(hist_engine):
+    """PR-7 regression: a fused-hist attempt that bails AFTER its leaf
+    select (here: evaluation window too far from the grid base) re-runs
+    the leaf on the general path — the probe's stats must be discarded,
+    not added on top of the general path's (stats equal a fused-disabled
+    oracle's exactly)."""
+    eng, _les, _data = hist_engine
+    q = "histogram_quantile(0.9, sum(rate(req_latency[2m])))"
+    # >= 2**31 ms from the grid base: the fused route bails post-leaf
+    start = BASE + 2**31 + 600_000
+    end, step = start + 300_000, 60_000
+    res = eng.query_range(q, start, end, step)
+    assert eng.last_exec_path == "local"
+    oracle = QueryEngine(eng.memstore, eng.dataset)
+    oracle._try_fused_hist = lambda plan, ctx=None: None
+    want = oracle.query_range(q, start, end, step)
+    got_d, want_d = res.stats.to_dict(), want.stats.to_dict()
+    for field in ("series_matched", "blocks_raw", "blocks_narrow",
+                  "rows_paged_in"):
+        assert got_d[field] == want_d[field], field

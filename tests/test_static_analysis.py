@@ -58,6 +58,9 @@ BAD_FIXTURES = {
                           "surface-metric-undeclared",
                           "surface-metric-kind"},
     "bad_lock_helper.py": {"lock-unheld-call"},
+    # PR 7: declared span surface (TRACE_SPEC, mirroring CONFIG/METRICS)
+    "bad_trace_span.py": {"surface-trace-undeclared",
+                          "surface-trace-unused"},
 }
 
 
@@ -157,6 +160,57 @@ def test_bad_wire_ops_fixture_is_flagged():
 
 def test_good_wire_ops_fixture_is_clean():
     findings = _op_findings("tests/fixtures/filolint/good_wire_ops.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def _trace_parity_findings(module_rel: str):
+    spec = {
+        "wire_module": "<none>",
+        "classifier_module": "<none>",
+        "error_base_modules": [],
+        "codec_pairs": [],
+        "depth_pair": ("_enc_plan", "_dec_plan"),
+        "error_root": "QueryError",
+        "trace_specs": [
+            {"symbol": "pack_trace_hdr",
+             "sides": [[module_rel, "Client"]]},
+            {"symbol": "unpack_trace_hdr",
+             "sides": [[module_rel, "_serve"]]},
+        ],
+    }
+    w = WireChecker(spec=spec)
+    w.check_module(module_rel, ast.parse((REPO / module_rel).read_text()))
+    return w.finalize()
+
+
+def test_bad_trace_wire_fixture_is_flagged():
+    findings = _trace_parity_findings(
+        "tests/fixtures/filolint/bad_trace_wire.py")
+    details = {f.detail for f in findings}
+    assert "one-sided:unpack_trace_hdr" in details   # server never strips
+    assert all(f.rule == "wire-trace-parity" for f in findings)
+
+
+def test_good_trace_wire_fixture_is_clean():
+    findings = _trace_parity_findings(
+        "tests/fixtures/filolint/good_trace_wire.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_production_trace_carriers_are_two_sided():
+    """The REAL trace carriers: the /exec header pair and the broker /
+    replication payload-block pairs both reference their carrier on every
+    side today (the tier-1 shape of the PR-7 wire-header satellite)."""
+    from filodb_tpu.analysis.wirecheck import WIRE_SPEC
+    symbols = {s["symbol"] for s in WIRE_SPEC["trace_specs"]}
+    assert {"TRACE_HEADER", "pack_trace_hdr", "unpack_trace_hdr"} <= symbols
+    w = WireChecker()
+    for spec in WIRE_SPEC["trace_specs"]:
+        for module, _scope in spec["sides"]:
+            if module not in w._modules:
+                w.check_module(module,
+                               ast.parse((REPO / module).read_text()))
+    findings = [f for f in w.finalize() if f.rule == "wire-trace-parity"]
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
@@ -559,6 +613,16 @@ def test_readme_metrics_table_matches_spec():
         "README Metrics table drifted from utils/metrics.py METRICS_SPEC — "
         "regenerate it with filodb_tpu.utils.metrics.metrics_markdown_table()")
     assert "filodb_swallowed_errors" in METRICS_SPEC
+
+
+def test_architecture_span_table_matches_spec():
+    from filodb_tpu.utils.tracing import TRACE_SPEC, trace_markdown_table
+    arch = (REPO / "ARCHITECTURE.md").read_text()
+    assert trace_markdown_table() in arch, (
+        "ARCHITECTURE span-taxonomy table drifted from utils/tracing.py "
+        "TRACE_SPEC — regenerate it with "
+        "filodb_tpu.utils.tracing.trace_markdown_table()")
+    assert len(TRACE_SPEC) >= 15
 
 
 def test_defaults_derive_from_config_spec():

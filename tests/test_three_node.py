@@ -4,6 +4,9 @@ its shards split across BOTH survivors with replan-once handling the
 partially-changed routes (ref: coordinator/src/multi-jvm/
 ClusterRecoverySpec.scala, doc/sharding.md §Automatic Reassignment)."""
 
+import json
+import urllib.request
+
 import numpy as np
 import pytest
 
@@ -14,6 +17,8 @@ from filodb_tpu.parallel.cluster import ShardManager
 from filodb_tpu.parallel.shardmapper import ShardMapper
 from filodb_tpu.query import wire
 from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.utils.tracing import (SPAN_QUERY, SPAN_QUERY_DISPATCH,
+                                      SPAN_QUERY_SERVE, tracer)
 
 from .test_remote_exec import DATASET, START, _as_comparable, _cfg, _ingest
 
@@ -86,6 +91,58 @@ def test_three_node_spanning_parity(three_node):
                                f"{query!r}; expected one per peer")
 
 
+def test_one_query_one_trace_with_spans_from_every_node(three_node):
+    """PR 7 acceptance: a spanning query yields ONE trace id whose spans
+    cover BOTH remote peers (context crosses the /exec wire), the response
+    stats equal the single-node oracle's (peer stats merge into the
+    caller's accumulator), and the trace is queryable at
+    /api/v1/debug/traces — valid Zipkin v2 JSON under ?format=zipkin."""
+    engines, oracle, _mgr, eps, servers, owner = three_node
+    start, end, step = START + 600_000, START + 900_000, 30_000
+    want = oracle.query_range('sum(rate(m[2m]))', start, end, step)
+    tracer.drain()
+    got = engines["a"].query_range('sum(rate(m[2m]))', start, end, step)
+    assert _as_comparable(got) == _as_comparable(want)
+
+    # stats: cluster-aggregated counters equal the oracle's local-only run
+    ws, gs = want.stats.to_dict(), got.stats.to_dict()
+    for field in ("series_matched", "result_cells"):
+        assert gs[field] == ws[field] > 0, field
+    assert gs["blocks_raw"] + gs["blocks_narrow"] \
+        == ws["blocks_raw"] + ws["blocks_narrow"] == NSHARDS
+    # the peers really contributed: their stage time crossed the wire
+    assert gs["stage_ms"].get("peer_exec", 0) > 0
+
+    # one trace id, spans from every participating node
+    spans = tracer.snapshot()
+    roots = [s for s in spans if s.name == SPAN_QUERY]
+    assert len(roots) == 1
+    tid = roots[0].trace_id
+    members = [s for s in spans if s.trace_id == tid]
+    serve_nodes = {s.tags.get("node") for s in members
+                   if s.name == SPAN_QUERY_SERVE}
+    assert serve_nodes == {"b", "c"}, serve_nodes
+    dispatches = [s for s in members if s.name == SPAN_QUERY_DISPATCH]
+    assert len(dispatches) == 2                 # one POST per peer
+    leaf_shards = {s.tags.get("shard") for s in members
+                   if s.name == "query.exec.leaf"}
+    assert leaf_shards == set(range(NSHARDS))   # every shard's leaf joined
+
+    # the debug plane serves the assembled trace...
+    url = f"http://{eps['a']}/api/v1/debug/traces?trace_id={tid}"
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        data = json.load(r)["data"]
+    assert len(data) == 1 and data[0]["trace_id"] == tid
+    assert data[0]["spans"][0]["name"] == SPAN_QUERY    # parent -> child
+    assert len(data[0]["spans"]) == len(members)
+    # ...and valid Zipkin v2 JSON under ?format=zipkin
+    with urllib.request.urlopen(url + "&format=zipkin", timeout=10.0) as r:
+        zk = json.load(r)
+    assert {z["traceId"] for z in zk} == {tid}
+    assert all(set(z) >= {"traceId", "id", "name", "timestamp", "duration"}
+               for z in zk)
+
+
 def test_kill_one_node_splits_shards_and_replans(three_node):
     """Kill node c: its two shards must split across BOTH survivors (least-
     loaded reassignment), and a query in flight across the takeover window
@@ -111,13 +168,17 @@ def test_kill_one_node_splits_shards_and_replans(three_node):
 
     engines["a"].endpoint_resolver = resolver
     start, end, step = START + 600_000, START + 900_000, 30_000
-    want = _as_comparable(oracle.query_range("sum by (dc) (m)",
-                                             start, end, step))
-    got = _as_comparable(engines["a"].query_range("sum by (dc) (m)",
-                                                  start, end, step))
+    want_res = oracle.query_range("sum by (dc) (m)", start, end, step)
+    want = _as_comparable(want_res)
+    got_res = engines["a"].query_range("sum by (dc) (m)", start, end, step)
+    got = _as_comparable(got_res)
     assert state["failed"], "the dead peer was never dispatched to"
     assert engines["a"].last_exec_path == "local-replanned"
     assert got == want
+    # the replan retry re-executed every leg: the first attempt's partial
+    # counts (successful peers, local leaves) must not double into stats
+    assert got_res.stats.to_dict()["series_matched"] \
+        == want_res.stats.to_dict()["series_matched"]
 
     # the dead node's shards split across BOTH survivors
     new_owner = {s: mgr.node_of(DATASET, s) for s in c_shards}

@@ -1,0 +1,194 @@
+"""Tracer mechanics (no device): monotonic durations, nested and
+cross-thread parentage, root-decided sampling, ring bounds, Zipkin shape."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.utils.tracing import Tracer
+
+
+@pytest.fixture()
+def tr():
+    return Tracer(capacity=64)
+
+
+def _by_name(tr):
+    return {s.name: s for s in tr.snapshot()}
+
+
+def test_nested_parentage_single_trace(tr):
+    with tr.span("outer"):
+        with tr.span("mid"):
+            with tr.span("inner", k="v"):
+                pass
+    spans = _by_name(tr)
+    assert set(spans) == {"outer", "mid", "inner"}
+    assert len({s.trace_id for s in spans.values()}) == 1
+    assert spans["outer"].parent_id is None
+    assert spans["mid"].parent_id == spans["outer"].span_id
+    assert spans["inner"].parent_id == spans["mid"].span_id
+    assert spans["inner"].tags == {"k": "v"}
+
+
+def test_duration_is_monotonic_not_wall_clock(tr, monkeypatch):
+    """A stepped (frozen) system clock must not zero span durations: only
+    the START timestamp reads time.time(); the duration comes from
+    perf_counter_ns (the PR-7 no-wall-clock satellite)."""
+    frozen = time.time()
+    monkeypatch.setattr(time, "time", lambda: frozen)
+    with tr.span("work"):
+        # burn >= 1ms of real (monotonic) time under the frozen wall clock
+        t0 = time.perf_counter_ns()
+        while time.perf_counter_ns() - t0 < 2_000_000:
+            pass
+    rec = tr.snapshot()[0]
+    assert rec.start_us == int(frozen * 1e6)
+    assert rec.duration_us >= 1_000
+
+
+def test_cross_thread_activate_joins_trace(tr):
+    """activate() adopts a parent frame on another thread: the worker's
+    span joins the caller's trace, parented under the activating span."""
+    got = {}
+
+    def worker(ctx):
+        with tr.activate(ctx):
+            with tr.span("child"):
+                pass
+        got["done"] = True
+
+    with tr.span("root"):
+        ctx = tr.current_context()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    spans = _by_name(tr)
+    assert got["done"]
+    assert spans["child"].trace_id == spans["root"].trace_id
+    assert spans["child"].parent_id == spans["root"].span_id
+
+
+def test_activate_none_and_malformed_are_noops(tr):
+    with tr.activate(None), tr.activate({"junk": 1}), tr.span("solo"):
+        pass
+    rec = tr.snapshot()[0]
+    assert rec.parent_id is None
+
+
+def test_activate_rejects_hostile_ids(tr):
+    """Wire-supplied trace ids reach /metrics exemplar LABELS: anything
+    that isn't bounded lowercase hex (quotes, braces, overlong) must be
+    refused at adoption so no carrier can corrupt the exposition."""
+    for bad in ('x"} garbage', "T" * 16, "a" * 33, "", 7, None):
+        with tr.activate({"trace_id": bad, "span_id": "c" * 16,
+                          "sampled": True}):
+            assert tr.current_context() is None
+        with tr.activate({"trace_id": "c" * 16, "span_id": bad,
+                          "sampled": True}):
+            assert tr.current_context() is None
+
+
+def test_sampling_decided_at_root_and_propagates(tr):
+    tr.sample_rate = 0.0
+    with tr.span("root"):
+        ctx = tr.current_context()
+        assert ctx["sampled"] is False
+        with tr.span("child"):
+            pass
+    assert tr.snapshot() == []          # nothing recorded, no clocks read
+    # a REMOTE sampled context overrides even a disabled local tracer:
+    # the root decided, every node records
+    tr.enabled = False
+    with tr.activate({"trace_id": "a" * 16, "span_id": "b" * 16,
+                      "sampled": True}):
+        with tr.span("adopted"):
+            pass
+    recs = tr.snapshot()
+    assert [s.name for s in recs] == ["adopted"]
+    assert recs[0].trace_id == "a" * 16
+    assert recs[0].parent_id == "b" * 16
+
+
+def test_disabled_tracer_records_nothing(tr):
+    tr.enabled = False
+    with tr.span("ghost"):
+        pass
+    assert tr.snapshot() == []
+    assert tr.current_context() is None
+
+
+def test_ring_is_bounded(tr):
+    for i in range(200):
+        with tr.span("s"):
+            pass
+    assert len(tr.snapshot()) == 64
+
+
+def test_traces_assemble_parent_then_child(tr):
+    with tr.span("a"):
+        with tr.span("b"):
+            pass
+        with tr.span("c"):
+            pass
+    with tr.span("other"):
+        pass
+    traces = tr.traces()
+    assert len(traces) == 2
+    assert traces[0]["spans"][0]["name"] == "other"     # newest first
+    names = [s["name"] for s in traces[1]["spans"]]
+    assert names[0] == "a" and set(names[1:]) == {"b", "c"}
+    # children follow their parent and carry its span_id
+    a = traces[1]["spans"][0]
+    assert all(s["parent_id"] == a["span_id"] for s in traces[1]["spans"][1:])
+
+
+def test_span_yields_mutable_tags(tr):
+    with tr.span("pub") as tags:
+        tags["failovers"] = 2
+    assert tr.snapshot()[0].tags["failovers"] == 2
+
+
+def test_zipkin_reporter_watermark_never_drains_ring(tr, monkeypatch):
+    """The exporter must coexist with the debug plane: exporting leaves the
+    ring intact, a failed POST retries the same spans, a successful one
+    advances the watermark so nothing ships twice."""
+    from filodb_tpu.utils.tracing import ZipkinReporter
+    posted, fail = [], {"on": True}
+
+    def fake_post(endpoint, spans=None):
+        if fail["on"]:
+            raise OSError("collector down")
+        posted.append([s.seq for s in spans])
+        return len(spans)
+
+    monkeypatch.setattr(tr, "post_zipkin", fake_post)
+    rep = ZipkinReporter(tr, "http://collector", interval_s=999)
+    with tr.span("a"):
+        pass
+    with pytest.raises(OSError):
+        rep.tick()                      # failed export: watermark holds
+    assert rep._watermark == 0 and len(tr.snapshot()) == 1
+    fail["on"] = False
+    assert rep.tick() == 1              # retried the SAME span
+    with tr.span("b"):
+        pass
+    assert rep.tick() == 1              # only the new span ships
+    assert posted == [[1], [2]]
+    assert len(tr.snapshot()) == 2      # ring untouched throughout
+    assert rep.tick() == 0
+
+
+def test_zipkin_export_shape(tr):
+    with tr.span("z", endpoint="e"):
+        pass
+    rows = json.loads(tr.export_zipkin_json())
+    assert len(rows) == 1
+    row = rows[0]
+    assert set(row) >= {"traceId", "id", "name", "timestamp", "duration",
+                        "tags"}
+    assert row["name"] == "z" and row["tags"] == {"endpoint": "e"}
+    # filtered export by trace id
+    assert json.loads(tr.export_zipkin_json(trace_id="nope")) == []
