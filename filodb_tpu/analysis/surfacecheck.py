@@ -34,6 +34,14 @@ surface, and these rules make drift impossible:
     is flagged even when the name matches — the taxonomy has exactly one
     spelling per span).
   * ``surface-trace-unused`` — a declared span no code opens.
+  * ``surface-cache-unbounded`` / ``surface-cache-no-eviction-metric`` —
+    every class named ``*Cache`` must expose a capacity bound (a
+    ``capacity``/``maxsize``/``max_entries`` parameter or attribute, or a
+    ``maxlen=``-bounded container) and account its evictions (an
+    identifier or metric name containing "eviction"). An unbounded cache
+    is a slow memory leak with no operational signal; the PR 8 plan and
+    result caches set the contract and this rule keeps every future cache
+    honest.
 
 All three surfaces are verified against the docs tables by
 tests/test_static_analysis.py (README tables are generated from the same
@@ -77,11 +85,15 @@ def _fstring_prefix(node: ast.JoinedStr) -> str | None:
     return ""
 
 
+CACHE_CAP_NAMES = {"capacity", "maxsize", "max_entries", "maxlen"}
+
+
 class SurfaceChecker:
     rules = ("surface-config-undeclared", "surface-config-unused",
              "surface-metric-undeclared", "surface-metric-kind",
              "surface-metric-duplicate", "surface-metric-unused",
-             "surface-trace-undeclared", "surface-trace-unused")
+             "surface-trace-undeclared", "surface-trace-unused",
+             "surface-cache-unbounded", "surface-cache-no-eviction-metric")
 
     def __init__(self):
         self._modules: dict[str, ast.Module] = {}
@@ -93,7 +105,69 @@ class SurfaceChecker:
 
     def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
         self._modules[path] = tree
-        return []
+        return self._check_cache_classes(path, tree)
+
+    # -- bounded caches -------------------------------------------------------
+
+    def _check_cache_classes(self, path: str,
+                             tree: ast.Module) -> list[Finding]:
+        """Every ``*Cache`` class needs a capacity bound and eviction
+        accounting — purely lexical (names and keywords), which is exactly
+        the contract: the bound and the signal must be VISIBLE in the
+        class, not implied by usage elsewhere."""
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef) \
+                    or not node.name.lower().endswith("cache"):
+                continue
+            has_cap = has_evict = False
+            # docstrings don't count as eviction ACCOUNTING — "eviction is
+            # handled elsewhere" in prose must not satisfy the rule
+            doc_ids = {
+                id(sub.body[0].value) for sub in ast.walk(node)
+                if isinstance(sub, (ast.ClassDef, ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                and sub.body and isinstance(sub.body[0], ast.Expr)
+                and isinstance(sub.body[0].value, ast.Constant)
+                and isinstance(sub.body[0].value.value, str)
+            }
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.arg) and sub.arg in CACHE_CAP_NAMES:
+                    has_cap = True
+                elif isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Store) \
+                        and sub.attr in CACHE_CAP_NAMES:
+                    has_cap = True
+                elif isinstance(sub, ast.keyword) \
+                        and sub.arg in ("maxlen", "maxsize"):
+                    has_cap = True
+                ident = None
+                if isinstance(sub, ast.Attribute):
+                    ident = sub.attr
+                elif isinstance(sub, ast.Name):
+                    ident = sub.id
+                elif isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and id(sub) not in doc_ids:
+                    ident = sub.value
+                if ident is not None and "eviction" in ident.lower():
+                    has_evict = True
+            if not has_cap:
+                findings.append(Finding(
+                    "surface-cache-unbounded", path, node.lineno, node.name,
+                    f"class:{node.name}",
+                    f"cache class {node.name} has no visible capacity bound "
+                    "(capacity/maxsize/max_entries attribute or param, or a "
+                    "maxlen-bounded container) — an unbounded cache is a "
+                    "slow memory leak"))
+            if not has_evict:
+                findings.append(Finding(
+                    "surface-cache-no-eviction-metric", path, node.lineno,
+                    node.name, f"evictions:{node.name}",
+                    f"cache class {node.name} never accounts evictions (no "
+                    "identifier or metric containing 'eviction') — capacity "
+                    "pressure must be operationally visible, not silent"))
+        return findings
 
     def finalize(self) -> list[Finding]:
         findings: list[Finding] = []

@@ -84,6 +84,37 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "per-query stats, and trace id); null disables the log."),
     "query.slow_log_size": (
         "int", 128, "Capacity of the slow-query ring buffer."),
+    "query.plan_cache_size": (
+        "int", 256,
+        "Compiled-plan cache capacity (process-global LRU of per-shape "
+        "XLA programs shared by the in-process, mesh, and remote-leaf "
+        "paths; evictions free the compiled executables)."),
+    "query.warmup_shapes": (
+        "list[dict]", [],
+        "Query shapes pre-traced at startup (fn/op/series/samples/steps/"
+        "window_ms/interval_ms/dtype per entry) so the first dashboard "
+        "load never eats a multi-second XLA compile."),
+    "query.result_cache_size": (
+        "int", 256,
+        "Step-aligned result-cache entries per engine, keyed on (promql, "
+        "start, end, step, tenant) and invalidated by per-shard ingest "
+        "watermark (0 disables)."),
+    "query.max_concurrent_cost": (
+        "int|null", None,
+        "Aggregate estimated query cost (series x steps x window-steps) "
+        "admitted to execute concurrently; transient overload sheds 503 + "
+        "Retry-After before execution, while a query whose own cost "
+        "exceeds the budget outright fails non-retryable 422 (null leaves "
+        "the global budget unbounded — tenant_quotas still apply)."),
+    "query.tenant_quotas": (
+        "dict", {},
+        "Per-tenant max concurrent cost (tenant name -> cost units; "
+        "tenants arrive via the X-Filo-Tenant header or tenant= query "
+        "param). Tenants absent from the map share only the global "
+        "budget; a query over its tenant's quota outright fails 422."),
+    "query.shed_retry_after": (
+        "duration", "1s",
+        "Retry-After hint returned with an admission-shed 503."),
     "downsample.enabled": ("bool", False,
                            "Inline downsampling at flush into durable "
                            "per-aggregate datasets ({ds}:ds_{res})."),
@@ -310,8 +341,15 @@ class Config:
         from .query.engine import QueryConfig
         q = self.data["query"]
         thr = q["slow_log_threshold_ms"]
+        max_cost = q["max_concurrent_cost"]
         return QueryConfig(
             stale_sample_after_ms=parse_duration_ms(q["stale_sample_after"]),
             sample_limit=q["sample_limit"],
             slow_log_threshold_ms=None if thr is None else float(thr),
+            result_cache_size=int(q["result_cache_size"]),
+            max_concurrent_cost=(None if max_cost is None
+                                 else float(max_cost)),
+            tenant_quotas=dict(q["tenant_quotas"] or {}),
+            shed_retry_after_s=parse_duration_ms(
+                q["shed_retry_after"]) / 1000.0,
         )

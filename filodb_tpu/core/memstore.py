@@ -128,6 +128,13 @@ class TimeSeriesShard:
                           if self._native_ps is not None else None)
         # bumped on every partition release: invalidates batch-resolved pids
         self._release_epoch = 0
+        # ingest/mutation watermark: bumped (under the shard lock) whenever
+        # query-visible data changes — rows staged, partitions released,
+        # retention compaction. The query result cache records the cluster
+        # vector of these counters per entry; a vector mismatch means a
+        # cached result could diverge from re-execution (query/engine.py
+        # QueryResultCache). Served over /api/v1/epochs for peer probes.
+        self.data_epoch = 0
         # purged slots available for reuse + membership filter of evicted keys
         # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
         self._free_pids: list[int] = []
@@ -407,6 +414,7 @@ class TimeSeriesShard:
         pid_list = pids.tolist()
         self.slot_epoch[pids] += 1
         self._release_epoch += 1
+        self.data_epoch += 1           # result-cache watermark: data gone
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
@@ -584,6 +592,12 @@ class TimeSeriesShard:
         """Land staged samples on the device store (caller holds the lock)."""
         if not self._staged:
             return 0
+        # result-cache watermark bumps at the VISIBILITY point: staged rows
+        # are host-side until this scatter, so bumping at stage time would
+        # let a query cached in the stage->flush window validate against a
+        # vector that already includes the not-yet-visible rows — a stale
+        # hit after the flush (review finding, PR 8)
+        self.data_epoch += 1
         pids = np.concatenate(self._stage_pid)
         ts = np.concatenate(self._stage_ts)
         vals = np.concatenate(self._stage_val, axis=0)
@@ -624,6 +638,7 @@ class TimeSeriesShard:
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
             with self.lock:
                 self.store.compact(cutoff)
+                self.data_epoch += 1   # result-cache watermark: rows aged out
         if residency != "off":
             # adopt/refresh the compressed-resident state AFTER any compact
             # (compact rehydrates — compressing first would be discarded

@@ -22,7 +22,7 @@ from ..ingest.broker import BrokerRetry
 from ..promql.parser import ParseError
 from ..query.engine import QueryEngine, slow_query_log
 from ..query.rangevector import QueryError
-from ..query.scheduler import Priority, SchedulerBusy
+from ..query.scheduler import AdmissionRejected, Priority, SchedulerBusy
 from ..utils.tracing import (SPAN_QUERY_SERVE, SPAN_REMOTE_WRITE, span,
                              tracer)
 
@@ -127,6 +127,15 @@ class FiloHttpServer:
                     self._send(503, {"status": "error",
                                      "errorType": "unavailable",
                                      "error": str(e)})
+                except AdmissionRejected as e:
+                    # cost-based admission shed BEFORE execution: retryable
+                    # overload, with the controller's hint as Retry-After —
+                    # an honored-backoff client lands every query
+                    self._send(503, {"status": "error",
+                                     "errorType": "unavailable",
+                                     "error": str(e)},
+                               headers={"Retry-After": str(max(
+                                   1, int(e.retry_after_s + 0.999)))})
                 except (QueryError, ParseError) as e:
                     self._send(422, {"status": "error", "errorType": "bad_data",
                                      "error": str(e)})
@@ -285,15 +294,21 @@ class FiloHttpServer:
             if engine is None:
                 h._send(404, {"status": "error", "error": f"no dataset {m.group(1)}"})
                 return
+            # tenant identity for admission quotas: header wins over the
+            # query param (proxies inject the header; dashboards the param)
+            tenant = h.headers.get("X-Filo-Tenant") or q.get("tenant") or None
             if m.group(2) == "query_range":
                 res = self._run(
                     lambda: engine.query_range(q["query"], _parse_time(q["start"]),
                                                _parse_time(q["end"]),
-                                               _parse_step(q["step"])),
+                                               _parse_step(q["step"]),
+                                               tenant=tenant),
                     Priority.QUERY)
             else:
                 res = self._run(
-                    lambda: engine.query_instant(q["query"], _parse_time(q["time"])),
+                    lambda: engine.query_instant(q["query"],
+                                                 _parse_time(q["time"]),
+                                                 tenant=tenant),
                     Priority.QUERY)
             body = {"status": "success", "data": matrix_to_prom_json(res)}
             if res.stats is not None:
@@ -301,6 +316,23 @@ class FiloHttpServer:
                 # participating shard and peer (reference QueryStats shape)
                 body["stats"] = res.stats.to_dict()
             h._send(200, body)
+            return
+
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/epochs", path)
+        if m:
+            engine = self.engines.get(m.group(1))
+            if engine is None:
+                h._send(404, {"status": "error",
+                              "error": f"no dataset {m.group(1)}"})
+                return
+            # ingest-watermark probe for peer result-cache validation:
+            # local shards only by construction (each node reports its own
+            # counters), index-free and lock-free — served on the handler
+            # thread like /__health so it never queues behind query work
+            h._send(200, {"status": "success",
+                          "data": {str(s.shard_num): s.data_epoch
+                                   for s in engine.memstore.shards_of(
+                                       engine.dataset)}})
             return
 
         # local=1 (strictly) marks a peer's metadata fan-out request: answer

@@ -230,28 +230,37 @@ def active_columns(C: int, lo: np.ndarray, hi: np.ndarray) -> tuple[int, int]:
     return 0, C
 
 
-@functools.lru_cache(maxsize=64)
 def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
                 narrow: bool = False, c0: int = 0, Ck: int = 0):
-    call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                        S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
+    """The compiled fused program via the explicit plan cache (query/
+    plancache.py) — its key IS this signature: fn/op statics, the padded
+    [S, C, Tp, G] shape buckets, and the residency mode (``narrow``)."""
+    from ..query.plancache import plan_cache
 
-    # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
-    # inside the jit — on a tunneled device every extra dispatch is a
-    # round-trip (~0.1s measured), dwarfing the kernel itself
-    if narrow:
-        def wrapped(val, vmin, scl, n, gids, *ops):
-            return call(val, vmin.reshape(S, 1), scl.reshape(S, 1),
-                        n.astype(jnp.int32).reshape(S, 1),
-                        gids.astype(jnp.int32).reshape(S, 1), *ops)
-    else:
-        def wrapped(val, n, gids, *ops):
-            return call(val.astype(jnp.float32),
-                        n.astype(jnp.int32).reshape(S, 1),
-                        gids.astype(jnp.int32).reshape(S, 1), *ops)
+    def build():
+        call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                            S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
 
-    return jax.jit(wrapped)
+        # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
+        # inside the jit — on a tunneled device every extra dispatch is a
+        # round-trip (~0.1s measured), dwarfing the kernel itself
+        if narrow:
+            def wrapped(val, vmin, scl, n, gids, *ops):
+                return call(val, vmin.reshape(S, 1), scl.reshape(S, 1),
+                            n.astype(jnp.int32).reshape(S, 1),
+                            gids.astype(jnp.int32).reshape(S, 1), *ops)
+        else:
+            def wrapped(val, n, gids, *ops):
+                return call(val.astype(jnp.float32),
+                            n.astype(jnp.int32).reshape(S, 1),
+                            gids.astype(jnp.int32).reshape(S, 1), *ops)
+        return wrapped
+
+    return plan_cache.program(
+        "fused-grid",
+        (fn, needs_sumsq, window_ms, interval_ms, S, Sb, C, Tp, G,
+         interpret, narrow, c0, Ck), build)
 
 
 def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,

@@ -59,7 +59,14 @@ def onehot_matrix(C: int, pos: np.ndarray, dtype=np.float32) -> np.ndarray:
     return m
 
 
-@functools.partial(jax.jit, static_argnames=("fn",))
+def _plan(kernel: str, key: tuple, build):
+    """Compiled program via the explicit plan cache (query/plancache.py):
+    every grid entry point below keys on (fn, padded shape, dtype) — the
+    variant kernels (hist / narrow) ARE the residency axis of the key."""
+    from ..query.plancache import plan_cache
+    return plan_cache.program(kernel, key, build)
+
+
 def _grid_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
                  rel_out, window_ms, interval_ms, stale_ms):
     """val [S, C]: sample k of each series at column k == grid cell k.
@@ -184,7 +191,6 @@ HIST_GRID_FNS = {"rate", "increase", "delta", "sum_over_time", "last_sample",
                  "last_over_time"}
 
 
-@functools.partial(jax.jit, static_argnames=("fn",))
 def _grid_hist_kernel(fn, val, n, band, band_open, onehot_lo, onehot_hi, lo, hi,
                       rel_out, window_ms, interval_ms, stale_ms):
     """Histogram variant: val [S, C, B] cumulative bucket counts; outputs
@@ -324,7 +330,6 @@ def _hist_narrow_operands_build(C, out_ts_key, window_ms, base_ts, interval_ms):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("fn",))
 def _grid_hist_kernel_narrow(fn, dd, first_d, n, band_open, prefix_lo,
                              prefix_hi, wband, cnt_static, lo, hi, rel_out,
                              window_ms, interval_ms, stale_ms):
@@ -405,15 +410,16 @@ def periodic_samples_grid_hist_narrow(dd, first_d, n, out_ts: np.ndarray,
     """Narrow hist grid path: [S, T, B] output streamed off the dd block."""
     C = dd.shape[1]
     ops = grid_operands_hist_narrow(C, out_ts, window_ms, base_ts, interval_ms)
-    return _grid_hist_kernel_narrow(
-        fn, dd, first_d, jnp.asarray(n, jnp.int32), ops["band_open"],
-        ops["prefix_lo"], ops["prefix_hi"], ops["wband"], ops["cnt_static"],
-        ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
-        ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
+    k = _plan("grid-hist-narrow",
+              (fn,) + tuple(dd.shape) + (len(out_ts), str(dd.dtype)),
+              lambda: functools.partial(_grid_hist_kernel_narrow, fn))
+    return k(dd, first_d, jnp.asarray(n, jnp.int32), ops["band_open"],
+             ops["prefix_lo"], ops["prefix_hi"], ops["wband"],
+             ops["cnt_static"], ops["lo"], ops["hi"], ops["rel_out"],
+             ops["window_ms"], ops["interval_ms"],
+             jnp.int32(min(stale_ms, 2**31 - 1)))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("fn", "num_groups", "has_corr"))
 def _fused_hist_quantile_narrow_kernel(q, les, dd, first_d, n, gids, fn,
                                        num_groups, has_corr, corr_sum,
                                        corr_cnt, band_open, prefix_lo,
@@ -458,17 +464,26 @@ def fused_hist_quantile_grid_narrow(q: float, les, dd, first_d, n, gids,
     else:
         corr_sum, corr_cnt = corr
         has_corr = True
-    return _fused_hist_quantile_narrow_kernel(
+    def build(fn=fn, num_groups=num_groups, has_corr=has_corr):
+        def run(q, les, dd, first_d, n, gids, corr_sum, corr_cnt, *ops_t):
+            return _fused_hist_quantile_narrow_kernel(
+                q, les, dd, first_d, n, gids, fn, num_groups, has_corr,
+                corr_sum, corr_cnt, *ops_t)
+        return run
+
+    k = _plan("fused-hist-narrow",
+              (fn, num_groups, has_corr) + tuple(dd.shape)
+              + (T, len(les), str(dd.dtype)), build)
+    return k(
         jnp.float64(q), jnp.asarray(les), dd, first_d,
-        jnp.asarray(n, jnp.int32), jnp.asarray(gids, jnp.int32), fn,
-        num_groups, has_corr, corr_sum, corr_cnt,
+        jnp.asarray(n, jnp.int32), jnp.asarray(gids, jnp.int32),
+        corr_sum, corr_cnt,
         ops["band_open"], ops["prefix_lo"], ops["prefix_hi"], ops["wband"],
         ops["cnt_static"], ops["lo"], ops["hi"], ops["rel_out"],
         ops["window_ms"], ops["interval_ms"],
         jnp.int32(min(stale_ms, 2**31 - 1)))
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "num_groups"))
 def _fused_hist_quantile_kernel(q, les, val, n, gids, fn, num_groups,
                                 band, band_open, onehot_lo, onehot_hi, lo, hi,
                                 rel_out, window_ms, interval_ms, stale_ms):
@@ -500,9 +515,19 @@ def fused_hist_quantile_grid(q: float, les, val, n, gids, num_groups: int,
     C = val.shape[1]
     dtype = np.float64 if val.dtype == jnp.float64 else np.float32
     ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
-    return _fused_hist_quantile_kernel(
+
+    def build(fn=fn, num_groups=num_groups):
+        def run(q, les, val, n, gids, *ops_t):
+            return _fused_hist_quantile_kernel(q, les, val, n, gids, fn,
+                                               num_groups, *ops_t)
+        return run
+
+    k = _plan("fused-hist",
+              (fn, num_groups) + tuple(val.shape)
+              + (len(out_ts), str(val.dtype)), build)
+    return k(
         jnp.float64(q), jnp.asarray(les), val, jnp.asarray(n, jnp.int32),
-        jnp.asarray(gids, jnp.int32), fn, num_groups,
+        jnp.asarray(gids, jnp.int32),
         ops["band"], ops["band_open"], ops["onehot_lo"],
         ops["onehot_hi"], ops["lo"], ops["hi"], ops["rel_out"],
         ops["window_ms"], ops["interval_ms"],
@@ -516,10 +541,13 @@ def periodic_samples_grid_hist(val, n, out_ts: np.ndarray, window_ms: int, fn: s
     C = val.shape[1]
     dtype = np.float64 if val.dtype == jnp.float64 else np.float32
     ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
-    return _grid_hist_kernel(fn, val, jnp.asarray(n, jnp.int32), ops["band"],
-                             ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
-                             ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
-                             ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
+    k = _plan("grid-hist",
+              (fn,) + tuple(val.shape) + (len(out_ts), str(val.dtype)),
+              lambda: functools.partial(_grid_hist_kernel, fn))
+    return k(val, jnp.asarray(n, jnp.int32), ops["band"],
+             ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
+             ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
+             ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
 
 
 def _hist_quantile(q, les, counts, xp):
@@ -573,7 +601,10 @@ def periodic_samples_grid(val, n, out_ts: np.ndarray, window_ms: int, fn: str,
     C = val.shape[1]
     dtype = np.float64 if val.dtype == jnp.float64 else np.float32
     ops = grid_operands(C, out_ts, window_ms, fn, base_ts, interval_ms, dtype)
-    return _grid_kernel(fn, val, jnp.asarray(n, jnp.int32), ops["band"],
-                        ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
-                        ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
-                        ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))
+    k = _plan("grid",
+              (fn,) + tuple(val.shape) + (len(out_ts), str(val.dtype)),
+              lambda: functools.partial(_grid_kernel, fn))
+    return k(val, jnp.asarray(n, jnp.int32), ops["band"],
+             ops["band_open"], ops["onehot_lo"], ops["onehot_hi"],
+             ops["lo"], ops["hi"], ops["rel_out"], ops["window_ms"],
+             ops["interval_ms"], jnp.int32(min(stale_ms, 2**31 - 1)))

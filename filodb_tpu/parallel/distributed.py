@@ -156,9 +156,33 @@ def _slot_matrix(fn, slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
         yield mat, gids[0]
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh"))
+def _dist_program(kernel: str, statics: tuple, slot_shapes: tuple, build):
+    """Mesh twin of the in-process kernel routing: every ``dist_*``
+    collective below is a per-key jitted program in the SAME process-global
+    compiled-plan cache (query/plancache.py), keyed on its statics plus the
+    global-array slot shapes — a dashboard's first mesh query compiles here,
+    every repeat (and every warmup-covered shape) hits."""
+    from ..query.plancache import plan_cache
+    return plan_cache.program(kernel, statics + slot_shapes, build)
+
+
+def _tvn_shapes(slot_tvn) -> tuple:
+    return tuple((tuple(ts.shape), tuple(n.shape), str(val.dtype))
+                 for ts, val, n in slot_tvn)
+
+
 def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
                    fn: str, op: str, num_groups: int, mesh: Mesh):
+    return _dist_program(
+        "dist-agg", (fn, op, num_groups, mesh, int(out_ts.shape[0])),
+        _tvn_shapes(slot_tvn),
+        lambda: functools.partial(_dist_aggregate_impl, fn, op, num_groups,
+                                  mesh)
+    )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
+
+
+def _dist_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
+                         slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
     """One compiled distributed query step: range function per resident slot
     block + segment partials combined locally + psum over the shard axis;
     every device ends with the same [G, T] final matrix (taken from device 0
@@ -183,9 +207,19 @@ def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
     )(slot_tvn, slot_gids)
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "num_groups", "mesh"))
 def dist_quantile_sketch(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
                          fn: str, num_groups: int, mesh: Mesh):
+    return _dist_program(
+        "dist-sketch", (fn, num_groups, mesh, int(out_ts.shape[0])),
+        _tvn_shapes(slot_tvn),
+        lambda: functools.partial(_dist_quantile_sketch_impl, fn, num_groups,
+                                  mesh)
+    )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
+
+
+def _dist_quantile_sketch_impl(fn: str, num_groups: int, mesh: Mesh,
+                               slot_tvn, slot_gids, out_ts, window_ms,
+                               a0, a1):
     """Distributed quantile map phase: per-slot range function -> DDSketch
     log-bucket counts scattered on device -> psum over the shard axis.
     Bucketing matches ops/aggregators.quantile_sketch bit-for-bit (same
@@ -230,11 +264,21 @@ def dist_quantile_sketch(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
     )(slot_tvn, slot_gids)
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "k", "bottom",
-                                             "num_groups", "mesh", "ndev"))
 def dist_topk(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
               fn: str, k: int, bottom: bool, num_groups: int, mesh: Mesh,
               ndev: int):
+    return _dist_program(
+        "dist-topk",
+        (fn, k, bottom, num_groups, mesh, ndev, int(out_ts.shape[0])),
+        _tvn_shapes(slot_tvn),
+        lambda: functools.partial(_dist_topk_impl, fn, k, bottom, num_groups,
+                                  mesh, ndev)
+    )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
+
+
+def _dist_topk_impl(fn: str, k: int, bottom: bool, num_groups: int,
+                    mesh: Mesh, ndev: int,
+                    slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
     """Distributed topk/bottomk: per-slot local top-k candidates, then ONE
     all_gather of the fixed-size [G, T, slots*k] candidate blocks and a
     global re-select — only k*shards candidates cross the ICI, never the
@@ -299,13 +343,25 @@ def dist_topk(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
     )(slot_tvn, slot_gids)
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
-                                             "window_ms", "interval_ms",
-                                             "S", "C", "Tp", "c0", "Ck"))
 def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
                          fn: str, op: str, num_groups: int, mesh: Mesh,
                          window_ms: int, interval_ms: int,
                          S: int, C: int, Tp: int, c0: int = 0, Ck: int = 0):
+    return _dist_program(
+        "dist-fused",
+        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck),
+        tuple(str(v.dtype) for v in slot_vals),
+        lambda: functools.partial(_dist_fused_aggregate_impl, fn, op,
+                                  num_groups, mesh, window_ms, interval_ms,
+                                  S, C, Tp, c0, Ck)
+    )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
+
+
+def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
+                               window_ms: int, interval_ms: int,
+                               S: int, C: int, Tp: int, c0: int, Ck: int,
+                               slot_vals, slot_ns, slot_gids, band, ohlo,
+                               lo, hi, rel):
     """Fused single-pass map phase on every resident slot block + psum of the
     partial-state layout over the shard axis — the multi-chip twin of
     ``fusedgrid.fused_grid_aggregate`` (ref: AggrOverRangeVectors.scala:62 —
@@ -345,15 +401,28 @@ def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
     )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
-@functools.partial(jax.jit, static_argnames=("fn", "op", "num_groups", "mesh",
-                                             "window_ms", "interval_ms",
-                                             "S", "C", "Tp", "c0", "Ck"))
 def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
                                 slot_gids, band, ohlo, lo, hi, rel,
                                 fn: str, op: str, num_groups: int, mesh: Mesh,
                                 window_ms: int, interval_ms: int,
                                 S: int, C: int, Tp: int, c0: int = 0,
                                 Ck: int = 0):
+    return _dist_program(
+        "dist-fused-narrow",
+        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck),
+        tuple(str(q.dtype) for q in slot_qs),
+        lambda: functools.partial(_dist_fused_narrow_impl, fn, op,
+                                  num_groups, mesh, window_ms, interval_ms,
+                                  S, C, Tp, c0, Ck)
+    )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
+      band, ohlo, lo, hi, rel)
+
+
+def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
+                            window_ms: int, interval_ms: int,
+                            S: int, C: int, Tp: int, c0: int, Ck: int,
+                            slot_qs, slot_vmins, slot_scales, slot_ns,
+                            slot_gids, band, ohlo, lo, hi, rel):
     """Narrow twin of :func:`dist_fused_aggregate`: every shard's resident
     i16 quantized state streams straight through the fused Pallas kernel
     (half the HBM bytes, decode in VMEM — ops/narrow.py) and the partial

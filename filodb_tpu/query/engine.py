@@ -15,23 +15,29 @@ import contextlib
 import threading
 import time
 
-from collections import deque
-from dataclasses import dataclass
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..core.memstore import TimeSeriesMemStore
 from ..parallel.shardmapper import ShardMapper
+from ..utils.metrics import (FILODB_QUERY_LATENCY_MS,
+                             FILODB_QUERY_RESULT_CACHE_EVICTIONS,
+                             FILODB_QUERY_RESULT_CACHE_HITS,
+                             FILODB_QUERY_RESULT_CACHE_INVALIDATIONS,
+                             FILODB_QUERY_RESULT_CACHE_MISSES,
+                             FILODB_QUERY_SLOW, registry)
 from ..promql import parser as promql
-from ..utils.metrics import (FILODB_QUERY_LATENCY_MS, FILODB_QUERY_SLOW,
-                             registry)
-from ..utils.tracing import (SPAN_QUERY, SPAN_QUERY_EXECUTE,
-                             SPAN_QUERY_PARSE, SPAN_QUERY_PLAN, span, tracer)
+from ..utils.tracing import (SPAN_QUERY, SPAN_QUERY_ADMIT,
+                             SPAN_QUERY_EXECUTE, SPAN_QUERY_PARSE,
+                             SPAN_QUERY_PLAN, span, tracer)
 from . import logical as L
 from .exec import QueryContext, group_keys_of
 from .planner import QueryPlanner
-from .rangevector import (QueryError, QueryResult, RangeVectorKey,
-                          ResultMatrix)
+from .rangevector import (QueryError, QueryResult, QueryStats,
+                          RangeVectorKey, ResultMatrix)
+from .scheduler import AdmissionController, AdmissionRejected
 
 # aggregation operators whose partial state crosses the mesh collective
 # (psum/pmin/pmax — ops/aggregators.py partial layout)
@@ -110,6 +116,105 @@ class QueryConfig:
     # queries at or over this wall duration enter the slow-query ring
     # (served at /api/v1/debug/slow_queries); None disables the log
     slow_log_threshold_ms: float | None = 1000.0
+    # step-aligned result cache entries per engine (0 disables — the library
+    # default; FiloServer turns it on via query.result_cache_size)
+    result_cache_size: int = 0
+    # aggregate estimated cost admitted to execute concurrently
+    # (query.max_concurrent_cost); None leaves the global budget unbounded
+    # — admission still runs when tenant_quotas is set, and is fully off
+    # only when both are unset
+    max_concurrent_cost: float | None = None
+    # tenant -> max concurrent cost (query.tenant_quotas); admission only
+    tenant_quotas: dict = field(default_factory=dict)
+    # Retry-After hint on an admission shed (query.shed_retry_after)
+    shed_retry_after_s: float = 1.0
+
+
+class QueryResultCache:
+    """Step-aligned range-result cache, invalidated by ingest watermark
+    (ref: the reference's repeated-dashboard serving posture — QueryEngine2
+    materializes once, serves many).
+
+    Entries are keyed on ``(promql, start, end, step, tenant)`` and record
+    the cluster EPOCH VECTOR — every participating shard's ``data_epoch``
+    mutation counter, local shards read directly and peer shards probed
+    over ``/api/v1/epochs`` — captured BEFORE the query executed. A hit
+    requires the current vector to EQUAL the recorded one, so any ingest,
+    purge, eviction, compaction, or topology change since makes the entry
+    unreachable (counted as an invalidation): a served hit is provably
+    identical to re-execution, because the data it would re-read cannot
+    have changed. Capacity-bounded LRU (query.result_cache_size) with an
+    evictions metric — filolint's bounded-cache rule enforces both for
+    every cache class in the package."""
+
+    def __init__(self, capacity: int = 256, tags: dict | None = None):
+        self.capacity = max(1, int(capacity))
+        # per-cache metric identity (e.g. {"dataset": ...}): untagged,
+        # every engine's cache would share one process-global counter set
+        # and stats() would report the sum as if it were this cache's
+        self.tags = dict(tags or {})
+        # key -> (epoch vector, payload) where payload =
+        # (matrix, result_type, warnings, stats_dict, exec_path)
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = registry.counter(FILODB_QUERY_RESULT_CACHE_HITS,
+                                      self.tags)
+        self._misses = registry.counter(FILODB_QUERY_RESULT_CACHE_MISSES,
+                                        self.tags)
+        self._evictions = registry.counter(
+            FILODB_QUERY_RESULT_CACHE_EVICTIONS, self.tags)
+        self._invalidations = registry.counter(
+            FILODB_QUERY_RESULT_CACHE_INVALIDATIONS, self.tags)
+
+    def get(self, key: tuple, current_epochs):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self._misses.increment()
+                return None
+            epochs, payload = e
+            if current_epochs is None:
+                # unverifiable vector (a peer probe failed): never serve
+                # what cannot be proven, but an unreadable watermark is not
+                # evidence the data changed — keep the entry for when the
+                # peer answers again
+                self._misses.increment()
+                return None
+            if epochs != current_epochs:
+                # the watermark moved: serving the entry could diverge
+                # from re-execution — drop it
+                del self._entries[key]
+                self._invalidations.increment()
+                self._misses.increment()
+                return None
+            self._entries.move_to_end(key)
+            self._hits.increment()
+            return payload
+
+    def put(self, key: tuple, payload, epochs) -> None:
+        if epochs is None:
+            return                      # unverifiable vector: never cache
+        with self._lock:
+            self._entries[key] = (epochs, payload)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._evictions.increment()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self.capacity,
+                    "hits": self._hits.value, "misses": self._misses.value,
+                    "evictions": self._evictions.value,
+                    "invalidations": self._invalidations.value}
 
 
 class SlowQueryLog:
@@ -178,11 +283,22 @@ class QueryEngine:
         # dataset name used for shard->node routing: a downsample-family
         # serving engine ("ds:ds_1m") routes by its RAW dataset's assignment
         self.route_dataset = route_dataset or dataset
-        # route taken by the last query:
-        # "mesh-fused" | "mesh-twostep" | "mesh-empty" | "local"
-        # (engine-shared — diagnostics/tests only; per-query consumers read
-        # ctx.exec_path, which _set_path records alongside)
-        self.last_exec_path: str | None = None
+        # serving fast path: step-aligned result cache + cost-based
+        # admission (both off unless configured — QueryConfig defaults)
+        self.result_cache = (QueryResultCache(self.config.result_cache_size,
+                                              tags={"dataset": dataset})
+                             if self.config.result_cache_size else None)
+        self.admission = (AdmissionController(
+            self.config.max_concurrent_cost, self.config.tenant_quotas,
+            self.config.shed_retry_after_s, tags={"dataset": dataset})
+            if (self.config.max_concurrent_cost is not None
+                or self.config.tenant_quotas) else None)
+        # a failed peer epoch probe arms this cooldown: until it passes,
+        # _epoch_vector returns None without scattering (caching fail-opens
+        # to miss), so a blackholed peer stalls at most one query per
+        # cooldown window instead of every query
+        self._epoch_probe_cooldown_s = 10.0
+        self._epoch_probe_down_until = 0.0
         schema = memstore._dataset_schema.get(dataset)
         opts = schema.options if schema else None
         route = self._route_endpoint if cluster is not None else None
@@ -214,36 +330,48 @@ class QueryEngine:
                             stale_ms=self.config.stale_sample_after_ms)
 
     def _set_path(self, ctx: QueryContext | None, path: str) -> None:
-        """Record the exec route both per-query (ctx — what the slow log
-        reports) and on the engine (last_exec_path — diagnostics/tests;
-        racy under concurrent queries by nature)."""
-        self.last_exec_path = path
+        """Record the exec route taken per-query (what the slow log and
+        QueryResult.exec_path report — the engine-shared last_exec_path
+        attribute this replaced was racy under concurrent queries)."""
         if ctx is not None:
             ctx.exec_path = path
 
     def query_range(self, promql_text: str, start_ms: int, end_ms: int,
-                    step_ms: int) -> QueryResult:
+                    step_ms: int, tenant: str | None = None) -> QueryResult:
         return self._query_traced(
             promql_text,
             lambda: promql.query_to_logical_plan(promql_text, start_ms,
-                                                 end_ms, step_ms))
+                                                 end_ms, step_ms),
+            range_key=(int(start_ms), int(end_ms), int(step_ms)),
+            tenant=tenant)
 
-    def query_instant(self, promql_text: str, time_ms: int) -> QueryResult:
+    def query_instant(self, promql_text: str, time_ms: int,
+                      tenant: str | None = None) -> QueryResult:
         res = self._query_traced(
             promql_text,
             lambda: promql.query_to_logical_plan(promql_text, time_ms,
-                                                 time_ms, 1))
+                                                 time_ms, 1),
+            tenant=tenant)
         res.result_type = "vector"
         return res
 
-    def _query_traced(self, promql_text: str, to_plan) -> QueryResult:
+    def _query_traced(self, promql_text: str, to_plan,
+                      range_key: tuple | None = None,
+                      tenant: str | None = None) -> QueryResult:
         """Shared query entry: ONE root span per query (every stage and
         every participating node's spans hang off its trace id), the
         end-to-end latency histogram (exemplar-tagged with that trace id),
         and the slow-query ring. Accounting runs in a FINALLY: the 30s
         query that then raises is exactly the one an operator opens the
         slow-query log to find, and tail latency must not under-report
-        during incidents."""
+        during incidents.
+
+        Serving fast path, in order: (1) the result cache answers a
+        repeated range query without parsing or executing when its ingest
+        watermark vector still matches; (2) cost-based admission sheds
+        what the budget cannot afford BEFORE it executes; (3) execution
+        populates the cache with the PRE-execution watermark vector, so a
+        concurrent ingest invalidates the entry rather than racing it."""
         ctx = self._ctx()
         t0 = time.perf_counter_ns()
         tctx = None
@@ -252,9 +380,22 @@ class QueryEngine:
             with span(SPAN_QUERY, dataset=self.dataset,
                       promql=promql_text[:200]):
                 tctx = tracer.current_context()
+                cache_key = epochs = None
+                if range_key is not None and self.result_cache is not None:
+                    cache_key = (promql_text, *range_key, tenant)
+                    epochs = self._epoch_vector()
+                    hit = self._result_cache_probe(cache_key, epochs, ctx)
+                    if hit is not None:
+                        return hit
                 with span(SPAN_QUERY_PARSE), ctx.stats.stage("parse"):
                     plan = to_plan()
-                return self.exec_logical(plan, ctx)
+                res = self._exec_admitted(plan, ctx, tenant)
+                if cache_key is not None:
+                    self.result_cache.put(
+                        cache_key,
+                        (res.matrix, res.result_type, list(res.warnings),
+                         ctx.stats.to_dict(), ctx.exec_path), epochs)
+                return res
         except BaseException as e:
             err = e                     # noted below, then re-raised
             raise
@@ -262,6 +403,103 @@ class QueryEngine:
             self._note_query_done(promql_text, ctx,
                                   (time.perf_counter_ns() - t0) / 1e6,
                                   tctx, err)
+
+    def _result_cache_probe(self, cache_key: tuple, epochs,
+                            ctx: QueryContext) -> QueryResult | None:
+        """A validated cache entry as a fresh QueryResult, else None. The
+        response carries the ORIGINAL execution's stats (they describe the
+        work that produced these bytes) plus a result_cache_hits marker."""
+        payload = self.result_cache.get(cache_key, epochs)
+        if payload is None:
+            return None
+        matrix, result_type, warnings, stats_dict, exec_path = payload
+        ctx.stats.merge(stats_dict)
+        ctx.stats.add("result_cache_hits")
+        self._set_path(ctx, f"result-cache[{exec_path}]")
+        res = QueryResult(matrix, result_type, list(warnings))
+        res.stats = ctx.stats
+        res.exec_path = ctx.exec_path
+        return res
+
+    def _exec_admitted(self, plan: L.LogicalPlan, ctx: QueryContext,
+                       tenant: str | None) -> QueryResult:
+        """Execute under the admission gate when one is configured: the
+        decision (cost estimate + reserve) runs under its own span; a shed
+        raises AdmissionRejected (HTTP 503 + Retry-After) and lands in
+        QueryStats and the slow-query ring before anything executes. A
+        structurally-oversized cost (could never fit the budget/quota)
+        raises plain QueryError instead — a 422 client error, not load."""
+        if self.admission is None:
+            return self.exec_logical(plan, ctx)
+        with span(SPAN_QUERY_ADMIT, tenant=tenant or "") as tags:
+            cost = self.estimate_cost(plan)
+            tags["cost"] = round(cost, 1)
+            try:
+                got = self.admission.acquire(cost, tenant)
+            except AdmissionRejected:
+                tags["shed"] = True
+                ctx.stats.add("admission_shed")
+                raise
+        try:
+            return self.exec_logical(plan, ctx)
+        finally:
+            self.admission.release(got, tenant)
+
+    def estimate_cost(self, plan: L.LogicalPlan) -> float:
+        """Admission-control cost estimate: the planner walks the logical
+        tree; this engine supplies the index probe (local series counts,
+        scaled up by the owned-shard fraction when peers hold shards —
+        the admission path must not pay a cluster round-trip)."""
+        def series_of(filters, from_ms, to_ms):
+            total = narrow = 0
+            shards = self.memstore.shards_of(self.dataset)
+            for sh in shards:
+                with sh.lock:
+                    pids = sh.part_ids_from_filters(list(filters), from_ms,
+                                                    to_ms)
+                total += len(pids)
+                if sh.store is not None \
+                        and getattr(sh.store, "_narrow", None) is not None:
+                    narrow += len(pids)
+            if shards and self._has_remote_shards():
+                scale = len(self.mapper.all_shards()) / len(shards)
+                total, narrow = total * scale, narrow * scale
+            return total, (narrow / total if total else 0.0)
+
+        return self.planner.estimate_cost(
+            plan, series_of, self.config.stale_sample_after_ms)
+
+    def _epoch_vector(self) -> tuple | None:
+        """The cluster ingest-watermark vector for this dataset: every
+        shard's data_epoch mutation counter — local shards read directly,
+        peer-owned topologies probed over /api/v1/epochs (one concurrent
+        scatter; a hit served off a matching vector is provably identical
+        to re-execution). None when any peer is unreachable — callers then
+        treat the lookup as a miss and skip caching — and a failure arms
+        a cooldown during which the scatter is skipped entirely."""
+        vec = [("local", sh.shard_num, sh.data_epoch)
+               for sh in self.memstore.shards_of(self.dataset)]
+        if self._has_remote_shards():
+            if time.monotonic() < self._epoch_probe_down_until:
+                return None
+            import json as _json
+            import urllib.request
+
+            def fetch(ep: str) -> dict:
+                url = (f"http://{ep}/promql/{self.dataset}/api/v1/epochs"
+                       "?local=1")
+                with urllib.request.urlopen(url, timeout=2.0) as r:
+                    return _json.load(r).get("data") or {}
+
+            for ep, res in self.peer_scatter_join(
+                    self.peer_scatter_begin(fetch)):
+                if isinstance(res, Exception):
+                    self._epoch_probe_down_until = (
+                        time.monotonic() + self._epoch_probe_cooldown_s)
+                    return None
+                vec.extend((ep, str(k), int(v))
+                           for k, v in sorted(res.items()))
+        return tuple(sorted(vec, key=str))
 
     def _note_query_done(self, promql_text: str, ctx: QueryContext,
                          dur_ms: float, tctx: dict | None,
@@ -275,9 +513,15 @@ class QueryEngine:
                            {"dataset": self.dataset}) \
             .record(dur_ms, trace_id=trace_id)
         thr = self.config.slow_log_threshold_ms
-        if thr is not None and dur_ms >= thr:
+        shed = isinstance(error, AdmissionRejected)
+        slow = thr is not None and dur_ms >= thr
+        if slow and not shed:
             registry.counter(FILODB_QUERY_SLOW,
                              {"dataset": self.dataset}).increment()
+        if slow or shed:
+            # admission sheds enter the ring regardless of duration: the
+            # operator diagnosing 503s needs the shed queries' text, cost
+            # and tenant in the same place as the slow ones
             entry = {
                 "promql": promql_text, "dataset": self.dataset,
                 "duration_ms": round(dur_ms, 3),
@@ -287,6 +531,11 @@ class QueryEngine:
                 # all come from the monotonic clock
                 "ts": time.time(),
             }
+            if shed:
+                entry["shed"] = True
+                entry["cost"] = round(error.cost, 1)
+                if error.tenant is not None:
+                    entry["tenant"] = error.tenant
             if error is not None:
                 entry["error"] = f"{type(error).__name__}: {error}"
             slow_query_log.record(entry)
@@ -300,6 +549,7 @@ class QueryEngine:
         m = res.matrix
         ctx.stats.add("result_cells", m.num_series * len(m.out_ts))
         res.stats = ctx.stats
+        res.exec_path = ctx.exec_path
         return res
 
     def _exec_logical(self, plan: L.LogicalPlan,
@@ -392,8 +642,6 @@ class QueryEngine:
             start_ms=raw.range_selector.from_ms,
             end_ms=raw.range_selector.to_ms)
         from dataclasses import replace as _dc_replace
-
-        from .rangevector import QueryStats
         ctx = ctx if ctx is not None else self._ctx()
         # probe accounting: the leaf select below counts series/blocks, but
         # an off-pattern outcome re-runs the SAME leaf on the general path

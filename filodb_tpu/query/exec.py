@@ -919,9 +919,17 @@ class AggPartial:
     bucket_les: np.ndarray | None = None
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
 def _segment_partial(op, values, gids, num_groups):
-    return aggregators.partial_aggregate(op, values, gids, num_groups)
+    """Segment reduce via the explicit compiled-plan cache: keyed on
+    (op, pow2 group bucket, value shape/dtype) — the in-process map phase's
+    half of the compile space (PSM's kernels carry the other half)."""
+    from .plancache import plan_cache
+    prog = plan_cache.program(
+        "segment",
+        (op, num_groups, tuple(values.shape), str(values.dtype)),
+        lambda: functools.partial(aggregators.partial_aggregate, op,
+                                  num_groups=num_groups))
+    return prog(values, gids)
 
 
 @dataclass

@@ -122,7 +122,8 @@ class QueryStats:
     (they measure work, not critical path)."""
 
     FIELDS = ("series_matched", "blocks_narrow", "blocks_raw",
-              "rows_paged_in", "result_cells")
+              "rows_paged_in", "result_cells", "result_cache_hits",
+              "admission_shed")
 
     def __init__(self):
         self.series_matched = 0        # series selected by leaf filters
@@ -130,6 +131,8 @@ class QueryStats:
         self.blocks_raw = 0            # raw f32/f64 store blocks read
         self.rows_paged_in = 0         # series paged in via ODP
         self.result_cells = 0          # final matrix series x steps
+        self.result_cache_hits = 0     # answered from the result cache
+        self.admission_shed = 0        # shed by cost-based admission
         self.stage_ms: dict[str, float] = {}
         self._lock = threading.Lock()
 
@@ -184,6 +187,11 @@ class QueryResult:
     # per-query accounting, aggregated across shards and peers (None only
     # for results built outside an engine, e.g. unit-test fixtures)
     stats: "QueryStats | None" = None
+    # exec route taken for THIS query ("local" / "mesh-*" / "fused-hist" /
+    # "result-cache" / ...): the per-query, race-free successor of the
+    # engine-shared last_exec_path attribute PR 7 flagged (copied off
+    # QueryContext.exec_path when the engine finishes the plan)
+    exec_path: str | None = None
 
 
 class QueryError(Exception):

@@ -15,6 +15,7 @@ ThrowException/status admin messages outrank LogicalPlan2Query):
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import itertools
 import logging
@@ -22,7 +23,11 @@ import threading
 from concurrent.futures import Future, InvalidStateError
 from enum import IntEnum
 
-from ..utils.metrics import FILODB_SCHEDULER_WORKER_ERRORS, registry
+from ..utils.metrics import (FILODB_QUERY_ADMISSION_COST,
+                             FILODB_QUERY_ADMISSION_OVERSIZED,
+                             FILODB_QUERY_ADMISSION_SHED,
+                             FILODB_SCHEDULER_WORKER_ERRORS, registry)
+from .rangevector import QueryError
 
 log = logging.getLogger("filodb_tpu.scheduler")
 
@@ -35,6 +40,132 @@ class Priority(IntEnum):
 
 class SchedulerBusy(RuntimeError):
     """Raised when the bounded queue is full (maps to HTTP 503)."""
+
+
+class AdmissionRejected(QueryError):
+    """Cost-based admission shed: the query's estimated cost does not fit
+    the configured in-flight budget (or its tenant's quota). Maps to HTTP
+    503 + Retry-After — retryable load shedding, never a bad query (the
+    same posture as the PR 2 peer breaker's fast shed)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 cost: float = 0.0, tenant: str | None = None):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+        self.cost = float(cost)
+        self.tenant = tenant
+
+
+class AdmissionController:
+    """Bounded concurrent-cost gate for query execution (ref: the
+    reference's query-limits / per-dataset scheduling config in
+    filodb-defaults.conf — here the unit is the planner's cost estimate,
+    roughly samples touched: series x steps x window-steps with a
+    narrow-residency discount).
+
+    Unlike the scheduler's QUEUE bound (which counts queries), this bounds
+    the aggregate WORK admitted to execute at once: one 1M-series monster
+    and a thousand single-series panels are no longer the same load. Over
+    budget => immediate AdmissionRejected (503 + Retry-After); nothing
+    queues here — the caller owns backoff, exactly like the broker's
+    RETRY shed."""
+
+    def __init__(self, max_cost: float | None,
+                 tenant_quotas: dict | None = None,
+                 retry_after_s: float = 1.0, tags: dict | None = None):
+        # None = unbounded global budget: a quota-only deployment (only
+        # query.tenant_quotas set) still enforces its per-tenant caps
+        self.max_cost = float(max_cost) if max_cost is not None else None
+        self.tenant_quotas = {str(k): float(v)
+                              for k, v in (tenant_quotas or {}).items()}
+        self.retry_after_s = float(retry_after_s)
+        # per-controller metric identity (e.g. {"dataset": ...}): untagged,
+        # two engines' controllers would overwrite one process-shared gauge
+        self.tags = dict(tags or {})
+        self._lock = threading.Lock()
+        self._in_use = 0.0
+        self._tenant_use: dict[str, float] = {}
+        self._gauge = registry.gauge(FILODB_QUERY_ADMISSION_COST, self.tags)
+
+    def _count_shed(self, key: str | None) -> None:
+        registry.counter(FILODB_QUERY_ADMISSION_SHED,
+                         dict(self.tags, tenant=key or "none")).increment()
+
+    def _count_oversized(self, key: str | None) -> None:
+        # distinct from the shed counter: these never answered 503, so an
+        # operator alerting on sheds as overload signal must not see them
+        registry.counter(FILODB_QUERY_ADMISSION_OVERSIZED,
+                         dict(self.tags, tenant=key or "none")).increment()
+
+    def acquire(self, cost: float, tenant: str | None = None) -> float:
+        """Reserve ``cost`` units or raise. Returns the (floored) cost
+        actually reserved — pass it back to release().
+
+        Two distinct rejections: a query that does not fit RIGHT NOW (other
+        queries hold the budget) sheds retryable AdmissionRejected (503 +
+        Retry-After — backoff will land it); a query whose own cost exceeds
+        the absolute budget or its tenant's quota could NEVER be admitted,
+        so it fails as a non-retryable QueryError (422) instead of
+        livelocking an honored-backoff client forever."""
+        cost = max(float(cost), 1.0)
+        key = str(tenant) if tenant is not None else None
+        with self._lock:
+            quota = self.tenant_quotas.get(key) if key is not None else None
+            over_global = self.max_cost is not None and cost > self.max_cost
+            if over_global or (quota is not None and cost > quota):
+                limit, which = ((quota, "tenant quota")
+                                if quota is not None and cost > quota
+                                else (self.max_cost, "cost budget"))
+                self._count_oversized(key)
+                raise QueryError(
+                    f"query cost {cost:.0f} exceeds the configured {which} "
+                    f"({limit:.0f}) outright and can never be admitted; "
+                    "narrow the selector, range, or step")
+            t_use = self._tenant_use.get(key, 0.0) if key is not None else 0.0
+            if (self.max_cost is not None
+                    and self._in_use + cost > self.max_cost) \
+                    or (quota is not None and t_use + cost > quota):
+                which = ("tenant quota" if quota is not None
+                         and t_use + cost > quota else "cost budget")
+                in_flight = (f"{self._in_use:.0f}/{self.max_cost:.0f}"
+                             if which == "cost budget"
+                             else f"{t_use:.0f}/{quota:.0f}")
+                self._count_shed(key)
+                raise AdmissionRejected(
+                    f"query shed: estimated cost {cost:.0f} over the "
+                    f"{which} ({in_flight} in flight); retry after backoff",
+                    retry_after_s=self.retry_after_s, cost=cost,
+                    tenant=tenant)
+            self._in_use += cost
+            if key is not None:
+                self._tenant_use[key] = t_use + cost
+            self._gauge.update(self._in_use)
+        return cost
+
+    def release(self, cost: float, tenant: str | None = None) -> None:
+        key = str(tenant) if tenant is not None else None
+        with self._lock:
+            self._in_use = max(self._in_use - cost, 0.0)
+            if key is not None:
+                left = self._tenant_use.get(key, 0.0) - cost
+                if left > 0:
+                    self._tenant_use[key] = left
+                else:
+                    self._tenant_use.pop(key, None)
+            self._gauge.update(self._in_use)
+
+    @contextlib.contextmanager
+    def admitted(self, cost: float, tenant: str | None = None):
+        got = self.acquire(cost, tenant)
+        try:
+            yield got
+        finally:
+            self.release(got, tenant)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"in_use": self._in_use, "max_cost": self.max_cost,
+                    "tenants": dict(self._tenant_use)}
 
 
 class QueryScheduler:

@@ -791,6 +791,26 @@ class FiloServer:
         tracer.sample_rate = float(cfg.get("trace.sample_rate", 1.0))
         from .query.engine import slow_query_log
         slow_query_log.resize(int(cfg["query.slow_log_size"]))
+        # serving fast path: bound the process-global compiled-plan cache
+        # and pre-trace the configured hot shapes in the background — the
+        # server accepts traffic immediately; warmed dashboards simply stop
+        # paying first-query compiles as each program lands
+        from .query.plancache import plan_cache
+        from .query.plancache import warmup as plan_warmup
+        plan_cache.resize(int(cfg["query.plan_cache_size"]))
+        shapes = cfg.get("query.warmup_shapes") or []
+        if shapes:
+            def warmup_once(_shapes=list(shapes)):
+                try:
+                    info = plan_warmup(_shapes)
+                    log.info("query warmup: %s program(s) traced in %.0f ms",
+                             info["programs"], info["ms"])
+                except Exception:  # noqa: BLE001 — warmup is an optimization;
+                    # a bad shape spec must not take the server down
+                    log.exception("query warmup failed")
+
+            threading.Thread(target=warmup_once, daemon=True,
+                             name="query-warmup").start()
         zep = cfg.get("trace.zipkin_endpoint")
         if zep:
             from .utils.tracing import ZipkinReporter

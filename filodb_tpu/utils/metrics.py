@@ -46,6 +46,17 @@ FILODB_SHARD_LOCK_CONTENTIONS = "filodb_shard_lock_contentions"
 FILODB_SHARD_LOCK_LONG_HOLDS = "filodb_shard_lock_long_holds"
 FILODB_QUERY_LATENCY_MS = "filodb_query_latency_ms"
 FILODB_QUERY_SLOW = "filodb_query_slow"
+FILODB_QUERY_COMPILE_CACHE_HITS = "filodb_query_compile_cache_hits"
+FILODB_QUERY_COMPILE_CACHE_MISSES = "filodb_query_compile_cache_misses"
+FILODB_QUERY_COMPILE_CACHE_EVICTIONS = "filodb_query_compile_cache_evictions"
+FILODB_QUERY_RESULT_CACHE_HITS = "filodb_query_result_cache_hits"
+FILODB_QUERY_RESULT_CACHE_MISSES = "filodb_query_result_cache_misses"
+FILODB_QUERY_RESULT_CACHE_EVICTIONS = "filodb_query_result_cache_evictions"
+FILODB_QUERY_RESULT_CACHE_INVALIDATIONS = \
+    "filodb_query_result_cache_invalidations"
+FILODB_QUERY_ADMISSION_SHED = "filodb_query_admission_shed"
+FILODB_QUERY_ADMISSION_OVERSIZED = "filodb_query_admission_oversized"
+FILODB_QUERY_ADMISSION_COST = "filodb_query_admission_cost"
 FILODB_INGEST_PUBLISH_LATENCY_MS = "filodb_ingest_publish_latency_ms"
 FILODB_TRACE_SPANS = "filodb_trace_spans"
 
@@ -109,6 +120,43 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "Queries that crossed query.slow_log_threshold_ms and "
                    "entered the slow-query ring "
                    "(/api/v1/debug/slow_queries)."),
+    FILODB_QUERY_COMPILE_CACHE_HITS: (
+        "counter", "Compiled-plan cache hits: the query's padded kernel "
+                   "shape reused an already-traced XLA program."),
+    FILODB_QUERY_COMPILE_CACHE_MISSES: (
+        "counter", "Compiled-plan cache misses: a new (kernel, fn/op, "
+                   "shape-bucket, dtype) key traced and compiled a fresh "
+                   "program (the multi-second first-query cost warmup "
+                   "exists to absorb)."),
+    FILODB_QUERY_COMPILE_CACHE_EVICTIONS: (
+        "counter", "Compiled programs dropped by the plan cache's LRU "
+                   "capacity bound (query.plan_cache_size)."),
+    FILODB_QUERY_RESULT_CACHE_HITS: (
+        "counter", "Result-cache hits: a repeated range query answered "
+                   "from the step-aligned fragment cache after its ingest "
+                   "watermark vector validated."),
+    FILODB_QUERY_RESULT_CACHE_MISSES: (
+        "counter", "Result-cache misses (no entry for the query key)."),
+    FILODB_QUERY_RESULT_CACHE_EVICTIONS: (
+        "counter", "Result-cache entries dropped by the LRU capacity bound "
+                   "(query.result_cache_size)."),
+    FILODB_QUERY_RESULT_CACHE_INVALIDATIONS: (
+        "counter", "Result-cache entries discarded because a shard's ingest "
+                   "watermark advanced past the entry's recorded vector "
+                   "(data changed; a hit would no longer equal "
+                   "re-execution)."),
+    FILODB_QUERY_ADMISSION_SHED: (
+        "counter", "Queries shed by cost-based admission control (tagged by "
+                   "tenant): estimated cost did not fit the in-flight "
+                   "budget, answered 503 + Retry-After."),
+    FILODB_QUERY_ADMISSION_OVERSIZED: (
+        "counter", "Queries rejected outright because their estimated cost "
+                   "exceeds the absolute budget or tenant quota (answered "
+                   "non-retryable 422; never admissible at any load — NOT "
+                   "an overload signal)."),
+    FILODB_QUERY_ADMISSION_COST: (
+        "gauge", "Estimated cost units currently admitted and executing "
+                 "(bounded by query.max_concurrent_cost)."),
     FILODB_INGEST_PUBLISH_LATENCY_MS: (
         "histogram", "BrokerBus pipelined publish-group round trip per "
                      "partition, exemplar-tagged with the publish trace "

@@ -60,6 +60,8 @@ SPAN_QUERY_REDUCE = "query.exec.reduce"
 SPAN_QUERY_DISPATCH = "query.exec.dispatch"
 SPAN_QUERY_SERVE = "query.exec.serve"
 SPAN_QUERY_ODP = "query.odp"
+SPAN_QUERY_COMPILE = "query.compile"
+SPAN_QUERY_ADMIT = "query.admission"
 SPAN_REMOTE_READ = "query.remote_read"
 SPAN_REMOTE_WRITE = "ingest.remote_write"
 SPAN_GATEWAY_PUBLISH = "ingest.gateway.publish"
@@ -85,6 +87,11 @@ TRACE_SPEC: dict[str, str] = {
                       "shard-owning node (tags: node).",
     SPAN_QUERY_ODP: "On-demand page-in of cold chunks for one leaf batch "
                     "(tags: shard, series).",
+    SPAN_QUERY_COMPILE: "First execution of a new compiled-plan-cache key: "
+                        "XLA trace + compile + run (tags: kernel; absent on "
+                        "warm shapes — its count IS the compile count).",
+    SPAN_QUERY_ADMIT: "Cost-based admission decision for one query (tags: "
+                      "cost, tenant, shed on rejection).",
     SPAN_REMOTE_READ: "Remote-read fan-out leg to one peer (tags: "
                       "endpoint).",
     SPAN_REMOTE_WRITE: "Remote-write batch accepted at the HTTP edge.",

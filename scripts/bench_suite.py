@@ -1205,8 +1205,149 @@ def bench_observability(full: bool) -> None:
          spans_per_query * full_us / (p50["off"] * 1000) * 100, "%")
 
 
+def bench_serving(full: bool) -> None:
+    """ISSUE 8: the query-serving fast path. Three phases on the hicard
+    fixture: (a) cold-vs-warm compile latency — the compiled-plan cache is
+    cleared to re-measure a cold process, then a config-style warmup
+    pre-traces the shape; (b) repeated-dashboard serving with the result
+    cache on vs off (hit must be >= 5x faster at bit parity); (c) overload:
+    a cost budget that admits ~2 queries at a time under 8 honored-backoff
+    clients — every query lands, the admitted cost never passes the
+    budget, and the shed count shows the gate actually worked."""
+    import threading
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.query.engine import QueryConfig, QueryEngine
+    from filodb_tpu.query.plancache import plan_cache, warmup
+    from filodb_tpu.query.scheduler import AdmissionRejected
+
+    n_series = 8192 if full else 2048
+    n_samples = 90                       # 15 minutes @ 10s
+    rng = np.random.default_rng(13)
+    cfg = StoreConfig(max_series_per_shard=n_series, samples_per_series=128,
+                      flush_batch_size=10**9, dtype="float32")
+    ms = TimeSeriesMemStore()
+    ms.setup("serve", PROM_COUNTER, 0, cfg)
+    for s in range(n_series):
+        b = RecordBuilder(PROM_COUNTER)
+        vals = np.cumsum(rng.exponential(5.0, n_samples))
+        for t in range(n_samples):
+            b.add({"_metric_": "request_total", "job": f"J{s % 4}",
+                   "instance": f"i{s}"}, BASE + t * IV, float(vals[t]))
+        ms.ingest("serve", 0, b.build())
+    ms.flush_all()
+    start, end, step = BASE + 300_000, BASE + (n_samples - 1) * IV, 60_000
+    q = 'sum(rate(request_total[1m]))'
+
+    # -- (a) cold vs warm compile ------------------------------------------
+    eng = QueryEngine(ms, "serve")
+
+    def one(engine=eng, query=q):
+        return engine.query_range(query, start, end, step)
+
+    plan_cache.clear()                   # a cold process, reproduced
+    t0 = time.perf_counter()
+    one()
+    cold_ms = (time.perf_counter() - t0) * 1000
+    dt, it = timed(one, max_iters=40)
+    warm_ms = dt / it * 1000
+    emit("serving", "cold_first_query_ms", cold_ms, "ms")
+    emit("serving", "warm_p50_ms", warm_ms, "ms")
+    emit("serving", "cold_vs_warm_speedup", cold_ms / warm_ms, "x")
+    # config-driven warmup absorbs the cold cost before the first query
+    plan_cache.clear()
+    winfo = warmup([{"fn": "rate", "op": "sum", "series": n_series,
+                     "samples": 128, "steps": (end - start) // step + 1,
+                     "step_ms": step, "window_ms": 60_000,
+                     "interval_ms": IV}])
+    tr0 = plan_cache.traces
+    t0 = time.perf_counter()
+    one()
+    emit("serving", "warmed_first_query_ms",
+         (time.perf_counter() - t0) * 1000, "ms")
+    emit("serving", "warmup_ms", winfo["ms"], "ms")
+    emit("serving", "warmup_programs", winfo["programs"], "count")
+    emit("serving", "first_query_compiles_after_warmup",
+         plan_cache.traces - tr0, "count")
+
+    # -- (b) result cache on vs off ----------------------------------------
+    ceng = QueryEngine(ms, "serve",
+                       config=QueryConfig(result_cache_size=64))
+    r_off = one()                        # warm, uncached engine
+    r_hit = ceng.query_range(q, start, end, step)   # populate
+    dt, it = timed(lambda: ceng.query_range(q, start, end, step),
+                   max_iters=200)
+    hit_ms = dt / it * 1000
+    dt, it = timed(one, max_iters=40)
+    exec_ms = dt / it * 1000
+    r_hit = ceng.query_range(q, start, end, step)
+    assert (r_hit.exec_path or "").startswith("result-cache")
+    parity = float(np.array_equal(np.asarray(r_off.matrix.to_host().values),
+                                  np.asarray(r_hit.matrix.to_host().values)))
+    emit("serving", "result_hit_p50_ms", hit_ms, "ms")
+    emit("serving", "reexec_p50_ms", exec_ms, "ms")
+    emit("serving", "result_cache_speedup", exec_ms / hit_ms, "x")
+    emit("serving", "result_cache_bit_parity", parity, "bool")
+    # repeated-dashboard qps, cache on vs off
+    dt, it = timed(lambda: ceng.query_range(q, start, end, step),
+                   max_iters=200)
+    emit("serving", "dashboard_qps_cache_on", it / dt, "queries/s")
+    dt, it = timed(one, max_iters=40)
+    emit("serving", "dashboard_qps_cache_off", it / dt, "queries/s")
+
+    # -- (c) overload: admission gate + honored-backoff clients ------------
+    per_cost = eng.estimate_cost(
+        __import__("filodb_tpu.promql.parser", fromlist=["x"])
+        .query_to_logical_plan(q, start, end, step))
+    budget = per_cost * 2.5              # ~2 queries execute at a time
+    aeng = QueryEngine(ms, "serve", config=QueryConfig(
+        max_concurrent_cost=budget, shed_retry_after_s=0.005))
+    n_clients, per_client = 8, 6
+    sheds = [0]
+    landed = [0]
+    peak = [0.0]
+    lock = threading.Lock()
+
+    def client():
+        done = 0
+        while done < per_client:
+            try:
+                r = aeng.query_range(q, start, end, step)
+                assert r.matrix.num_series == 1
+                done += 1
+            except AdmissionRejected as e:
+                with lock:
+                    sheds[0] += 1
+                time.sleep(e.retry_after_s)      # honor the hint
+            with lock:
+                peak[0] = max(peak[0], aeng.admission.stats()["in_use"])
+        with lock:
+            landed[0] += done
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    emit("serving", "overload_budget_cost", budget, "cost")
+    emit("serving", "overload_queries_landed", landed[0], "count")
+    emit("serving", "overload_sheds", sheds[0], "count")
+    emit("serving", "overload_peak_cost_in_use", peak[0], "cost")
+    emit("serving", "overload_budget_respected",
+         float(peak[0] <= budget), "bool")
+    emit("serving", "overload_wall_s", wall, "s")
+    assert landed[0] == n_clients * per_client, \
+        "every honored-backoff client must land every query"
+    assert peak[0] <= budget, "admitted cost exceeded the budget"
+
+
 SUITES = {
     "ingestion": bench_ingestion,
+    "serving": bench_serving,
     "observability": bench_observability,
     "ingest": bench_ingest,
     "ingest_soak": bench_ingest_soak,

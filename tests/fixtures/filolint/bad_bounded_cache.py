@@ -1,0 +1,16 @@
+"""Fixture: a ``*Cache`` class with NO capacity bound and NO eviction
+accounting must trip surface-cache-unbounded AND
+surface-cache-no-eviction-metric (the PR 8 bounded-cache contract)."""
+
+
+class RouteCache:
+    """Entries age out naturally; eviction is handled by the GC."""
+
+    def __init__(self):
+        self._entries = {}
+
+    def get(self, key, build):
+        v = self._entries.get(key)
+        if v is None:
+            v = self._entries[key] = build()
+        return v

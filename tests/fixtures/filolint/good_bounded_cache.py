@@ -1,0 +1,16 @@
+"""Fixture twin: the cache exposes a capacity bound and counts evictions —
+surface-cache-unbounded / surface-cache-no-eviction-metric stay quiet."""
+
+
+class RouteCache:
+    def __init__(self, capacity=32, evictions_counter=None):
+        self.capacity = capacity
+        self._evictions = evictions_counter
+        self._entries = {}
+
+    def put(self, key, value):
+        self._entries[key] = value
+        while len(self._entries) > self.capacity:
+            self._entries.pop(next(iter(self._entries)))
+            if self._evictions is not None:
+                self._evictions.increment()

@@ -1,0 +1,244 @@
+"""Cost-based admission control (ISSUE 8): planner cost estimates feed a
+bounded concurrent-cost gate with per-tenant quotas; over-budget queries
+shed BEFORE execution. Two rejection flavors: a query that does not fit
+RIGHT NOW (others hold the budget) sheds typed AdmissionRejected (HTTP 503
++ Retry-After — an honored-backoff client lands every query once capacity
+frees), while a query whose own cost exceeds the budget or its tenant's
+quota outright could NEVER be admitted and fails non-retryable (HTTP 422)
+instead of livelocking a backoff client. Sheds land in QueryStats + the
+slow-query ring."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.http.api import FiloHttpServer
+from filodb_tpu.query.engine import QueryConfig, QueryEngine, slow_query_log
+from filodb_tpu.query.rangevector import QueryError
+from filodb_tpu.query.scheduler import (AdmissionController,
+                                        AdmissionRejected)
+
+START = 1_000_000
+INTERVAL = 10_000
+N = 60
+DS = "admit"
+
+
+def _store(n_series=8):
+    ms = TimeSeriesMemStore()
+    ms.setup(DS, GAUGE, 0,
+             StoreConfig(max_series_per_shard=32, samples_per_series=128,
+                         flush_batch_size=10**9, dtype="float64"))
+    for i in range(n_series):
+        b = RecordBuilder(GAUGE)
+        for t in range(N):
+            b.add({"_metric_": "m", "host": f"h{i}"}, START + t * INTERVAL,
+                  float(i + t))
+        ms.ingest(DS, 0, b.build())
+    ms.flush_all()
+    return ms
+
+
+# -- controller semantics -----------------------------------------------------
+
+def test_controller_budget_and_tenant_quota():
+    ctl = AdmissionController(100.0, {"t1": 30.0}, retry_after_s=2.0)
+    got = ctl.acquire(60.0)
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.acquire(50.0)                    # 60 + 50 > 100
+    assert ei.value.retry_after_s == 2.0
+    ctl.acquire(20.0, tenant="t1")
+    with pytest.raises(AdmissionRejected):
+        ctl.acquire(20.0, tenant="t1")       # 20 + 20 > quota 30
+    ctl.acquire(15.0, tenant="t2")           # unquota'd tenant: global only
+    ctl.release(got)
+    ctl.release(20.0, tenant="t1")
+    ctl.release(15.0, tenant="t2")
+    assert ctl.stats()["in_use"] == 0.0 and ctl.stats()["tenants"] == {}
+
+
+def test_controller_structurally_oversized_is_non_retryable():
+    """A cost that exceeds the budget (or quota) OUTRIGHT could never be
+    admitted — even on an idle controller it must fail as a plain
+    QueryError (422), not retryable AdmissionRejected: signaling
+    'retry after backoff' for it would livelock an honoring client."""
+    ctl = AdmissionController(100.0, {"t1": 30.0})
+    with pytest.raises(QueryError) as ei:
+        ctl.acquire(150.0)                   # > max_cost, nothing in flight
+    assert not isinstance(ei.value, AdmissionRejected)
+    assert "never be admitted" in str(ei.value)
+    with pytest.raises(QueryError) as ei:
+        ctl.acquire(50.0, tenant="t1")       # > its quota, idle
+    assert not isinstance(ei.value, AdmissionRejected)
+    assert ctl.stats()["in_use"] == 0.0, "a reject must reserve nothing"
+
+
+def test_controller_never_exceeds_budget_under_concurrency():
+    """The invariant the overload bench leans on: whatever the thread
+    interleaving, reserved cost never passes the budget."""
+    ctl = AdmissionController(100.0)
+    peak = [0.0]
+    peak_lock = threading.Lock()
+    landed = [0]
+
+    def worker():
+        for _ in range(50):
+            while True:
+                try:
+                    with ctl.admitted(30.0):
+                        with peak_lock:
+                            peak[0] = max(peak[0], ctl.stats()["in_use"])
+                    break
+                except AdmissionRejected:
+                    continue               # immediate retry: worst case
+        with peak_lock:
+            landed[0] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert landed[0] == 8, "every honored-backoff client must land"
+    assert peak[0] <= 100.0, f"budget exceeded: {peak[0]}"
+
+
+# -- engine integration -------------------------------------------------------
+
+def test_engine_sheds_over_budget_and_records_everywhere():
+    ms = _store()
+    eng = QueryEngine(ms, DS, config=QueryConfig(
+        max_concurrent_cost=1_000_000, shed_retry_after_s=3.0))
+    slow_query_log.clear()
+    hogged = eng.admission.acquire(999_999)  # transient: budget held
+    try:
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.query_range("sum(rate(m[2m]))", START + 300_000,
+                            START + 500_000, 30_000, tenant="grafana")
+    finally:
+        eng.admission.release(hogged)
+    assert ei.value.retry_after_s == 3.0
+    assert ei.value.cost > 0
+    entries = slow_query_log.entries(5)
+    shed = [e for e in entries if e.get("shed")]
+    assert shed and shed[0]["tenant"] == "grafana"
+    assert shed[0]["stats"]["admission_shed"] == 1
+
+
+def test_quota_only_admission_without_global_budget():
+    """query.tenant_quotas alone must arm the gate — quotas were dead
+    config unless max_concurrent_cost was also set (review finding)."""
+    ms = _store()
+    eng = QueryEngine(ms, DS,
+                      config=QueryConfig(tenant_quotas={"small": 1.0}))
+    assert eng.admission is not None
+    with pytest.raises(QueryError) as ei:
+        eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                        30_000, tenant="small")
+    assert not isinstance(ei.value, AdmissionRejected)
+    # unquota'd tenants ride the unbounded global budget freely
+    r = eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                        30_000, tenant="big")
+    assert r.matrix.num_series == 1
+    assert eng.admission.stats()["in_use"] == 0.0
+
+
+def test_engine_structurally_oversized_fails_non_retryable():
+    ms = _store()
+    eng = QueryEngine(ms, DS, config=QueryConfig(max_concurrent_cost=5))
+    with pytest.raises(QueryError) as ei:
+        eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                        30_000)
+    assert not isinstance(ei.value, AdmissionRejected)
+    assert "never be admitted" in str(ei.value)
+
+
+def test_engine_admits_within_budget_and_releases():
+    ms = _store()
+    eng = QueryEngine(ms, DS,
+                      config=QueryConfig(max_concurrent_cost=1_000_000))
+    r = eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                        30_000)
+    assert r.matrix.num_series == 1
+    assert eng.admission.stats()["in_use"] == 0.0, "cost must release"
+    # hog the budget -> shed; release -> the honored-backoff retry lands
+    hogged = eng.admission.acquire(999_999)
+    with pytest.raises(AdmissionRejected):
+        eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                        30_000)
+    eng.admission.release(hogged)
+    r2 = eng.query_range("sum(rate(m[2m]))", START + 300_000, START + 500_000,
+                         30_000)
+    np.testing.assert_array_equal(np.asarray(r.matrix.to_host().values),
+                                  np.asarray(r2.matrix.to_host().values))
+
+
+def test_planner_cost_shape():
+    """The estimate is monotone in the axes it claims: series, steps,
+    window; narrow residency discounts."""
+    ms = _store(n_series=8)
+    eng = QueryEngine(ms, DS, config=QueryConfig(max_concurrent_cost=1e12))
+    from filodb_tpu.promql import parser as promql
+
+    def cost(q, start, end, step):
+        return eng.estimate_cost(
+            promql.query_to_logical_plan(q, start, end, step))
+
+    s, e = START + 300_000, START + 500_000
+    base = cost("sum(rate(m[2m]))", s, e, 30_000)
+    assert base > 0
+    assert cost('sum(rate(m{host="h1"}[2m]))', s, e, 30_000) < base
+    assert cost("sum(rate(m[2m]))", s, e, 10_000) > base        # more steps
+    assert cost("sum(rate(m[4m]))", s, e, 30_000) > base        # wider window
+    both = cost("sum(rate(m[2m])) + sum(rate(m[2m]))", s, e, 30_000)
+    assert both == pytest.approx(2 * base)                      # joins add
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+def test_http_503_retry_after_and_tenant_quota():
+    ms = _store()
+    eng = QueryEngine(ms, DS, config=QueryConfig(
+        max_concurrent_cost=1_000_000, tenant_quotas={"small": 1.0},
+        shed_retry_after_s=2.0))
+    srv = FiloHttpServer({DS: eng}, port=0).start()
+    try:
+        base = (f"http://127.0.0.1:{srv.port}/promql/{DS}/api/v1/query_range"
+                f"?query=sum(m)&start={(START + 300_000) / 1000}"
+                f"&end={(START + 500_000) / 1000}&step=30s")
+        with urllib.request.urlopen(base, timeout=10.0) as r:
+            assert json.load(r)["status"] == "success"
+        # transient overload (the budget is held by in-flight work) sheds
+        # retryable: 503 + Retry-After
+        hogged = eng.admission.acquire(999_999)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base, timeout=10.0)
+        finally:
+            eng.admission.release(hogged)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) == 2
+        body = json.loads(ei.value.read())
+        assert body["errorType"] == "unavailable"
+        # the quota'd tenant's query exceeds its quota OUTRIGHT — it could
+        # never be admitted, so it fails non-retryable 422 (a 503 would
+        # livelock an honored-backoff client)
+        req = urllib.request.Request(base,
+                                     headers={"X-Filo-Tenant": "small"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10.0)
+        assert ei.value.code == 422
+        assert json.loads(ei.value.read())["errorType"] == "bad_data"
+        # a tenant WITHOUT a quota rides only the (ample) global budget —
+        # the tenant= query-param form of identity
+        with urllib.request.urlopen(base + "&tenant=big", timeout=10.0) as r:
+            assert json.load(r)["status"] == "success"
+    finally:
+        srv.stop()

@@ -141,7 +141,7 @@ def build_f32_store():
 def test_engine_routes_promql_through_mesh():
     """A PromQL string executes end-to-end via shard_map/psum: the engine's
     planner-level dispatch (ref: queryengine2/QueryEngine.scala:59-67 routes
-    every query through per-shard dispatchers), asserted via last_exec_path —
+    every query through per-shard dispatchers), asserted via the per-query result exec_path —
     not by calling MeshQueryExecutor.aggregate directly."""
     from filodb_tpu.query.engine import QueryEngine
 
@@ -151,7 +151,7 @@ def test_engine_routes_promql_through_mesh():
     start, end, step = START + 300_000, START + 500_000, 20_000
 
     r = eng.query_range("sum(rate(m[5m]))", start, end, step)
-    assert eng.last_exec_path == "mesh-fused", eng.last_exec_path
+    assert r.exec_path == "mesh-fused", r.exec_path
     want = local.query_range("sum(rate(m[5m]))", start, end, step)
     (_k, _t, got), = list(r.matrix.iter_series())
     (_k, _t, exp), = list(want.matrix.iter_series())
@@ -159,7 +159,7 @@ def test_engine_routes_promql_through_mesh():
 
     # grouped aggregate: keys + values must match the local path per group
     r = eng.query_range("sum by (grp) (rate(m[5m]))", start, end, step)
-    assert eng.last_exec_path == "mesh-fused"
+    assert r.exec_path == "mesh-fused"
     want = local.query_range("sum by (grp) (rate(m[5m]))", start, end, step)
     got = {k: v for k, _t, v in r.matrix.iter_series()}
     exp = {k: v for k, _t, v in want.matrix.iter_series()}
@@ -170,7 +170,7 @@ def test_engine_routes_promql_through_mesh():
     # filtered selection: non-matching rows must not leak into the sum
     q = 'sum(rate(m{grp="g1"}[5m]))'
     r = eng.query_range(q, start, end, step)
-    assert eng.last_exec_path.startswith("mesh-")
+    assert r.exec_path.startswith("mesh-")
     want = local.query_range(q, start, end, step)
     (_k, _t, got), = list(r.matrix.iter_series())
     (_k, _t, exp), = list(want.matrix.iter_series())
@@ -178,7 +178,7 @@ def test_engine_routes_promql_through_mesh():
 
     # min/max ride the twostep mesh path (pmin/pmax collectives)
     r = eng.query_range("max(rate(m[5m]))", start, end, step)
-    assert eng.last_exec_path == "mesh-twostep"
+    assert r.exec_path == "mesh-twostep"
     want = local.query_range("max(rate(m[5m]))", start, end, step)
     (_k, _t, got), = list(r.matrix.iter_series())
     (_k, _t, exp), = list(want.matrix.iter_series())
@@ -186,7 +186,7 @@ def test_engine_routes_promql_through_mesh():
 
     # instant query through the same dispatch
     ri = eng.query_instant("sum(rate(m[5m]))", end)
-    assert eng.last_exec_path == "mesh-fused"
+    assert ri.exec_path == "mesh-fused"
     wi = local.query_instant("sum(rate(m[5m]))", end)
     (_k, _t, got), = list(ri.matrix.iter_series())
     (_k, _t, exp), = list(wi.matrix.iter_series())
@@ -204,17 +204,17 @@ def test_engine_mesh_fallbacks():
 
     # count_values partials are value-STRING keyed — host merge, local route
     r = eng.query_range('count_values("v", count(m) by (grp))', start, end, step)
-    assert eng.last_exec_path == "local"
+    assert r.exec_path == "local"
     assert r.matrix.num_series > 0
 
     # bare selector (no aggregate): per-series results stay local
     r = eng.query_range("rate(m[5m])", start, end, step)
-    assert eng.last_exec_path == "local"
+    assert r.exec_path == "local"
     assert r.matrix.num_series == 24
 
     # no matching series: mesh dispatch answers empty without kernels
     r = eng.query_range("sum(rate(nosuch[5m]))", start, end, step)
-    assert eng.last_exec_path == "mesh-empty"
+    assert r.exec_path == "mesh-empty"
     assert r.matrix.num_series == 0
 
 
@@ -245,9 +245,9 @@ def test_engine_mesh_topk_and_quantile():
                      ("topk(2, rate(m[5m])) by (grp)", "mesh-topk"),
                      ('topk(2, rate(m{grp="g1"}[5m]))', "mesh-topk")):
         r = eng.query_range(q, start, end, step)
-        assert eng.last_exec_path == route, (q, eng.last_exec_path)
+        assert r.exec_path == route, (q, r.exec_path)
         want = local.query_range(q, start, end, step)
-        assert local.last_exec_path == "local"
+        assert want.exec_path == "local"
         got = {k: (t.tolist(), v) for k, t, v in r.matrix.iter_series()}
         exp = {k: (t.tolist(), v) for k, t, v in want.matrix.iter_series()}
         # same winners at the same steps; values agree within the grid-vs-
@@ -262,7 +262,7 @@ def test_engine_mesh_topk_and_quantile():
     for q in ("quantile(0.5, rate(m[5m]))",
               "quantile(0.9, rate(m[5m])) by (grp)"):
         r = eng.query_range(q, start, end, step)
-        assert eng.last_exec_path == "mesh-sketch", (q, eng.last_exec_path)
+        assert r.exec_path == "mesh-sketch", (q, r.exec_path)
         want = local.query_range(q, start, end, step)
         got = {k: v for k, _t, v in r.matrix.iter_series()}
         exp = {k: v for k, _t, v in want.matrix.iter_series()}
@@ -301,7 +301,7 @@ def test_mesh_two_shards_per_device():
               "max(rate(m[5m]))", "topk(3, rate(m[5m]))",
               "quantile(0.5, rate(m[5m]))"):
         r = eng.query_range(q, start, end, step)
-        assert eng.last_exec_path.startswith("mesh-"), (q, eng.last_exec_path)
+        assert r.exec_path.startswith("mesh-"), (q, r.exec_path)
         want = local.query_range(q, start, end, step)
         got = {k: v for k, _t, v in r.matrix.iter_series()}
         exp = {k: v for k, _t, v in want.matrix.iter_series()}

@@ -412,13 +412,13 @@ def test_fused_hist_quantile_route_and_parity(hist_engine):
     start, end, step = BASE + 600_000, BASE + 900_000, 60_000
     q = "histogram_quantile(0.9, sum(rate(req_latency[2m])))"
     r1 = eng.query_range(q, start, end, step)
-    assert eng.last_exec_path == "fused-hist"
+    assert r1.exec_path == "fused-hist"
     # grouping by an absent label still routes fused and must equal the
     # global sum (one group)
     r2 = eng.query_range(
         "histogram_quantile(0.9, sum by (__absent__) (rate(req_latency[2m])))",
         start, end, step)
-    assert eng.last_exec_path == "fused-hist"
+    assert r2.exec_path == "fused-hist"
     (_k, _t, v1), = list(r1.matrix.iter_series())
     (_k, _t, v2), = list(r2.matrix.iter_series())
     np.testing.assert_allclose(v1, v2, rtol=1e-12, equal_nan=True)
@@ -426,7 +426,7 @@ def test_fused_hist_quantile_route_and_parity(hist_engine):
     eng2 = QueryEngine(eng.memstore, eng.dataset)
     eng2._try_fused_hist = lambda plan, ctx=None: None
     r3 = eng2.query_range(q, start, end, step)
-    assert eng2.last_exec_path == "local"
+    assert r3.exec_path == "local"
     (_k, _t, v3), = list(r3.matrix.iter_series())
     np.testing.assert_allclose(v1, v3, rtol=1e-12, equal_nan=True)
 
@@ -443,7 +443,7 @@ def test_fused_bail_after_leaf_does_not_double_count_stats(hist_engine):
     start = BASE + 2**31 + 600_000
     end, step = start + 300_000, 60_000
     res = eng.query_range(q, start, end, step)
-    assert eng.last_exec_path == "local"
+    assert res.exec_path == "local"
     oracle = QueryEngine(eng.memstore, eng.dataset)
     oracle._try_fused_hist = lambda plan, ctx=None: None
     want = oracle.query_range(q, start, end, step)

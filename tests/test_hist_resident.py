@@ -158,7 +158,7 @@ def test_hist_query_parity_resident_vs_f32(mixed):
               'histogram_quantile(0.9, sum(rate(h{host="x1"}[2m])))'):
         ra = ea.query_range(q, start, end, step)
         rb = eb.query_range(q, start, end, step)
-        assert ea.last_exec_path == eb.last_exec_path
+        assert ra.exec_path == rb.exec_path
         a, b = np.asarray(ra.matrix.values), np.asarray(rb.matrix.values)
         assert a.shape == b.shape, q
         if mixed:
@@ -181,7 +181,7 @@ def test_hist_fused_path_never_materializes():
     eng = QueryEngine(ms, "prometheus")
     r = eng.query_range("histogram_quantile(0.9, sum(rate(h[2m])))",
                         START + 300_000, START + 800_000, 30_000)
-    assert eng.last_exec_path == "fused-hist"
+    assert r.exec_path == "fused-hist"
     assert r.matrix.num_series == 1
     r2 = eng.query_range("sum(rate(h[2m]))", START + 300_000, START + 800_000,
                          30_000)
@@ -306,7 +306,7 @@ def test_mesh_accepts_narrow_resident_stores(q):
     eh = QueryEngine(ms, "prometheus", mapper)          # host path oracle
     start, end, step = START + 300_000, START + 800_000, 30_000
     rm = em.query_range(q, start, end, step)
-    assert em.last_exec_path.startswith("mesh-"), em.last_exec_path
+    assert rm.exec_path.startswith("mesh-"), rm.exec_path
     rh = eh.query_range(q, start, end, step)
     a = {k: v for k, _t, v in rh.matrix.iter_series()}
     b = {k: v for k, _t, v in rm.matrix.iter_series()}
@@ -330,9 +330,9 @@ def test_mesh_narrow_fused_streams_i16():
         s.store.value_block = (lambda o=orig:
                                counts.__setitem__("v", counts["v"] + 1) or o())
     em = QueryEngine(ms, "prometheus", mapper, mesh=make_mesh())
-    em.query_range("sum(rate(m[2m]))", START + 300_000, START + 800_000,
-                   30_000)
-    assert em.last_exec_path == "mesh-fused-narrow", em.last_exec_path
+    rn = em.query_range("sum(rate(m[2m]))", START + 300_000,
+                        START + 800_000, 30_000)
+    assert rn.exec_path == "mesh-fused-narrow", rn.exec_path
     assert counts["v"] == 0
     for st, orig in origs:
         st.value_block = orig

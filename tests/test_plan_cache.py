@@ -1,0 +1,142 @@
+"""Compiled-plan cache: compile-count harness (ISSUE 8).
+
+The cache instruments REAL traces (a counter inside the traced body runs
+only at trace time) and records a ``query.compile`` span per new program, so
+these tests assert the serving contract directly: the second identical query
+compiles NOTHING — across the in-process path AND the mesh path — warmup
+pre-traces a dashboard's shape before its first query, and the LRU capacity
+bound actually evicts (with the metric to prove it)."""
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE, PROM_COUNTER
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.plancache import plan_cache, warmup
+from filodb_tpu.utils.metrics import (FILODB_QUERY_COMPILE_CACHE_EVICTIONS,
+                                      registry)
+from filodb_tpu.utils.tracing import SPAN_QUERY_COMPILE, tracer
+
+BASE = 1_700_000_000_000
+IV = 10_000
+
+
+def _counter_store(n_series=64, n_samples=90, max_series=64,
+                   dataset="plancache"):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=max_series,
+                      samples_per_series=128, flush_batch_size=10**9,
+                      dtype="float32")
+    ms.setup(dataset, PROM_COUNTER, 0, cfg)
+    rng = np.random.default_rng(7)
+    for s in range(n_series):
+        b = RecordBuilder(PROM_COUNTER)
+        vals = np.cumsum(rng.exponential(5.0, n_samples))
+        for t in range(n_samples):
+            b.add({"_metric_": "rt", "job": f"J{s % 4}", "inst": f"i{s}"},
+                  BASE + t * IV, float(vals[t]))
+        ms.ingest(dataset, 0, b.build())
+    ms.flush_all()
+    return ms
+
+
+def _compile_spans():
+    return [s for s in tracer.snapshot() if s.name == SPAN_QUERY_COMPILE]
+
+
+def test_second_identical_query_compiles_nothing_in_process():
+    ms = _counter_store()
+    eng = QueryEngine(ms, "plancache")
+    start, end, step = BASE + 300_000, BASE + 890_000, 60_000
+    q = 'sum(rate(rt[1m]))'
+    r1 = eng.query_range(q, start, end, step)
+    tracer.drain()
+    t0, h0 = plan_cache.traces, plan_cache.stats()["hits"]
+    r2 = eng.query_range(q, start, end, step)
+    assert plan_cache.traces == t0, \
+        "second identical query must trace/compile nothing"
+    assert _compile_spans() == [], "no query.compile span on the warm path"
+    assert plan_cache.stats()["hits"] > h0, "the warm path must HIT the cache"
+    np.testing.assert_array_equal(np.asarray(r1.matrix.values),
+                                  np.asarray(r2.matrix.values))
+
+
+def test_second_identical_query_compiles_nothing_on_mesh():
+    from filodb_tpu.parallel.distributed import make_mesh
+    mesh = make_mesh()
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    for i, dev in enumerate(mesh.devices.ravel()):
+        ms.setup("meshpc", GAUGE, i, cfg, device=dev)
+    rng = np.random.default_rng(5)
+    for i in range(24):
+        b = RecordBuilder(GAUGE)
+        vals = np.cumsum(rng.exponential(5.0, 60))
+        for t in range(60):
+            b.add({"_metric_": "m", "host": f"h{i}", "grp": f"g{i % 4}"},
+                  BASE + t * IV, float(vals[t]))
+        ms.ingest("meshpc", i % 8, b.build())
+    ms.flush_all()
+    eng = QueryEngine(ms, "meshpc", mesh=mesh)
+    start, end, step = BASE + 300_000, BASE + 500_000, 20_000
+    for q in ("sum(rate(m[5m]))", "max(rate(m[5m]))"):
+        r1 = eng.query_range(q, start, end, step)
+        assert r1.exec_path.startswith("mesh-"), r1.exec_path
+        tracer.drain()
+        t0 = plan_cache.traces
+        r2 = eng.query_range(q, start, end, step)
+        assert plan_cache.traces == t0, \
+            f"second identical mesh query must compile nothing ({q})"
+        assert _compile_spans() == []
+        assert r2.exec_path == r1.exec_path
+        np.testing.assert_array_equal(np.asarray(r1.matrix.values),
+                                      np.asarray(r2.matrix.values))
+
+
+def test_warmup_pretraces_the_dashboard_shape():
+    """query.warmup_shapes contract: after warming the (fn, op, series,
+    samples, steps, window, interval) bucket, the first real dashboard query
+    of that shape traces NOTHING new."""
+    ms = _counter_store(dataset="warmshape")
+    eng = QueryEngine(ms, "warmshape")
+    plan_cache.clear()          # cold process: every program must rebuild
+    info = warmup([{"fn": "rate", "op": "sum", "series": 64, "samples": 128,
+                    "steps": 10, "step_ms": 60_000, "window_ms": 60_000,
+                    "interval_ms": 10_000}])
+    assert info["programs"] > 0, "a cold warmup must trace programs"
+    tracer.drain()
+    t0 = plan_cache.traces
+    r = eng.query_range('sum(rate(rt[1m]))', BASE + 300_000, BASE + 840_000,
+                        60_000)
+    assert plan_cache.traces == t0, \
+        "warmed dashboard shape must not compile on first load"
+    assert _compile_spans() == []
+    assert r.matrix.num_series == 1
+
+
+def test_eviction_respects_capacity_bound_and_counts():
+    ev = registry.counter(FILODB_QUERY_COMPILE_CACHE_EVICTIONS)
+    old_cap = plan_cache.capacity
+    ev0 = ev.value
+    try:
+        plan_cache.resize(4)
+        for i in range(9):
+            plan_cache.program("evict-probe", (i,), lambda: (lambda x: x))
+        assert len(plan_cache) <= 4
+        assert ev.value >= ev0 + 5, "LRU overflow must count as evictions"
+        # the survivors are the most recently inserted keys: re-requesting
+        # the newest is a hit, the oldest a miss (rebuild)
+        h0 = plan_cache.stats()["hits"]
+        plan_cache.program("evict-probe", (8,), lambda: (lambda x: x))
+        assert plan_cache.stats()["hits"] == h0 + 1
+    finally:
+        plan_cache.resize(old_cap)
+
+
+def test_cache_stats_surface():
+    s = plan_cache.stats()
+    assert {"size", "capacity", "hits", "misses", "evictions",
+            "traces"} <= set(s)
+    assert s["capacity"] >= 1

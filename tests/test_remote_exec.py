@@ -351,7 +351,7 @@ def test_peer_death_replans_once_to_survivor():
         pytest.skip("strategy assigned both shards to one node")
     r = eng.query_range("count(m)", START + 600_000, START + 900_000, 30_000)
     assert state["failed"], "the dead peer was never dispatched to"
-    assert eng.last_exec_path == "local-replanned"
+    assert r.exec_path == "local-replanned"
     assert float(np.asarray(r.matrix.values)[0, 0]) == 8.0
 
 
@@ -622,7 +622,7 @@ def test_batched_peer_death_replans_once():
         pytest.skip("strategy assigned every shard to one node")
     r = eng.query_range("count(m)", START + 600_000, START + 900_000, 30_000)
     assert state["failed"]
-    assert eng.last_exec_path == "local-replanned"
+    assert r.exec_path == "local-replanned"
     assert float(np.asarray(r.matrix.values)[0, 0]) == 8.0
 
 

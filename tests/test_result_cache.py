@@ -1,0 +1,240 @@
+"""Watermark-invalidated result cache: correctness under mutation (ISSUE 8).
+
+The contract under test: a cache hit is PROVABLY identical to re-execution —
+entries validate against the cluster ingest-watermark vector (every shard's
+``data_epoch``, peers probed over ``/api/v1/epochs``), so any ingest, purge,
+or compaction since the entry was recorded makes it unreachable. Covered:
+single-node hit/invalidate/parity, LRU eviction under capacity, tenant key
+isolation, and the cluster fixture (hit with peer probes, ingest on the PEER
+invalidates, bit-parity cached vs recomputed vs oracle throughout)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.http.api import FiloHttpServer
+from filodb_tpu.parallel.cluster import ShardManager
+from filodb_tpu.parallel.shardmapper import ShardMapper
+from filodb_tpu.query.engine import QueryConfig, QueryEngine
+
+START = 1_000_000
+INTERVAL = 10_000
+N = 90
+DS = "rescache"
+
+
+def _cfg():
+    return StoreConfig(max_series_per_shard=32, samples_per_series=256,
+                       flush_batch_size=10**9, dtype="float64")
+
+
+def _ingest_series(ms, shard, i, n=N, metric="m", dataset=DS):
+    b = RecordBuilder(GAUGE)
+    for t in range(n):
+        b.add({"_metric_": metric, "host": f"h{i}", "dc": f"dc{i % 2}"},
+              START + t * INTERVAL, float(100.0 * (i + 1) + t))
+    ms.ingest(dataset, shard, b.build())
+
+
+def _single_node(cache_size=8):
+    ms = TimeSeriesMemStore()
+    ms.setup(DS, GAUGE, 0, _cfg())
+    for i in range(6):
+        _ingest_series(ms, 0, i)
+    ms.flush_all()
+    eng = QueryEngine(ms, DS,
+                      config=QueryConfig(result_cache_size=cache_size))
+    return ms, eng
+
+
+def _vals(res):
+    return np.asarray(res.matrix.to_host().values)
+
+
+def test_hit_is_bit_identical_then_ingest_invalidates():
+    ms, eng = _single_node()
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    q = "sum by (dc) (rate(m[2m]))"
+    r1 = eng.query_range(q, start, end, step)
+    assert not (r1.exec_path or "").startswith("result-cache")
+    r2 = eng.query_range(q, start, end, step)
+    assert (r2.exec_path or "").startswith("result-cache"), r2.exec_path
+    assert r2.stats.to_dict()["result_cache_hits"] == 1
+    np.testing.assert_array_equal(_vals(r1), _vals(r2))
+
+    # new samples past the watermark (a fresh series inside the queried
+    # window): the entry must become unreachable and the recomputed answer
+    # must equal an uncached engine's bit-for-bit
+    _ingest_series(ms, 0, 99)
+    ms.flush_all()
+    inv0 = eng.result_cache.stats()["invalidations"]
+    r3 = eng.query_range(q, start, end, step)
+    assert not (r3.exec_path or "").startswith("result-cache")
+    assert eng.result_cache.stats()["invalidations"] == inv0 + 1
+    oracle = QueryEngine(ms, DS)        # cache-free oracle on the same store
+    r4 = oracle.query_range(q, start, end, step)
+    np.testing.assert_array_equal(_vals(r3), _vals(r4))
+    assert not np.array_equal(_vals(r3), _vals(r1)), \
+        "the mutation must actually change the answer (else the test is vacuous)"
+    # and the refreshed entry serves again
+    r5 = eng.query_range(q, start, end, step)
+    assert (r5.exec_path or "").startswith("result-cache")
+    np.testing.assert_array_equal(_vals(r5), _vals(r3))
+
+
+def test_eviction_under_capacity():
+    _ms, eng = _single_node(cache_size=2)
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    ev0 = eng.result_cache.stats()["evictions"]
+    for q in ("sum(m)", "avg(m)", "count(m)"):
+        eng.query_range(q, start, end, step)
+    assert len(eng.result_cache) <= 2
+    assert eng.result_cache.stats()["evictions"] >= ev0 + 1
+    # the newest entry survived LRU and still hits
+    r = eng.query_range("count(m)", start, end, step)
+    assert (r.exec_path or "").startswith("result-cache")
+
+
+def test_tenant_is_part_of_the_key():
+    _ms, eng = _single_node()
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    ra = eng.query_range("sum(m)", start, end, step, tenant="a")
+    rb = eng.query_range("sum(m)", start, end, step, tenant="b")
+    assert not (rb.exec_path or "").startswith("result-cache"), \
+        "tenant b's first query must not read tenant a's entry"
+    ra2 = eng.query_range("sum(m)", start, end, step, tenant="a")
+    assert (ra2.exec_path or "").startswith("result-cache")
+    np.testing.assert_array_equal(_vals(ra), _vals(ra2))
+    np.testing.assert_array_equal(_vals(ra), _vals(rb))
+
+
+def test_instant_queries_bypass_the_cache():
+    _ms, eng = _single_node()
+    r1 = eng.query_instant("sum(m)", START + 800_000)
+    r2 = eng.query_instant("sum(m)", START + 800_000)
+    assert not (r2.exec_path or "").startswith("result-cache")
+    np.testing.assert_array_equal(_vals(r1), _vals(r2))
+
+
+# -- cluster fixture: peer-probed watermark vector ---------------------------
+
+@pytest.fixture()
+def two_node_cached():
+    """Two nodes, two shards split across them (every store holds every
+    shard's data, the post-takeover convention of the remote-exec tests);
+    node a's engine caches results, so its hits depend on node b's epochs
+    answering over /api/v1/epochs."""
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset(DS, 2)
+    owner = {s: mgr.node_of(DS, s) for s in (0, 1)}
+    if len(set(owner.values())) != 2:
+        pytest.skip("strategy assigned both shards to one node")
+    stores = {n: TimeSeriesMemStore() for n in ("a", "b")}
+    oracle_ms = TimeSeriesMemStore()
+    for s in (0, 1):
+        oracle_ms.setup(DS, GAUGE, s, _cfg())
+        for n in ("a", "b"):
+            stores[n].setup(DS, GAUGE, s, _cfg())
+    for i in range(8):
+        _ingest_series(oracle_ms, i % 2, i)
+        for n in ("a", "b"):
+            _ingest_series(stores[n], i % 2, i)
+    for ms in (*stores.values(), oracle_ms):
+        ms.flush_all()
+    eps: dict[str, str] = {}
+    engines = {
+        "a": QueryEngine(stores["a"], DS, ShardMapper(2), cluster=mgr,
+                         node="a", endpoint_resolver=eps.get,
+                         config=QueryConfig(result_cache_size=8)),
+        "b": QueryEngine(stores["b"], DS, ShardMapper(2), cluster=mgr,
+                         node="b", endpoint_resolver=eps.get),
+    }
+    servers = {n: FiloHttpServer({DS: engines[n]}, port=0).start()
+               for n in ("a", "b")}
+    for n, srv in servers.items():
+        eps[n] = f"127.0.0.1:{srv.port}"
+    oracle = QueryEngine(oracle_ms, DS, ShardMapper(2))
+    try:
+        yield engines, stores, oracle, oracle_ms, owner
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_cluster_hit_and_peer_ingest_invalidation(two_node_cached):
+    engines, stores, oracle, oracle_ms, owner = two_node_cached
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    q = "sum by (dc) (rate(m[2m]))"
+    eng = engines["a"]
+    want = oracle.query_range(q, start, end, step)
+    r1 = eng.query_range(q, start, end, step)
+    np.testing.assert_array_equal(_vals(r1), _vals(want))
+    # repeated dashboard query: served from cache after the peer epoch
+    # vector validates over HTTP — still bit-identical to the oracle
+    r2 = eng.query_range(q, start, end, step)
+    assert (r2.exec_path or "").startswith("result-cache"), r2.exec_path
+    np.testing.assert_array_equal(_vals(r2), _vals(want))
+
+    # ingest a new window of samples into the PEER-owned shard on every
+    # replica (+ the oracle): node b's data_epoch advances, so node a's
+    # cached entry must invalidate even though a's local copy of its OWN
+    # shard never changed
+    b_shard = next(s for s, n in owner.items() if n == "b")
+    newbie = 10 + b_shard   # routes-agnostic: ingest straight to the shard
+    _ingest_series(oracle_ms, b_shard, newbie, n=N + 20)
+    for n in ("a", "b"):
+        _ingest_series(stores[n], b_shard, newbie, n=N + 20)
+    oracle_ms.flush_all()
+    for n in ("a", "b"):
+        stores[n].flush_all()
+    inv0 = eng.result_cache.stats()["invalidations"]
+    want2 = oracle.query_range(q, start, end, step)
+    r3 = eng.query_range(q, start, end, step)
+    assert not (r3.exec_path or "").startswith("result-cache")
+    assert eng.result_cache.stats()["invalidations"] == inv0 + 1
+    np.testing.assert_array_equal(_vals(r3), _vals(want2))
+    assert not np.array_equal(_vals(r3), _vals(r1)), \
+        "the peer-side mutation must change the cluster answer"
+    # and the refreshed entry serves the new answer
+    r4 = eng.query_range(q, start, end, step)
+    assert (r4.exec_path or "").startswith("result-cache")
+    np.testing.assert_array_equal(_vals(r4), _vals(want2))
+
+
+def test_unverifiable_epoch_vector_fails_open_to_miss(two_node_cached):
+    """A dead peer makes the epoch vector unverifiable (None): the cache
+    must neither store nor serve against it — an entry it cannot validate
+    is treated as a miss (but kept: an unreadable watermark is not
+    evidence the data changed). The failed probe arms a cooldown so a
+    blackholed peer stalls at most one query per window, not every one."""
+    engines, _stores, _oracle, _oracle_ms, _owner = two_node_cached
+    eng = engines["a"]
+    resolver0 = eng.endpoint_resolver
+    good_vec = eng._epoch_vector()
+    assert good_vec is not None and any(part[0] != "local"
+                                        for part in good_vec), \
+        "the healthy vector must cover peer shards"
+    # sever the peer endpoint: the probe fails -> unverifiable vector
+    eng.endpoint_resolver = lambda node: "127.0.0.1:1"
+    assert eng._epoch_vector() is None
+    # put() with an unverifiable vector is a no-op...
+    eng.result_cache.put(("probe-key",), ("payload",), None)
+    assert eng.result_cache.get(("probe-key",), good_vec) is None
+    # ...and get() against one is a miss that KEEPS the entry — it serves
+    # again once the vector can be read (no invalidation: nothing moved)
+    eng.result_cache.put(("probe-key",), ("payload",), good_vec)
+    inv0 = eng.result_cache.stats()["invalidations"]
+    assert eng.result_cache.get(("probe-key",), None) is None
+    assert eng.result_cache.stats()["invalidations"] == inv0
+    assert eng.result_cache.get(("probe-key",), good_vec) == ("payload",)
+    # the failure armed the probe cooldown: even with the peer healthy
+    # again, the scatter is skipped (fail-open, no per-query stall) until
+    # the cooldown passes
+    eng.endpoint_resolver = resolver0
+    assert eng._epoch_vector() is None
+    eng._epoch_probe_down_until = 0.0
+    assert eng._epoch_vector() == good_vec

@@ -61,6 +61,10 @@ BAD_FIXTURES = {
     # PR 7: declared span surface (TRACE_SPEC, mirroring CONFIG/METRICS)
     "bad_trace_span.py": {"surface-trace-undeclared",
                           "surface-trace-unused"},
+    # PR 8: bounded-cache contract — every *Cache class needs a capacity
+    # bound and eviction accounting (plan cache / result cache set the bar)
+    "bad_bounded_cache.py": {"surface-cache-unbounded",
+                             "surface-cache-no-eviction-metric"},
 }
 
 

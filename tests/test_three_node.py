@@ -173,7 +173,7 @@ def test_kill_one_node_splits_shards_and_replans(three_node):
     got_res = engines["a"].query_range("sum by (dc) (m)", start, end, step)
     got = _as_comparable(got_res)
     assert state["failed"], "the dead peer was never dispatched to"
-    assert engines["a"].last_exec_path == "local-replanned"
+    assert got_res.exec_path == "local-replanned"
     assert got == want
     # the replan retry re-executed every leg: the first attempt's partial
     # counts (successful peers, local leaves) must not double into stats
@@ -185,9 +185,9 @@ def test_kill_one_node_splits_shards_and_replans(three_node):
     assert set(new_owner.values()) == {"a", "b"}, (
         f"expected {c_shards} split across both survivors, got {new_owner}")
     # and steady-state queries (no replan) stay correct on the new topology
-    got2 = _as_comparable(engines["b"].query_range("sum by (dc) (m)",
-                                                   start, end, step))
+    got2_res = engines["b"].query_range("sum by (dc) (m)", start, end, step)
+    got2 = _as_comparable(got2_res)
     assert got2 == want
-    assert engines["b"].last_exec_path == "local"
+    assert got2_res.exec_path == "local"
     # unreferenced, but documents the window: the dead endpoint is gone
     assert dead_ep not in eps.values()
