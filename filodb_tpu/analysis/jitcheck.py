@@ -18,6 +18,17 @@ expensive ones (accelerator guide: host/device boundary):
     value (the silent 100x cliff); an unhashable static argument (list/dict/
     set/ndarray) raises at call time. Checked both at the decoration (float
     defaults on static params) and at same-module call sites.
+  * ``jit-donation-unused`` — donation discipline on the flush path, both
+    directions: (a) a ``donate_argnums``/``donate_argnames`` argument that
+    never flows to the function's return is a donation with zero aliasing
+    win — the input buffer is deleted (the caller may still hold it) and
+    nothing is updated in place; (b) a jitted function that scatter-updates
+    a parameter (``p.at[...].set/add``) and returns the result WITHOUT
+    donating it allocates a full copy of the buffer per call — on the
+    memstore flush path that is a store-sized allocation per staged-row
+    commit (core/chunkstore.py's scatter jits donate for exactly this
+    reason). Deliberate copies suppress with
+    ``# filolint: ignore[jit-donation-unused]`` + reason.
 
 Jitted functions are recognized by decorator (``@jax.jit``,
 ``@functools.partial(jax.jit, ...)``), by wrapping assignment
@@ -62,6 +73,8 @@ class _JitInfo:
     qualname: str
     static_names: set = field(default_factory=set)
     static_nums: set = field(default_factory=set)   # positional indices
+    donate_names: set = field(default_factory=set)
+    donate_nums: set = field(default_factory=set)   # positional indices
     aliases: set = field(default_factory=set)       # names callable at sites
 
     def params(self) -> list[str]:
@@ -69,13 +82,19 @@ class _JitInfo:
         return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
                 + [p.arg for p in a.kwonlyargs])
 
-    def static_params(self) -> set:
-        names = set(self.static_names)
+    def _resolve(self, names: set, nums: set) -> set:
+        out = set(names)
         plist = self.params()
-        for i in self.static_nums:
+        for i in nums:
             if 0 <= i < len(plist):
-                names.add(plist[i])
-        return names
+                out.add(plist[i])
+        return out
+
+    def static_params(self) -> set:
+        return self._resolve(self.static_names, self.static_nums)
+
+    def donated_params(self) -> set:
+        return self._resolve(self.donate_names, self.donate_nums)
 
 
 class _ModuleIndex(ast.NodeVisitor):
@@ -151,9 +170,99 @@ class _ModuleIndex(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+SCATTER_UPDATE_ATTRS = {"set", "add", "subtract", "multiply", "divide",
+                        "min", "max", "power", "apply"}
+
+
+def _names_flowing_to_return(fn: ast.FunctionDef) -> set:
+    """Over-approximate the set of names whose value can reach a ``return``
+    expression: seed with the names read in return expressions, close
+    backwards through (Ann/Aug)Assign statements, ``for``/``with`` target
+    bindings, and mutating method calls on a name (``out.append(x)`` makes
+    ``out`` depend on ``x``). Reassignment versions are not distinguished —
+    over-approximation only ever SUPPRESSES findings."""
+    deps: dict[str, set] = {}
+
+    def _loads(expr: ast.expr | None) -> set:
+        if expr is None:
+            return set()
+        return {n.id for n in ast.walk(expr)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+    def _bind(targets, names: set) -> None:
+        for t in targets:
+            for tn in ast.walk(t):
+                if isinstance(tn, ast.Name) and isinstance(tn.ctx,
+                                                           (ast.Store,
+                                                            ast.Load)):
+                    deps.setdefault(tn.id, set()).update(names)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            _bind(node.targets, _loads(node.value))
+        elif isinstance(node, ast.AugAssign):
+            names = _loads(node.value)
+            if isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+            _bind([node.target], names)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            _bind([node.target], _loads(node.value))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            _bind([node.target], _loads(node.iter))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    _bind([item.optional_vars], _loads(item.context_expr))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)):
+            # a method call may mutate its receiver with the args' values
+            names = set()
+            for a in node.args:
+                names |= _loads(a)
+            for kw in node.keywords:
+                names |= _loads(kw.value)
+            if names:
+                deps.setdefault(node.func.value.id, set()).update(names)
+    flowing: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            flowing |= {n.id for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)}
+    changed = True
+    while changed:
+        changed = False
+        for name in list(flowing):
+            for s in deps.get(name, ()):
+                if s not in flowing:
+                    flowing.add(s)
+                    changed = True
+    return flowing
+
+
+def _scatter_updated_params(fn: ast.FunctionDef, params: set) -> dict:
+    """{param name: first lineno} of parameters used as the BASE of an
+    in-place-eligible ``p.at[...].set/add/...`` update chain."""
+    out: dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in SCATTER_UPDATE_ATTRS
+                and isinstance(node.func.value, ast.Subscript)
+                and isinstance(node.func.value.value, ast.Attribute)
+                and node.func.value.value.attr == "at"
+                and isinstance(node.func.value.value.value, ast.Name)):
+            continue
+        base = node.func.value.value.value.id
+        if base in params:
+            out.setdefault(base, node.lineno)
+    return out
+
+
 class JitChecker:
     rules = ("jit-host-sync", "jit-traced-branch", "jit-mutable-closure",
-             "jit-static-args")
+             "jit-static-args", "jit-donation-unused")
 
     def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
         idx = _ModuleIndex()
@@ -163,7 +272,41 @@ class JitChecker:
         for info in jitted.values():
             findings += self._check_body(path, info, idx)
             findings += self._check_decoration(path, info)
+            findings += self._check_donation(path, info)
         findings += self._check_call_sites(path, tree, jitted)
+        return findings
+
+    # -- donation discipline ----------------------------------------------
+
+    def _check_donation(self, path: str, info: _JitInfo) -> list[Finding]:
+        """jit-donation-unused, both directions: a donated argument that
+        never flows to an output (the donation deletes an input for zero
+        aliasing win), and a scatter-updated-and-returned parameter that is
+        NOT donated (a full buffer copy per call on the flush path)."""
+        findings: list[Finding] = []
+        donated = info.donated_params()
+        params = set(info.params()) - {"self"}
+        flowing = _names_flowing_to_return(info.node)
+        for name in sorted(donated):
+            if name not in flowing:
+                findings.append(Finding(
+                    "jit-donation-unused", path, info.node.lineno,
+                    info.qualname, f"donated-unread:{name}",
+                    f"donated argument {name!r} never flows to the jitted "
+                    "function's return — the donation deletes the caller's "
+                    "buffer without any in-place update to alias into; "
+                    "drop it from donate_argnums or update-and-return it"))
+        scattered = _scatter_updated_params(info.node, params)
+        for name, lineno in sorted(scattered.items()):
+            if name in flowing and name not in donated:
+                findings.append(Finding(
+                    "jit-donation-unused", path, lineno, info.qualname,
+                    f"undonated-scatter:{name}",
+                    f"parameter {name!r} is scatter-updated and returned "
+                    "but not donated — the update allocates a full copy of "
+                    "the buffer per call; donate it (donate_argnums) so "
+                    "the commit updates the array in place, or suppress "
+                    "with a reason if the copy is deliberate"))
         return findings
 
     # -- recognizing jitted functions ------------------------------------
@@ -188,6 +331,14 @@ class JitChecker:
                 for v in ast.walk(kw.value):
                     if isinstance(v, ast.Constant) and isinstance(v.value, int):
                         info.static_nums.add(v.value)
+            elif kw.arg == "donate_argnames":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                        info.donate_names.add(v.value)
+            elif kw.arg == "donate_argnums":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                        info.donate_nums.add(v.value)
 
     def _find_jitted(self, tree: ast.Module,
                      idx: _ModuleIndex) -> dict[int, _JitInfo]:

@@ -99,6 +99,22 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "Step-aligned result-cache entries per engine, keyed on (promql, "
         "start, end, step, tenant) and invalidated by per-shard ingest "
         "watermark (0 disables)."),
+    "query.negative_cache_size": (
+        "int", 256,
+        "TTL-bounded negative result cache entries per engine: a query "
+        "whose selection matched ZERO series cluster-wide short-circuits "
+        "(no parse/plan/execute) until its TTL expires (0 disables)."),
+    "query.negative_cache_ttl": (
+        "duration", "30s",
+        "Lifetime of a negative-cache entry — the bound on how long a "
+        "newly-appearing series can be masked by a cached empty result."),
+    "query.fused_kernels": (
+        "str", "pallas",
+        "Fused compressed-resident kernel tier (ops/fusedresident.py): "
+        "off = composed two-step chain (grid kernel + segment reduce), "
+        "xla = one XLA-fused program per shape (lax.scan over the same "
+        "row tiles), pallas = single-pass Pallas kernels (interpret-mode "
+        "on CPU, compiled Mosaic on TPU)."),
     "query.max_concurrent_cost": (
         "int|null", None,
         "Aggregate estimated query cost (series x steps x window-steps) "
@@ -352,4 +368,7 @@ class Config:
             tenant_quotas=dict(q["tenant_quotas"] or {}),
             shed_retry_after_s=parse_duration_ms(
                 q["shed_retry_after"]) / 1000.0,
+            negative_cache_size=int(q["negative_cache_size"]),
+            negative_cache_ttl_s=parse_duration_ms(
+                q["negative_cache_ttl"]) / 1000.0,
         )

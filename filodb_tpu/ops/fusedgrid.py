@@ -38,6 +38,10 @@ from jax.experimental.pallas import tpu as pltpu
 from . import gridfns
 
 FUSED_FNS = {"rate", "increase", "delta"}
+# window-aggregation shapes of the fused tier (ISSUE 9): the same one-pass
+# select+decode+window+fold plan serves avg_over_time/sum_over_time-into-
+# reduce dashboards — closed band instead of the open one, cnt >= 1 presence
+FUSED_WINDOW_FNS = {"sum_over_time", "avg_over_time", "count_over_time"}
 FUSED_OPS = {"sum", "avg", "count", "group", "stddev", "stdvar"}
 
 
@@ -45,49 +49,48 @@ def _roundup(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                 Sb: int, Ca: int, Tp: int, G: int, narrow: bool, c0: int,
-                 *refs):
-    """``Ca`` is the streamed column width and ``c0`` its global offset into
-    the store: a sub-range query streams (and matmuls) only its active
-    columns (see active_columns); full-range queries have c0=0, Ca=C."""
-    if narrow:
-        (val_ref, vmin_ref, scl_ref, n_ref, gid_ref, band_ref, ohlo_ref,
-         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
-    else:
-        (val_ref, n_ref, gid_ref, band_ref, ohlo_ref,
-         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
-    i = pl.program_id(0)
-    is_counter = fn != "delta"
+def tile_contrib(fn: str, window_ms: int, interval_ms: int, c0: int,
+                 v, n, band, ohlo, lo, hi, rel, roll):
+    """Shared per-tile window math of the fused tier: decoded values
+    ``v [Sb, Ca]`` -> ``(contrib [Sb, Tp]`` with absent cells zeroed,
+    ``okf [Sb, Tp]`` presence as f32). ONE definition per tiling plan for
+    BOTH backends: the Pallas kernel body reads its VMEM refs and calls
+    this; the XLA-fused twin (ops/fusedresident.py) scans the same row
+    tiles through it — variant parity is by construction, not discipline.
+    ``roll`` abstracts the backend's shift primitive (pltpu.roll in-kernel,
+    jnp.roll in the scan); the wrapped column's garbage is masked either
+    way. ``band`` is the OPEN band for the rate family and the CLOSED band
+    for the window-aggregation fns (host_operands builds the right one)."""
     f32 = jnp.float32
-
-    if narrow:
-        # u16 mirror decode in VMEM (ops/narrow.py): q * 2^e is exact
-        # (q < 2^16, power-of-two scale) and vmin + d reproduces the f32
-        # value bit-exactly for rows the encoder verified — HALF the HBM
-        # bytes of the raw f32 store stream (ref: the reference decompresses
-        # NibblePack chunks on access for the same bandwidth reason)
-        # biased i16 mirror: stored x = q - 32768 for q = round((v-vmin)/2^e)
-        # in [0, 65535]; decode recovers q = x + 32768 (integers <= 65535 are
-        # exact in f32), then vmin + q * 2^e reproduces v bit-exactly for
-        # rows the encoder verified
-        v = (vmin_ref[:]
-             + (val_ref[:].astype(f32) + 32768.0) * scl_ref[:])  # [Sb, Ca]
-    else:
-        v = val_ref[:]                                        # [Sb, Ca]
-    n = n_ref[:]                                              # [Sb, 1] i32
+    Sb, Ca = v.shape
     lcol = jax.lax.broadcasted_iota(jnp.int32, (Sb, Ca), 1)
     col = lcol + c0                                           # global cell
     valid = col < n
     v = jnp.where(valid, v, 0.0)
 
+    last_cell = n - 1                                         # [Sb, 1]
+    f_idx = jnp.maximum(lo, 0)                                # [1, Tp]
+    l_idx = jnp.minimum(hi, last_cell)                        # [Sb, Tp]
+    cnt = jnp.maximum(l_idx - f_idx + 1, 0)
+    cnt_f = cnt.astype(f32)
+
+    if fn in FUSED_WINDOW_FNS:
+        ok = cnt >= 1
+        if fn == "count_over_time":
+            return jnp.where(ok, cnt_f, 0.0), ok.astype(f32)
+        s = jnp.dot(v, band, preferred_element_type=f32)      # closed band
+        if fn == "avg_over_time":
+            s = s / cnt_f
+        return jnp.where(ok, s, 0.0), ok.astype(f32)
+
+    is_counter = fn != "delta"
     # increments: valid cells are a prefix of each row, so cell c has a valid
     # predecessor exactly when c > 0 and c is valid; roll's column-0 wraparound
     # is masked out by that same condition. With a column offset the local
     # column 0 wraps to the slice's LAST column — its increment is garbage but
     # never consumed (band rows at/below the first window edge are zero);
     # zero it anyway so no value-dependent surprise can leak
-    prev = pltpu.roll(v, jnp.int32(1), 1)   # i32 shift: x64 mode would lower an i64 operand, which tpu.dynamic_rotate rejects
+    prev = roll(v)
     raw = v - prev
     inc = jnp.maximum(raw, 0.0) if is_counter else raw
     mask = valid & (col > 0)
@@ -95,22 +98,14 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
         mask &= lcol > 0
     inc = jnp.where(mask, inc, 0.0)
 
-    delta = jnp.dot(inc, band_ref[:], preferred_element_type=f32)   # [Sb, Tp]
-    f_v = jnp.dot(v, ohlo_ref[:], preferred_element_type=f32)
+    delta = jnp.dot(inc, band, preferred_element_type=f32)    # [Sb, Tp]
+    f_v = jnp.dot(v, ohlo, preferred_element_type=f32)
 
-    lo = lo_ref[:]                                            # [1, Tp] i32
-    hi = hi_ref[:]
-    rel = rel_ref[:].astype(f32)                              # [1, Tp]
-    last_cell = n - 1                                         # [Sb, 1]
-    f_idx = jnp.maximum(lo, 0)                                # [1, Tp]
-    l_idx = jnp.minimum(hi, last_cell)                        # [Sb, Tp]
-    cnt = jnp.maximum(l_idx - f_idx + 1, 0)
-    cnt_f = cnt.astype(f32)
-
+    relf = rel.astype(f32)                                    # [1, Tp]
     f_rel = (f_idx * interval_ms).astype(f32)
     l_rel = (l_idx * interval_ms).astype(f32)
-    dur_start = (f_rel - (rel - window_ms)) / 1000.0
-    dur_end = (rel - l_rel) / 1000.0
+    dur_start = (f_rel - (relf - window_ms)) / 1000.0
+    dur_end = (relf - l_rel) / 1000.0
     sampled = (l_rel - f_rel) / 1000.0
     avg_dur = sampled / (cnt_f - 1.0)
     if is_counter:
@@ -127,8 +122,47 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
         scaled = scaled * (1000.0 / window_ms)
 
     ok = cnt >= 2
-    contrib = jnp.where(ok, scaled, 0.0)
-    okf = ok.astype(f32)
+    return jnp.where(ok, scaled, 0.0), ok.astype(f32)
+
+
+def decode_narrow_tile(q, vmin, scale):
+    """u16 mirror decode (ops/narrow.py), shared by both fused backends: the
+    biased i16 mirror stores x = q - 32768 for q = round((v - vmin)/2^e) in
+    [0, 65535]; q * 2^e is exact (q < 2^16, power-of-two scale) and
+    vmin + q * 2^e reproduces the f32 value bit-exactly for rows the encoder
+    verified — HALF the HBM bytes of the raw f32 store stream (ref: the
+    reference decompresses NibblePack chunks on access for the same
+    bandwidth reason). Integers <= 65535 are exact in f32."""
+    return vmin + (q.astype(jnp.float32) + 32768.0) * scale
+
+
+def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
+                 Sb: int, Ca: int, Tp: int, G: int, narrow: bool, c0: int,
+                 *refs):
+    """``Ca`` is the streamed column width and ``c0`` its global offset into
+    the store: a sub-range query streams (and matmuls) only its active
+    columns (see active_columns); full-range queries have c0=0, Ca=C."""
+    if narrow:
+        (val_ref, vmin_ref, scl_ref, n_ref, gid_ref, band_ref, ohlo_ref,
+         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
+    else:
+        (val_ref, n_ref, gid_ref, band_ref, ohlo_ref,
+         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
+    i = pl.program_id(0)
+    f32 = jnp.float32
+
+    if narrow:
+        # decode in VMEM: see decode_narrow_tile
+        v = decode_narrow_tile(val_ref[:], vmin_ref[:], scl_ref[:])  # [Sb, Ca]
+    else:
+        v = val_ref[:]                                        # [Sb, Ca]
+    n = n_ref[:]                                              # [Sb, 1] i32
+    # i32 shift: x64 mode would lower an i64 operand, which
+    # tpu.dynamic_rotate rejects
+    contrib, okf = tile_contrib(
+        fn, window_ms, interval_ms, c0, v, n, band_ref[:], ohlo_ref[:],
+        lo_ref[:], hi_ref[:], rel_ref[:],
+        roll=lambda x: pltpu.roll(x, jnp.int32(1), 1))
 
     # per-group fold on the MXU: [G, Sb] one-hot x [Sb, Tp]
     gid = gid_ref[:]                                          # [Sb, 1] i32
@@ -230,17 +264,84 @@ def active_columns(C: int, lo: np.ndarray, hi: np.ndarray) -> tuple[int, int]:
     return 0, C
 
 
+def build_xla_tiles(fn: str, needs_sumsq: bool, window_ms: int,
+                    interval_ms: int, S: int, Sb: int, C: int, Tp: int,
+                    G: int, narrow: bool = False, c0: int = 0, Ck: int = 0):
+    """XLA-fused twin of :func:`build_pallas`, built from the SAME tiling
+    plan: one ``lax.scan`` walks the identical [Sb, Ca] row tiles through
+    the identical :func:`tile_contrib` math and accumulates the same [G, Tp]
+    partial state — one compiled program, intermediates bounded by one tile,
+    the [S, T] matrix never materializes in HBM. Selected per
+    ``query.fused_kernels`` (ops/fusedresident.py); signature-compatible
+    with build_pallas's returned call so the mesh route swaps them freely."""
+    f32 = jnp.float32
+    Ca = Ck if Ck else C
+    nt = S // Sb
+    dn = (((0,), (0,)), ((), ()))
+    roll = lambda x: jnp.roll(x, 1, axis=1)  # noqa: E731 — tile-local wrap,
+    # masked in tile_contrib exactly like pltpu.roll's
+
+    def fold(carry, xs, band, ohlo, lo, hi, rel):
+        if narrow:
+            # per-TILE decode, like the Pallas body's VMEM decode: the full
+            # [S, C] f32 block never materializes on this variant either
+            q_t, vmin_t, scl_t, n_t, g_t = xs
+            v = decode_narrow_tile(q_t, vmin_t, scl_t)
+        else:
+            v, n_t, g_t = xs
+        contrib, okf = tile_contrib(fn, window_ms, interval_ms, c0,
+                                    v, n_t, band, ohlo, lo, hi, rel, roll)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (Sb, G), 1)
+        oh = (gcol == g_t).astype(f32)
+        out = (carry[0] + jax.lax.dot_general(oh, contrib, dn,
+                                              preferred_element_type=f32),
+               carry[1] + jax.lax.dot_general(oh, okf, dn,
+                                              preferred_element_type=f32))
+        if needs_sumsq:
+            out += (carry[2] + jax.lax.dot_general(
+                oh, contrib * contrib, dn, preferred_element_type=f32),)
+        return out, None
+
+    def run_tiles(tiles, band, ohlo, lo, hi, rel):
+        init = tuple(jnp.zeros((G, Tp), f32)
+                     for _ in range(3 if needs_sumsq else 2))
+        outs, _ = jax.lax.scan(
+            lambda c, xs: fold(c, xs, band, ohlo, lo, hi, rel), init, tiles)
+        return outs
+
+    if narrow:
+        def call(q, vmin, scl, n2, g2, band, ohlo, lo, hi, rel):
+            tiles = (q[:, c0:c0 + Ca].reshape(nt, Sb, Ca),
+                     vmin.reshape(nt, Sb, 1), scl.reshape(nt, Sb, 1),
+                     n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1))
+            return run_tiles(tiles, band, ohlo, lo, hi, rel)
+    else:
+        def call(val, n2, g2, band, ohlo, lo, hi, rel):
+            # active columns sliced like the pallas block index map
+            tiles = (val[:, c0:c0 + Ca].reshape(nt, Sb, Ca),
+                     n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1))
+            return run_tiles(tiles, band, ohlo, lo, hi, rel)
+    return call
+
+
 def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
-                narrow: bool = False, c0: int = 0, Ck: int = 0):
+                narrow: bool = False, c0: int = 0, Ck: int = 0,
+                variant: str = "pallas"):
     """The compiled fused program via the explicit plan cache (query/
     plancache.py) — its key IS this signature: fn/op statics, the padded
-    [S, C, Tp, G] shape buckets, and the residency mode (``narrow``)."""
+    [S, C, Tp, G] shape buckets, the residency mode (``narrow``), and the
+    backend ``variant`` ("pallas" | "xla") — the two backends are distinct
+    compiled programs and cache as distinct kernel variants."""
     from ..query.plancache import plan_cache
 
     def build():
-        call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                            S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
+        if variant == "xla":
+            call = build_xla_tiles(fn, needs_sumsq, window_ms, interval_ms,
+                                   S, Sb, C, Tp, G, narrow, c0, Ck)
+        else:
+            call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                                S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
 
         # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
         # inside the jit — on a tunneled device every extra dispatch is a
@@ -260,45 +361,60 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     return plan_cache.program(
         "fused-grid",
         (fn, needs_sumsq, window_ms, interval_ms, S, Sb, C, Tp, G,
-         interpret, narrow, c0, Ck), build)
+         interpret, narrow, c0, Ck, variant), build)
 
 
-def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
-                  base_ts: int, interval_ms: int):
-    """Band/one-hot/edge operands as host arrays + active column range:
-    (band, ohlo, lo[1,Tp], hi[1,Tp], rel[1,Tp], c0, Ck) — shared by the
-    single-chip upload cache below and the mesh path (which replicates them
-    across shard devices). For a sub-range query the band/ohlo rows are
-    sliced to the active [c0, c0+Ck*128) columns (the tiled kernel streams
-    only those store tiles); full-range queries keep [C, Tp] operands."""
-    T = len(out_ts)
-    lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
-    rel = out_ts - base_ts
+def pad_edges(lo: np.ndarray, hi: np.ndarray, rel: np.ndarray,
+              window_ms: int, Tp: int):
+    """Step-edge operands padded to the kernel's Tp grid as [1, Tp] i32:
+    lo zero-padded, hi padded with -1 (an empty window — cnt clamps to 0
+    so padded steps contribute nothing), rel zero-padded. One definition
+    for every fused tier (scalar here, hist in ops/fusedresident.py) —
+    the sentinel values are kernel contracts, not formatting."""
+    T = len(rel)
     assert abs(rel).max(initial=0) < 2**31 and window_ms < 2**31
     lo_p = np.zeros(Tp, np.int32); lo_p[:T] = lo
     hi_p = np.full(Tp, -1, np.int32); hi_p[:T] = hi
     rel_p = np.zeros(Tp, np.int32); rel_p[:T] = rel
+    return (lo_p.reshape(1, Tp), hi_p.reshape(1, Tp), rel_p.reshape(1, Tp))
+
+
+def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
+                  base_ts: int, interval_ms: int, fn_kind: str = "rate"):
+    """Band/one-hot/edge operands as host arrays + active column range:
+    (band, ohlo, lo[1,Tp], hi[1,Tp], rel[1,Tp], c0, Ck) — shared by the
+    single-chip upload cache below and the mesh path (which replicates them
+    across shard devices). For a sub-range query the band/ohlo rows are
+    sliced to the active [c0, c0+Ck) columns (the tiled kernel streams
+    only those store tiles); full-range queries keep [C, Tp] operands.
+    ``fn_kind`` picks the band form: "rate" builds the OPEN band the
+    increment matmul needs, "window" the CLOSED band of the *_over_time
+    fns (tile_contrib consumes whichever matches its fn)."""
+    T = len(out_ts)
+    lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
+    rel = out_ts - base_ts
+    lo_p, hi_p, rel_p = pad_edges(lo, hi, rel, window_ms, Tp)
     band = np.zeros((C, Tp), np.float32)
-    band[:, :T] = gridfns.band_matrix(C, lo, hi, True, np.float32)
+    band[:, :T] = gridfns.band_matrix(C, lo, hi, fn_kind == "rate",
+                                      np.float32)
     ohlo = np.zeros((C, Tp), np.float32)
     ohlo[:, :T] = gridfns.onehot_matrix(C, np.maximum(lo, 0), np.float32)
     c0, Ca = active_columns(C, lo, hi)
     if Ca < C:
         band = np.ascontiguousarray(band[c0:c0 + Ca])
         ohlo = np.ascontiguousarray(ohlo[c0:c0 + Ca])
-    return (band, ohlo, lo_p.reshape(1, Tp), hi_p.reshape(1, Tp),
-            rel_p.reshape(1, Tp), c0, Ca)
+    return (band, ohlo, lo_p, hi_p, rel_p, c0, Ca)
 
 
 @functools.lru_cache(maxsize=32)
 def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
-                     base_ts: int, interval_ms: int):
+                     base_ts: int, interval_ms: int, fn_kind: str = "rate"):
     """Band/one-hot/edge operands on device, cached per query shape — the
     upload matters: repeated host->device transfers of the [C, Tp] bands per
     row-batch would dominate over a tunneled device link."""
     out_ts = np.frombuffer(out_ts_key, np.int64)
     *arrs, c0, Ck = host_operands(C, Tp, out_ts, window_ms, base_ts,
-                                  interval_ms)
+                                  interval_ms, fn_kind)
     return tuple(jnp.asarray(a) for a in arrs) + (c0, Ck)
 
 
@@ -347,7 +463,7 @@ class PaddedPartials:
 def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
                          out_ts: np.ndarray, window_ms: int,
                          base_ts: int, interval_ms: int, fetch: bool = True,
-                         narrow=None):
+                         narrow=None, variant: str = "pallas"):
     """One-pass ``op(fn(metric[window]))`` partials over a grid-aligned block.
 
     val [S, C] f32 (S a multiple of 512 or a power of two), n [S] i32 valid
@@ -360,7 +476,7 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     HBM bytes; the caller must already have zeroed ``n`` for rows whose
     mirror is not bit-exact.
     """
-    assert fn in FUSED_FNS and op in FUSED_OPS
+    assert fn in FUSED_FNS | FUSED_WINDOW_FNS and op in FUSED_OPS
     S, C = val.shape
     T = len(out_ts)
     assert fusable(S, C, T, num_groups), (S, C, T, num_groups)
@@ -370,13 +486,14 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
 
     band, ohlo, lo_d, hi_d, rel_d, c0, Ck = _device_operands(
         C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
-        int(window_ms), int(base_ts), int(interval_ms))
+        int(window_ms), int(base_ts), int(interval_ms),
+        "window" if fn in FUSED_WINDOW_FNS else "rate")
 
     needs_sumsq = op in ("stddev", "stdvar")
     interpret = jax.default_backend() != "tpu"
     call = _build_call(fn, needs_sumsq, int(window_ms), int(interval_ms),
                        S, Sb, C, Tp, G, interpret, narrow is not None,
-                       c0, Ck)
+                       c0, Ck, variant)
     # the framework runs with x64 on (int64 timestamps); Mosaic rejects the
     # i64 scalars x64 tracing injects (grid index maps, roll shifts), and the
     # kernel itself is pure f32/i32 — so trace the call with x64 off

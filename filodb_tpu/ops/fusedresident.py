@@ -1,0 +1,446 @@
+"""Fused compressed-resident query kernels: the single-pass execution tier.
+
+Reference role: the reference FiloDB's performance core is hand-rolled
+columnar kernels (NibblePack, 2D-delta, XOR) that compute ON compressed data
+in place — select, decode, window function, and aggregation run as one
+iterator chain per chunk (PAPER.md §0; doc/compression.md). This module is
+the TPU analog for the top query shapes: delta reconstruction, bucket-cumsum
+commutation, the range function, and the segment reduce execute as ONE
+device program per shape, with no intermediate f32 materialization of the
+decoded store — per-tile state lives in registers/VMEM.
+
+The registry below keys three fused shapes, each implemented TWICE from the
+same tiling plan and selected at plan time by ``query.fused_kernels``:
+
+  shape           query pattern                       tile math shared by
+  --------------  ----------------------------------  --------------------
+  rate_sum        sum/avg/...(rate|increase|delta)    fusedgrid.tile_contrib
+  window_reduce   sum/...(avg_over_time|sum_over_time fusedgrid.tile_contrib
+                  |count_over_time)
+  hist_quantile   histogram_quantile(q, sum(fn(h[w])) hist_tile_contrib
+                  over i8/i16 2D-delta-resident blocks  (this module)
+
+Backends per shape:
+  * ``pallas`` — a Pallas kernel streaming [Sb, ...] row tiles; on CPU it
+    runs under ``pl.pallas_call(..., interpret=True)`` so tier-1 exercises
+    the real kernel body, and the compiled Mosaic path lights up on TPU.
+  * ``xla`` — an XLA-fused fallback built from the SAME tiling plan: one
+    ``lax.scan`` walks the identical tiles through the identical tile math
+    (variant parity by construction). This is also the portable path for
+    backends without Pallas.
+  * ``off`` — the composed two-step chain (grid kernel + segment reduce
+    with the intermediate [S, T(,B)] matrix), the A/B baseline.
+
+Both variants of a shape are DISTINCT kernel variants in the process-global
+compiled-plan cache (query/plancache.py): the variant name is part of the
+key, so switching modes never aliases programs and warmup covers whichever
+variant will serve.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.metrics import (FILODB_QUERY_FUSED_FALLBACK,
+                             FILODB_QUERY_FUSED_SERVED, registry)
+from . import fusedgrid, gridfns
+
+MODES = ("off", "xla", "pallas")
+
+# process-global execution mode, like the plan cache and the tracer: every
+# serving path (in-process exec, fused-hist engine route, mesh collectives,
+# warmup) must agree on the variant or warm programs would miss at serve
+# time. Set once at startup from ``query.fused_kernels`` (standalone.py);
+# tests flip it under try/finally.
+_mode: str = "pallas"
+
+HIST_FUSED_FNS = frozenset({"rate", "increase", "delta"})
+MAX_BUCKETS = 64    # [Sb, C, B] tile + [G, Tp*B] accumulators stay in VMEM
+
+# the declarative registry: shape name -> (window fns, reduce ops) it serves.
+# exec.py / engine.py consult it for plan-time eligibility; the bench suite
+# and warmup iterate it so every shape is covered by measurement and
+# pre-tracing alike.
+FUSED_SHAPES = {
+    "rate_sum": (frozenset(fusedgrid.FUSED_FNS),
+                 frozenset(fusedgrid.FUSED_OPS)),
+    "window_reduce": (frozenset(fusedgrid.FUSED_WINDOW_FNS),
+                      frozenset(fusedgrid.FUSED_OPS)),
+    "hist_quantile": (HIST_FUSED_FNS, frozenset({"sum"})),
+}
+
+
+def mode() -> str:
+    """The active fused-kernel mode ("off" | "xla" | "pallas")."""
+    return _mode
+
+
+def set_mode(m: str) -> None:
+    """Select the fused-kernel tier (config: ``query.fused_kernels``)."""
+    global _mode
+    if m not in MODES:
+        raise ValueError(f"query.fused_kernels must be one of {MODES}, "
+                         f"got {m!r}")
+    _mode = m
+
+
+def scalar_shape_of(fn: str) -> str | None:
+    """Registry shape serving a scalar window fn, or None."""
+    if fn in fusedgrid.FUSED_FNS:
+        return "rate_sum"
+    if fn in fusedgrid.FUSED_WINDOW_FNS:
+        return "window_reduce"
+    return None
+
+
+def count_served(shape: str) -> None:
+    registry.counter(FILODB_QUERY_FUSED_SERVED,
+                     {"shape": shape, "mode": _mode}).increment()
+
+
+def count_fallback(shape: str) -> None:
+    """A query matched a fused shape but fell back to the composed path
+    (shape gate, group cap, off-grid store, ...)."""
+    registry.counter(FILODB_QUERY_FUSED_FALLBACK, {"shape": shape}).increment()
+
+
+# ---------------------------------------------------------------------------
+# scalar shapes (rate_sum / window_reduce): thin mode dispatch over the two
+# backends that share ops/fusedgrid.tile_contrib and its tiling plan
+# ---------------------------------------------------------------------------
+
+def scalar_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
+                     out_ts: np.ndarray, window_ms: int, base_ts: int,
+                     interval_ms: int, fetch: bool = True, narrow=None):
+    """Mode-routed one-pass ``op(fn(metric[w]))`` partials (see
+    fusedgrid.fused_grid_aggregate for operand contracts). Caller checked
+    eligibility and guarantees ``mode() != "off"``."""
+    assert _mode != "off"
+    out = fusedgrid.fused_grid_aggregate(
+        op, fn, val, n, gids, num_groups, out_ts, window_ms, base_ts,
+        interval_ms, fetch=fetch, narrow=narrow, variant=_mode)
+    count_served(scalar_shape_of(fn) or "rate_sum")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hist_quantile: fused histogram_quantile over i8/i16 2D-delta-resident
+# [S, C, B] blocks — the narrow dd state streams through static matmuls and
+# ONE bucket cumsum per tile; the decoded f32 store never exists
+# ---------------------------------------------------------------------------
+
+_roundup = fusedgrid._roundup
+
+
+def hist_fusable(S: int, C: int, T: int, B: int, num_groups: int) -> bool:
+    """Shape gate: per-tile operands + [G, Tp*B] accumulators stay in VMEM.
+    Unlike the scalar tier there is no active-column slicing: the quantile's
+    first-sample prefix bands need every column from cell 0."""
+    return (C <= fusedgrid.MAX_CAPACITY
+            and _roundup(max(T, 1), 128) * B <= fusedgrid.MAX_STEPS * 8
+            and num_groups <= fusedgrid.MAX_GROUPS
+            and 0 < B <= MAX_BUCKETS
+            and (S % 512 == 0 or (S <= 512 and S % 8 == 0)))
+
+
+def hist_tile_contrib(fn: str, window_ms: int, interval_ms: int, B: int,
+                      ddf, first_d, n, band_open, prefix_lo, lo, hi, rel):
+    """Shared per-tile math of the hist_quantile shape: the decoded 2D-delta
+    tile ``ddf [Sb, Ca, B]`` (+ ``first_d [Sb, B]`` first-frame bucket
+    deltas, ``n [Sb, 1]`` valid counts) -> ``(contrib, okf)`` both
+    ``[Sb, Tp*B]`` flat in the aggregators layout (t*B + b). Both backends
+    call this — the Pallas body on VMEM refs, the XLA twin inside its scan.
+
+    The bucket-cumsum commutation (ops/gridfns.py narrow-hist notes): the
+    window delta of cumulative buckets equals ``cumsum_b(dd @ band_open)``
+    and the first-sample value ``F + cumsum_b(dd @ prefix_lo)`` — every
+    reduction is LINEAR in the frames, so the per-tile matmuls read the
+    NARROW dd encoding directly and the per-(series, step) extrapolation
+    algebra is identical to _grid_hist_kernel_narrow elementwise."""
+    f32 = jnp.float32
+    Sb, Ca, _B = ddf.shape
+    Tp = band_open.shape[1]
+    flat = ddf.transpose(0, 2, 1).reshape(Sb * B, Ca)         # [Sb*B, Ca]
+    delta = jnp.cumsum(
+        jnp.dot(flat, band_open, preferred_element_type=f32)
+        .reshape(Sb, B, Tp), axis=1)                          # [Sb, B, Tp]
+    F = jnp.cumsum(first_d, axis=1)                           # [Sb, B]
+    f_v = F[:, :, None] + jnp.cumsum(
+        jnp.dot(flat, prefix_lo, preferred_element_type=f32)
+        .reshape(Sb, B, Tp), axis=1)
+
+    last_cell = n - 1                                         # [Sb, 1]
+    f_idx = jnp.maximum(lo, 0)                                # [1, Tp]
+    l_idx = jnp.minimum(hi, last_cell)                        # [Sb, Tp]
+    cnt = jnp.maximum(l_idx - f_idx + 1, 0)
+    cnt_f = cnt.astype(f32)
+    relf = rel.astype(f32)
+    f_rel = (f_idx * interval_ms).astype(f32)
+    l_rel = (l_idx * interval_ms).astype(f32)
+    dur_start = (f_rel - (relf - window_ms)) / 1000.0         # [Sb, Tp]
+    dur_end = (relf - l_rel) / 1000.0
+    sampled = (l_rel - f_rel) / 1000.0
+    avg_dur = sampled / (cnt_f - 1.0)
+    thresh = avg_dur * 1.1
+    if fn != "delta":
+        # per-bucket counter zero-clamp — same expressions as the composed
+        # narrow kernel (_grid_hist_kernel_narrow), per tile
+        dur_zero = jnp.where(delta > 0,
+                             sampled[:, None, :] * (f_v / delta), jnp.inf)
+        ds = jnp.broadcast_to(dur_start[:, None, :], delta.shape)
+        ds = jnp.where((delta > 0) & (f_v >= 0) & (dur_zero < ds),
+                       dur_zero, ds)
+        extrap = sampled[:, None, :] \
+            + jnp.where(ds < thresh[:, None, :], ds,
+                        avg_dur[:, None, :] / 2) \
+            + jnp.where(dur_end[:, None, :] < thresh[:, None, :],
+                        dur_end[:, None, :], avg_dur[:, None, :] / 2)
+        factor = extrap / sampled[:, None, :]
+    else:
+        extrap = sampled \
+            + jnp.where(dur_start < thresh, dur_start, avg_dur / 2) \
+            + jnp.where(dur_end < thresh, dur_end, avg_dur / 2)
+        factor = (extrap / sampled)[:, None, :]
+    scaled = delta * factor
+    if fn == "rate":
+        scaled = scaled * (1000.0 / window_ms)
+
+    ok = cnt >= 2                                             # [Sb, Tp]
+    contrib = jnp.where(ok[:, None, :], scaled, 0.0)          # [Sb, B, Tp]
+    okb = jnp.broadcast_to(ok[:, None, :], contrib.shape).astype(f32)
+    # aggregators layout: [G, T*B] with flat index t*B + b
+    return (contrib.transpose(0, 2, 1).reshape(Sb, Tp * B),
+            okb.transpose(0, 2, 1).reshape(Sb, Tp * B))
+
+
+def _hist_fold(Sb: int, G: int, gid, contrib, okf):
+    """Per-group fold of one tile's flat [Sb, Tp*B] contributions on the
+    MXU — identical in both backends."""
+    f32 = jnp.float32
+    gcol = jax.lax.broadcasted_iota(jnp.int32, (Sb, G), 1)
+    oh = (gcol == gid).astype(f32)
+    dn = (((0,), (0,)), ((), ()))
+    return (jax.lax.dot_general(oh, contrib, dn, preferred_element_type=f32),
+            jax.lax.dot_general(oh, okf, dn, preferred_element_type=f32))
+
+
+def _hist_kernel_body(fn: str, window_ms: int, interval_ms: int, Sb: int,
+                      Ca: int, Tp: int, B: int, G: int,
+                      dd_ref, fd_ref, n_ref, gid_ref, band_ref, plo_ref,
+                      lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref):
+    i = pl.program_id(0)
+    ddf = dd_ref[:].astype(jnp.float32)        # i8/i16 decode in VMEM
+    contrib, okf = hist_tile_contrib(fn, window_ms, interval_ms, B,
+                                     ddf, fd_ref[:], n_ref[:], band_ref[:],
+                                     plo_ref[:], lo_ref[:], hi_ref[:],
+                                     rel_ref[:])
+    psum, pcnt = _hist_fold(Sb, G, gid_ref[:], contrib, okf)
+
+    @pl.when(i == 0)
+    def _():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+    sum_ref[:] += psum
+    cnt_ref[:] += pcnt
+
+
+@functools.lru_cache(maxsize=32)
+def build_hist_pallas(fn: str, window_ms: int, interval_ms: int, S: int,
+                      Sb: int, C: int, Tp: int, B: int, G: int,
+                      interpret: bool):
+    """The raw (traceable) fused hist-quantile map-phase pallas_call: grid
+    over [Sb] row tiles of the [S, C, B] dd block, [G, Tp*B] partial-state
+    accumulators resident in VMEM across the sequential grid. The compiled
+    (non-interpret) path targets TPU with the lane-dim caveat documented in
+    COMPONENTS.md (B rides the minor axis of the tile; pad B to the lane
+    multiple on real hardware when Mosaic requires it)."""
+    body = functools.partial(_hist_kernel_body, fn, window_ms, interval_ms,
+                             Sb, C, Tp, B, G)
+    acc = pl.BlockSpec((G, Tp * B), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    const = functools.partial(pl.BlockSpec, index_map=lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+    row = lambda shape: pl.BlockSpec(shape, lambda i: (i, 0),  # noqa: E731
+                                     memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((Sb, C, B), lambda i: (i, 0, 0),
+                     memory_space=pltpu.VMEM),                  # dd
+        row((Sb, B)),                                           # first_d
+        row((Sb, 1)), row((Sb, 1)),                             # n, gid
+        const((C, Tp)), const((C, Tp)),                         # bands
+        const((1, Tp)), const((1, Tp)), const((1, Tp)),         # lo, hi, rel
+    ]
+    return pl.pallas_call(
+        body,
+        grid=(S // Sb,),
+        in_specs=in_specs,
+        out_specs=(acc, acc),
+        out_shape=tuple(jax.ShapeDtypeStruct((G, Tp * B), jnp.float32)
+                        for _ in range(2)),
+        interpret=interpret,
+    )
+
+
+def build_hist_xla_tiles(fn: str, window_ms: int, interval_ms: int, S: int,
+                         Sb: int, C: int, Tp: int, B: int, G: int):
+    """XLA-fused twin of :func:`build_hist_pallas` from the same tiling
+    plan: lax.scan over the identical [Sb, C, B] tiles through the identical
+    hist_tile_contrib + fold; intermediates bounded by one tile."""
+    f32 = jnp.float32
+    nt = S // Sb
+
+    def call(dd, first_d, n2, g2, band, plo, lo, hi, rel):
+        tiles = (dd.reshape(nt, Sb, C, B), first_d.reshape(nt, Sb, B),
+                 n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1))
+
+        def fold(carry, xs):
+            dd_t, fd_t, n_t, g_t = xs
+            contrib, okf = hist_tile_contrib(
+                fn, window_ms, interval_ms, B, dd_t.astype(f32), fd_t, n_t,
+                band, plo, lo, hi, rel)
+            psum, pcnt = _hist_fold(Sb, G, g_t, contrib, okf)
+            return (carry[0] + psum, carry[1] + pcnt), None
+
+        init = (jnp.zeros((G, Tp * B), f32), jnp.zeros((G, Tp * B), f32))
+        outs, _ = jax.lax.scan(fold, init, tiles)
+        return outs
+
+    return call
+
+
+def _hist_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
+                   base_ts: int, interval_ms: int):
+    """Host operand build for the hist tier: open band for window deltas,
+    prefix band selecting v at the lo cells (cells [1..l0] — needs every
+    column from 0, hence no active-column slicing here), padded edges."""
+    T = len(out_ts)
+    lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
+    rel = out_ts - base_ts
+    lo_p, hi_p, rel_p = fusedgrid.pad_edges(lo, hi, rel, window_ms, Tp)
+    band = np.zeros((C, Tp), np.float32)
+    band[:, :T] = gridfns.band_matrix(C, lo, hi, True, np.float32)
+    l0 = np.maximum(lo, 0)
+    plo = np.zeros((C, Tp), np.float32)
+    plo[:, :T] = gridfns.band_matrix(C, np.zeros(T, np.int64),
+                                     np.minimum(l0, C - 1), True, np.float32)
+    return (band, plo, lo_p, hi_p, rel_p)
+
+
+@functools.lru_cache(maxsize=32)
+def _hist_device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
+                          base_ts: int, interval_ms: int):
+    out_ts = np.frombuffer(out_ts_key, np.int64)
+    return tuple(jnp.asarray(a) for a in _hist_operands(
+        C, Tp, out_ts, window_ms, base_ts, interval_ms))
+
+
+def _hist_map_program(variant: str, fn: str, window_ms: int, interval_ms: int,
+                      S: int, Sb: int, C: int, Tp: int, B: int, G: int,
+                      dd_dtype: str):
+    """The cached map-phase program (variant is part of the key: the two
+    backends are distinct compiled kernels). Wrapped so dtype casts and
+    [S] -> [S, 1] reshapes ride the one dispatch."""
+    from ..query.plancache import plan_cache
+
+    def build():
+        if variant == "xla":
+            call = build_hist_xla_tiles(fn, window_ms, interval_ms,
+                                        S, Sb, C, Tp, B, G)
+        else:
+            call = build_hist_pallas(fn, window_ms, interval_ms, S, Sb, C,
+                                     Tp, B, G,
+                                     jax.default_backend() != "tpu")
+
+        def wrapped(dd, first_d, n, gids, band, plo, lo, hi, rel):
+            return call(dd, first_d,
+                        n.astype(jnp.int32).reshape(S, 1),
+                        gids.astype(jnp.int32).reshape(S, 1),
+                        band, plo, lo, hi, rel)
+        return wrapped
+
+    return plan_cache.program(
+        "fusedres-hist",
+        (variant, fn, window_ms, interval_ms, S, Sb, C, Tp, B, G, dd_dtype),
+        build)
+
+
+def _hist_finish_program(G: int, T: int, Tp: int, B: int, has_corr: bool,
+                         nles: int):
+    """The shared finish: slice the padded [G, Tp*B] partials to the true
+    steps, fold the cohort-pool correction partials in, mask empty groups,
+    and run the f64 Prometheus quantile — numerically identical to the
+    composed narrow path's finish (same histogram_quantile program)."""
+    from ..query.plancache import plan_cache
+
+    def build():
+        def fin(q, les, psum, pcnt, corr_sum, corr_cnt):
+            ps = psum.reshape(G, Tp, B)[:, :T, :].reshape(G, T * B)
+            pc = pcnt.reshape(G, Tp, B)[:, :T, :].reshape(G, T * B)
+            if has_corr:
+                ps = ps + corr_sum
+                pc = pc + corr_cnt
+            summed = jnp.where(pc == 0, jnp.nan, ps)
+            return gridfns.histogram_quantile(q, les,
+                                              summed.reshape(G, T, B))
+        return fin
+
+    return plan_cache.program("fusedres-hist-finish",
+                              (G, T, Tp, B, has_corr, nles), build)
+
+
+def fused_hist_quantile_resident(q: float, les, dd, first_d, n, gids,
+                                 num_groups: int, out_ts: np.ndarray,
+                                 window_ms: int, fn: str, base_ts: int,
+                                 interval_ms: int, corr=None,
+                                 variant: str | None = None):
+    """histogram_quantile(q, sum by(...)(fn(m[w]))) over a hist-resident
+    store, map phase per the active mode: per-bucket window deltas, group
+    fold, and quantile with the [S, C, B] f32 decode never materialized.
+    ``corr=(sum, cnt)`` carries cohort-pool rows' partials ([G, T*B], those
+    rows' gids excluded here). Returns the [G, T] device array."""
+    assert fn in HIST_FUSED_FNS
+    S, C, B = dd.shape
+    T = len(out_ts)
+    G = _roundup(max(num_groups, 8), 8)
+    assert hist_fusable(S, C, T, B, G), (S, C, T, B, G)
+    Tp = _roundup(max(T, 1), 128)
+    Sb = 512 if S % 512 == 0 else S
+    variant = variant or _mode
+    assert variant in ("xla", "pallas")
+
+    band, plo, lo_d, hi_d, rel_d = _hist_device_operands(
+        C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
+        int(window_ms), int(base_ts), int(interval_ms))
+    prog = _hist_map_program(variant, fn, int(window_ms), int(interval_ms),
+                             S, Sb, C, Tp, B, G, str(dd.dtype))
+    # x64 tracing injects i64 scalars Mosaic rejects (grid index maps); the
+    # map phase is pure f32/i32 — trace it with x64 off, exactly like the
+    # scalar fused tier. The f64 quantile finish traces under default x64.
+    from ..utils import enable_x64
+    with enable_x64(False):
+        psum, pcnt = prog(dd, first_d, jnp.asarray(n), jnp.asarray(gids),
+                          band, plo, lo_d, hi_d, rel_d)
+    if corr is None:
+        z = jnp.zeros((G, T * B), jnp.float32)
+        corr_sum = corr_cnt = z
+        has_corr = False
+    else:
+        corr_sum, corr_cnt = corr
+        if corr_sum.shape[0] != G:
+            # the engine builds corr partials at its pow2 group bucket,
+            # which sits below this kernel's 8-aligned G for small group
+            # counts — pad with empty groups (they are masked by pc == 0
+            # and sliced off by the caller's [:num_groups_true])
+            pad = ((0, G - corr_sum.shape[0]), (0, 0))
+            corr_sum = jnp.pad(corr_sum, pad)
+            corr_cnt = jnp.pad(corr_cnt, pad)
+        has_corr = True
+    fin = _hist_finish_program(G, T, Tp, B, has_corr, int(les.shape[0]))
+    return fin(jnp.float64(q), jnp.asarray(les), psum, pcnt,
+               corr_sum, corr_cnt)

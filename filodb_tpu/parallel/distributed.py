@@ -343,23 +343,43 @@ def _dist_topk_impl(fn: str, k: int, bottom: bool, num_groups: int,
     )(slot_tvn, slot_gids)
 
 
+def _fused_map_call(fn: str, needs_sumsq: bool, window_ms: int,
+                    interval_ms: int, S: int, Sb: int, C: int, Tp: int,
+                    G: int, narrow: bool, c0: int, Ck: int, variant: str):
+    """The per-shard fused map-phase program by backend variant — the
+    Pallas kernel or its XLA-fused scan twin (same tiling plan, same
+    tile_contrib math; ops/fusedgrid.py). ``query.fused_kernels`` picks it
+    and the variant rides the dist program's plan-cache key."""
+    if variant == "xla":
+        return fusedgrid.build_xla_tiles(fn, needs_sumsq, window_ms,
+                                         interval_ms, S, Sb, C, Tp, G,
+                                         narrow=narrow, c0=c0, Ck=Ck)
+    return fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
+                                  S, Sb, C, Tp, G,
+                                  jax.default_backend() != "tpu",
+                                  narrow=narrow, c0=c0, Ck=Ck)
+
+
 def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
                          fn: str, op: str, num_groups: int, mesh: Mesh,
                          window_ms: int, interval_ms: int,
-                         S: int, C: int, Tp: int, c0: int = 0, Ck: int = 0):
+                         S: int, C: int, Tp: int, c0: int = 0, Ck: int = 0,
+                         variant: str = "pallas"):
     return _dist_program(
         "dist-fused",
-        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck),
+        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck,
+         variant),
         tuple(str(v.dtype) for v in slot_vals),
         lambda: functools.partial(_dist_fused_aggregate_impl, fn, op,
                                   num_groups, mesh, window_ms, interval_ms,
-                                  S, C, Tp, c0, Ck)
+                                  S, C, Tp, c0, Ck, variant)
     )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
 def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
                                window_ms: int, interval_ms: int,
                                S: int, C: int, Tp: int, c0: int, Ck: int,
+                               variant: str,
                                slot_vals, slot_ns, slot_gids, band, ohlo,
                                lo, hi, rel):
     """Fused single-pass map phase on every resident slot block + psum of the
@@ -371,10 +391,8 @@ def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
     slot, partials summed locally before the collective."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
-    call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                                  S, Sb, C, Tp, num_groups,
-                                  jax.default_backend() != "tpu",
-                                  c0=c0, Ck=Ck)
+    call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
+                           S, Sb, C, Tp, num_groups, False, c0, Ck, variant)
 
     def per_device(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel):
         outs = None
@@ -406,14 +424,15 @@ def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
                                 fn: str, op: str, num_groups: int, mesh: Mesh,
                                 window_ms: int, interval_ms: int,
                                 S: int, C: int, Tp: int, c0: int = 0,
-                                Ck: int = 0):
+                                Ck: int = 0, variant: str = "pallas"):
     return _dist_program(
         "dist-fused-narrow",
-        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck),
+        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck,
+         variant),
         tuple(str(q.dtype) for q in slot_qs),
         lambda: functools.partial(_dist_fused_narrow_impl, fn, op,
                                   num_groups, mesh, window_ms, interval_ms,
-                                  S, C, Tp, c0, Ck)
+                                  S, C, Tp, c0, Ck, variant)
     )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
       band, ohlo, lo, hi, rel)
 
@@ -421,19 +440,18 @@ def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
 def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
                             window_ms: int, interval_ms: int,
                             S: int, C: int, Tp: int, c0: int, Ck: int,
+                            variant: str,
                             slot_qs, slot_vmins, slot_scales, slot_ns,
                             slot_gids, band, ohlo, lo, hi, rel):
     """Narrow twin of :func:`dist_fused_aggregate`: every shard's resident
-    i16 quantized state streams straight through the fused Pallas kernel
+    i16 quantized state streams straight through the fused map kernel
     (half the HBM bytes, decode in VMEM — ops/narrow.py) and the partial
     state psums over the shard axis. Compressed-resident stores stay
     mesh-eligible without ever materializing their f32 blocks."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
-    call = fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                                  S, Sb, C, Tp, num_groups,
-                                  jax.default_backend() != "tpu",
-                                  narrow=True, c0=c0, Ck=Ck)
+    call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
+                           S, Sb, C, Tp, num_groups, True, c0, Ck, variant)
 
     def per_device(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
                    band, ohlo, lo, hi, rel):
@@ -516,8 +534,12 @@ class MeshQueryExecutor:
         slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
         G = _pow2(num_groups)
         S, C, T = self.dstore.S, self.dstore.C, len(out_ts)
+        from ..ops import fusedresident
+        variant = fusedresident.mode()
         grid = (self._fused_grid()
-                if fn in fusedgrid.FUSED_FNS and op in fusedgrid.FUSED_OPS
+                if variant != "off"
+                and fn in fusedgrid.FUSED_FNS | fusedgrid.FUSED_WINDOW_FNS
+                and op in fusedgrid.FUSED_OPS
                 and fusedgrid.fusable(S, C, T, G) else None)
         if grid is not None:
             base_ts, interval_ms = grid
@@ -526,7 +548,8 @@ class MeshQueryExecutor:
             # dominate on a tunneled device link (same cache as single-chip)
             band, ohlo, lo, hi, rel, c0, Ck = fusedgrid._device_operands(
                 C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
-                int(window_ms), base_ts, int(interval_ms))
+                int(window_ms), base_ts, int(interval_ms),
+                "window" if fn in fusedgrid.FUSED_WINDOW_FNS else "rate")
             # narrow-resident shards stream their i16 state through the
             # fused kernel; stores with cohort-pool rows (or raw residency)
             # feed it the f32 view instead (a transient decode per shard
@@ -542,7 +565,7 @@ class MeshQueryExecutor:
                         tuple(t[3] for t in narrow),
                         slot_gids, band, ohlo, lo, hi, rel,
                         fn, op, G, self.dstore.mesh, int(window_ms),
-                        int(interval_ms), S, C, Tp, c0, Ck)
+                        int(interval_ms), S, C, Tp, c0, Ck, variant)
                 else:
                     slot_vn = tuple(self.dstore.value_arrays())
                     out = dist_fused_aggregate(
@@ -550,8 +573,14 @@ class MeshQueryExecutor:
                         tuple(t[1] for t in slot_vn),
                         slot_gids, band, ohlo, lo, hi, rel,
                         fn, op, G, self.dstore.mesh, int(window_ms),
-                        int(interval_ms), S, C, Tp, c0, Ck)
-            self.last_path = "fused-narrow" if narrow is not None else "fused"
+                        int(interval_ms), S, C, Tp, c0, Ck, variant)
+            fusedresident.count_served(
+                fusedresident.scalar_shape_of(fn) or "rate_sum")
+            # exec-path keeps the historical "fused"/"fused-narrow" names
+            # for the default pallas backend; the xla twin is suffixed
+            sfx = "" if variant == "pallas" else "-xla"
+            self.last_path = ("fused-narrow" if narrow is not None
+                              else "fused") + sfx
             res = LazyMeshResult(out, num_groups, T)
             return res.resolve() if fetch else res
         slot_tvn = tuple(self.dstore.arrays())

@@ -23,6 +23,8 @@ import numpy as np
 from ..core.memstore import TimeSeriesMemStore
 from ..parallel.shardmapper import ShardMapper
 from ..utils.metrics import (FILODB_QUERY_LATENCY_MS,
+                             FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS,
+                             FILODB_QUERY_NEGATIVE_CACHE_HITS,
                              FILODB_QUERY_RESULT_CACHE_EVICTIONS,
                              FILODB_QUERY_RESULT_CACHE_HITS,
                              FILODB_QUERY_RESULT_CACHE_INVALIDATIONS,
@@ -128,6 +130,11 @@ class QueryConfig:
     tenant_quotas: dict = field(default_factory=dict)
     # Retry-After hint on an admission shed (query.shed_retry_after)
     shed_retry_after_s: float = 1.0
+    # TTL+size-bounded NEGATIVE result cache for provably-empty selections
+    # (query.negative_cache_size / query.negative_cache_ttl; 0 disables —
+    # the library default; FiloServer turns it on from config)
+    negative_cache_size: int = 0
+    negative_cache_ttl_s: float = 30.0
 
 
 class QueryResultCache:
@@ -217,6 +224,95 @@ class QueryResultCache:
                     "invalidations": self._invalidations.value}
 
 
+class NegativeResultCache:
+    """TTL- and size-bounded cache of query texts whose selection came back
+    EMPTY (0 series): a typo'd metric name on a dashboard refresh loop stops
+    costing a full parse+plan+execute per tick (ROADMAP item 1 leftover).
+
+    Unlike QueryResultCache this is deliberately NOT watermark-validated:
+    an empty selection usually stays empty (the metric does not exist), and
+    the TTL bounds how long a newly-appearing series can be masked — the
+    documented freshness trade of negative caching. Keys are
+    ``(promql, tenant)`` only, so a sliding dashboard window keeps hitting —
+    but emptiness is only PROVEN for the executed time range (leaf
+    selection is time-bounded: an existing series queried over a pre-ingest
+    range matches zero series THERE, not everywhere). Each entry therefore
+    records its proven ``[start, end]``, and a hit requires the requested
+    range to stay inside it, extended forward by the wall time elapsed
+    since the proof — exactly the window the TTL trade already concedes to
+    newly-appearing data, enough for a sliding dashboard to keep hitting,
+    while a query over a DIFFERENT (e.g. live vs historical) range misses
+    and re-executes. Capacity-bounded LRU with TTL expiry, both counted as
+    evictions (filolint's bounded-cache contract: visible bound + eviction
+    accounting)."""
+
+    def __init__(self, capacity: int = 256, ttl_s: float = 30.0,
+                 tags: dict | None = None):
+        self.capacity = max(1, int(capacity))
+        self.ttl_s = float(ttl_s)
+        self.tags = dict(tags or {})
+        # key -> (expiry, proven start ms, proven end ms, proof monotonic s)
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = registry.counter(FILODB_QUERY_NEGATIVE_CACHE_HITS,
+                                      self.tags)
+        self._evictions = registry.counter(
+            FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS, self.tags)
+
+    def hit(self, key: tuple, range_key: tuple,
+            now: float | None = None) -> bool:
+        """True when a recent execution proved this query empty over a
+        range covering the requested ``(start, end, step)`` (see class
+        docstring for the forward-extension rule; expired entries evict
+        here). A non-covering range is a miss but keeps the entry — the
+        proof still stands for ITS range."""
+        now = time.monotonic() if now is None else now
+        start, end, step = range_key
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return False
+            exp, p_start, p_end, t_proof = ent
+            if now >= exp:
+                del self._entries[key]
+                self._evictions.increment()
+                return False
+            # the proven-empty range, slid forward by elapsed wall time
+            # (+ one step of grid slack): the only unproven data a hit can
+            # mask is data newer than the proof — the documented TTL trade
+            if start < p_start \
+                    or end > p_end + (now - t_proof) * 1000.0 + step:
+                return False
+            self._entries.move_to_end(key)
+            self._hits.increment()
+            return True
+
+    def put(self, key: tuple, range_key: tuple,
+            now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        start, end, _step = range_key
+        with self._lock:
+            self._entries[key] = (now + self.ttl_s, start, end, now)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._evictions.increment()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self.capacity,
+                    "ttl_s": self.ttl_s, "hits": self._hits.value,
+                    "evictions": self._evictions.value}
+
+
 class SlowQueryLog:
     """Bounded ring of slow-query records: promql text, duration, plan
     summary (the engine's exec path), per-query stats, and the trace id —
@@ -293,6 +389,12 @@ class QueryEngine:
             self.config.shed_retry_after_s, tags={"dataset": dataset})
             if (self.config.max_concurrent_cost is not None
                 or self.config.tenant_quotas) else None)
+        # TTL-bounded negative cache: empty selections short-circuit before
+        # parse/plan/execute (typo'd dashboards; see NegativeResultCache)
+        self.negative_cache = (NegativeResultCache(
+            self.config.negative_cache_size,
+            self.config.negative_cache_ttl_s, tags={"dataset": dataset})
+            if self.config.negative_cache_size else None)
         # a failed peer epoch probe arms this cooldown: until it passes,
         # _epoch_vector returns None without scattering (caching fail-opens
         # to miss), so a blackholed peer stalls at most one query per
@@ -380,6 +482,13 @@ class QueryEngine:
             with span(SPAN_QUERY, dataset=self.dataset,
                       promql=promql_text[:200]):
                 tctx = tracer.current_context()
+                neg_key = None
+                if range_key is not None and self.negative_cache is not None:
+                    # probed FIRST: a negative hit needs no epoch scatter,
+                    # no parse, no plan — the typo'd-dashboard fast exit
+                    neg_key = (promql_text, tenant)
+                    if self.negative_cache.hit(neg_key, range_key):
+                        return self._negative_hit(range_key, ctx)
                 cache_key = epochs = None
                 if range_key is not None and self.result_cache is not None:
                     cache_key = (promql_text, *range_key, tenant)
@@ -395,6 +504,13 @@ class QueryEngine:
                         cache_key,
                         (res.matrix, res.result_type, list(res.warnings),
                          ctx.stats.to_dict(), ctx.exec_path), epochs)
+                if (neg_key is not None and ctx.stats.series_matched == 0
+                        and res.matrix.num_series == 0):
+                    # the SELECTION was provably empty cluster-wide (peer
+                    # legs merge their series_matched into ctx.stats): the
+                    # next refresh skips the whole pipeline until the TTL
+                    # admits newly-appearing series
+                    self.negative_cache.put(neg_key, range_key)
                 return res
         except BaseException as e:
             err = e                     # noted below, then re-raised
@@ -403,6 +519,21 @@ class QueryEngine:
             self._note_query_done(promql_text, ctx,
                                   (time.perf_counter_ns() - t0) / 1e6,
                                   tctx, err)
+
+    def _negative_hit(self, range_key: tuple,
+                      ctx: QueryContext) -> QueryResult:
+        """The synthesized empty result for a negative-cache hit: the step
+        grid of THIS request (the key ignores the sliding window — empty is
+        range-invariant while the entry lives), zero series."""
+        start, end, step = range_key
+        out_ts = np.arange(start, end + 1, max(step, 1), dtype=np.int64)
+        ctx.stats.add("negative_cache_hits")
+        self._set_path(ctx, "negative-cache")
+        res = QueryResult(ResultMatrix(out_ts, np.zeros((0, len(out_ts))),
+                                       []))
+        res.stats = ctx.stats
+        res.exec_path = ctx.exec_path
+        return res
 
     def _result_cache_probe(self, cache_key: tuple, epochs,
                             ctx: QueryContext) -> QueryResult | None:
@@ -459,7 +590,12 @@ class QueryEngine:
                                                     to_ms)
                 total += len(pids)
                 if sh.store is not None \
-                        and getattr(sh.store, "_narrow", None) is not None:
+                        and (getattr(sh.store, "_narrow", None) is not None
+                             or getattr(sh.store, "_nhist", None)
+                             is not None):
+                    # compressed residency (scalar i16 OR hist 2D-delta)
+                    # halves the streamed bytes — and the fused-resident
+                    # tier reads it in place, so cost discounts both
                     narrow += len(pids)
             if shards and self._has_remote_shards():
                 scale = len(self.mapper.all_shards()) / len(shards)
@@ -610,6 +746,12 @@ class QueryEngine:
                 and plan.function == "histogram_quantile"
                 and isinstance(plan.vectors, L.Aggregate)):
             return None
+        from ..ops import fusedresident
+        if fusedresident.mode() == "off":
+            # query.fused_kernels=off: the composed ExecPlan chain (PSM ->
+            # bucket-wise reduce -> quantile as separate dispatches) is the
+            # configured path — the fused tier's A/B baseline
+            return None
         agg = plan.vectors
         if agg.operator != "sum" or agg.params:
             return None
@@ -672,6 +814,7 @@ class QueryEngine:
                 return QueryResult(ResultMatrix(
                     out_ts, np.zeros((0, len(out_ts))), []))
             base_ts, interval_ms = data.grid
+            path = "fused-hist"
             if data.hist_narrow is not None:
                 # hist-resident store: one fused program off the i8/i16
                 # 2D-delta block — the [S, C, B] f32 temp never exists.
@@ -698,16 +841,37 @@ class QueryEngine:
                         "sum", hc.reshape(P, Tp * B), jnp.asarray(cg), Gp)
                     corr = (parts["sum"].astype(jnp.float32),
                             parts["count"].astype(jnp.float32))
-                out = gridfns.fused_hist_quantile_grid_narrow(
-                    q, np.asarray(data.bucket_les, np.float64), dd, first_d,
-                    data.n, gids, Gp, out_eval, window, fn,
-                    base_ts, interval_ms, stale_ms=ctx.stale_ms, corr=corr)
+                B = dd.shape[2]
+                if (fn in fusedresident.HIST_FUSED_FNS
+                        and fusedresident.hist_fusable(
+                            dd.shape[0], dd.shape[1], len(out_eval), B,
+                            max(Gp, 8))):
+                    # the registry's hist_quantile shape: per-tile decode +
+                    # window delta + group fold as ONE map program (Pallas
+                    # or the XLA twin per query.fused_kernels), keyed as a
+                    # distinct kernel variant in the plan cache
+                    out = fusedresident.fused_hist_quantile_resident(
+                        q, np.asarray(data.bucket_les, np.float64), dd,
+                        first_d, data.n, gids, Gp, out_eval, window, fn,
+                        base_ts, interval_ms, corr=corr)
+                    path = f"fused-hist-narrow[{fusedresident.mode()}]"
+                    ctx.stats.add("fused_kernels")
+                    fusedresident.count_served("hist_quantile")
+                else:
+                    # fns/shapes outside the tiled tier keep the one-program
+                    # XLA composition (bit-parity guaranteed by PR 1 rules)
+                    fusedresident.count_fallback("hist_quantile")
+                    out = gridfns.fused_hist_quantile_grid_narrow(
+                        q, np.asarray(data.bucket_les, np.float64), dd,
+                        first_d, data.n, gids, Gp, out_eval, window, fn,
+                        base_ts, interval_ms, stale_ms=ctx.stale_ms,
+                        corr=corr)
             else:
                 out = gridfns.fused_hist_quantile_grid(
                     q, np.asarray(data.bucket_les, np.float64), data.val,
                     data.n, gids, _pow2(G), out_eval, window, fn,
                     base_ts, interval_ms, stale_ms=ctx.stale_ms)
-        self._set_path(ctx, "fused-hist")
+        self._set_path(ctx, path)
         ctx.stats.merge(pctx.stats)             # committed: fused serves
         vals = np.asarray(out)[:G, :T]
         m = ResultMatrix(out_ts, vals, list(uniq))
@@ -866,6 +1030,10 @@ class QueryEngine:
                                     G, args=(a0, a1), fetch=False)
             if ctx is not None:     # committed: the mesh path serves this
                 ctx.stats.add("series_matched", matched_total)
+                if ex.last_path.startswith("fused"):
+                    # stats symmetry with the in-process fused route
+                    # (exec.py): cluster stats equal the single-node oracle
+                    ctx.stats.add("fused_kernels")
         self._set_path(ctx, f"mesh-{ex.last_path}")
         if op in ("topk", "bottomk"):
             m = self._present_mesh_topk(lazy, shards, epochs, out_ts,

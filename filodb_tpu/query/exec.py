@@ -304,12 +304,15 @@ class PeriodicSamplesMapper(Transformer):
                 vals = vals[:, :T]
             return MatrixView(out_ts, vals, data.keys, data.rows, data.bucket_les)
         if grid_usable and fn in gridfns.GRID_FNS:
-            from ..ops import fusedgrid
+            from ..ops import fusedgrid, fusedresident
             S, C = data.val.shape
-            if (fn in fusedgrid.FUSED_FNS and data.val.dtype == jnp.float32
+            if (fusedresident.mode() != "off"
+                    and fusedresident.scalar_shape_of(fn) is not None
+                    and data.val.dtype == jnp.float32
                     and fusedgrid.fusable(S, C, len(out_ts), 1)):
                 # defer: a following AggregateMapReduce can fuse the window
-                # function with the aggregation in one HBM pass
+                # function with the aggregation in one single-pass program
+                # (Pallas or the XLA-fused twin per query.fused_kernels)
                 return FusedWindowData(data, out_ts, window, fn, ctx.stale_ms)
             base_ts, interval_ms = data.grid
             vals = gridfns.periodic_samples_grid(_dval(data.val), data.n,
@@ -569,12 +572,14 @@ class AggregateMapReduce(Transformer):
         are excluded there (n forced to 0) and folded in via the general path.
         Returns None when the group count exceeds the kernel's VMEM cap — the
         caller falls back to the two-step path (segment_sum handles large G)."""
-        from ..ops import fusedgrid
+        from ..ops import fusedgrid, fusedresident
         sel = data.sel
         R = sel.val.shape[0]
         gids, uniq, G = _group_ids_for(sel.keys, sel.rows, R, self.by, self.without)
         Gp = _pow2(G)
         if Gp > fusedgrid.MAX_GROUPS:
+            fusedresident.count_fallback(
+                fusedresident.scalar_shape_of(data.fn) or "rate_sum")
             return None
         base_ts, interval_ms = sel.grid
         n_eff = sel.n
@@ -599,13 +604,16 @@ class AggregateMapReduce(Transformer):
         # fetch=False: the leaf holds the shard lock through this dispatch —
         # the blocking host fetch happens at present/merge time, outside it.
         # With narrow operands the kernel streams the i16 state and sel.val
-        # may stay a deferred decode (shape metadata only)
-        parts = fusedgrid.fused_grid_aggregate(
+        # may stay a deferred decode (shape metadata only). The registry
+        # picks the backend (Pallas kernel / XLA-fused twin) per
+        # query.fused_kernels and records the per-query fused route
+        parts = fusedresident.scalar_aggregate(
             self.operator, data.fn,
             sel.val if narrow is not None else _dval(sel.val),
             n_eff, gids_dev, Gp,
             data.out_ts, data.window, base_ts, interval_ms, fetch=False,
             narrow=narrow)
+        ctx.stats.add("fused_kernels")
         if has_minority:
             rows = np.asarray(minority, np.int32)
             sub_ts, sub_val, sub_n, P = _gather_rows_padded(sel.ts, sel.val,

@@ -164,8 +164,13 @@ def warmup(shapes: list) -> dict:
     count for wide dashboards), ``samples`` (store capacity C), ``steps``
     (output step count), ``step_ms``, ``window_ms``, ``interval_ms`` (scrape
     interval — part of the FUSED kernel's static key), ``groups`` (by()
-    cardinality), ``dtype`` ("float32"/"float64"), and ``grid`` (False to
-    warm only the general searchsorted path). Returns
+    cardinality), ``dtype`` ("float32"/"float64"), ``grid`` (False to
+    warm only the general searchsorted path), ``buckets`` (>0 warms the
+    fused hist-resident quantile variant for that bucket count too, with
+    ``dd_dtype`` "int16"/"int8"). Fused-tier shapes warm the variant the
+    ACTIVE ``query.fused_kernels`` mode will serve (pallas or the XLA
+    twin) — set_mode runs before warmup at server startup exactly so the
+    warmed program is the serving program. Returns
     ``{"programs": <new traces>, "ms": <wall>}``.
     """
     import numpy as np
@@ -173,7 +178,7 @@ def warmup(shapes: list) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from ..ops import fusedgrid, gridfns, rangefns
+    from ..ops import fusedgrid, fusedresident, gridfns, rangefns
     from .exec import _pad_steps, _pow2, _segment_partial
     t0 = time.perf_counter()
     before = plan_cache.traces
@@ -203,20 +208,41 @@ def warmup(shapes: list) -> dict:
         # general searchsorted path (off-grid shards, minority corrections)
         ts = jax.device_put(jnp.zeros((R, C), jnp.int64), dev)
         rangefns.periodic_samples(ts, val, n, out_eval, window, fn)
+        fmode = fusedresident.mode()
         if spec.get("grid", True):
             # grid band-matmul path + the fused single-pass map phase when
             # the shape qualifies (the dashboard hot path)
             gridfns.periodic_samples_grid(val, n, out_eval, window, fn,
                                           0, iv)
-            if (not f64 and fn in fusedgrid.FUSED_FNS
+            if (fmode != "off" and not f64
+                    and fusedresident.scalar_shape_of(fn) is not None
                     and op in fusedgrid.FUSED_OPS
                     and fusedgrid.fusable(R, C, steps, groups)):
                 # single-group warmups route gids through the same cached
-                # device zeros the engine's fused path uses
+                # device zeros the engine's fused path uses; the variant is
+                # the ACTIVE mode's, so the warmed program is the serving one
                 g_dev = (fusedgrid.zero_gids(R) if groups == 1
                          else np.zeros(R, np.int32))
                 fusedgrid.fused_grid_aggregate(op, fn, val, n, g_dev,
-                                               groups, out_ts, window, 0, iv)
+                                               groups, out_ts, window, 0, iv,
+                                               variant=fmode)
+        B = int(spec.get("buckets", 0) or 0)
+        if spec.get("grid", True) and B and fmode != "off":
+            # fused hist-resident quantile variant: serve-time shapes are
+            # the engine's (out_eval steps, pow2 group bucket, dd dtype)
+            Gp = _pow2(groups)
+            if (fn in fusedresident.HIST_FUSED_FNS
+                    and fusedresident.hist_fusable(R, C, len(out_eval), B,
+                                                   Gp)):
+                dd_dt = (jnp.int8 if spec.get("dd_dtype") == "int8"
+                         else jnp.int16)
+                dd = jax.device_put(jnp.zeros((R, C, B), dd_dt), dev)
+                fd = jax.device_put(jnp.zeros((R, B), jnp.float32), dev)
+                les = np.arange(1, B + 1, dtype=np.float64)
+                les[-1] = np.inf
+                fusedresident.fused_hist_quantile_resident(
+                    0.9, les, dd, fd, n, np.zeros(R, np.int32), Gp,
+                    out_eval, window, fn, 0, iv)
         # two-step reduce: PSM output is sliced back to the TRUE step count
         # before the segment partial, so warm the unpadded T
         _segment_partial(op, jnp.zeros((R, T), jnp.float64),

@@ -123,7 +123,7 @@ class QueryStats:
 
     FIELDS = ("series_matched", "blocks_narrow", "blocks_raw",
               "rows_paged_in", "result_cells", "result_cache_hits",
-              "admission_shed")
+              "negative_cache_hits", "fused_kernels", "admission_shed")
 
     def __init__(self):
         self.series_matched = 0        # series selected by leaf filters
@@ -132,6 +132,10 @@ class QueryStats:
         self.rows_paged_in = 0         # series paged in via ODP
         self.result_cells = 0          # final matrix series x steps
         self.result_cache_hits = 0     # answered from the result cache
+        self.negative_cache_hits = 0   # empty selection served from the
+                                       # TTL-bounded negative cache
+        self.fused_kernels = 0         # fused-resident kernel executions
+                                       # (ops/fusedresident.py) in this query
         self.admission_shed = 0        # shed by cost-based admission
         self.stage_ms: dict[str, float] = {}
         self._lock = threading.Lock()

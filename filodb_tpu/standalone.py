@@ -791,6 +791,10 @@ class FiloServer:
         tracer.sample_rate = float(cfg.get("trace.sample_rate", 1.0))
         from .query.engine import slow_query_log
         slow_query_log.resize(int(cfg["query.slow_log_size"]))
+        # fused compressed-resident kernel tier: pick the backend BEFORE the
+        # warmup thread starts, so warmed programs are the ones that serve
+        from .ops import fusedresident
+        fusedresident.set_mode(str(cfg["query.fused_kernels"]))
         # serving fast path: bound the process-global compiled-plan cache
         # and pre-trace the configured hot shapes in the background — the
         # server accepts traffic immediately; warmed dashboards simply stop

@@ -57,6 +57,11 @@ FILODB_QUERY_RESULT_CACHE_INVALIDATIONS = \
 FILODB_QUERY_ADMISSION_SHED = "filodb_query_admission_shed"
 FILODB_QUERY_ADMISSION_OVERSIZED = "filodb_query_admission_oversized"
 FILODB_QUERY_ADMISSION_COST = "filodb_query_admission_cost"
+FILODB_QUERY_FUSED_SERVED = "filodb_query_fused_served"
+FILODB_QUERY_FUSED_FALLBACK = "filodb_query_fused_fallback"
+FILODB_QUERY_NEGATIVE_CACHE_HITS = "filodb_query_negative_cache_hits"
+FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS = \
+    "filodb_query_negative_cache_evictions"
 FILODB_INGEST_PUBLISH_LATENCY_MS = "filodb_ingest_publish_latency_ms"
 FILODB_TRACE_SPANS = "filodb_trace_spans"
 
@@ -157,6 +162,23 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_QUERY_ADMISSION_COST: (
         "gauge", "Estimated cost units currently admitted and executing "
                  "(bounded by query.max_concurrent_cost)."),
+    FILODB_QUERY_FUSED_SERVED: (
+        "counter", "Queries served by a fused compressed-resident kernel, "
+                   "tagged by registry shape (rate_sum / window_reduce / "
+                   "hist_quantile) and backend mode (query.fused_kernels: "
+                   "xla / pallas)."),
+    FILODB_QUERY_FUSED_FALLBACK: (
+        "counter", "Queries that matched a fused shape but fell back to "
+                   "the composed two-step path (shape gate, group cap, "
+                   "off-grid store), tagged by shape."),
+    FILODB_QUERY_NEGATIVE_CACHE_HITS: (
+        "counter", "Range queries answered from the TTL-bounded negative "
+                   "result cache: a recent execution proved the selection "
+                   "empty (typo'd metric), so plan+execute is skipped until "
+                   "the TTL expires."),
+    FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS: (
+        "counter", "Negative-cache entries dropped by TTL expiry or the "
+                   "capacity bound (query.negative_cache_size)."),
     FILODB_INGEST_PUBLISH_LATENCY_MS: (
         "histogram", "BrokerBus pipelined publish-group round trip per "
                      "partition, exemplar-tagged with the publish trace "

@@ -28,6 +28,7 @@ stays in /root/repo/bench.py (QueryInMemoryBenchmark equivalent).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -1345,7 +1346,184 @@ def bench_serving(full: bool) -> None:
     assert peak[0] <= budget, "admitted cost exceeded the budget"
 
 
+def bench_fused_resident(full: bool) -> None:
+    """ISSUE 9: the fused compressed-resident kernel tier. Per-shape A/B of
+    the fused path (query.fused_kernels = xla / pallas) against the composed
+    (PR 8-cached) two-step chain (mode off) at MATCHED fixtures; plus the
+    flush-path row proving the donated scatter stops copying the store. All
+    paths run warm (plan cache populated) — the delta is execution, not
+    compilation.
+
+    Fixtures are the shapes the tier exists for: high-cardinality
+    dashboards (many series, fine step grid, T steps >> C stored samples)
+    where the composed chain materializes the [S, Tp]/[S, Tp*B] windowed
+    intermediate in HBM and re-reads it for the segment reduce — the
+    traffic the one-pass program deletes.
+
+    Parity semantics (same rules the tests assert, tests/
+    test_fused_resident.py): the two fused backends share one tiling plan
+    and tile math, so pallas vs xla is BIT-IDENTICAL (asserted). Against
+    the composed oracle, single-tile shapes (S <= 512) are exact; at the
+    multi-tile scale benchmarked here the per-tile f32 fold sums in a
+    different order than the oracle's one-shot contraction, so the oracle
+    rows document max relative delta instead (asserted <= 2e-5, f32
+    epsilon-order)."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_COUNTER, PROM_HISTOGRAM
+    from filodb_tpu.ops import fusedresident
+    from filodb_tpu.query.engine import QueryEngine
+
+    n_series = 32768 if full else 16384
+    n_samp = 48          # 30s scrape over a 23-minute retention window
+    siv = 30_000
+    n_hist = 8192 if full else 4096
+    nh_samp = 32         # 10s scrape, 32-bucket latency histograms
+    nb = 32
+    les = np.concatenate([2.0 ** np.arange(nb - 1), [np.inf]])
+
+    def scalar_store():
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=n_series,
+                          samples_per_series=n_samp,
+                          flush_batch_size=10**9, dtype="float32")
+        ms.setup("fr", PROM_COUNTER, 0, cfg)
+        rng = np.random.default_rng(3)
+        for s0 in range(0, n_series, 512):
+            b = RecordBuilder(PROM_COUNTER)
+            vals = np.cumsum(rng.exponential(5.0, (512, n_samp)), axis=1)
+            for t in range(n_samp):
+                for s in range(s0, s0 + 512):
+                    b.add({"_metric_": "rt", "job": f"J{s % 8}",
+                           "inst": f"i{s}"}, BASE + t * siv,
+                          float(vals[s - s0, t]))
+            ms.ingest("fr", 0, b.build())
+        ms.flush_all()
+        return ms
+
+    def hist_store():
+        ms = TimeSeriesMemStore()
+        sh = ms.setup("frh", PROM_HISTOGRAM, 0,
+                      StoreConfig(max_series_per_shard=n_hist,
+                                  samples_per_series=nh_samp,
+                                  flush_batch_size=10**9, dtype="float32",
+                                  compressed_residency="all"))
+        rng = np.random.default_rng(5)
+        for s0 in range(0, n_hist, 256):
+            b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+            c = np.cumsum(np.cumsum(
+                rng.poisson(0.4, (256, nh_samp, nb)), axis=1),
+                axis=2).astype(np.float64)
+            for t in range(nh_samp):
+                for s in range(256):
+                    b.add({"_metric_": "h", "host": f"x{s0 + s}"},
+                          BASE + t * IV, c[s, t])
+            ms.ingest("frh", 0, b.build())
+        sh.flush()
+        assert sh.store.is_narrow_resident
+        return ms
+
+    # dashboard step grids: T steps >> C stored cells (step finer than the
+    # scrape interval — Grafana auto-intervals on a zoomed panel)
+    sc_range = (BASE + 240_000, BASE + (n_samp - 2) * siv, 2_500)
+    h_range = (BASE + 120_000, BASE + (nh_samp - 2) * IV, 2_500)
+    old_mode = fusedresident.mode()
+    sstore = scalar_store()          # shared: both scalar shapes, one build
+    shapes = [
+        ("rate_sum", sstore, "fr", "sum(rate(rt[2m]))", sc_range),
+        ("window_reduce", sstore, "fr", "sum(avg_over_time(rt[2m]))",
+         sc_range),
+        ("hist_quantile", hist_store(), "frh",
+         "histogram_quantile(0.9, sum(rate(h[1m])))", h_range),
+    ]
+    try:
+        for shape, ms, ds, q, (start, end, step) in shapes:
+            eng = QueryEngine(ms, ds)
+            res = {}
+            for mode in ("off", "xla", "pallas"):
+                fusedresident.set_mode(mode)
+                r0 = eng.query_range(q, start, end, step)   # warm compile
+                dt, iters = timed(
+                    lambda: eng.query_range(q, start, end, step))
+                ms_q = dt / iters * 1000
+                res[mode] = (ms_q, np.asarray(r0.matrix.values))
+                emit("fused_resident", f"{shape}_{mode}_ms", ms_q, "ms")
+            # pallas vs xla: one tiling plan, one tile math — bit parity
+            # by construction, asserted
+            vparity = np.array_equal(res["xla"][1], res["pallas"][1],
+                                     equal_nan=True)
+            emit("fused_resident", f"{shape}_variant_bit_parity",
+                 float(vparity), "bool")
+            assert vparity, f"{shape}: pallas and xla variants must be " \
+                            "bit-identical"
+            # vs the composed oracle: exact at single-tile, f32 fold-order
+            # delta at this scale (see docstring)
+            with np.errstate(all="ignore"):
+                o = res["off"][1]
+                maxrel = float(max(
+                    np.nanmax(np.abs(res[m][1] - o)
+                              / np.maximum(np.abs(o), 1e-12), initial=0.0)
+                    for m in ("xla", "pallas")))
+            emit("fused_resident", f"{shape}_oracle_exact",
+                 float(all(np.array_equal(res[m][1], o, equal_nan=True)
+                           for m in ("xla", "pallas"))), "bool")
+            emit("fused_resident", f"{shape}_oracle_maxrel_ppm",
+                 maxrel * 1e6, "ppm")
+            assert maxrel <= 2e-5, (shape, maxrel)
+            emit("fused_resident", f"{shape}_speedup_xla_x",
+                 res["off"][0] / res["xla"][0], "x")
+            emit("fused_resident", f"{shape}_speedup_pallas_x",
+                 res["off"][0] / res["pallas"][0], "x")
+    finally:
+        fusedresident.set_mode(old_mode)
+
+    # -- flush-path donation: the donated scatter updates the store arrays
+    # in place; the undonated twin allocates (and writes) a full copy of
+    # the [S, C] ts+val blocks per staged-row commit
+    from filodb_tpu.core.chunkstore import _scatter_append
+
+    @functools.partial(jax.jit)   # undonated twin of the SAME body
+    def _scatter_copy(ts, val, n, rows, cols, new_ts, new_val, counts_add):
+        ts = ts.at[rows, cols].set(new_ts, mode="drop")
+        val = val.at[rows, cols].set(new_val, mode="drop")
+        return ts, val, n + counts_add
+
+    S, C = (65536, 512) if full else (32768, 512)
+    m = 4096
+    ts = jnp.full((S, C), 1 << 62, jnp.int64)
+    val = jnp.zeros((S, C), jnp.float32)
+    n = jnp.zeros(S, jnp.int32)
+    rows = jnp.asarray(np.arange(m, dtype=np.int32) % S)
+    cols = jnp.zeros(m, jnp.int32)
+    new_ts = jnp.asarray(np.full(m, BASE, np.int64))
+    new_val = jnp.ones(m, jnp.float32)
+    counts = jnp.zeros(S, jnp.int32)
+
+    def donated():
+        nonlocal ts, val, n
+        ts, val, n = _scatter_append(ts, val, n, rows, cols, new_ts,
+                                     new_val, counts)
+        n.block_until_ready()
+
+    def copied():
+        out = _scatter_copy(ts, val, n, rows, cols, new_ts, new_val, counts)
+        out[2].block_until_ready()
+
+    dt_c, it_c = timed(copied, min_s=0.5)
+    dt_d, it_d = timed(donated, min_s=0.5)
+    ms_d, ms_c = dt_d / it_d * 1000, dt_c / it_c * 1000
+    bytes_saved = S * C * (8 + 4)      # the ts+val copy that no longer exists
+    emit("fused_resident", "flush_scatter_donated_ms", ms_d, "ms")
+    emit("fused_resident", "flush_scatter_copy_ms", ms_c, "ms")
+    emit("fused_resident", "flush_scatter_speedup_x", ms_c / ms_d, "x")
+    emit("fused_resident", "flush_alloc_saved_mb", bytes_saved / 2**20, "MB")
+
+
 SUITES = {
+    "fused_resident": bench_fused_resident,
     "ingestion": bench_ingestion,
     "serving": bench_serving,
     "observability": bench_observability,

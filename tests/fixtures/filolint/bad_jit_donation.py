@@ -1,0 +1,22 @@
+"""MUST flag jit-donation-unused: a donated argument that never becomes an
+output, and a flush-path scatter jit with no donation at all."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def donated_unread(buf, stale, rows, vals):
+    # BAD: `stale` is donated but only read into a reduction — it never
+    # flows to the return, so the donation deletes the caller's buffer
+    # without any in-place update to alias into
+    jnp.sum(stale)
+    return buf.at[rows].set(vals)
+
+
+@jax.jit
+def scatter_copy(store, rows, vals):
+    # BAD: the flush-path scatter updates and returns `store` WITHOUT
+    # donating it — a full copy of the buffer per staged-row commit
+    return store.at[rows].set(vals, mode="drop")

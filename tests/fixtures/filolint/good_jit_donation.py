@@ -1,0 +1,42 @@
+"""Must NOT flag: donated buffers update in place and flow to the return;
+read-only operands stay undonated; a deliberate copy is suppressed."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def scatter_append(ts, n, rows, cols, new_ts, counts):
+    ts = ts.at[rows, cols].set(new_ts, mode="drop")   # ok: donated, in place
+    n = n + counts                                    # ok: donated, returned
+    return ts, n
+
+
+@jax.jit
+def pure_read(store, rows):
+    return jnp.take(store, rows, axis=0)              # ok: no update, no need
+
+
+@jax.jit
+def versioned_copy(store, rows, vals):
+    # ok: the caller keeps the old version on purpose (snapshot semantics)
+    return store.at[rows].set(vals)  # filolint: ignore[jit-donation-unused] — versioned snapshot, both copies live
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def loop_accumulated(rows):
+    # ok: the donated arg reaches the return through a for-loop target and
+    # a mutating .append call — neither is an Assign statement
+    out = []
+    for r in rows:
+        out.append(r * 2)
+    return jnp.stack(out)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def with_bound(store, view_of):
+    # ok: flows to the return through a `with ... as` binding
+    with view_of(store) as view:
+        acc = view + 1
+    return acc

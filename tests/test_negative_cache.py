@@ -1,0 +1,136 @@
+"""TTL- and size-bounded negative result cache (ISSUE 9 satellite,
+ROADMAP item 1 leftover): a query whose selection matched ZERO series
+cluster-wide short-circuits before parse/plan/execute until its TTL expires
+— a typo'd metric name on a dashboard refresh loop stops costing a full
+pipeline pass per tick."""
+
+import numpy as np
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.query.engine import (NegativeResultCache, QueryConfig,
+                                     QueryEngine)
+
+BASE = 1_700_000_000_000
+IV = 10_000
+
+
+def _store(dataset="negcache", n_series=4):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9)
+    ms.setup(dataset, GAUGE, 0, cfg)
+    for s in range(n_series):
+        b = RecordBuilder(GAUGE)
+        for t in range(30):
+            b.add({"_metric_": "m", "host": f"h{s}"}, BASE + t * IV,
+                  float(t))
+        ms.ingest(dataset, 0, b.build())
+    ms.flush_all()
+    return ms
+
+
+def _eng(ms, **kw):
+    return QueryEngine(ms, "negcache",
+                       config=QueryConfig(negative_cache_size=8, **kw))
+
+
+def test_typo_metric_hits_negative_cache_and_skips_the_pipeline():
+    ms = _store()
+    eng = _eng(ms)
+    start, end, step = BASE + 100_000, BASE + 250_000, 30_000
+    r1 = eng.query_range("sum(rate(typo_metric[1m]))", start, end, step)
+    assert r1.matrix.num_series == 0
+    assert r1.stats.negative_cache_hits == 0
+    # second refresh (different window — dashboards slide): negative hit,
+    # and the execution pipeline provably never runs
+    orig = eng.exec_logical
+    calls = {"n": 0}
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    eng.exec_logical = counting
+    r2 = eng.query_range("sum(rate(typo_metric[1m]))", start + step,
+                         end + step, step)
+    assert calls["n"] == 0, "a negative hit must not plan or execute"
+    assert r2.stats.negative_cache_hits == 1
+    assert r2.exec_path == "negative-cache"
+    assert r2.matrix.num_series == 0
+    # the synthesized grid is THIS request's step grid
+    np.testing.assert_array_equal(
+        r2.matrix.out_ts,
+        np.arange(start + step, end + step + 1, step, dtype=np.int64))
+
+
+def test_matched_but_empty_results_are_not_negative_cached():
+    """A comparison filter can return 0 series while the SELECTION matched:
+    values change, so such queries must never be masked by the cache."""
+    ms = _store()
+    eng = _eng(ms)
+    start, end, step = BASE + 100_000, BASE + 250_000, 30_000
+    q = "topk(0, m)"                    # matches series, emits none
+    r1 = eng.query_range(q, start, end, step)
+    assert r1.matrix.num_series == 0
+    assert r1.stats.series_matched > 0
+    r2 = eng.query_range(q, start, end, step)
+    assert r2.stats.negative_cache_hits == 0
+    assert r2.exec_path != "negative-cache"
+
+
+def test_ttl_expiry_and_capacity_evictions_are_counted():
+    rk = (BASE, BASE + 100_000, 10_000)
+    c = NegativeResultCache(capacity=2, ttl_s=10.0)
+    ev0 = c.stats()["evictions"]
+    c.put(("q1", None), rk, now=0.0)
+    assert c.hit(("q1", None), rk, now=5.0)
+    # TTL expiry: the entry dies and counts as an eviction
+    assert not c.hit(("q1", None), rk, now=11.0)
+    assert c.stats()["evictions"] == ev0 + 1
+    # capacity bound: LRU overflow evicts and counts
+    c.put(("a", None), rk, now=0.0)
+    c.put(("b", None), rk, now=0.0)
+    c.put(("c", None), rk, now=0.0)
+    assert len(c) == 2
+    assert c.stats()["evictions"] == ev0 + 2
+    assert not c.hit(("a", None), rk, now=1.0)   # the evicted oldest
+    assert c.hit(("c", None), rk, now=1.0)
+
+
+def test_range_coverage_gates_the_hit():
+    """Emptiness is proven only for the executed range: a query over a
+    disjoint (e.g. historical) range must miss and re-execute, while a
+    dashboard window sliding forward within the TTL keeps hitting."""
+    c = NegativeResultCache(capacity=8, ttl_s=30.0)
+    start, end, step = BASE, BASE + 100_000, 10_000
+    c.put(("q", None), (start, end, step), now=0.0)
+    # sliding forward: covered by elapsed-wall-time extension (+step slack)
+    assert c.hit(("q", None), (start + step, end + step, step), now=5.0)
+    # a range starting BEFORE the proven window is never covered
+    assert not c.hit(("q", None), (start - step, end, step), now=5.0)
+    # far-future end beyond the elapsed extension: miss (entry survives)
+    assert not c.hit(("q", None),
+                     (start, end + 3_600_000, step), now=1.0)
+    assert c.hit(("q", None), (start, end, step), now=2.0)
+
+
+def test_negative_cache_off_by_default_in_library_config():
+    ms = _store()
+    eng = QueryEngine(ms, "negcache")            # default QueryConfig
+    assert eng.negative_cache is None
+    start, end, step = BASE + 100_000, BASE + 250_000, 30_000
+    r = eng.query_range("sum(rate(typo[1m]))", start, end, step)
+    assert r.stats.negative_cache_hits == 0
+
+
+def test_tenant_isolation_in_the_key():
+    ms = _store()
+    eng = _eng(ms)
+    start, end, step = BASE + 100_000, BASE + 250_000, 30_000
+    eng.query_range("sum(absent_metric)", start, end, step, tenant="a")
+    r = eng.query_range("sum(absent_metric)", start, end, step, tenant="b")
+    assert r.stats.negative_cache_hits == 0      # different tenant: no hit
+    r2 = eng.query_range("sum(absent_metric)", start, end, step, tenant="a")
+    assert r2.stats.negative_cache_hits == 1

@@ -116,6 +116,65 @@ def test_warmup_pretraces_the_dashboard_shape():
     assert r.matrix.num_series == 1
 
 
+def test_warmup_pretraces_the_fused_variant_in_every_mode():
+    """ISSUE 9 satellite: query.warmup_shapes must cover the fused-resident
+    kernel VARIANT the active query.fused_kernels mode serves — a warmed
+    server previously still paid first-query compile on the fused path when
+    the mode's program differed from the warmed one."""
+    from filodb_tpu.ops import fusedresident
+    ms = _counter_store(dataset="warmfused")
+    eng = QueryEngine(ms, "warmfused")
+    spec = {"fn": "rate", "op": "sum", "series": 64, "samples": 128,
+            "steps": 10, "step_ms": 60_000, "window_ms": 60_000,
+            "interval_ms": 10_000}
+    old = fusedresident.mode()
+    try:
+        for mode in ("xla", "pallas"):
+            fusedresident.set_mode(mode)
+            plan_cache.clear()
+            info = warmup([spec])
+            assert info["programs"] > 0
+            tracer.drain()
+            t0 = plan_cache.traces
+            r = eng.query_range('sum(rate(rt[1m]))', BASE + 300_000,
+                                BASE + 840_000, 60_000)
+            assert plan_cache.traces == t0, \
+                f"warmed {mode} variant must not compile on first load"
+            assert _compile_spans() == []
+            assert r.stats.fused_kernels >= 1, \
+                f"the {mode} fused variant must actually serve"
+    finally:
+        fusedresident.set_mode(old)
+
+
+def test_warmup_pretraces_the_fused_hist_variant():
+    """A warmup spec with ``buckets`` covers the hist-resident quantile
+    variant: the map-phase AND finish programs trace at warmup, so the
+    matching serve-time call compiles nothing."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.ops import fusedresident
+    from filodb_tpu.query.exec import _pad_steps
+    plan_cache.clear()
+    spec = {"fn": "rate", "op": "sum", "series": 64, "samples": 128,
+            "steps": 10, "step_ms": 60_000, "window_ms": 60_000,
+            "interval_ms": 10_000, "buckets": 8}
+    info = warmup([spec])
+    assert info["programs"] > 0
+    t0 = plan_cache.traces
+    # the serve-time shapes the engine would use for this spec
+    out_ts = np.int64(60_000) + np.arange(10, dtype=np.int64) * 60_000
+    out_eval, _T = _pad_steps(out_ts)
+    dd = jnp.zeros((64, 128, 8), jnp.int16)
+    fd = jnp.zeros((64, 8), jnp.float32)
+    les = np.arange(1, 9, dtype=np.float64); les[-1] = np.inf
+    fusedresident.fused_hist_quantile_resident(
+        0.9, les, dd, fd, jnp.zeros(64, jnp.int32), np.zeros(64, np.int32),
+        8, out_eval, 60_000, "rate", 0, 10_000)
+    assert plan_cache.traces == t0, \
+        "warmed hist-resident shape must not compile at serve time"
+
+
 def test_eviction_respects_capacity_bound_and_counts():
     ev = registry.counter(FILODB_QUERY_COMPILE_CACHE_EVICTIONS)
     old_cap = plan_cache.capacity

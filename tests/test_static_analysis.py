@@ -40,6 +40,7 @@ BAD_FIXTURES = {
     "bad_jit_branch.py": {"jit-traced-branch"},
     "bad_jit_closure.py": {"jit-mutable-closure"},
     "bad_jit_static.py": {"jit-static-args"},
+    "bad_jit_donation.py": {"jit-donation-unused"},
     # v2 interprocedural families (resource lifecycle / except-flow /
     # declared surface / inherited-holder lockcheck)
     "bad_thread_leak.py": {"resource-thread-no-stop",
