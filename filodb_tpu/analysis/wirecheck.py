@@ -63,6 +63,12 @@ WIRE_SPEC = {
         # delegated to from BrokerServer._serve)
         {"module": "filodb_tpu/ingest/replication.py", "prefix": "OP_",
          "server_fn": "serve_replication", "client_class": "FollowerLink"},
+        # the durable chunk tier (PR 10): every StoreServer op — including
+        # the streaming OP_APPEND_CRC and atomic OP_CHECKPOINT — must be
+        # dispatched by StoreServer._serve AND sent by the RemoteStore
+        # client; a one-sided op is a live flush/recovery protocol desync
+        {"module": "filodb_tpu/core/diststore.py", "prefix": "OP_",
+         "server_fn": "_serve", "client_class": "RemoteStore"},
     ],
     # trace-context carrier parity: every (module, scope) side must
     # reference the symbol — scopes are function OR class names, so the

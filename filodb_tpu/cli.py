@@ -33,9 +33,11 @@ def main(argv=None) -> int:
     q.add_argument("--end", type=float, required=True)
     q.add_argument("--step", default="15s")
     q.add_argument("--resolution", default=None, metavar="RES",
-                   help="query the downsample family instead of raw data "
-                        "(e.g. 1m -> {dataset}:ds_1m; select columns with "
-                        "metric::dAvg)")
+                   help="retention routing override: serve the query from "
+                        "this resolution ('raw', '1m', ...) instead of the "
+                        "router's choice; the server validates it against "
+                        "the configured set and fails with the available "
+                        "list (select ds columns with metric::dAvg)")
 
     lv = sub.add_parser("labelvalues", help="list label values")
     lv.add_argument("label")
@@ -98,14 +100,17 @@ def main(argv=None) -> int:
     if args.cmd == "serve":
         return _serve(args)
     if args.cmd == "query":
-        dataset = args.dataset
+        # --resolution is a ROUTING OVERRIDE on the raw dataset's endpoint,
+        # not a dataset swap: the old ds_family swap silently returned an
+        # empty result when the resolution was unconfigured (a nonexistent
+        # dataset); the server now validates and names the available set
+        params = {"query": args.promql, "start": args.start,
+                  "end": args.end, "step": args.step}
         if args.resolution:
-            from .core.downsample import ds_family
-            from .config import parse_duration_ms
-            dataset = ds_family(dataset, parse_duration_ms(args.resolution))
-        return _http_get(args.host, f"/promql/{dataset}/api/v1/query_range",
-                         {"query": args.promql, "start": args.start,
-                          "end": args.end, "step": args.step})
+            params["resolution"] = args.resolution
+        return _http_get(args.host,
+                         f"/promql/{args.dataset}/api/v1/query_range",
+                         params)
     if args.cmd == "labelvalues":
         return _http_get(args.host, f"/promql/{args.dataset}/api/v1/label/{args.label}/values", {})
     if args.cmd == "series":

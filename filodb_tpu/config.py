@@ -146,6 +146,31 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "duration", "30s",
         "Refresh cadence of the downsample serving views "
         "(/promql/{ds}:ds_1m/...)."),
+    "retention.routing": (
+        "bool", False,
+        "Downsample-aware query routing: long-range/coarse-step queries "
+        "serve from the ds_family resolution that best covers "
+        "[start,end,step], stitching the recent raw tail at the in-memory "
+        "horizon (off = raw-only serving; &resolution= overrides per "
+        "query)."),
+    "retention.resolutions": (
+        "list[str]", [],
+        "Serving resolution set for routing: 'raw' plus durations that "
+        "name inline-downsample families (empty = 'raw' + every "
+        "downsample.resolutions entry)."),
+    "retention.raw_ttl": (
+        "duration|null", None,
+        "Durable raw retention: a background job ages raw chunks older "
+        "than this out of the (replicated) sink and bumps data_epoch so "
+        "cached results invalidate (null = keep raw forever)."),
+    "retention.compact_interval": (
+        "duration", "1h",
+        "Cadence of the durable raw age-out job (retention.raw_ttl)."),
+    "retention.store_timeout": (
+        "duration", "10s",
+        "Connect/read timeout of RemoteStore links to StoreServer nodes; "
+        "a dead backend times out and fails over to the next replica "
+        "instead of stalling the read."),
     "ingest.publish_window": (
         "int", 64,
         "Frames per broker PUBLISH_BATCH round trip — the in-flight "

@@ -37,8 +37,10 @@ import zlib
 
 import numpy as np
 
+from ..utils.metrics import FILODB_RETENTION_REPLICA_FAILOVER, registry
 from ..utils.netio import recv_exact as _recv_exact
-from .store import ChunkSink, encode_chunkset, iter_chunksets
+from .store import (ChunkSink, encode_age_out, encode_chunkset,
+                    head_frame_min_ts, iter_chunksets)
 
 log = logging.getLogger(__name__)
 
@@ -46,11 +48,24 @@ _REQ = struct.Struct("<BII")      # op, header_len, payload_len
 _RESP = struct.Struct("<BQ")      # status (0 ok), u64 body_len (logs can be big)
 
 OP_APPEND, OP_PUT, OP_GET, OP_STAT = 1, 2, 3, 4
+# streaming/checkpoint ops of the durable-tier flush path (PR 10):
+#   OP_APPEND_CRC — CRC32-verified chunk-frame append: the server recomputes
+#     the payload checksum and refuses a torn/corrupted frame instead of
+#     appending garbage the log parser would silently truncate at
+#   OP_CHECKPOINT — server-side atomic per-(dataset, shard, group) watermark
+#     merge: the old client read-modify-write of checkpoint.json lost a
+#     concurrent group's commit when two flush groups checkpointed at once
+#   OP_COMMIT — atomic rename of a staged ``.rewrite`` object over its live
+#     twin: age-out rewrites stage slices beside the log and commit once,
+#     so a connection lost mid-rewrite leaves the live log untouched (a
+#     truncating in-place PUT destroyed already-replicated frames)
+OP_APPEND_CRC, OP_CHECKPOINT, OP_COMMIT = 5, 6, 7
 
 _MAX_HEADER = 1 << 16             # refuse absurd frames instead of OOMing
 _MAX_PAYLOAD = 256 << 20
 
-_ALLOWED = {"chunks.log", "partkeys.log", "meta.json", "checkpoint.json"}
+_ALLOWED = {"chunks.log", "partkeys.log", "meta.json", "checkpoint.json",
+            "chunks.log.rewrite"}
 
 
 class StoreServer:
@@ -60,9 +75,32 @@ class StoreServer:
         import os
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # serializes checkpoint merges (OP_CHECKPOINT): two flush groups
+        # committing concurrently must not lose each other's watermark
+        self._cp_lock = threading.Lock()
+        # per-object commit generation, bumped whenever a whole object is
+        # REPLACED (OP_COMMIT age-out promotion, OP_PUT): ranged readers
+        # compare the generation across their read to detect that offsets
+        # from the old file landed mid-frame in a rewritten one
+        self._gen_lock = threading.Lock()
+        self._gens: dict = {}
+        # established connections, severed by stop(): RemoteStore clients
+        # pool their socket, so a handler thread blocked in recv would keep
+        # SERVING a "stopped" node forever — an in-process kill must look
+        # like a process kill (reset the peer) for failover to engage
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 try:
                     while True:
@@ -107,11 +145,48 @@ class StoreServer:
             with open(path, "ab") as f:
                 f.write(payload)
             return b""
+        if op == OP_APPEND_CRC:
+            # refuse a frame whose bytes were damaged in flight: appending it
+            # would poison the log tail (the WAL parser stops at the first
+            # bad frame, hiding every later good one)
+            want = int(meta["crc"])
+            got = zlib.crc32(payload)
+            if got != want:
+                raise ValueError(
+                    f"chunk frame crc mismatch (got {got:#x}, want "
+                    f"{want:#x}); refusing append")
+            with open(path, "ab") as f:
+                f.write(payload)
+            return b""
+        if op == OP_CHECKPOINT:
+            # atomic server-side merge of one group's watermark
+            with self._cp_lock:
+                cp = {}
+                if os.path.exists(path):
+                    with open(path) as f:
+                        cp = json.load(f)
+                cp[str(int(meta["group"]))] = int(meta["offset"])
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(cp, f)
+                os.replace(tmp, path)
+            return b""
         if op == OP_PUT:
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(payload)
             os.replace(tmp, path)
+            self._bump_gen(path)
+            return b""
+        if op == OP_COMMIT:
+            # atomically promote a staged rewrite over the live object; the
+            # stage must exist (a lost rewrite must surface, not no-op)
+            if not path.endswith(".rewrite"):
+                raise ValueError("commit target must be a staged "
+                                 "'.rewrite' object")
+            live = path[:-len(".rewrite")]
+            os.replace(path, live)
+            self._bump_gen(live)
             return b""
         if op == OP_GET:
             if not os.path.exists(path):
@@ -123,8 +198,14 @@ class StoreServer:
                 return f.read(int(length)) if length is not None else f.read()
         if op == OP_STAT:
             size = os.path.getsize(path) if os.path.exists(path) else 0
-            return struct.pack("<Q", size)
+            with self._gen_lock:
+                gen = self._gens.get(path, 0)
+            return struct.pack("<QQ", size, gen)
         raise ValueError(f"unknown op {op}")
+
+    def _bump_gen(self, path: str) -> None:
+        with self._gen_lock:
+            self._gens[path] = self._gens.get(path, 0) + 1
 
     @property
     def port(self) -> int:
@@ -137,21 +218,44 @@ class StoreServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         self._thread.join(timeout=3)
 
 
 class RemoteStore(ChunkSink):
-    """ChunkSink client of a StoreServer; wire formats match FileColumnStore."""
+    """ChunkSink client of a StoreServer; wire formats match FileColumnStore.
 
-    def __init__(self, addr: str):
+    Connect and read are BOUNDED (``connect_timeout_s`` / ``timeout_s``): a
+    dead backend surfaces as a timeout the ReplicatedColumnStore fails over
+    from, instead of stalling the query/flush thread on a silent socket."""
+
+    remote_tier = True     # ODP accounting: pages come over the wire
+
+    def __init__(self, addr: str, timeout_s: float = 30.0,
+                 connect_timeout_s: float = 5.0):
         self.addr = addr
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
         self._sock = None
         self._lock = threading.Lock()
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
             host, port = self.addr.rsplit(":", 1)
-            self._sock = socket.create_connection((host, int(port)), timeout=30)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.connect_timeout_s)
+            s.settimeout(self.timeout_s)   # bounds every recv/send after
+            self._sock = s
         return self._sock
 
     def _request(self, op: int, dataset, shard, name, payload: bytes = b"",
@@ -174,8 +278,9 @@ class RemoteStore(ChunkSink):
     # -- ChunkSink: writes ---------------------------------------------------
 
     def write_chunkset(self, dataset, shard, group, records):
-        self._request(OP_APPEND, dataset, shard, "chunks.log",
-                      encode_chunkset(group, records))
+        buf = encode_chunkset(group, records)
+        self._request(OP_APPEND_CRC, dataset, shard, "chunks.log", buf,
+                      crc=zlib.crc32(buf))
 
     def write_part_keys(self, dataset, shard, entries):
         lines = "".join(
@@ -189,20 +294,30 @@ class RemoteStore(ChunkSink):
                       json.dumps(meta).encode())
 
     def write_checkpoint(self, dataset, shard, group, offset):
-        cp = self.read_checkpoints(dataset, shard)
-        cp[group] = offset
-        self._request(OP_PUT, dataset, shard, "checkpoint.json",
-                      json.dumps({str(k): v for k, v in cp.items()}).encode())
+        # one round trip, merged atomically server-side: the old client
+        # read-modify-write lost a concurrent group's commit
+        self._request(OP_CHECKPOINT, dataset, shard, "checkpoint.json",
+                      group=int(group), offset=int(offset))
 
     # -- reads ---------------------------------------------------------------
 
     def read_chunksets(self, dataset, shard, start_ms: int = 0,
                        end_ms: int = 1 << 62):
         # stream the log in ranged chunks instead of buffering it whole: the
-        # parser sees a buffered file-like over ranged GETs
+        # parser sees a buffered file-like over ranged GETs. The read takes
+        # no lock against an age-out rewrite (OP_COMMIT swaps the file), so
+        # bracket it with the server's commit generation: offsets from the
+        # old file land mid-frame in the rewritten one and iter_chunksets
+        # would silently truncate — raise instead, so the replicated layer
+        # fails over (or the caller retries) rather than serving a partial
+        # answer as complete
+        gen0 = self._stat(dataset, shard, "chunks.log")[1]
         raw = _RangedReader(self, dataset, shard, "chunks.log")
         yield from iter_chunksets(io.BufferedReader(raw, 1 << 20),
                                   start_ms, end_ms)
+        if self._stat(dataset, shard, "chunks.log")[1] != gen0:
+            raise IOError("chunks.log was rewritten (age-out commit) during "
+                          "a ranged read; rereading required")
 
     def read_part_keys(self, dataset, shard):
         blob = self._request(OP_GET, dataset, shard, "partkeys.log")
@@ -215,10 +330,14 @@ class RemoteStore(ChunkSink):
                 return
             yield e["id"], e["labels"], e["start"]
 
+    def _stat(self, dataset, shard, name) -> tuple:
+        """(byte size, commit generation) of a store object."""
+        body = self._request(OP_STAT, dataset, shard, name)
+        return struct.unpack("<QQ", body) if body else (0, 0)
+
     def chunk_log_size(self, dataset, shard) -> int:
         """Byte size of the shard's chunk log (cheap best-replica probe)."""
-        body = self._request(OP_STAT, dataset, shard, "chunks.log")
-        return struct.unpack("<Q", body)[0] if body else 0
+        return self._stat(dataset, shard, "chunks.log")[0]
 
     def read_meta(self, dataset, shard) -> dict:
         blob = self._request(OP_GET, dataset, shard, "meta.json")
@@ -227,6 +346,41 @@ class RemoteStore(ChunkSink):
     def read_checkpoints(self, dataset, shard):
         blob = self._request(OP_GET, dataset, shard, "checkpoint.json")
         return {int(k): v for k, v in json.loads(blob).items()} if blob else {}
+
+    # age_out rewrite slice size: comfortably under the server's
+    # _MAX_PAYLOAD frame cap (a whole-log single PUT would be silently
+    # dropped — connection severed, no response — once the log outgrew it)
+    _AGE_OUT_SLICE = 64 << 20
+
+    def age_out(self, dataset, shard, cutoff_ms: int) -> int:
+        """Durable raw retention: rewrite the shard's chunk log dropping
+        samples older than ``cutoff_ms`` (the caller serializes against
+        concurrent flush appends — see TimeSeriesShard.age_out_durable).
+        The rewrite stages beside the live log in bounded CRC'd slices and
+        commits with ONE atomic server-side rename (OP_COMMIT): a
+        connection lost mid-rewrite leaves the live log untouched — a
+        truncating in-place PUT would have destroyed already-replicated
+        frames on that replica. Returns samples dropped."""
+        # steady-state skip: probe the head frame with ONE small ranged
+        # read — when it holds nothing past the cutoff, the full pass
+        # would pull and decode the whole log over the network (and buffer
+        # the rewrite in memory) to drop zero samples, all while the
+        # caller holds every group flush lock (see head_frame_min_ts)
+        raw = _RangedReader(self, dataset, shard, "chunks.log")
+        head = head_frame_min_ts(io.BufferedReader(raw, 1 << 20))
+        if head is None or head >= cutoff_ms:
+            return 0
+        buf, dropped = encode_age_out(
+            self.read_chunksets(dataset, shard), cutoff_ms)
+        if dropped:
+            first, rest = buf[:self._AGE_OUT_SLICE], buf[self._AGE_OUT_SLICE:]
+            self._request(OP_PUT, dataset, shard, "chunks.log.rewrite", first)
+            for at in range(0, len(rest), self._AGE_OUT_SLICE):
+                sl = rest[at:at + self._AGE_OUT_SLICE]
+                self._request(OP_APPEND_CRC, dataset, shard,
+                              "chunks.log.rewrite", sl, crc=zlib.crc32(sl))
+            self._request(OP_COMMIT, dataset, shard, "chunks.log.rewrite")
+        return dropped
 
     def close(self):
         if self._sock is not None:
@@ -269,6 +423,17 @@ class ReplicatedColumnStore(ChunkSink):
     answer must not mask a complete one (ref: Cassandra replica placement;
     read-best stands in for read repair)."""
 
+    remote_tier = True     # ODP accounting: pages come over the wire
+
+    WRITE_ATTEMPTS = 2     # per-replica retries before the write is skipped
+    # writes safe to re-send to the SAME replica: meta/checkpoint replace
+    # atomically and part-key events dedup at recovery (latest-per-pid wins).
+    # Chunk appends are NOT here — a lost response after a server-side apply
+    # would duplicate the frame in that replica's log; they get one attempt
+    # per replica and rely on cross-replica failover instead
+    _IDEMPOTENT_WRITES = frozenset({"write_meta", "write_checkpoint",
+                                    "write_part_keys"})
+
     def __init__(self, backends: list, replication: int = 2):
         assert backends, "need at least one backend"
         self.backends = backends
@@ -280,16 +445,30 @@ class ReplicatedColumnStore(ChunkSink):
         return [self.backends[(start + i) % len(self.backends)]
                 for i in range(self.replication)]
 
+    @staticmethod
+    def _count_failover(op: str) -> None:
+        registry.counter(FILODB_RETENTION_REPLICA_FAILOVER,
+                         {"op": op}).increment()
+
     def _write(self, dataset, shard, fn_name, *args):
         wrote = 0
         last_err = None
+        attempts = (self.WRITE_ATTEMPTS
+                    if fn_name in self._IDEMPOTENT_WRITES else 1)
         for b in self._replicas(dataset, shard):
-            try:
-                getattr(b, fn_name)(dataset, shard, *args)
-                wrote += 1
-            except Exception as e:  # noqa: BLE001 - replica failure tolerated
-                last_err = e
-                log.warning("replica write %s failed on %r: %s", fn_name, b, e)
+            # idempotent writes get one bounded same-replica retry (a
+            # transient fault lands on retry); non-idempotent chunk appends
+            # take one attempt per replica — failover, never re-send (see
+            # _IDEMPOTENT_WRITES)
+            for attempt in range(attempts):
+                try:
+                    getattr(b, fn_name)(dataset, shard, *args)
+                    wrote += 1
+                    break
+                except Exception as e:  # noqa: BLE001 - replica tolerated
+                    last_err = e
+                    log.warning("replica write %s failed on %r "
+                                "(attempt %d): %s", fn_name, b, attempt + 1, e)
         if wrote == 0:
             raise IOError(f"all {self.replication} replicas failed") from last_err
         return wrote
@@ -318,6 +497,7 @@ class ReplicatedColumnStore(ChunkSink):
                             else res))
             except Exception as e:  # noqa: BLE001 - fail over
                 last_err = e
+                self._count_failover(fn_name)
                 log.warning("replica read %s failed on %r: %s", fn_name, b, e)
         if not out:
             raise IOError("all replicas failed") from last_err
@@ -373,6 +553,7 @@ class ReplicatedColumnStore(ChunkSink):
                 return list(b.read_chunksets(dataset, shard, start_ms, end_ms))
             except Exception as e:  # noqa: BLE001 - fail over
                 last_err = e
+                self._count_failover("read_chunksets")
                 log.warning("replica read failed on %r: %s", b, e)
         raise IOError("all replicas failed") from last_err
 
@@ -393,6 +574,22 @@ class ReplicatedColumnStore(ChunkSink):
             for g, off in (res or {}).items():
                 merged[g] = max(merged.get(g, -1), off)
         return merged
+
+    def age_out(self, dataset, shard, cutoff_ms: int) -> int:
+        """Age raw samples past the retention horizon out of EVERY replica
+        (each rewrites its own view — replicas may hold different frame
+        sets after an outage; a per-replica rewrite never copies one
+        replica's gaps onto another). Returns the max dropped count."""
+        dropped = 0
+        for b in self._replicas(dataset, shard):
+            if not hasattr(b, "age_out"):
+                continue
+            try:
+                dropped = max(dropped, b.age_out(dataset, shard, cutoff_ms))
+            except Exception as e:  # noqa: BLE001 - replica tolerated
+                self._count_failover("age_out")
+                log.warning("replica age_out failed on %r: %s", b, e)
+        return dropped
 
     def close(self):
         for b in self.backends:

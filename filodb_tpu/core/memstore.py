@@ -35,6 +35,9 @@ from .record import RecordContainer
 from .schemas import Schema, Schemas, part_key_of
 from .store import ChunkSetRecord, ChunkSink
 from ..utils.diagnostics import TimedRLock, assert_owned
+from ..utils.metrics import (FILODB_RETENTION_AGED_OUT_ROWS,
+                             FILODB_RETENTION_ODP_ROWS, registry)
+from ..utils.tracing import SPAN_ODP_DURABLE, span
 
 
 @dataclass
@@ -128,6 +131,11 @@ class TimeSeriesShard:
                           if self._native_ps is not None else None)
         # bumped on every partition release: invalidates batch-resolved pids
         self._release_epoch = 0
+        # O(1) data-time lead: the max sample timestamp ever staged or
+        # recovered into this shard. The retention router consults it per
+        # query — a full last_ts scan there would cost O(max_series) on
+        # every query's hot path (monotonic: purge/compact never lower it)
+        self.lead_ms = 0
         # ingest/mutation watermark: bumped (under the shard lock) whenever
         # query-visible data changes — rows staged, partitions released,
         # retention compaction. The query result cache records the cluster
@@ -571,6 +579,9 @@ class TimeSeriesShard:
         self._stage_pid.append(pids)
         self._stage_ts.append(ts)
         self._stage_val.append(vals)
+        lead = int(ts.max())
+        if lead > self.lead_ms:
+            self.lead_ms = lead
         self._staged += len(ts)
         self._pending_offset = max(self._pending_offset, offset)
         self.stats.rows_ingested += len(ts)
@@ -883,6 +894,9 @@ class TimeSeriesShard:
             if len(pids):
                 with self.lock:   # append donates the store buffers
                     self.store.append(pids, ts, vals)
+                    lead = int(ts.max())
+                    if lead > self.lead_ms:
+                        self.lead_ms = lead
         # between chunk load and replay: replayed rows flow through the
         # normal flush pipeline, so state seeded here (e.g. the streaming
         # downsampler's open buckets) sees each sample exactly once
@@ -958,17 +972,54 @@ class TimeSeriesShard:
     def read_cold_for(self, pids: np.ndarray, start_ms: int, end_ms: int):
         """Sink-side cold chunks for the given pids: pid -> ([ts...], [vals...]).
         Needs NO shard lock — sink logs are append-only and torn-tolerant, so
-        wide paged scans must not stall ingest while reading disk."""
+        wide paged scans must not stall ingest while reading disk. The scan
+        is traced and its paged samples counted per tier: a remote sink
+        (StoreServer ring) is the cluster-wide durable-tier ODP path."""
         cold_ts: dict[int, list] = {int(p): [] for p in pids}
         cold_val: dict[int, list] = {int(p): [] for p in pids}
         reader = getattr(self.sink, "read_chunksets", None)
         if reader is not None:
-            for _g, records in reader(self.dataset, self.shard_num, start_ms, end_ms) or ():
-                for r in records:
-                    if r.part_id in cold_ts:
-                        cold_ts[r.part_id].append(r.ts)
-                        cold_val[r.part_id].append(np.asarray(r.values))
+            tier = ("remote" if getattr(self.sink, "remote_tier", False)
+                    else "local")
+            rows = 0
+            with span(SPAN_ODP_DURABLE, shard=self.shard_num,
+                      tier=tier) as tags:
+                for _g, records in reader(self.dataset, self.shard_num,
+                                          start_ms, end_ms) or ():
+                    for r in records:
+                        if r.part_id in cold_ts:
+                            cold_ts[r.part_id].append(r.ts)
+                            cold_val[r.part_id].append(np.asarray(r.values))
+                            rows += len(r.ts)
+                tags["rows"] = rows
+            if rows:
+                registry.counter(FILODB_RETENTION_ODP_ROWS,
+                                 {"dataset": self.dataset,
+                                  "tier": tier}).increment(rows)
         return cold_ts, cold_val
+
+    def age_out_durable(self, cutoff_ms: int) -> int:
+        """Durable raw retention (retention.raw_ttl): drop sink samples older
+        than ``cutoff_ms`` and bump ``data_epoch`` so cached results over the
+        aged-out range invalidate. All group flush locks are held across the
+        log rewrite — flush_group appends are serialized per group through
+        them, so the rewrite can never lose a concurrent append."""
+        import contextlib
+        sink = self.sink
+        if sink is None or not hasattr(sink, "age_out"):
+            return 0
+        with contextlib.ExitStack() as stack:
+            for lk in self._group_flush_locks:   # ascending index: in-order
+                stack.enter_context(lk)
+            dropped = int(sink.age_out(self.dataset, self.shard_num,
+                                       cutoff_ms))
+        if dropped:
+            with self.lock:
+                self.data_epoch += 1   # result-cache watermark: rows aged out
+            registry.counter(FILODB_RETENTION_AGED_OUT_ROWS,
+                             {"dataset": self.dataset,
+                              "shard": str(self.shard_num)}).increment(dropped)
+        return dropped
 
     def read_with_paging(self, pids: np.ndarray, start_ms: int, end_ms: int,
                          cold=None, column=None):
@@ -1027,8 +1078,18 @@ class TimeSeriesShard:
                 own_start = self.index.start_time(p)
                 sel = (ct < boundary) & (ct >= own_start)
                 order = np.argsort(ct[sel], kind="stable")
-                rows_ts.append(np.concatenate([ct[sel][order], hot_t]))
-                rows_val.append(np.concatenate([cv[sel][order], hot_v]))
+                st, sv = ct[sel][order], cv[sel][order]
+                if len(st):
+                    # keep-first timestamp dedup: a requeued flush after a
+                    # partial sink failure (or a lost-response write) can
+                    # leave duplicate frames in the log — recovery replay
+                    # dedups via the store's out-of-order drop, and the
+                    # paged read path must match it or duplicated samples
+                    # double-count in sum/count_over_time
+                    keep = np.concatenate([[True], np.diff(st) > 0])
+                    st, sv = st[keep], sv[keep]
+                rows_ts.append(np.concatenate([st, hot_t]))
+                rows_val.append(np.concatenate([sv, hot_v]))
             else:
                 rows_ts.append(hot_t)
                 rows_val.append(hot_v)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -200,6 +201,48 @@ def iter_chunksets(f, start_ms: int = 0, end_ms: int = 1 << 62):
             yield group, records
 
 
+def head_frame_min_ts(f):
+    """Min timestamp of the FIRST chunk-log frame on a stream (None when the
+    log is empty/torn): the cheap age-out skip probe. Frames append in flush
+    order, so between TTL boundaries (the steady state) the head frame holds
+    nothing past the cutoff and the full read-decode-rewrite pass — which
+    would drop nothing — can be skipped after one small read. Out-of-order
+    older samples in LATER frames are only deferred, never retained forever:
+    the cutoff advances with the data lead, so once it passes the head
+    frame's own timestamps a full pass runs and drops them."""
+    head = next(iter_chunksets(f), None)
+    if head is None:
+        return None
+    _group, records = head
+    return min(int(r.ts[0]) for r in records)
+
+
+def encode_age_out(chunksets, cutoff_ms: int) -> tuple[bytes, int]:
+    """Re-encode a chunk-log stream keeping only samples at or after
+    ``cutoff_ms`` (the durable raw-retention compaction, shared by the local
+    file store and the remote store client). Returns (new log bytes, samples
+    dropped); records emptied entirely are elided, untouched records
+    re-encode bit-identically (same codecs, same order)."""
+    frames = []
+    dropped = 0
+    for group, records in chunksets or ():
+        keep = []
+        for r in records:
+            sel = r.ts >= cutoff_ms
+            if sel.all():
+                keep.append(r)
+            elif sel.any():
+                keep.append(ChunkSetRecord(r.part_id, r.ts[sel],
+                                           np.asarray(r.values)[sel],
+                                           r.layout))
+                dropped += int((~sel).sum())
+            else:
+                dropped += len(r.ts)
+        if keep:
+            frames.append(encode_chunkset(group, keep))
+    return b"".join(frames), dropped
+
+
 class FileColumnStore(ChunkSink):
     """Durable columnar chunk store on local disk (the Cassandra-equivalent)."""
 
@@ -229,6 +272,31 @@ class FileColumnStore(ChunkSink):
             return
         with open(path, "rb") as f:
             yield from iter_chunksets(f, start_ms, end_ms)
+
+    def age_out(self, dataset, shard, cutoff_ms: int) -> int:
+        """Durable raw retention: atomically rewrite the chunk log dropping
+        samples older than ``cutoff_ms`` (caller serializes against
+        concurrent flush appends — see TimeSeriesShard.age_out_durable).
+        Returns samples dropped."""
+        path = os.path.join(self._dir(dataset, shard), "chunks.log")
+        if not os.path.exists(path):
+            return 0
+        # steady-state skip: when the head frame holds nothing past the
+        # cutoff, the full pass would read/decode/re-encode the whole log
+        # to drop zero samples (see head_frame_min_ts)
+        with open(path, "rb") as f:
+            head = head_frame_min_ts(f)
+        if head is None or head >= cutoff_ms:
+            return 0
+        # materialize BEFORE replacing: read_chunksets streams the same file
+        buf, dropped = encode_age_out(
+            list(self.read_chunksets(dataset, shard)), cutoff_ms)
+        if dropped:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(buf)
+            os.replace(tmp, path)   # atomic commit
+        return dropped
 
     # -- part keys ------------------------------------------------------------
 
@@ -272,14 +340,21 @@ class FileColumnStore(ChunkSink):
 
     # -- checkpoints (ref: cassandra/.../metastore/CheckpointTable.scala) ------
 
+    # serializes the checkpoint read-modify-write across ALL instances of a
+    # process (tests open several stores over one root): two flush groups
+    # committing concurrently must not lose each other's watermark — the
+    # same contract OP_CHECKPOINT gives the remote tier server-side
+    _checkpoint_lock = threading.Lock()
+
     def write_checkpoint(self, dataset, shard, group, offset):
         path = os.path.join(self._dir(dataset, shard), "checkpoint.json")
-        cp = self.read_checkpoints(dataset, shard)
-        cp[group] = offset
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({str(k): v for k, v in cp.items()}, f)
-        os.replace(tmp, path)   # atomic commit
+        with FileColumnStore._checkpoint_lock:
+            cp = self.read_checkpoints(dataset, shard)
+            cp[group] = offset
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({str(k): v for k, v in cp.items()}, f)
+            os.replace(tmp, path)   # atomic commit
 
     def read_checkpoints(self, dataset, shard):
         path = os.path.join(self._dir(dataset, shard), "checkpoint.json")

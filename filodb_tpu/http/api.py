@@ -297,18 +297,24 @@ class FiloHttpServer:
             # tenant identity for admission quotas: header wins over the
             # query param (proxies inject the header; dashboards the param)
             tenant = h.headers.get("X-Filo-Tenant") or q.get("tenant") or None
+            # &resolution=: per-query retention routing override ("raw" /
+            # "1m" / ...) — validated by the engine against the configured
+            # set (unknown values fail 422 with the available list)
+            resolution = q.get("resolution") or None
             if m.group(2) == "query_range":
                 res = self._run(
                     lambda: engine.query_range(q["query"], _parse_time(q["start"]),
                                                _parse_time(q["end"]),
                                                _parse_step(q["step"]),
-                                               tenant=tenant),
+                                               tenant=tenant,
+                                               resolution=resolution),
                     Priority.QUERY)
             else:
                 res = self._run(
                     lambda: engine.query_instant(q["query"],
                                                  _parse_time(q["time"]),
-                                                 tenant=tenant),
+                                                 tenant=tenant,
+                                                 resolution=resolution),
                     Priority.QUERY)
             body = {"status": "success", "data": matrix_to_prom_json(res)}
             if res.stats is not None:
@@ -352,7 +358,11 @@ class FiloHttpServer:
                 for filt in (mfilter_sets or [None]):
                     out.update(engine.label_names(filt,
                                                   local_only=local_only))
-                return sorted(out)
+                # Prometheus surface: the internal metric label renders as
+                # __name__ (the series endpoint already maps it; labels
+                # must agree so UI discovery works on ds families too)
+                return sorted("__name__" if n == "_metric_" else n
+                              for n in out)
 
             h._send(200, {"status": "success",
                           "data": self._run(fetch_names, Priority.METADATA)})
@@ -361,6 +371,11 @@ class FiloHttpServer:
         if m:
             engine = self.engines[m.group(1)]
             name = m.group(2)
+            # Prometheus surface: /labels advertises __name__ for the
+            # internal _metric_ label — fold it back so discovered-name
+            # lookups hit the index instead of returning empty
+            if name == "__name__":
+                name = "_metric_"
             top_k = int(q["top_k"]) if q.get("top_k") else None
             # counts=1: peer-leg form — return [value, series_count] pairs so
             # the caller can re-rank ACROSS nodes (a value barely in one

@@ -401,6 +401,10 @@ class QueryEngine:
         # cooldown window instead of every query
         self._epoch_probe_cooldown_s = 10.0
         self._epoch_probe_down_until = 0.0
+        # downsample-aware routing (query/retention.py RetentionRouter),
+        # installed by FiloServer on the RAW engine when retention.routing
+        # is on; family serving engines never carry one (no re-routing)
+        self.retention = None
         schema = memstore._dataset_schema.get(dataset)
         opts = schema.options if schema else None
         route = self._route_endpoint if cluster is not None else None
@@ -439,16 +443,48 @@ class QueryEngine:
             ctx.exec_path = path
 
     def query_range(self, promql_text: str, start_ms: int, end_ms: int,
-                    step_ms: int, tenant: str | None = None) -> QueryResult:
-        return self._query_traced(
+                    step_ms: int, tenant: str | None = None,
+                    resolution: str | None = None,
+                    _skip_routing: bool = False) -> QueryResult:
+        """``resolution`` (&resolution= / filo-cli --resolution) overrides
+        the retention router's decision for the whole range; it requires
+        routing to be configured (unknown values fail with the available
+        list). ``_skip_routing`` is the router's own raw-tail leg."""
+        if self.retention is not None and not _skip_routing:
+            routed = self.retention.route_range(
+                self, promql_text, int(start_ms), int(end_ms), int(step_ms),
+                tenant, resolution)
+            if routed is not None:
+                return routed
+        elif resolution is not None and not _skip_routing:
+            raise QueryError(
+                "resolution override requires retention routing "
+                "(retention.routing + downsample.enabled); none configured")
+        res = self._query_traced(
             promql_text,
             lambda: promql.query_to_logical_plan(promql_text, start_ms,
                                                  end_ms, step_ms),
             range_key=(int(start_ms), int(end_ms), int(step_ms)),
             tenant=tenant)
+        if self.retention is not None and res.stats is not None \
+                and res.stats.resolution is None:
+            res.stats.resolution = "raw"   # routing ran and chose raw
+        return res
 
     def query_instant(self, promql_text: str, time_ms: int,
-                      tenant: str | None = None) -> QueryResult:
+                      tenant: str | None = None,
+                      resolution: str | None = None) -> QueryResult:
+        if self.retention is not None:
+            routed = self.retention.route_instant(self, promql_text,
+                                                  int(time_ms), tenant,
+                                                  resolution)
+            if routed is not None:
+                routed.result_type = "vector"
+                return routed
+        elif resolution is not None:
+            raise QueryError(
+                "resolution override requires retention routing "
+                "(retention.routing + downsample.enabled); none configured")
         res = self._query_traced(
             promql_text,
             lambda: promql.query_to_logical_plan(promql_text, time_ms,
@@ -1214,8 +1250,11 @@ class QueryEngine:
         for shard in self.memstore.shards_of(self.dataset):
             names.update(shard.label_names(filters))
         if not local_only:
-            names.update(self._peer_metadata(
-                "/api/v1/labels" + self._match_suffix(filters)))
+            # peers answer on the Prometheus surface (__name__); fold back
+            # to the internal metric label so the merge stays canonical
+            names.update("_metric_" if n == "__name__" else n
+                         for n in self._peer_metadata(
+                             "/api/v1/labels" + self._match_suffix(filters)))
         return sorted(names)
 
     def series(self, filters, start_ms: int, end_ms: int,

@@ -1173,7 +1173,12 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def _paged_selection(self, shard, pids, keys, cold=None,
                          column=None) -> SeriesSelection:
-        with span(SPAN_QUERY_ODP, shard=self.shard, series=len(pids)):
+        # tier tag: a remote sink (StoreServer ring) means the page-in paid
+        # the durable tier's network round trips, not just local disk
+        tier = ("remote" if getattr(shard.sink, "remote_tier", False)
+                else "local")
+        with span(SPAN_QUERY_ODP, shard=self.shard, series=len(pids),
+                  tier=tier):
             ts_h, val_h, n_h = shard.read_with_paging(pids, self.start_ms,
                                                       self.end_ms, cold=cold,
                                                       column=column)

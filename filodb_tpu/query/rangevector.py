@@ -137,6 +137,10 @@ class QueryStats:
         self.fused_kernels = 0         # fused-resident kernel executions
                                        # (ops/fusedresident.py) in this query
         self.admission_shed = 0        # shed by cost-based admission
+        # serving resolution the retention router picked ("raw" / "1m" /
+        # "1h+raw" for a stitched range); None when routing is off — a
+        # label, not a counter, so merge() keeps the top-level value
+        self.resolution: str | None = None
         self.stage_ms: dict[str, float] = {}
         self._lock = threading.Lock()
 
@@ -177,6 +181,8 @@ class QueryStats:
     def to_dict(self) -> dict:
         with self._lock:
             out = {f: getattr(self, f) for f in self.FIELDS}
+            if self.resolution is not None:
+                out["resolution"] = self.resolution
             out["stage_ms"] = {k: round(v, 3)
                                for k, v in self.stage_ms.items()}
         return out

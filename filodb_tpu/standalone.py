@@ -264,6 +264,7 @@ class FiloServer:
         self._cascade_stop = None
         self._cascade_wm: dict[int, int] = {}
         self._ds_serve_stop = None
+        self._retention_stop = None
         self._endpoints: dict[str, str] = {}
         self._endpoints_at = 0.0
         self._zipkin = None
@@ -504,10 +505,16 @@ class FiloServer:
             self.manager.add_dataset(dataset, num_shards)
         if cfg.get("store_nodes"):
             # remote storage nodes with replication (the Cassandra-layer
-            # deployment shape; ref: CassandraTSStoreFactory wiring)
+            # deployment shape; ref: CassandraTSStoreFactory wiring) —
+            # links get bounded connect/read timeouts so a dead backend
+            # fails over instead of stalling flush/query threads
             from .core.diststore import RemoteStore, ReplicatedColumnStore
+            store_tmo = parse_duration_ms(
+                cfg["retention.store_timeout"]) / 1000.0
             self._sink = ReplicatedColumnStore(
-                [RemoteStore(a) for a in cfg["store_nodes"]],
+                [RemoteStore(a, timeout_s=store_tmo,
+                             connect_timeout_s=min(store_tmo, 5.0))
+                 for a in cfg["store_nodes"]],
                 replication=cfg.get("store_replication") or 2)
         else:
             self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
@@ -553,6 +560,22 @@ class FiloServer:
             self.memstore, dataset, mapper, cfg.query_config(), mesh=mesh,
             cluster=self.manager, node=self.node,
             endpoint_resolver=self._resolve_endpoint)
+        if cfg.get("retention.routing"):
+            # downsample-aware routing on the RAW engine: long-range /
+            # coarse-step queries serve from the ds_family whose resolution
+            # best covers [start,end,step], stitching the recent raw tail
+            # (query/retention.py; family engines resolve live from
+            # self.engines — the serving refresh below keeps them fresh)
+            from .core.downsample import ds_family as _fam_of
+            from .query.retention import RetentionPolicy, RetentionRouter
+            policy = RetentionPolicy.from_config(
+                cfg.get("retention.resolutions") or [], list(self._ds_res),
+                raw_window_ms=self._store_cfg.retention_ms)
+            self.engines[dataset].retention = RetentionRouter(
+                policy,
+                lambda res_ms, _ds=dataset: self.engines.get(
+                    _fam_of(_ds, res_ms)),
+                dataset=dataset)
 
         # remote-write sink: durable bus publish when configured, else direct
         # ingest. The whole batch is validated against owned shards BEFORE
@@ -776,6 +799,40 @@ class FiloServer:
 
             threading.Thread(target=cascade_loop, daemon=True,
                              name="cascade-downsampler").start()
+        if cfg.get("retention.raw_ttl") is not None and self._sink is not None:
+            # durable raw age-out: drop sink samples older than raw_ttl on a
+            # cadence; each pass bumps the shard's data_epoch so cached
+            # results over the aged-out range invalidate (the downsample
+            # families keep the history at their resolutions)
+            self._retention_stop = threading.Event()
+            raw_ttl_ms = parse_duration_ms(cfg["retention.raw_ttl"])
+            compact_s = parse_duration_ms(
+                cfg["retention.compact_interval"]) / 1000.0
+
+            def retention_loop(_ds=dataset):
+                # broad on purpose: ANY fault must not kill the age-out
+                # loop for the server's lifetime (filolint:
+                # resource-worker-silent-death)
+                while not self._retention_stop.wait(compact_s):
+                    try:
+                        with self._shards_lock:
+                            owned = sorted(self._running)
+                        for s in owned:
+                            sh = self.memstore.shard(_ds, s)
+                            # O(1) per-shard data-lead watermark (the same
+                            # one the router reads) — not an O(max_series)
+                            # last_ts scan per pass
+                            lead = int(getattr(sh, "lead_ms", 0))
+                            if lead > 0:
+                                n = sh.age_out_durable(lead - raw_ttl_ms)
+                                if n:
+                                    log.info("retention: aged %d raw "
+                                             "samples out of shard %d", n, s)
+                    except Exception:  # noqa: BLE001
+                        log.exception("retention age-out pass failed")
+
+            threading.Thread(target=retention_loop, daemon=True,
+                             name="retention-ageout").start()
         if cfg.get("profiler.enabled"):
             from .utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler(
@@ -828,6 +885,8 @@ class FiloServer:
             self._cascade_stop.set()
         if self._ds_serve_stop is not None:
             self._ds_serve_stop.set()
+        if self._retention_stop is not None:
+            self._retention_stop.set()
         if self._gw_flush_stop is not None:
             self._gw_flush_stop.set()
         if self.gateway is not None:

@@ -64,6 +64,10 @@ FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS = \
     "filodb_query_negative_cache_evictions"
 FILODB_INGEST_PUBLISH_LATENCY_MS = "filodb_ingest_publish_latency_ms"
 FILODB_TRACE_SPANS = "filodb_trace_spans"
+FILODB_RETENTION_ROUTED_QUERIES = "filodb_retention_routed_queries"
+FILODB_RETENTION_ODP_ROWS = "filodb_retention_odp_rows"
+FILODB_RETENTION_REPLICA_FAILOVER = "filodb_retention_replica_failover"
+FILODB_RETENTION_AGED_OUT_ROWS = "filodb_retention_aged_out_rows"
 
 METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_INGESTED_ROWS: (
@@ -186,6 +190,23 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_TRACE_SPANS: (
         "counter", "Spans recorded into the tracer ring buffer (sampled-in "
                    "only; sampled-out spans cost no clock reads)."),
+    FILODB_RETENTION_ROUTED_QUERIES: (
+        "counter", "Queries the retention router served from a downsample "
+                   "family (tagged dataset + resolution; stitched raw+ds "
+                   "queries count under the family's resolution)."),
+    FILODB_RETENTION_ODP_ROWS: (
+        "counter", "Samples paged in from the durable chunk tier by "
+                   "on-demand paging, tagged tier=local|remote (remote = "
+                   "the replicated StoreServer ring)."),
+    FILODB_RETENTION_REPLICA_FAILOVER: (
+        "counter", "Replica reads that failed and fell over to the next "
+                   "backend of the ReplicatedColumnStore ring (tagged by "
+                   "op; a rising rate means a dead or flapping "
+                   "StoreServer)."),
+    FILODB_RETENTION_AGED_OUT_ROWS: (
+        "counter", "Raw samples aged out of the durable tier past "
+                   "retention.raw_ttl (each pass also bumps the shard's "
+                   "data_epoch so cached results invalidate)."),
     "filodb_shard_*": (
         "gauge", "Per-shard ingest/eviction stats exported from the shard's "
                  "IngestStats dataclass fields on each /metrics scrape."),

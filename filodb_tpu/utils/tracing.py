@@ -70,6 +70,8 @@ SPAN_BROKER_APPEND = "ingest.broker.append"
 SPAN_REPLICATE = "ingest.replicate"
 SPAN_REPLICATE_SERVE = "ingest.replicate.serve"
 SPAN_INGEST_CONSUME = "ingest.consume"
+SPAN_QUERY_RETENTION = "query.retention"
+SPAN_ODP_DURABLE = "query.odp.durable"
 
 TRACE_SPEC: dict[str, str] = {
     SPAN_QUERY: "Root span of one PromQL query (tags: dataset, promql).",
@@ -107,6 +109,12 @@ TRACE_SPEC: dict[str, str] = {
                           "append (tags: partition, broker).",
     SPAN_INGEST_CONSUME: "One consumer drain: bus containers scattered "
                          "into the shard store (tags: dataset, shard).",
+    SPAN_QUERY_RETENTION: "Downsample-aware routing of one query: the "
+                          "resolution decision and its routed/stitched "
+                          "leg queries hang under it (tags: dataset, "
+                          "resolution, stitched).",
+    SPAN_ODP_DURABLE: "Durable-tier chunk scan of one ODP page-in batch "
+                      "(tags: shard, tier=local|remote, rows).",
 }
 
 

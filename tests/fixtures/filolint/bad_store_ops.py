@@ -1,0 +1,46 @@
+"""Bad twin for the StoreServer op-space wirecheck (WIRE_SPEC op_specs,
+diststore flavor): the streaming append op OP_APPEND_CRC is sent by the
+client but has no server dispatch branch, the checkpoint op OP_CHECKPOINT is
+dispatched but never sent (the client still does its racy read-modify-write),
+and OP_STAT collides with OP_GET's value. Analyzed with a custom WIRE_SPEC
+whose op_spec names this file (tests/test_static_analysis.py)."""
+
+OP_APPEND, OP_PUT, OP_GET = 1, 2, 3
+OP_STAT = 3            # collision with OP_GET
+OP_APPEND_CRC = 5
+OP_CHECKPOINT = 6
+
+
+class StoreServer:
+    def _serve(self, op, meta, payload):
+        if op == OP_APPEND:
+            return b""
+        if op == OP_PUT:
+            return b""
+        if op == OP_GET:
+            return payload
+        if op == OP_STAT:
+            return b"\x00" * 8
+        if op == OP_CHECKPOINT:
+            return b""
+        raise ValueError(f"unknown op {op}")
+
+
+class RemoteStore:
+    def write_chunkset(self, payload):
+        return self._request(OP_APPEND_CRC, payload)
+
+    def write_part_keys(self, payload):
+        return self._request(OP_APPEND, payload)
+
+    def write_meta(self, payload):
+        return self._request(OP_PUT, payload)
+
+    def read(self):
+        return self._request(OP_GET, b"")
+
+    def stat(self):
+        return self._request(OP_STAT, b"")
+
+    def _request(self, op, payload):
+        return op, payload

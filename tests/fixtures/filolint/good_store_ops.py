@@ -1,0 +1,47 @@
+"""Good twin for the StoreServer op-space wirecheck (WIRE_SPEC op_specs,
+diststore flavor): every op — including the streaming OP_APPEND_CRC and the
+atomic OP_CHECKPOINT — is dispatched by the server AND sent by the client,
+with distinct values."""
+
+OP_APPEND, OP_PUT, OP_GET, OP_STAT = 1, 2, 3, 4
+OP_APPEND_CRC, OP_CHECKPOINT = 5, 6
+
+
+class StoreServer:
+    def _serve(self, op, meta, payload):
+        if op == OP_APPEND:
+            return b""
+        if op == OP_APPEND_CRC:
+            return b""
+        if op == OP_CHECKPOINT:
+            return b""
+        if op == OP_PUT:
+            return b""
+        if op == OP_GET:
+            return payload
+        if op == OP_STAT:
+            return b"\x00" * 8
+        raise ValueError(f"unknown op {op}")
+
+
+class RemoteStore:
+    def write_chunkset(self, payload):
+        return self._request(OP_APPEND_CRC, payload)
+
+    def write_part_keys(self, payload):
+        return self._request(OP_APPEND, payload)
+
+    def write_meta(self, payload):
+        return self._request(OP_PUT, payload)
+
+    def write_checkpoint(self, group, offset):
+        return self._request(OP_CHECKPOINT, b"")
+
+    def read(self):
+        return self._request(OP_GET, b"")
+
+    def stat(self):
+        return self._request(OP_STAT, b"")
+
+    def _request(self, op, payload):
+        return op, payload

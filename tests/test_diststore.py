@@ -169,3 +169,189 @@ def test_batch_downsample_over_splits_matches_single_pass(tmp_path):
     np.testing.assert_array_equal(st_all[order], one[0].ts)
     np.testing.assert_allclose(sv_all[order],
                                np.asarray(one[0].values)[:, ci])
+
+
+# -- PR 10: streaming/checkpoint ops, bounded timeouts, failover counter ------
+
+def test_crc_verified_append_refuses_corrupt_frame(tmp_path):
+    """OP_APPEND_CRC: the server recomputes the payload checksum and refuses
+    a damaged frame — nothing lands in the log (a bad frame would hide every
+    later good one behind the WAL parser's truncation)."""
+    import zlib
+    from filodb_tpu.core.diststore import OP_APPEND_CRC
+    from filodb_tpu.core.store import encode_chunkset
+    srv = StoreServer(str(tmp_path / "n0")).start()
+    try:
+        st = RemoteStore(f"127.0.0.1:{srv.port}")
+        buf = encode_chunkset(0, [ChunkSetRecord(
+            0, BASE + np.arange(4) * IV, np.arange(4.0))])
+        with pytest.raises(IOError, match="crc mismatch"):
+            st._request(OP_APPEND_CRC, "ds", 0, "chunks.log", buf,
+                        crc=zlib.crc32(buf) ^ 0xDEAD)
+        assert st.chunk_log_size("ds", 0) == 0
+        # the good frame (write_chunkset computes the crc) lands
+        st.write_chunkset("ds", 0, 0, [ChunkSetRecord(
+            0, BASE + np.arange(4) * IV, np.arange(4.0))])
+        assert st.chunk_log_size("ds", 0) > 0
+        assert sum(len(r.ts) for _g, recs in st.read_chunksets("ds", 0)
+                   for r in recs) == 4
+    finally:
+        srv.stop()
+
+
+def test_checkpoint_op_merges_atomically_across_groups(tmp_path):
+    """OP_CHECKPOINT is a single server-side merge: concurrent groups can
+    no longer lose each other's watermark to the old client
+    read-modify-write (two groups committing at once raced on
+    checkpoint.json)."""
+    import threading
+    srv = StoreServer(str(tmp_path / "n0")).start()
+    try:
+        st = RemoteStore(f"127.0.0.1:{srv.port}")
+        # each group checkpoints over its own connection, concurrently
+        clients = [RemoteStore(f"127.0.0.1:{srv.port}") for _ in range(8)]
+        threads = [threading.Thread(target=clients[g].write_checkpoint,
+                                    args=("ds", 0, g, 100 + g))
+                   for g in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert st.read_checkpoints("ds", 0) == {g: 100 + g for g in range(8)}
+    finally:
+        srv.stop()
+
+
+def test_dead_backend_times_out_and_fails_over(tmp_path):
+    """A backend that accepts connections but never answers (dead disk,
+    wedged node) must not stall the read: the bounded read timeout fails it
+    over to the healthy replica and counts the failover."""
+    import socket
+    from filodb_tpu.utils.metrics import (FILODB_RETENTION_REPLICA_FAILOVER,
+                                          registry)
+    # black hole: accepts and then ignores the connection
+    hole = socket.socket()
+    hole.bind(("127.0.0.1", 0))
+    hole.listen(4)
+    srv = StoreServer(str(tmp_path / "good")).start()
+    try:
+        dead = RemoteStore(f"127.0.0.1:{hole.getsockname()[1]}",
+                           timeout_s=0.3, connect_timeout_s=0.3)
+        live = RemoteStore(f"127.0.0.1:{srv.port}")
+        live.write_part_keys("prometheus", 0, [(0, {"_metric_": "m"}, 1)])
+        live.write_chunkset("prometheus", 0, 0, [ChunkSetRecord(
+            0, BASE + np.arange(4) * IV, np.arange(4.0))])
+        repl = ReplicatedColumnStore([dead, live], replication=2)
+        c = registry.counter(FILODB_RETENTION_REPLICA_FAILOVER,
+                             {"op": "read_part_keys"})
+        before = c.value
+        keys = list(repl.read_part_keys("prometheus", 0))
+        assert len(keys) == 1
+        assert c.value > before       # the dead replica's failure counted
+        recs = list(repl.read_chunksets("prometheus", 0))
+        assert recs and len(recs[0][1][0].ts) == 4
+    finally:
+        srv.stop()
+        hole.close()
+
+
+def test_stop_severs_established_connections_and_reads_fail_over(tmp_path):
+    """StoreServer.stop() must reset pooled client sockets, not just close
+    the listener: RemoteStore keeps one connection open, so a handler
+    thread blocked in recv would keep SERVING a "stopped" node forever —
+    an in-process kill has to look like a process kill for the
+    ReplicatedColumnStore failover path (and its counter) to engage."""
+    from filodb_tpu.utils.metrics import (FILODB_RETENTION_REPLICA_FAILOVER,
+                                          registry)
+    a = StoreServer(str(tmp_path / "a")).start()
+    b = StoreServer(str(tmp_path / "b")).start()
+    try:
+        repl = ReplicatedColumnStore(
+            [RemoteStore(f"127.0.0.1:{a.port}", timeout_s=2.0,
+                         connect_timeout_s=1.0),
+             RemoteStore(f"127.0.0.1:{b.port}", timeout_s=2.0,
+                         connect_timeout_s=1.0)], replication=2)
+        repl.write_chunkset("ds", 0, 0, [ChunkSetRecord(
+            0, BASE + np.arange(4) * IV, np.arange(4.0))])
+        # both replicas hold the frame and both client sockets are pooled
+        n0 = sum(len(r.ts) for _g, recs in repl.read_chunksets("ds", 0, 0,
+                 BASE + 10 * IV) for r in recs)
+        assert n0 == 4
+        c = registry.counter(FILODB_RETENTION_REPLICA_FAILOVER,
+                             {"op": "read_chunksets"})
+        before = c.value
+        a.stop()                       # no client-side close(): stop() alone
+        n1 = sum(len(r.ts) for _g, recs in repl.read_chunksets("ds", 0, 0,
+                 BASE + 10 * IV) for r in recs)
+        assert n1 == 4                 # served by the survivor
+        assert c.value > before        # the severed replica counted as
+                                       # a failover, not silently served
+    finally:
+        for s in (a, b):
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 - already stopped
+                pass
+
+
+def test_ranged_read_detects_concurrent_age_out_rewrite(tmp_path):
+    """An age-out commit (OP_COMMIT atomic rename) swaps chunks.log under a
+    lock-free ranged reader: offsets from the old file land mid-frame in
+    the rewritten one and iter_chunksets would silently truncate. The
+    client brackets the read with the server's commit generation and
+    raises instead — the replicated layer turns that into failover, the
+    direct caller into a retry, never into a partial answer served as
+    complete."""
+    srv = StoreServer(str(tmp_path / "node0")).start()
+    try:
+        st = RemoteStore(f"127.0.0.1:{srv.port}")
+        for g in range(2):
+            st.write_chunkset("ds", 0, g, [ChunkSetRecord(
+                g, BASE + np.arange(6) * IV, np.arange(6.0))])
+        # a clean read completes (same generation on both sides)
+        assert len(list(st.read_chunksets("ds", 0))) == 2
+        it = st.read_chunksets("ds", 0)
+        next(it)                               # generation captured
+        st2 = RemoteStore(f"127.0.0.1:{srv.port}")
+        dropped = st2.age_out("ds", 0, BASE + 100 * IV)   # rewrite + commit
+        assert dropped == 12
+        with pytest.raises(IOError, match="rewritten"):
+            list(it)                           # exhaust -> detect the swap
+        st.close()
+        st2.close()
+    finally:
+        srv.stop()
+
+
+def test_age_out_steady_state_skips_full_pass(tmp_path):
+    """Between TTL boundaries nothing is past the cutoff: the head-frame
+    probe must skip the whole read-decode-rewrite pass (local and remote)
+    instead of materializing the full log to drop zero samples."""
+    import filodb_tpu.core.diststore as dst
+    import filodb_tpu.core.store as cst
+
+    local = FileColumnStore(str(tmp_path / "local"))
+    local.write_chunkset("ds", 0, 0, [ChunkSetRecord(
+        0, BASE + np.arange(6) * IV, np.arange(6.0))])
+    srv = StoreServer(str(tmp_path / "node0")).start()
+    try:
+        remote = RemoteStore(f"127.0.0.1:{srv.port}")
+        remote.write_chunkset("ds", 0, 0, [ChunkSetRecord(
+            0, BASE + np.arange(6) * IV, np.arange(6.0))])
+        orig = cst.encode_age_out
+
+        def _must_not_run(*_a, **_k):
+            raise AssertionError("full age-out pass ran in steady state")
+
+        cst.encode_age_out = dst.encode_age_out = _must_not_run
+        try:
+            assert local.age_out("ds", 0, BASE) == 0          # cutoff <= head
+            assert remote.age_out("ds", 0, BASE) == 0
+        finally:
+            cst.encode_age_out = dst.encode_age_out = orig
+        # once the head frame itself ages past the cutoff the pass runs
+        assert local.age_out("ds", 0, BASE + 3 * IV) == 3
+        assert remote.age_out("ds", 0, BASE + 3 * IV) == 3
+        remote.close()
+    finally:
+        srv.stop()

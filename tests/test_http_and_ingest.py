@@ -190,9 +190,16 @@ def test_cli_status_drilldown_and_ds_query(capsys):
                   float(t))
         ms.ingest("prometheus", s, b.build())
     ms.flush_all()
-    # a second engine standing in for a served downsample family
-    engines = {"prometheus": QueryEngine(ms, "prometheus"),
-               "prometheus:ds_1m": QueryEngine(ms, "prometheus")}
+    # a second engine standing in for a served downsample family; the raw
+    # engine routes to it via the retention override (PR 10: --resolution
+    # is a routing override, no longer a raw dataset swap)
+    from filodb_tpu.query.retention import RetentionPolicy, RetentionRouter
+    fam = QueryEngine(ms, "prometheus")
+    raw = QueryEngine(ms, "prometheus")
+    raw.retention = RetentionRouter(
+        RetentionPolicy([60_000], raw_window_ms=3_600_000),
+        lambda res: fam if res == 60_000 else None, dataset="prometheus")
+    engines = {"prometheus": raw, "prometheus:ds_1m": fam}
     srv = FiloHttpServer(engines, port=0).start()
     try:
         host = f"http://127.0.0.1:{srv.port}"

@@ -1,0 +1,424 @@
+"""Retention subsystem: downsample-aware routing, stitching, durable-tier
+streaming with kill-and-recover, cluster ODP accounting, and raw age-out
+(ISSUE 10 / ROADMAP item 2; ref: the reference's downsample cluster +
+Cassandra chunk store + --resolution CLI)."""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import FileColumnStore
+from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
+                                               run_batch_downsample)
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.rangevector import QueryError
+from filodb_tpu.query.retention import (RAW, RetentionPolicy, RetentionRouter,
+                                        resolution_label)
+
+BASE = 1_700_000_000_000
+IV = 30_000                      # 30s raw scrape interval
+M1, H1 = 60_000, 3_600_000
+
+
+# ---------------------------------------------------------------- policy
+
+def test_policy_decide_rules():
+    # raw window 2h, families 1m + 1h, data lead at BASE + 20h
+    lead = BASE + 20 * H1
+    pol = RetentionPolicy([M1, H1], raw_window_ms=2 * H1)
+    horizon = lead - 2 * H1
+    # fine step: raw regardless of range
+    assert pol.decide(BASE, lead, IV, lead).resolution_ms == RAW
+    # recent range: raw even at coarse step
+    d = pol.decide(lead - H1, lead, M1, lead)
+    assert d.resolution_ms == RAW
+    # old range, 1m step: routed whole to 1m
+    d = pol.decide(BASE, horizon - H1, M1, lead)
+    assert d.resolution_ms == M1 and d.seam_ms is None
+    # old range, 1h step: the coarsest fitting family wins
+    d = pol.decide(BASE, horizon - H1, H1, lead)
+    assert d.resolution_ms == H1 and d.seam_ms is None
+    # straddling range: stitched at the first step-grid point past horizon
+    d = pol.decide(BASE, lead, M1, lead)
+    assert d.resolution_ms == M1 and d.seam_ms is not None
+    assert horizon <= d.seam_ms < horizon + M1
+    assert (d.seam_ms - BASE) % M1 == 0
+    assert d.label == "1m+raw"
+    # tiny range never routes
+    assert pol.decide(BASE, BASE + M1, M1, lead).resolution_ms == RAW
+
+
+def test_policy_override_validation():
+    pol = RetentionPolicy([M1, H1], raw_window_ms=2 * H1)
+    assert pol.parse_override("raw") == RAW
+    assert pol.parse_override("1m") == M1
+    assert pol.parse_override("1h") == H1
+    with pytest.raises(QueryError) as ei:
+        pol.parse_override("5m")
+    # the configured set is named — the old CLI dataset swap yielded a
+    # silent empty result instead
+    assert "raw, 1m, 1h" in str(ei.value)
+    with pytest.raises(QueryError):
+        pol.parse_override("bogus")
+
+
+def test_policy_from_config_validates_families():
+    pol = RetentionPolicy.from_config(["raw", "1m"], [M1, H1], 2 * H1)
+    assert pol.resolutions_ms == [M1]
+    with pytest.raises(ValueError):
+        RetentionPolicy.from_config(["raw", "5m"], [M1, H1], 2 * H1)
+    # empty spec = raw + every downsample family
+    pol = RetentionPolicy.from_config([], [M1, H1], 2 * H1)
+    assert pol.resolutions_ms == [M1, H1]
+    assert pol.labels() == ["raw", "1m", "1h"]
+    # NO downsample families at all (downsample.enabled off): a non-raw
+    # entry could never serve — refuse at startup, don't accept a family
+    # that silently falls back to raw forever
+    with pytest.raises(ValueError, match="downsample.enabled"):
+        RetentionPolicy.from_config(["raw", "1m"], [], 2 * H1)
+    pol = RetentionPolicy.from_config(["raw"], [], 2 * H1)
+    assert pol.labels() == ["raw"]
+
+
+def test_resolution_labels():
+    assert resolution_label(RAW) == "raw"
+    assert resolution_label(90_000) == "90s"
+    assert resolution_label(M1) == "1m"
+    assert resolution_label(H1) == "1h"
+
+
+# ---------------------------------------------------------------- fixtures
+
+N_SAMPLES = 24 * 120             # 24h at 30s
+N_SERIES = 4
+
+
+def _build_tiers(tmp_path, sink=None):
+    """Raw shard + persisted chunks + 1m/1h downsample families, a routed
+    engine set, and the router. Returns (raw_engine, fams, sink, shard)."""
+    sink = sink or FileColumnStore(str(tmp_path / "chunks"))
+    cfg = StoreConfig(max_series_per_shard=N_SERIES,
+                      samples_per_series=1 << 16,
+                      flush_batch_size=10**9, groups_per_shard=2,
+                      dtype="float64")
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+    ts_arr = BASE + np.arange(N_SAMPLES, dtype=np.int64) * IV
+    b = RecordBuilder(GAUGE)
+    for s in range(N_SERIES):
+        b.add_batch({"_metric_": "m", "host": f"h{s}"}, ts_arr,
+                    np.cumsum(np.full(N_SAMPLES, 1.0 + s)))
+    shard.ingest(b.build(), offset=0)
+    shard.flush_all_groups()
+    for res in (M1, H1):
+        run_batch_downsample(sink, "prometheus", 0, res)
+    fams = {}
+    for res in (M1, H1):
+        fms = TimeSeriesMemStore()
+        load_downsampled(sink, "prometheus", 0, res, "dAvg", fms)
+        from filodb_tpu.core.downsample import ds_family
+        fams[res] = QueryEngine(fms, ds_family("prometheus", res))
+    raw = QueryEngine(ms, "prometheus")
+    raw.retention = RetentionRouter(
+        RetentionPolicy([M1, H1], raw_window_ms=2 * H1),
+        lambda r: fams.get(r), dataset="prometheus")
+    return raw, fams, sink, shard
+
+
+def test_routed_query_serves_downsampled(tmp_path):
+    raw, fams, _sink, _shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    start, end = BASE + H1, lead - 4 * H1       # entirely past the horizon
+    q = "sum(avg_over_time(m[1h]))"
+    routed = raw.query_range(q, start, end, H1)
+    assert routed.stats.resolution == "1h"
+    assert routed.exec_path.startswith("retention[1h]:")
+    oracle = fams[H1].query_range(q, start, end, H1)
+    assert np.array_equal(np.asarray(routed.matrix.values),
+                          np.asarray(oracle.matrix.values), equal_nan=True)
+    # stats surface the resolution over the wire form too
+    assert routed.stats.to_dict()["resolution"] == "1h"
+
+
+def test_stitched_query_matches_leg_oracles(tmp_path):
+    raw, fams, _sink, _shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    start, end, step = BASE + H1, lead, M1
+    q = "sum(avg_over_time(m[5m]))"
+    res = raw.query_range(q, start, end, step)
+    assert res.stats.resolution == "1m+raw"
+    assert "stitch(" in res.exec_path
+    # the stitched grid is exactly the raw grid
+    grid = np.arange(start, end + 1, step, dtype=np.int64)
+    assert np.array_equal(np.asarray(res.matrix.out_ts), grid)
+    # tail values equal the raw engine's own answer over the tail range
+    seam = raw.retention.policy.decide(
+        start, end, step, raw.retention._now_ms(raw)).seam_ms
+    tail = raw.query_range(q, seam, end, step, _skip_routing=True)
+    got_tail = np.asarray(res.matrix.values)[:, grid >= seam]
+    assert np.array_equal(got_tail, np.asarray(tail.matrix.values),
+                          equal_nan=True)
+    # body values equal the 1m family's answer over the body range
+    body = fams[M1].query_range(q, start, seam - step, step)
+    got_body = np.asarray(res.matrix.values)[:, grid < seam]
+    assert np.array_equal(got_body, np.asarray(body.matrix.values),
+                          equal_nan=True)
+
+
+def test_override_and_validation_via_engine(tmp_path):
+    raw, fams, _sink, _shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    q = "sum(avg_over_time(m[1h]))"
+    # force raw over an old range the router would downsample
+    res = raw.query_range(q, BASE + H1, lead - 4 * H1, H1, resolution="raw")
+    assert res.stats.resolution == "raw"
+    # force 1m where the router would pick 1h
+    res = raw.query_range(q, BASE + H1, lead - 4 * H1, H1, resolution="1m")
+    assert res.stats.resolution == "1m"
+    with pytest.raises(QueryError) as ei:
+        raw.query_range(q, BASE, lead, H1, resolution="7m")
+    assert "available: raw, 1m, 1h" in str(ei.value)
+    # no routing configured: the override fails loudly, not silently empty
+    bare = QueryEngine(raw.memstore, "prometheus")
+    with pytest.raises(QueryError):
+        bare.query_range(q, BASE, lead, H1, resolution="1m")
+
+
+def test_missing_family_falls_back_to_raw(tmp_path):
+    raw, fams, _sink, _shard = _build_tiers(tmp_path)
+    raw.retention.family_engine = lambda r: None     # nothing published yet
+    lead = BASE + (N_SAMPLES - 1) * IV
+    # an EXPLICIT override of an unpublished family fails loudly — silent
+    # substitution is the bug the old dataset swap had
+    with pytest.raises(QueryError, match="no published downsample data"):
+        raw.query_range("sum(avg_over_time(m[1h]))", BASE + H1,
+                        lead - 4 * H1, H1, resolution="1m")
+    res = raw.query_range("sum(avg_over_time(m[1h]))", BASE + H1,
+                          lead - 4 * H1, H1)
+    assert res.stats.resolution == "raw"
+    oracle = QueryEngine(raw.memstore, "prometheus").query_range(
+        "sum(avg_over_time(m[1h]))", BASE + H1, lead - 4 * H1, H1)
+    assert np.array_equal(np.asarray(res.matrix.values),
+                          np.asarray(oracle.matrix.values), equal_nan=True)
+
+
+def test_routing_trace_and_counter(tmp_path):
+    from filodb_tpu.utils.metrics import (FILODB_RETENTION_ROUTED_QUERIES,
+                                          registry)
+    from filodb_tpu.utils.tracing import SPAN_QUERY_RETENTION, tracer
+    raw, _fams, _sink, _shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    c = registry.counter(FILODB_RETENTION_ROUTED_QUERIES,
+                         {"dataset": "prometheus", "resolution": "1h"})
+    before = c.value
+    raw.query_range("sum(avg_over_time(m[1h]))", BASE + H1, lead - 4 * H1, H1)
+    assert c.value == before + 1
+    names = {s["name"] for t in tracer.traces(limit=20) for s in t["spans"]}
+    assert SPAN_QUERY_RETENTION in names
+
+
+# ------------------------------------------------- durable tier + recovery
+
+def _start_ring(tmp_path, n=2):
+    from filodb_tpu.core.diststore import (RemoteStore,
+                                           ReplicatedColumnStore, StoreServer)
+    servers = [StoreServer(str(tmp_path / f"node{i}")).start()
+               for i in range(n)]
+    stores = [RemoteStore(f"127.0.0.1:{s.port}", timeout_s=5.0,
+                          connect_timeout_s=2.0) for s in servers]
+    return servers, stores, ReplicatedColumnStore(stores, replication=2)
+
+
+def test_kill_one_replica_and_recover_bit_identical(tmp_path):
+    """The acceptance proof, scaled to tier-1: flushes stream to a 2-backend
+    replicated StoreServer tier; one backend dies mid-stream; a restarted
+    shard node recovers from the survivor to checkpoint parity and a
+    month-scale windowed query over evicted series answers bit-identically
+    to the pre-kill oracle at all three resolutions (raw tail stitched),
+    with the serving resolution visible in QueryStats."""
+    servers, stores, repl = _start_ring(tmp_path)
+    try:
+        raw, fams, sink, shard = _build_tiers(tmp_path, sink=repl)
+        lead = BASE + (N_SAMPLES - 1) * IV
+
+        # evict the old raw data from memory: the cold body now pages from
+        # the replicated durable tier on demand
+        cut = lead - 2 * H1
+        with shard.lock:
+            shard.store.compact(cut)
+            shard.data_epoch += 1
+
+        q = "sum(avg_over_time(m[1h]))"
+        ranges = {
+            "raw": (lead - 10 * H1, lead - 6 * H1, H1),   # cold: pure ODP
+            "1m": (BASE + H1, lead - 4 * H1, H1),
+            "1h": (BASE + H1, lead - 4 * H1, H1),
+        }
+        oracle = {}
+        for lbl, (s, e, st) in ranges.items():
+            r = raw.query_range(q, s, e, st, resolution=lbl)
+            assert r.stats.resolution == lbl
+            if lbl == "raw":
+                assert r.stats.rows_paged_in > 0    # paged from the ring
+            oracle[lbl] = np.asarray(r.matrix.values)
+
+        # kill one backend mid-stream, then keep writing: the survivor
+        # carries the flush path (consistency ONE)
+        holders = [i for i, st_ in enumerate(stores)
+                   if list(st_.read_chunksets("prometheus", 0))]
+        assert len(holders) == 2
+        servers[holders[0]].stop()
+        stores[holders[0]].close()
+        b = RecordBuilder(GAUGE)
+        ts2 = lead + IV + np.arange(8, dtype=np.int64) * IV
+        for s in range(N_SERIES):
+            b.add_batch({"_metric_": "m", "host": f"h{s}"}, ts2,
+                        np.full(8, 1.0))
+        shard.ingest(b.build(), offset=1)
+        shard.flush_all_groups()
+
+        # restart the shard node: recovery replays from the survivor
+        cfg = StoreConfig(max_series_per_shard=N_SERIES,
+                          samples_per_series=1 << 16,
+                          flush_batch_size=10**9, groups_per_shard=2,
+                          dtype="float64")
+        ms2 = TimeSeriesMemStore()
+        shard2 = ms2.setup("prometheus", GAUGE, 0, cfg, sink=repl)
+        shard2.recover()
+        assert shard2.num_series == N_SERIES
+        # checkpoint parity with the pre-restart shard
+        assert np.array_equal(shard2.group_watermarks,
+                              shard.group_watermarks)
+
+        raw2 = QueryEngine(ms2, "prometheus")
+        raw2.retention = RetentionRouter(raw.retention.policy,
+                                         raw.retention.family_engine,
+                                         dataset="prometheus")
+        for lbl, (s, e, st) in ranges.items():
+            r2 = raw2.query_range(q, s, e, st, resolution=lbl)
+            assert r2.stats.resolution == lbl
+            assert np.array_equal(np.asarray(r2.matrix.values), oracle[lbl],
+                                  equal_nan=True), lbl
+        # auto-routing still stitches the raw tail over the full range
+        full = raw2.query_range(q, BASE + H1, lead, M1)
+        assert full.stats.resolution == "1m+raw"
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001 - already killed mid-test
+                pass
+
+
+def test_remote_odp_counter_counts_remote_tier(tmp_path):
+    from filodb_tpu.utils.metrics import FILODB_RETENTION_ODP_ROWS, registry
+    servers, _stores, repl = _start_ring(tmp_path)
+    try:
+        raw, _fams, _sink, shard = _build_tiers(tmp_path, sink=repl)
+        lead = BASE + (N_SAMPLES - 1) * IV
+        with shard.lock:
+            shard.store.compact(lead - 2 * H1)
+        c = registry.counter(FILODB_RETENTION_ODP_ROWS,
+                             {"dataset": "prometheus", "tier": "remote"})
+        before = c.value
+        r = raw.query_range("sum(avg_over_time(m[1h]))", lead - 10 * H1,
+                            lead - 6 * H1, H1, resolution="raw")
+        assert r.stats.rows_paged_in > 0
+        assert c.value > before
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_age_out_durable_drops_and_bumps_epoch(tmp_path):
+    raw, _fams, sink, shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    before_epoch = shard.data_epoch
+    cutoff = lead - 4 * H1
+    dropped = shard.age_out_durable(cutoff)
+    assert dropped > 0
+    assert shard.data_epoch == before_epoch + 1
+    for _g, recs in sink.read_chunksets("prometheus", 0):
+        for r in recs:
+            assert (r.ts >= cutoff).all()
+    # idempotent: a second pass at the same cutoff drops nothing
+    assert shard.age_out_durable(cutoff) == 0
+
+
+def test_age_out_replicated_rewrites_every_replica(tmp_path):
+    servers, stores, repl = _start_ring(tmp_path)
+    try:
+        raw, _fams, _sink, shard = _build_tiers(tmp_path, sink=repl)
+        lead = BASE + (N_SAMPLES - 1) * IV
+        cutoff = lead - 4 * H1
+        assert shard.age_out_durable(cutoff) > 0
+        for st in stores:
+            for _g, recs in st.read_chunksets("prometheus", 0):
+                for r in recs:
+                    assert (r.ts >= cutoff).all()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_paged_read_dedups_duplicate_sink_frames(tmp_path):
+    """A duplicate chunk frame in the log (requeued flush after a partial
+    sink failure, or a lost-response write) must not double-count samples
+    on the ODP read path — the paged merge keep-first dedups by timestamp,
+    matching recovery replay's out-of-order drop."""
+    raw, _fams, sink, shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    start, end = lead - 10 * H1, lead - 6 * H1
+    oracle = raw.query_range("sum(sum_over_time(m[1h]))", start, end, H1,
+                             resolution="raw")
+    # duplicate every in-range frame, then evict the range from memory so
+    # the query pages it from the (now duplicated) log
+    dups = list(sink.read_chunksets("prometheus", 0, start, end))
+    for g, recs in dups:
+        sink.write_chunkset("prometheus", 0, g, recs)
+    with shard.lock:
+        shard.store.compact(lead - 2 * H1)
+        shard.data_epoch += 1
+    paged = raw.query_range("sum(sum_over_time(m[1h]))", start, end, H1,
+                            resolution="raw")
+    assert paged.stats.rows_paged_in > 0
+    assert np.array_equal(np.asarray(paged.matrix.values),
+                          np.asarray(oracle.matrix.values), equal_nan=True)
+
+
+# ---------------------------------------------------------------- HTTP
+
+def test_http_resolution_param_and_validation(tmp_path):
+    import json as _json
+    from filodb_tpu.http.api import FiloHttpServer
+    raw, fams, _sink, _shard = _build_tiers(tmp_path)
+    from filodb_tpu.core.downsample import ds_family
+    engines = {"prometheus": raw}
+    for res, e in fams.items():
+        engines[ds_family("prometheus", res)] = e
+    srv = FiloHttpServer(engines, port=0).start()
+    try:
+        lead = BASE + (N_SAMPLES - 1) * IV
+        url = (f"http://127.0.0.1:{srv.port}/promql/prometheus/api/v1/"
+               f"query_range?query=sum(avg_over_time(m[1h]))"
+               f"&start={(BASE + H1) / 1000}&end={(lead - 4 * H1) / 1000}"
+               f"&step=3600")
+        with urllib.request.urlopen(url + "&resolution=1m") as r:
+            body = _json.load(r)
+        assert body["stats"]["resolution"] == "1m"
+        # auto decision also lands in the response stats
+        with urllib.request.urlopen(url) as r:
+            body = _json.load(r)
+        assert body["stats"]["resolution"] in ("1h", "1h+raw")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "&resolution=7m")
+        assert ei.value.code == 422
+        err = _json.load(ei.value)
+        assert "available: raw, 1m, 1h" in err["error"]
+    finally:
+        srv.stop()

@@ -1,0 +1,101 @@
+"""Downsample-family metadata completeness: ds_family datasets are visible
+and label-complete through /api/v1/labels, /api/v1/series, and label_values —
+including the peer-merge path — so routed queries and UI discovery agree
+(ISSUE 10 satellite; ref: the reference's downsample datasets share the raw
+datasets' part keys, so metadata parity is a contract, not a coincidence)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from filodb_tpu.core.downsample import ds_family
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import FileColumnStore
+from filodb_tpu.http.api import FiloHttpServer
+from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
+                                               run_batch_downsample)
+from filodb_tpu.parallel.cluster import ShardManager
+from filodb_tpu.parallel.shardmapper import ShardMapper
+from filodb_tpu.query.engine import QueryEngine
+
+BASE = 1_700_000_000_000
+IV = 30_000
+M1 = 60_000
+N_SAMPLES = 240
+
+
+def _persist_shard(sink, shard_num, hosts):
+    cfg = StoreConfig(max_series_per_shard=8, samples_per_series=1 << 12,
+                      flush_batch_size=10**9, groups_per_shard=2,
+                      dtype="float64")
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prometheus", GAUGE, shard_num, cfg, sink=sink)
+    ts_arr = BASE + np.arange(N_SAMPLES, dtype=np.int64) * IV
+    b = RecordBuilder(GAUGE)
+    for i, h in enumerate(hosts):
+        b.add_batch({"_metric_": "m", "host": h, "dc": f"dc{shard_num}"},
+                    ts_arr, np.cumsum(np.full(N_SAMPLES, 1.0 + i)))
+    sh.ingest(b.build(), offset=0)
+    sh.flush_all_groups()
+    run_batch_downsample(sink, "prometheus", shard_num, M1)
+
+
+def _fam_engine(sink, shard_num, **kw):
+    ms = TimeSeriesMemStore()
+    load_downsampled(sink, "prometheus", shard_num, M1, "dAvg", ms)
+    return QueryEngine(ms, ds_family("prometheus", M1), **kw)
+
+
+def test_family_metadata_is_label_complete(tmp_path):
+    sink = FileColumnStore(str(tmp_path / "chunks"))
+    _persist_shard(sink, 0, ["h0", "h1"])
+    fam = ds_family("prometheus", M1)
+    srv = FiloHttpServer({fam: _fam_engine(sink, 0)}, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/promql/{fam}/api/v1"
+        with urllib.request.urlopen(f"{base}/labels") as r:
+            names = json.load(r)["data"]
+        assert {"__name__", "host", "dc"} <= set(names)
+        with urllib.request.urlopen(f"{base}/label/host/values") as r:
+            assert json.load(r)["data"] == ["h0", "h1"]
+        with urllib.request.urlopen(
+                f"{base}/series?match[]=m&start=0&end=9999999999") as r:
+            series = json.load(r)["data"]
+        assert {d["host"] for d in series} == {"h0", "h1"}
+        assert all(d["__name__"] == "m" for d in series)
+    finally:
+        srv.stop()
+
+
+def test_family_metadata_peer_merge(tmp_path):
+    """Two nodes each serving one family shard: node A's metadata answers
+    include node B's values through the peer fan-out (local=1 leg), exactly
+    like the raw dataset's peer merge."""
+    sink = FileColumnStore(str(tmp_path / "chunks"))
+    _persist_shard(sink, 0, ["h0", "h1"])
+    _persist_shard(sink, 1, ["h2", "h3"])
+    fam = ds_family("prometheus", M1)
+    eng_b = _fam_engine(sink, 1)
+    srv_b = FiloHttpServer({fam: eng_b}, port=0).start()
+    try:
+        addr_a = "127.0.0.1:1"                  # never dialed (self)
+        addr_b = f"127.0.0.1:{srv_b.port}"
+        sm = ShardManager()
+        sm.add_node(addr_a)
+        sm.add_node(addr_b)
+        sm.add_dataset(fam, 2, claimed={0: addr_a, 1: addr_b})
+        eng_a = _fam_engine(sink, 0, shard_mapper=ShardMapper(2),
+                            cluster=sm, node=addr_a)
+        assert set(eng_a.label_values("host")) == {"h0", "h1", "h2", "h3"}
+        assert {"host", "dc", "_metric_"} <= set(eng_a.label_names())
+        got = eng_a.series([], 0, 1 << 61)
+        hosts = {d.get("host") for d in got}
+        assert {"h0", "h1", "h2", "h3"} <= hosts
+        # counted top-k re-ranks across the peer leg too
+        counts = eng_a.label_value_counts("dc", top_k=2)
+        assert set(counts) == {"dc0", "dc1"}
+    finally:
+        srv_b.stop()

@@ -168,6 +168,40 @@ def test_good_wire_ops_fixture_is_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+def _store_op_findings(module_rel: str):
+    """Op-parity run shaped like the PRODUCTION diststore spec (server
+    ``_serve`` + client class ``RemoteStore``)."""
+    spec = {
+        "wire_module": "<none>",
+        "classifier_module": "<none>",
+        "error_base_modules": [],
+        "codec_pairs": [],
+        "depth_pair": ("_enc_plan", "_dec_plan"),
+        "error_root": "QueryError",
+        "op_specs": [{"module": module_rel, "prefix": "OP_",
+                      "server_fn": "_serve", "client_class": "RemoteStore"}],
+    }
+    w = WireChecker(spec=spec)
+    w.check_module(module_rel, ast.parse((REPO / module_rel).read_text()))
+    return w.finalize()
+
+
+def test_bad_store_ops_fixture_is_flagged():
+    findings = _store_op_findings("tests/fixtures/filolint/bad_store_ops.py")
+    details = {f.detail for f in findings}
+    # streaming op sent but never dispatched; checkpoint op dispatched but
+    # never sent; two ops share one value
+    assert "op-unserved:OP_APPEND_CRC" in details
+    assert "op-unsent:OP_CHECKPOINT" in details
+    assert any(d.startswith("op-collision:") for d in details)
+    assert all(f.rule == "wire-tag-parity" for f in findings)
+
+
+def test_good_store_ops_fixture_is_clean():
+    findings = _store_op_findings("tests/fixtures/filolint/good_store_ops.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def _trace_parity_findings(module_rel: str):
     spec = {
         "wire_module": "<none>",
@@ -216,6 +250,28 @@ def test_production_trace_carriers_are_two_sided():
                 w.check_module(module,
                                ast.parse((REPO / module).read_text()))
     findings = [f for f in w.finalize() if f.rule == "wire-trace-parity"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_diststore_op_tags_are_exhaustive():
+    """The production StoreServer protocol: every OP_* constant in
+    core/diststore.py — including the PR-10 streaming (OP_APPEND_CRC) and
+    checkpoint (OP_CHECKPOINT) ops — is dispatched by StoreServer._serve
+    AND sent by the RemoteStore client, with distinct values."""
+    import ast as _ast
+    from filodb_tpu.analysis.wirecheck import WIRE_SPEC
+    rel = "filodb_tpu/core/diststore.py"
+    assert any(s["module"] == rel for s in WIRE_SPEC["op_specs"])
+    tree = _ast.parse((REPO / rel).read_text())
+    names = {t.id for node in tree.body if isinstance(node, _ast.Assign)
+             for t in (node.targets[0].elts
+                       if isinstance(node.targets[0], _ast.Tuple)
+                       else node.targets)
+             if isinstance(t, _ast.Name) and t.id.startswith("OP_")}
+    assert {"OP_APPEND_CRC", "OP_CHECKPOINT"} <= names
+    w = WireChecker()
+    w.check_module(rel, tree)
+    findings = w.finalize()
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
