@@ -15,6 +15,14 @@ surface, and these rules make drift impossible:
     full dotted name or by leaf segment — ``store_config()`` reads leaves
     off the sub-dict) is dead surface: a typo'd rename or a removed
     feature still showing up in docs.
+  * ``surface-config-type`` — a declared default literal that cannot
+    satisfy its declared type string (an ``int`` defaulting to a string,
+    a ``duration`` defaulting to ``"5x"``, a non-null default missing its
+    ``|null``): DEFAULTS derives from the spec, so such a key ships a
+    value the declared readers (``int(...)``, ``parse_duration_ms``)
+    crash on the first time an operator relies on the default. Only
+    LITERAL defaults are judged — computed expressions (``1 << 20``) are
+    skipped, never guessed.
   * ``surface-metric-undeclared`` — every ``filodb_*`` metric registered
     via ``registry.counter/gauge/histogram`` must be one of the declared
     name CONSTANTS in utils/metrics.py's ``METRICS_SPEC`` (call sites use
@@ -90,6 +98,7 @@ CACHE_CAP_NAMES = {"capacity", "maxsize", "max_entries", "maxlen"}
 
 class SurfaceChecker:
     rules = ("surface-config-undeclared", "surface-config-unused",
+             "surface-config-type",
              "surface-metric-undeclared", "surface-metric-kind",
              "surface-metric-duplicate", "surface-metric-unused",
              "surface-trace-undeclared", "surface-trace-unused",
@@ -193,6 +202,49 @@ class SurfaceChecker:
                     return path, node.value
         return None
 
+    _DURATION_RE = None     # compiled lazily (module import stays light)
+
+    @classmethod
+    def _default_matches(cls, typ: str, node: ast.expr) -> bool:
+        """True unless the default LITERAL provably violates ``typ``.
+        Computed expressions return True (skipped, never guessed)."""
+        import re as _re
+        if typ.endswith("|null"):
+            if isinstance(node, ast.Constant) and node.value is None:
+                return True
+            typ = typ[:-len("|null")]
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, ast.USub) and \
+                isinstance(node.operand, ast.Constant):
+            node = node.operand
+        if typ.startswith("list[") and typ.endswith("]"):
+            if not isinstance(node, ast.List):
+                return not isinstance(node, (ast.Constant, ast.Dict))
+            inner = typ[5:-1]
+            return all(cls._default_matches(inner, el) for el in node.elts)
+        if typ == "dict":
+            return isinstance(node, ast.Dict) or \
+                not isinstance(node, (ast.Constant, ast.List))
+        if not isinstance(node, ast.Constant):
+            return True            # computed expression: not judged
+        v = node.value
+        if typ == "bool":
+            return isinstance(v, bool)
+        if typ == "int":
+            return isinstance(v, int) and not isinstance(v, bool)
+        if typ == "float":
+            return isinstance(v, (int, float)) and not isinstance(v, bool)
+        if typ == "str":
+            return isinstance(v, str)
+        if typ == "duration":
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return True        # raw milliseconds are accepted anywhere
+            if cls._DURATION_RE is None:
+                cls._DURATION_RE = _re.compile(r"\d+(?:\.\d+)?(?:ms|[smhd])")
+            return isinstance(v, str) and \
+                cls._DURATION_RE.fullmatch(v) is not None
+        return True                # unknown type string: out of scope
+
     def _check_config(self) -> list[Finding]:
         spec = self._find_spec_dict("CONFIG_SPEC")
         if spec is None:
@@ -206,6 +258,22 @@ class SurfaceChecker:
                 declared[s] = k.lineno
                 spec_key_ids.add(id(k))
         findings: list[Finding] = []
+        # default-vs-type parity: the spec IS the deployment contract, so
+        # a default its own declared type cannot represent is a shipped bug
+        for k, v in zip(spec_dict.keys, spec_dict.values):
+            key = _const_str(k) if k is not None else None
+            if key is None or not isinstance(v, ast.Tuple) \
+                    or len(v.elts) < 2:
+                continue
+            typ = _const_str(v.elts[0])
+            if typ and not self._default_matches(typ, v.elts[1]):
+                findings.append(Finding(
+                    "surface-config-type", spec_path, k.lineno,
+                    "CONFIG_SPEC", f"type:{key}",
+                    f"config key {key!r} declares type {typ!r} but its "
+                    "default literal cannot satisfy it — the derived "
+                    "DEFAULTS tree would hand readers a value their "
+                    "declared parser crashes on"))
         used_full: set = set()
         all_strings: set = set()
         for path, tree in self._modules.items():

@@ -171,6 +171,34 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "Connect/read timeout of RemoteStore links to StoreServer nodes; "
         "a dead backend times out and fails over to the next replica "
         "instead of stalling the read."),
+    "rules.groups": (
+        "list[dict]", [],
+        "Recording/alerting rule groups (Prometheus rule-file shape: "
+        "name/interval/rules with record|alert, expr, labels, for). "
+        "Validated at startup; expressions with @ are rejected."),
+    "rules.default_interval": (
+        "duration", "30s",
+        "Evaluation interval for groups that do not set their own."),
+    "rules.max_concurrent": (
+        "int", 2,
+        "Group evaluations admitted to run at once (an AdmissionController "
+        "gate; a group over the bound waits and its lag gauge grows)."),
+    "rules.max_catchup": (
+        "int", 2,
+        "Missed grid ticks re-evaluated after a restart or stall, newest "
+        "last; the re-publish dedupes via deterministic (rule, eval_ts) "
+        "pub-ids, so catch-up is exactly-once."),
+    "rules.webhook_url": (
+        "str|null", None,
+        "Alert notification webhook (POST JSON on firing/resolved "
+        "transitions); null disables notifications."),
+    "rules.webhook_retries": (
+        "int", 3,
+        "Webhook delivery attempts before the notification is dropped and "
+        "counted failed."),
+    "rules.webhook_backoff": (
+        "duration", "1s",
+        "Base backoff between webhook retries (doubles per attempt)."),
     "ingest.publish_window": (
         "int", 64,
         "Frames per broker PUBLISH_BATCH round trip — the in-flight "

@@ -91,6 +91,9 @@ class FiloHttpServer:
         self.cluster = cluster
         self.writers = writers or {}
         self.scheduler = scheduler
+        # rules subsystem handle (RulesManager): serves /api/v1/rules and
+        # /api/v1/alerts when the FiloServer configured rule groups
+        self.rules = None
         # debug-plane profiler slot (/api/v1/debug/profile start/stop/
         # report); FiloServer hands over its config-started SimpleProfiler
         self.profiler = None
@@ -280,6 +283,19 @@ class FiloHttpServer:
             h.send_header("Content-Length", str(len(body)))
             h.end_headers()
             h.wfile.write(body)
+            return
+        if path in ("/api/v1/rules", "/api/v1/alerts"):
+            # Prometheus rules surface: the evaluator's view of every
+            # group/rule (health, last eval, alert instances) — served on
+            # the handler thread like /__health (index-free snapshot reads)
+            if self.rules is None:
+                h._send(404, {"status": "error",
+                              "error": "no rule groups configured "
+                                       "(rules.groups)"})
+                return
+            data = (self.rules.rules_payload() if path.endswith("/rules")
+                    else self.rules.alerts_payload())
+            h._send(200, {"status": "success", "data": data})
             return
         if path == "/api/v1/cluster/status" or path.startswith("/api/v1/cluster/"):
             h._send(200, {"status": "success", "data": self._cluster_status(path)})

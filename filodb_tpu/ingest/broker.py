@@ -676,13 +676,23 @@ class BrokerBus:
         return int.from_bytes(os.urandom(8), "little") | 1
 
     def publish(self, container: RecordContainer) -> int:
+        return self.publish_with_id(container, self._pub_id())
+
+    def publish_with_id(self, container: RecordContainer,
+                        pub_id: int) -> int:
+        """Publish one frame under a CALLER-SUPPLIED publish id (low bit
+        forced — id 0 means 'no id' on the wire). The rules subsystem
+        derives ids from (rule, eval_ts, shard), so re-publishing a
+        re-evaluated tick resolves to the original offsets instead of
+        appending duplicates — exactly-once derived writes ride the same
+        journaled idempotence as retry replays."""
         payload = container.to_bytes()
-        pub_id = self._pub_id()
-        off, _ = self._request(OP_PUBLISH, offset=pub_id,
+        pid = int(pub_id) | 1
+        off, _ = self._request(OP_PUBLISH, offset=pid,
                                plen=len(payload), payload=payload)
         if self.track_acks:
             with self._lock:
-                self.acked_ids.append(pub_id)
+                self.acked_ids.append(pid)
         return off
 
     def publish_async(self, container: RecordContainer) -> None:

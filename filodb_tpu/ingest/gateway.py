@@ -31,8 +31,10 @@ import time
 from ..core.record import RecordBuilder, fnv1a64
 from ..core.schemas import GAUGE, Schema, part_key_of, shard_key_of
 from ..parallel.shardmapper import ShardMapper
+from ..rules.spec import RULE_LABEL
 from ..utils.metrics import (FILODB_GATEWAY_INGESTED_ROWS,
                              FILODB_GATEWAY_PARSE_ERRORS,
+                             FILODB_RULES_SPOOF_REJECTS,
                              FILODB_SWALLOWED_ERRORS, registry)
 from ..utils.tracing import SPAN_GATEWAY_PUBLISH, span
 
@@ -419,6 +421,18 @@ class GatewayServer:
                 measurement = tags = None
             else:
                 measurement, tags, fields, ts_ns = parse_influx_line(line)
+                if RULE_LABEL in tags:
+                    # reserved provenance tag: only the rules subsystem's
+                    # deterministic-pub-id publisher may write it (strict
+                    # re-raises; otherwise a counted drop like any bad
+                    # line). A spoofed head never reaches the route memo,
+                    # so every such line funnels through this parse path.
+                    registry.counter(FILODB_RULES_SPOOF_REJECTS,
+                                     {"site": "gateway"}).increment()
+                    raise InfluxParseError(
+                        f"tag {RULE_LABEL!r} is reserved for "
+                        "recording-rule output and cannot be ingested "
+                        "externally")
         except InfluxParseError:
             if self.strict:
                 raise

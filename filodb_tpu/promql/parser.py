@@ -9,7 +9,12 @@ range selectors extend the raw lookback window.
 Coverage matches the reference's ~60% of PromQL: literals, vector/range selectors,
 offset, all enum'd functions, aggregations with by/without and k/quantile params,
 arithmetic/comparison/set binary operators with bool modifier, on/ignoring,
-group_left/group_right, unary minus, parentheses.
+group_left/group_right, unary minus, parentheses — plus, beyond the reference:
+subqueries ``expr[1h:5m]`` (lowered to a nested range evaluation executed by
+SubqueryWindowExec) and the ``@ <unix-seconds>`` modifier on vector selectors
+(lowering pins the selector's start/end at the pinned instant and broadcasts
+the result across the query grid; recording rules REJECT ``@`` — see
+``reject_at_modifier``).
 """
 
 from __future__ import annotations
@@ -59,13 +64,17 @@ AGG_OPS = {
 _DUR_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000,
            "w": 604_800_000, "y": 31_536_000_000}
 
+# omitted subquery step (``expr[1h:]``): the Prometheus analog resolves it
+# from the global evaluation interval; here one documented constant
+DEFAULT_SUBQUERY_STEP_MS = 60_000
+
 _TOKEN_RE = re.compile(r"""
     (?P<WS>\s+)
   | (?P<DURATION>\d+(?:ms|[smhdwy]))
   | (?P<NUMBER>(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|0x[0-9a-fA-F]+|[Ii]nf|NaN)
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
   | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:]*)
-  | (?P<OP>=~|!~|!=|==|<=|>=|\^|[-+*/%(){}\[\],=<>])
+  | (?P<OP>=~|!~|!=|==|<=|>=|\^|@|[-+*/%(){}\[\],=<>:])
 """, re.X)
 
 KEYWORDS = {"by", "without", "on", "ignoring", "group_left", "group_right",
@@ -126,6 +135,19 @@ class VectorSelector(Expr):
     metric: str
     matchers: list[Filter]
     window_ms: int | None = None      # set for range selectors m[5m]
+    offset_ms: int = 0
+    at_ms: int | None = None          # set by the @ <unix-seconds> modifier
+
+
+@dataclass
+class Subquery(Expr):
+    """``expr[range:step]`` — the inner expression re-evaluated on a
+    ``step``-aligned grid covering the trailing ``range`` at every outer
+    step (an omitted step defaults to DEFAULT_SUBQUERY_STEP_MS, the
+    Prometheus default-evaluation-interval analog)."""
+    expr: Expr
+    range_ms: int
+    step_ms: int
     offset_ms: int = 0
 
 
@@ -259,18 +281,63 @@ class Parser:
                 d = self.next()
                 if d.kind != "DURATION":
                     raise ParseError(f"expected duration at {d.pos}")
-                self.expect("]")
-                if not isinstance(e, VectorSelector):
-                    raise ParseError("range selector requires a vector selector")
-                e.window_ms = parse_duration_ms(d.text)
+                if self.peek().text.startswith(":"):
+                    # subquery ``expr[range:step]``: any instant-vector
+                    # expression qualifies (the whole point — rules over
+                    # ``max_over_time(rate(m[1m])[1h:5m])`` are idiomatic).
+                    # The colon may arrive fused into one IDENT token
+                    # (":5m" — identifiers admit leading colons for
+                    # recording-rule names) or standalone ("[1h : 5m]").
+                    tail = self.next().text[1:]
+                    step_ms = DEFAULT_SUBQUERY_STEP_MS
+                    if tail:
+                        step_ms = parse_duration_ms(tail)
+                    elif self.peek().kind == "DURATION":
+                        step_ms = parse_duration_ms(self.next().text)
+                    self.expect("]")
+                    if isinstance(e, VectorSelector) \
+                            and e.window_ms is not None:
+                        raise ParseError(
+                            "subquery requires an instant vector, "
+                            "got a range selector")
+                    if step_ms <= 0:
+                        raise ParseError("subquery step must be positive")
+                    e = Subquery(e, parse_duration_ms(d.text), step_ms)
+                else:
+                    self.expect("]")
+                    if not isinstance(e, VectorSelector):
+                        raise ParseError(
+                            "range selector requires a vector selector")
+                    e.window_ms = parse_duration_ms(d.text)
             elif t.text == "offset":
                 self.next()
                 d = self.next()
                 if d.kind != "DURATION":
                     raise ParseError(f"expected duration at {d.pos}")
-                if not isinstance(e, VectorSelector):
-                    raise ParseError("offset requires a vector selector")
+                if not isinstance(e, (VectorSelector, Subquery)):
+                    raise ParseError("offset requires a vector selector "
+                                     "or subquery")
                 e.offset_ms = parse_duration_ms(d.text)
+            elif t.text == "@":
+                # @ <unix-seconds>: pin the selector's evaluation instant
+                # (ref upstream promql/parser: stepInvariantExpr). Applies
+                # to the SELECTOR only — @ on a subquery is out of scope.
+                self.next()
+                ts = self.next()
+                if ts.kind != "NUMBER":
+                    raise ParseError(
+                        f"@ expects a unix timestamp in seconds at {ts.pos}")
+                if not isinstance(e, VectorSelector):
+                    raise ParseError("@ modifier requires a vector selector")
+                try:
+                    at_s = float(ts.text)
+                except ValueError:
+                    at_s = float("nan")      # 0x... hex: not a timestamp
+                if not (at_s == at_s and abs(at_s) != float("inf")):
+                    raise ParseError(
+                        f"@ expects a finite unix timestamp, got {ts.text!r}"
+                        f" at {ts.pos}")
+                e.at_ms = int(at_s * 1000)
             else:
                 break
         return e
@@ -449,9 +516,21 @@ def _raw(vs: VectorSelector, p: QueryParams, lookback_ms: int) -> L.RawSeries:
     return L.RawSeries(L.IntervalSelector(start, end), tuple(filters), columns)
 
 
-def _lower_vector(vs: VectorSelector, p: QueryParams) -> L.PeriodicSeries:
+def _pin_params(p: QueryParams, at_ms: int) -> QueryParams:
+    """Query params with start/end PINNED at the @ timestamp: the selector
+    evaluates once, at ``at_ms``, regardless of the query grid."""
+    return QueryParams(at_ms, at_ms, 1, p.metric_column, p.staleness_ms)
+
+
+def _lower_vector(vs: VectorSelector, p: QueryParams) -> L.PeriodicSeriesPlan:
     if vs.window_ms is not None:
         raise ParseError("range selector used where instant vector expected")
+    if vs.at_ms is not None:
+        pinned = _pin_params(p, vs.at_ms)
+        raw = _raw(vs, pinned, p.staleness_ms)
+        inner = L.PeriodicSeries(raw, pinned.start_ms - vs.offset_ms, 1,
+                                 pinned.end_ms - vs.offset_ms)
+        return L.ApplyAtTimestamp(inner, p.start_ms, p.step_ms, p.end_ms)
     raw = _raw(vs, p, p.staleness_ms)
     return L.PeriodicSeries(raw, p.start_ms - vs.offset_ms, p.step_ms, p.end_ms - vs.offset_ms)
 
@@ -485,6 +564,10 @@ def _lower(e: Expr, p: QueryParams) -> L.LogicalPlan:
         return _lower_call(e, p)
     if isinstance(e, BinaryExpr):
         return _lower_binary(e, p)
+    if isinstance(e, Subquery):
+        raise ParseError(
+            "subquery must be the argument of a range function, e.g. "
+            "max_over_time(expr[1h:5m])")
     raise ParseError(f"cannot lower {e!r}")
 
 
@@ -530,8 +613,17 @@ def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
                 raise ParseError(f"{name} expects one range vector")
             fn_args = ()
             vec = e.args[0]
+        if isinstance(vec, Subquery):
+            return _lower_subquery(name, fn_args, vec, p)
         if not isinstance(vec, VectorSelector) or vec.window_ms is None:
             raise ParseError(f"{name} expects a range selector like m[5m]")
+        if vec.at_ms is not None:
+            pinned = _pin_params(p, vec.at_ms)
+            raw = _raw(vec, pinned, vec.window_ms)
+            inner = L.PeriodicSeriesWithWindowing(
+                raw, pinned.start_ms - vec.offset_ms, 1,
+                pinned.end_ms - vec.offset_ms, vec.window_ms, name, fn_args)
+            return L.ApplyAtTimestamp(inner, p.start_ms, p.step_ms, p.end_ms)
         raw = _raw(vec, p, vec.window_ms)
         return L.PeriodicSeriesWithWindowing(
             raw, p.start_ms - vec.offset_ms, p.step_ms, p.end_ms - vec.offset_ms,
@@ -552,6 +644,54 @@ def _lower_call(e: Call, p: QueryParams) -> L.LogicalPlan:
     if name in SORT_FNS:
         return L.ApplySortFunction(_lower(e.args[0], p), name)
     raise ParseError(f"unknown function {name}")
+
+
+def _lower_subquery(fn: str, fn_args: tuple, sq: Subquery,
+                    p: QueryParams) -> L.LogicalPlan:
+    """``fn(inner[range:sub])`` -> SubqueryWithWindowing: the inner instant
+    expression lowers onto the absolute sub-step grid covering
+    ``(start - range, end]`` (Prometheus aligns subquery evaluation points
+    to multiples of the sub-step, not to the outer grid), and the outer
+    range function slides over that synthetic stream."""
+    sub = max(int(sq.step_ms), 1)
+    rng = int(sq.range_ms)
+    if rng <= 0:
+        raise ParseError("subquery range must be positive")
+    start = p.start_ms - sq.offset_ms
+    end = p.end_ms - sq.offset_ms
+    # first grid point STRICTLY after start - range (PromQL windows are
+    # left-open], last at or before end
+    inner_start = ((start - rng) // sub + 1) * sub
+    inner_end = (end // sub) * sub
+    inner_p = QueryParams(inner_start, inner_end, sub, p.metric_column,
+                          p.staleness_ms)
+    inner = _lower(sq.expr, inner_p)
+    if isinstance(inner, _SCALAR_PLANS):
+        inner = L.VectorOfScalar(inner)
+    return L.SubqueryWithWindowing(inner, start, p.step_ms, end, rng, fn,
+                                   fn_args, sub)
+
+
+def reject_at_modifier(text: str) -> None:
+    """Typed guard for recording/alerting rules: an ``@``-pinned selector
+    makes the rule's output a constant of wall history instead of a pure
+    function of the evaluation timestamp — re-evaluation after failover
+    would no longer be idempotent, so rules refuse it at load time."""
+    def walk(e: Expr) -> None:
+        if isinstance(e, VectorSelector) and e.at_ms is not None:
+            raise ParseError(
+                "@ modifier is not allowed in rule expressions: a rule must "
+                "be a pure function of its evaluation timestamp so "
+                "re-evaluation after a crash or failover writes the same "
+                "derived samples (exactly-once pub-ids)")
+        for v in vars(e).values():
+            if isinstance(v, Expr):
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Expr):
+                        walk(x)
+    walk(parse_query(text))
 
 
 def _lower_binary(e: BinaryExpr, p: QueryParams) -> L.LogicalPlan:

@@ -104,7 +104,15 @@ def _peer_read_fetch(body: bytes, engine):
 
 def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
     """snappy(WriteRequest) -> {shard: RecordContainer} routed like the gateway
-    (shard-key hash selects the shard group, part hash spreads within it)."""
+    (shard-key hash selects the shard group, part hash spreads within it).
+
+    The reserved ``__rule__`` label is REJECTED here (typed 422): it marks
+    recording-rule output, which publishes through the rules subsystem's
+    own deterministic-pub-id path — an external write carrying it would
+    forge derived-series provenance."""
+    from ..query.rangevector import QueryError
+    from ..rules.spec import RULE_LABEL
+    from ..utils.metrics import FILODB_RULES_SPOOF_REJECTS, registry
     req = pb.WriteRequest()
     req.ParseFromString(snappy.decompress(body))
     builders: dict[int, RecordBuilder] = {}
@@ -112,6 +120,13 @@ def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
     for series in req.timeseries:
         labels = {("_metric_" if lp.name == "__name__" else lp.name): lp.value
                   for lp in series.labels}
+        if RULE_LABEL in labels:
+            registry.counter(FILODB_RULES_SPOOF_REJECTS,
+                             {"site": "remote-write"}).increment()
+            raise QueryError(
+                f"label {RULE_LABEL!r} is reserved for recording-rule "
+                "output and cannot be written externally (derived-series "
+                "provenance is broker-verified, not client-asserted)")
         shard = mapper.shard_of(
             fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
             fnv1a64(part_key_of(labels, opts)))

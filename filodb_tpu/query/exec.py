@@ -1430,6 +1430,77 @@ class DistConcatExec(ExecPlan):
 
 
 @dataclass
+class SubqueryWindowExec(ExecPlan):
+    """Range function over a SUBQUERY's synthetic sample stream
+    (``fn(expr[window:sub_step])``): the child plan evaluates the inner
+    expression on the absolute sub-step grid, its matrix becomes per-series
+    (ts, val) sample arrays (NaN steps = no sample), and the SAME window
+    kernels that serve raw selections slide over them — bit-parity with a
+    hand-nested evaluation by construction."""
+    child: ExecPlan | None = None
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+    window_ms: int = 0
+    function: str = "last_over_time"
+    args: tuple = ()
+    sub_step_ms: int = 60_000
+
+    def do_execute(self, ctx):
+        from ..core.chunkstore import TS_PAD
+        inner = _as_matrix(self.child.execute(ctx)).to_host()
+        step = max(self.step_ms, 1)
+        out_ts = np.arange(self.start_ms, self.end_ms + 1, step,
+                           dtype=np.int64)
+        S = inner.num_series
+        if len(out_ts) == 0 or S == 0:
+            return ResultMatrix(out_ts, np.zeros((S, len(out_ts))),
+                                list(inner.keys))
+        sub_ts = np.asarray(inner.out_ts, np.int64)
+        vals = np.asarray(inner.values, np.float64)
+        finite = np.isfinite(vals)
+        n = finite.sum(axis=1).astype(np.int32)
+        C = max(int(n.max(initial=0)), 1)
+        ts2d = np.full((S, C), TS_PAD, np.int64)
+        val2d = np.zeros((S, C), np.float64)
+        for i in range(S):
+            m = finite[i]
+            k = int(n[i])
+            ts2d[i, :k] = sub_ts[m]
+            val2d[i, :k] = vals[i, m]
+        ctx.stats.add("subquery_inner_cells", int(S * len(sub_ts)))
+        out_eval, T = _pad_steps(out_ts)
+        a0 = float(self.args[0]) if len(self.args) > 0 else 0.0
+        a1 = float(self.args[1]) if len(self.args) > 1 else 0.0
+        out = rangefns.periodic_samples(ts2d, val2d, n, out_eval,
+                                        self.window_ms, self.function, a0, a1)
+        return ResultMatrix(out_ts, np.asarray(out)[:, :T], list(inner.keys))
+
+
+@dataclass
+class RepeatAtExec(ExecPlan):
+    """Broadcast an @-pinned evaluation across the query grid: the child
+    runs on its own single-step grid at the pinned instant; the result is
+    step-invariant by construction, so it tiles to [start_ms, end_ms]."""
+    child: ExecPlan | None = None
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+
+    def do_execute(self, ctx):
+        inner = _as_matrix(self.child.execute(ctx)).to_host()
+        step = max(self.step_ms, 1)
+        out_ts = np.arange(self.start_ms, self.end_ms + 1, step,
+                           dtype=np.int64)
+        vals = np.asarray(inner.values, np.float64)
+        if vals.shape[1] == 0:
+            out = np.full((inner.num_series, len(out_ts)), np.nan)
+        else:
+            out = np.repeat(vals[:, -1:], len(out_ts), axis=1)
+        return ResultMatrix(out_ts, out, list(inner.keys), inner.bucket_les)
+
+
+@dataclass
 class ReduceAggregateExec(ExecPlan):
     """Cross-shard reduce (ref: ReduceAggregateExec in AggrOverRangeVectors.scala).
 

@@ -73,6 +73,34 @@ class PeriodicSeriesWithWindowing(PeriodicSeriesPlan):
 
 
 @dataclass(frozen=True)
+class SubqueryWithWindowing(PeriodicSeriesPlan):
+    """Range function over a SUBQUERY ``inner[window:sub_step]`` — the inner
+    periodic plan re-evaluates on the ``sub_step`` grid covering
+    ``[start - window, end]`` and the outer range function slides over that
+    synthetic sample stream (ref: upstream PromQL subqueries; the reference
+    parser stops short of them)."""
+    inner: PeriodicSeriesPlan
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: int
+    function: str
+    function_args: tuple[float, ...] = ()
+    sub_step_ms: int = 60_000
+
+
+@dataclass(frozen=True)
+class ApplyAtTimestamp(PeriodicSeriesPlan):
+    """``selector @ t``: the inner plan evaluates on its own pinned
+    single-step grid at ``t`` and the (step-invariant) result broadcasts
+    across the query grid ``[start_ms, end_ms]``."""
+    vectors: PeriodicSeriesPlan
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+
+@dataclass(frozen=True)
 class Aggregate(PeriodicSeriesPlan):
     operator: str                      # sum/min/max/avg/count/stddev/stdvar/topk/bottomk/count_values/quantile
     vectors: PeriodicSeriesPlan

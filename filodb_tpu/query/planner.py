@@ -218,6 +218,18 @@ class QueryPlanner:
         if isinstance(p, L.VectorOfScalar):
             # a scalar exec already yields a one-series matrix
             return self._walk(p.scalar)
+        if isinstance(p, L.SubqueryWithWindowing):
+            from .exec import SubqueryWindowExec
+            return SubqueryWindowExec(
+                child=self._walk(p.inner), start_ms=p.start_ms,
+                step_ms=p.step_ms, end_ms=p.end_ms, window_ms=p.window_ms,
+                function=p.function, args=p.function_args,
+                sub_step_ms=p.sub_step_ms)
+        if isinstance(p, L.ApplyAtTimestamp):
+            from .exec import RepeatAtExec
+            return RepeatAtExec(child=self._walk(p.vectors),
+                                start_ms=p.start_ms, step_ms=p.step_ms,
+                                end_ms=p.end_ms)
         if isinstance(p, L.RawChunkMeta):
             shards = self.shards_for_filters(list(p.filters))
             children = [self._route(SelectChunkInfosExec(
@@ -300,6 +312,12 @@ class QueryPlanner:
                 return walk(p.vectors)
             if isinstance(p, L.VectorOfScalar):
                 return walk(p.scalar)
+            if isinstance(p, L.SubqueryWithWindowing):
+                # the inner plan already carries its own (denser) grid; the
+                # outer window slide is host-side and cheap in comparison
+                return walk(p.inner)
+            if isinstance(p, L.ApplyAtTimestamp):
+                return walk(p.vectors)
             return 0.0        # scalar literals / time() / chunk-meta probes
 
         return walk(plan)

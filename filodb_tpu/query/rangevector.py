@@ -123,7 +123,8 @@ class QueryStats:
 
     FIELDS = ("series_matched", "blocks_narrow", "blocks_raw",
               "rows_paged_in", "result_cells", "result_cache_hits",
-              "negative_cache_hits", "fused_kernels", "admission_shed")
+              "negative_cache_hits", "fused_kernels", "admission_shed",
+              "subquery_inner_cells")
 
     def __init__(self):
         self.series_matched = 0        # series selected by leaf filters
@@ -137,6 +138,8 @@ class QueryStats:
         self.fused_kernels = 0         # fused-resident kernel executions
                                        # (ops/fusedresident.py) in this query
         self.admission_shed = 0        # shed by cost-based admission
+        self.subquery_inner_cells = 0  # inner-grid cells a subquery's
+                                       # nested evaluation materialized
         # serving resolution the retention router picked ("raw" / "1m" /
         # "1h+raw" for a stitched range); None when routing is off — a
         # label, not a counter, so merge() keeps the top-level value
@@ -222,6 +225,16 @@ def serialize_matrix(m: ResultMatrix) -> bytes:
     host = m.to_host()
     P, T = len(host.keys), len(host.out_ts)
     vals = np.asarray(host.values, "<f8")
+    if vals.shape[0] > P:
+        # padded leaf output (synthetic-pad empty selections, pow2-padded
+        # kernel rows): rows beyond the keyed prefix carry no series by the
+        # ResultMatrix contract (iter_series indexes values by key
+        # position) — shipping them would desync the receiver's offsets
+        vals = vals[:P]
+    elif vals.shape[0] < P:
+        raise ValueError(
+            f"matrix has {len(host.keys)} keys but {vals.shape[0]} value "
+            "rows — refusing to ship a truncated result")
     # B comes from the bucket bounds; shape disagreement is a caller bug and
     # must fail here, not as a corrupt blob at the receiver
     B = len(host.bucket_les) if host.bucket_les is not None else 0

@@ -1,0 +1,26 @@
+"""Streaming recording rules & alerting — the read/write loop over the
+replicated ingest plane (ROADMAP item 1's scenario tentpole).
+
+A rule-group scheduler continuously evaluates PromQL through the full
+QueryEngine and publishes derived series back through the gateway/broker
+path with DETERMINISTIC (rule, eval_ts) pub-ids — crash or leader-failover
+re-evaluation is exactly-once by PR 6's pub-id idempotence. Alerting rules
+run ``for``-duration state machines whose timers persist to the durable
+ring, and a webhook notifier delivers firing/resolved transitions with
+retry/backoff. See ARCHITECTURE.md "Rules & alerting".
+"""
+
+from .alerts import AlertManager, WebhookNotifier
+from .evaluator import RuleEvaluator, RULES_TENANT
+from .manager import RulesManager
+from .publish import DerivedSeriesPublisher, derive_pub_id
+from .scheduler import RuleGroupScheduler
+from .spec import (RULE_LABEL, RuleGroupSpec, RuleSpec, load_groups)
+from .state import RuleStateStore
+
+__all__ = [
+    "AlertManager", "WebhookNotifier", "RuleEvaluator", "RULES_TENANT",
+    "RulesManager", "DerivedSeriesPublisher", "derive_pub_id",
+    "RuleGroupScheduler", "RULE_LABEL", "RuleGroupSpec", "RuleSpec",
+    "load_groups", "RuleStateStore",
+]

@@ -247,6 +247,8 @@ class FiloServer:
         self._gw_flush_stop: threading.Event | None = None
         self.scheduler = None
         self.engines: dict[str, QueryEngine] = {}
+        self.rules = None
+        self._rules_buses: dict[int, object] = {}
         self.profiler = None
         self.membership = None
         self._registrar = None
@@ -368,6 +370,28 @@ class FiloServer:
         cfg = self.config
         return int(cfg.get("ingest.partitions")
                    or _pow2(cfg["num_shards"]))
+
+    def _make_shard_buses(self, num_shards: int) -> dict[int, object]:
+        """Per-shard PUBLISH buses over the configured ingest plane —
+        BrokerBus against the replicated broker tier (shard s publishes to
+        partition s mod partitions) or FileBus per shard; empty when
+        neither is configured (callers then ingest directly). One
+        construction shared by the gateway and rules publishers so their
+        wiring can never drift."""
+        cfg = self.config
+        if self._bus_addrs():
+            from .ingest.broker import BrokerBus
+            parts = self._num_partitions()
+            return {s: BrokerBus(self._bus_addrs(), s % parts,
+                                 publish_window=cfg["ingest.publish_window"],
+                                 retry_backoff_ms=parse_duration_ms(
+                                     cfg["ingest.retry_backoff"]),
+                                 max_retries=cfg["ingest.publish_retries"])
+                    for s in range(num_shards)}
+        if cfg.get("bus_dir"):
+            return {s: FileBus(f"{cfg['bus_dir']}/shard{s}.log")
+                    for s in range(num_shards)}
+        return {}
 
     def _shard_accept(self, shard_num: int):
         """Demux predicate for shared broker partitions: keep containers
@@ -608,20 +632,7 @@ class FiloServer:
             # Broker publishes ride the windowed PUBLISH_BATCH path; sub-
             # window remainders drain on the gateway's flush cadence.
             from .ingest.gateway import GatewayServer
-            if self._bus_addrs():
-                from .ingest.broker import BrokerBus
-                parts = self._num_partitions()
-                self._gw_buses = {
-                    s: BrokerBus(self._bus_addrs(), s % parts,
-                                 publish_window=cfg["ingest.publish_window"],
-                                 retry_backoff_ms=parse_duration_ms(
-                                     cfg["ingest.retry_backoff"]),
-                                 max_retries=cfg["ingest.publish_retries"])
-                    for s in range(num_shards)}
-            elif cfg.get("bus_dir"):
-                self._gw_buses = {
-                    s: FileBus(f"{cfg['bus_dir']}/shard{s}.log")
-                    for s in range(num_shards)}
+            self._gw_buses = self._make_shard_buses(num_shards)
 
             def gw_publish(shard, container, _ds=dataset):
                 bus = self._gw_buses.get(shard)
@@ -669,6 +680,39 @@ class FiloServer:
 
                 threading.Thread(target=gw_bus_flush, daemon=True,
                                  name="gw-bus-flush").start()
+        if cfg.get("rules.groups"):
+            # streaming recording rules & alerting: a scheduler evaluates
+            # rule groups through THIS node's engine and publishes derived
+            # series back through the broker plane with deterministic
+            # (rule, eval_ts) pub-ids — crash/failover re-evaluation is
+            # exactly-once (rules/; ARCHITECTURE "Rules & alerting")
+            from .rules import DerivedSeriesPublisher, RulesManager
+            schema_obj = self.memstore.schemas[cfg["schema"]]
+            if schema_obj.is_histogram:
+                raise ValueError(
+                    "rules.groups requires a scalar ingest schema: "
+                    "recording rules emit scalar derived samples")
+            self._rules_buses = self._make_shard_buses(num_shards)
+
+            def rules_publish(shard, container, pub_id, _ds=dataset):
+                bus = self._rules_buses.get(shard)
+                if bus is None:
+                    # in-process deployment: the store's out-of-order drop
+                    # dedupes a same-timestamp replay
+                    self.memstore.ingest(_ds, shard, container)
+                elif hasattr(bus, "publish_with_id"):
+                    bus.publish_with_id(container, pub_id)
+                else:
+                    # FileBus has no id journal: at-least-once transport,
+                    # deduped at the store like the direct path
+                    bus.publish(container)
+
+            publisher = DerivedSeriesPublisher(
+                schema_obj, mapper, rules_publish, dataset=dataset)
+            self.rules = RulesManager.from_config(
+                cfg, self.engines[dataset], publisher, self._sink, dataset)
+            self.rules.start()
+            self.http.rules = self.rules
         if cfg.get("cluster.registrar"):
             # watch peers: a silent peer's shards are reassigned to survivors,
             # whose _on_shard_event resync starts the consumers
@@ -881,6 +925,16 @@ class FiloServer:
         return self
 
     def shutdown(self) -> None:
+        if self.rules is not None:
+            # first: no rule evaluation may publish into a closing bus
+            self.rules.stop()
+        for b in self._rules_buses.values():
+            try:
+                if hasattr(b, "close"):
+                    b.close()
+            except (ConnectionError, OSError, RuntimeError):
+                log.warning("rules bus close failed on shutdown",
+                            exc_info=True)
         if self._cascade_stop is not None:
             self._cascade_stop.set()
         if self._ds_serve_stop is not None:

@@ -68,6 +68,15 @@ FILODB_RETENTION_ROUTED_QUERIES = "filodb_retention_routed_queries"
 FILODB_RETENTION_ODP_ROWS = "filodb_retention_odp_rows"
 FILODB_RETENTION_REPLICA_FAILOVER = "filodb_retention_replica_failover"
 FILODB_RETENTION_AGED_OUT_ROWS = "filodb_retention_aged_out_rows"
+FILODB_RULES_EVALUATIONS = "filodb_rules_evaluations"
+FILODB_RULES_EVAL_FAILURES = "filodb_rules_eval_failures"
+FILODB_RULES_EVAL_LATENCY_MS = "filodb_rules_eval_latency_ms"
+FILODB_RULES_EVAL_LAG_MS = "filodb_rules_eval_lag_ms"
+FILODB_RULES_DERIVED_ROWS = "filodb_rules_derived_rows"
+FILODB_RULES_ALERTS_FIRING = "filodb_rules_alerts_firing"
+FILODB_RULES_ALERT_TRANSITIONS = "filodb_rules_alert_transitions"
+FILODB_RULES_NOTIFICATIONS = "filodb_rules_notifications"
+FILODB_RULES_SPOOF_REJECTS = "filodb_rules_spoof_rejects"
 
 METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_INGESTED_ROWS: (
@@ -207,6 +216,37 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "Raw samples aged out of the durable tier past "
                    "retention.raw_ttl (each pass also bumps the shard's "
                    "data_epoch so cached results invalidate)."),
+    FILODB_RULES_EVALUATIONS: (
+        "counter", "Rule evaluations completed, tagged group= and rule= "
+                   "(one per rule per scheduler tick)."),
+    FILODB_RULES_EVAL_FAILURES: (
+        "counter", "Rule evaluations that raised (bad data mid-flight, "
+                   "admission shed after retries, publish fault), tagged "
+                   "group= and rule=; the group keeps evaluating."),
+    FILODB_RULES_EVAL_LATENCY_MS: (
+        "histogram", "Wall time of one whole group evaluation (every rule "
+                     "in the group, sequentially, derived publish "
+                     "included), tagged group=."),
+    FILODB_RULES_EVAL_LAG_MS: (
+        "gauge", "How far the group's completed evaluation trails its "
+                 "scheduled grid tick, per group — sustained growth means "
+                 "the interval is shorter than the evaluation costs."),
+    FILODB_RULES_DERIVED_ROWS: (
+        "counter", "Derived samples published back through the ingest "
+                   "plane by recording rules, tagged group=."),
+    FILODB_RULES_ALERTS_FIRING: (
+        "gauge", "Alert instances currently in the firing state, tagged "
+                 "rule=."),
+    FILODB_RULES_ALERT_TRANSITIONS: (
+        "counter", "Alert state-machine transitions, tagged rule= and to= "
+                   "(pending/firing/inactive)."),
+    FILODB_RULES_NOTIFICATIONS: (
+        "counter", "Webhook notifications attempted, tagged status=ok| "
+                   "failed (failed = retries exhausted)."),
+    FILODB_RULES_SPOOF_REJECTS: (
+        "counter", "External writes rejected for carrying the reserved "
+                   "__rule__ label (tagged site=remote-write|gateway): "
+                   "derived-series provenance cannot be forged."),
     "filodb_shard_*": (
         "gauge", "Per-shard ingest/eviction stats exported from the shard's "
                  "IngestStats dataclass fields on each /metrics scrape."),

@@ -72,6 +72,7 @@ SPAN_REPLICATE_SERVE = "ingest.replicate.serve"
 SPAN_INGEST_CONSUME = "ingest.consume"
 SPAN_QUERY_RETENTION = "query.retention"
 SPAN_ODP_DURABLE = "query.odp.durable"
+SPAN_RULES_EVAL = "rules.eval"
 
 TRACE_SPEC: dict[str, str] = {
     SPAN_QUERY: "Root span of one PromQL query (tags: dataset, promql).",
@@ -115,6 +116,9 @@ TRACE_SPEC: dict[str, str] = {
                           "resolution, stitched).",
     SPAN_ODP_DURABLE: "Durable-tier chunk scan of one ODP page-in batch "
                       "(tags: shard, tier=local|remote, rows).",
+    SPAN_RULES_EVAL: "One rule evaluation inside a scheduler tick (tags: "
+                     "group, rule, eval_ts; its PromQL query and derived "
+                     "publish spans hang under it).",
 }
 
 

@@ -1,0 +1,15 @@
+"""surface-config-type: declared defaults the declared type string cannot
+represent — an int defaulting to prose, a duration with a bogus unit, an
+int posing as a bool, and a missing |null."""
+
+CONFIG_SPEC = {
+    "ingest.window": ("int", "sixty-four", "Frames per round trip."),
+    "ingest.timeout": ("duration", "5x", "Bad duration unit."),
+    "ingest.flag": ("bool", 1, "Int posing as bool."),
+    "ingest.limit": ("int", None, "Null default without |null."),
+}
+
+
+def start(cfg):
+    return (cfg.get("ingest.window"), cfg["ingest.timeout"],
+            cfg["ingest.flag"], cfg.get("ingest.limit"))

@@ -1,0 +1,20 @@
+"""Good twin: every default literal satisfies its declared type —
+including |null, durations in both spellings, computed expressions
+(skipped, never guessed), and typed lists."""
+
+CONFIG_SPEC = {
+    "ingest.window": ("int", 64, "Frames per round trip."),
+    "ingest.timeout": ("duration", "5s", "Publish timeout."),
+    "ingest.timeout_raw": ("duration", 5000, "Raw-milliseconds spelling."),
+    "ingest.flag": ("bool", False, "Feature flag."),
+    "ingest.limit": ("int|null", None, "Unbounded when null."),
+    "ingest.capacity": ("int", 1 << 20, "Computed literal: not judged."),
+    "ingest.resolutions": ("list[duration]", ["1m", "1h"], "Cascade."),
+}
+
+
+def start(cfg):
+    return (cfg.get("ingest.window"), cfg["ingest.timeout"],
+            cfg["ingest.timeout_raw"], cfg["ingest.flag"],
+            cfg.get("ingest.limit"), cfg["ingest.capacity"],
+            cfg["ingest.resolutions"])

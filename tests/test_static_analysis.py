@@ -55,6 +55,9 @@ BAD_FIXTURES = {
                               "except-state-leak"},
     "bad_config_key.py": {"surface-config-undeclared",
                           "surface-config-unused"},
+    # PR 11: default-vs-type parity inside CONFIG_SPEC itself (the rules
+    # subsystem grew the spec; this keeps every entry's default honest)
+    "bad_config_type.py": {"surface-config-type"},
     "bad_metric_dup.py": {"surface-metric-duplicate",
                           "surface-metric-undeclared",
                           "surface-metric-kind"},

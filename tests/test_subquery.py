@@ -1,0 +1,216 @@
+"""PromQL subqueries ``expr[range:step]`` and the ``@`` modifier
+(ISSUE 11 satellites): parse shapes, typed rejections, and execution
+parity against hand-nested oracle evaluation."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.promql import parser as P
+from filodb_tpu.promql.parser import ParseError, parse_query, \
+    reject_at_modifier
+from filodb_tpu.query import logical as L
+from filodb_tpu.query.engine import QueryEngine
+
+START = 1_000_000
+IV = 10_000
+N = 120
+
+
+@pytest.fixture(scope="module")
+def engine():
+    ms = TimeSeriesMemStore()
+    ms.setup("ds", GAUGE, 0, StoreConfig(
+        max_series_per_shard=16, samples_per_series=256,
+        flush_batch_size=10**9, dtype="float64"))
+    b = RecordBuilder(GAUGE)
+    for i in range(3):
+        for t in range(N):
+            b.add({"_metric_": "m", "host": f"h{i}"},
+                  START + t * IV, 100.0 * (i + 1) + 10.0 * np.sin(t / 7 + i))
+    ms.ingest("ds", 0, b.build())
+    ms.flush_all()
+    return QueryEngine(ms, "ds")
+
+
+# -- parsing ------------------------------------------------------------------
+
+def test_subquery_parse_shapes():
+    e = parse_query("max_over_time(rate(m[1m])[1h:5m])")
+    (sq,) = e.args
+    assert isinstance(sq, P.Subquery)
+    assert sq.range_ms == 3_600_000 and sq.step_ms == 300_000
+    # omitted step -> documented default
+    e = parse_query("avg_over_time(m[1h:])")
+    (sq,) = e.args
+    assert sq.step_ms == P.DEFAULT_SUBQUERY_STEP_MS
+    # offset applies to the subquery
+    e = parse_query("avg_over_time(m[30m:1m] offset 5m)")
+    (sq,) = e.args
+    assert sq.offset_ms == 300_000
+    # colon-bearing recording-rule names still lex as one identifier —
+    # including the LEADING-colon convention (kubernetes-mixin style)
+    v = parse_query("job:rate:sum5m")
+    assert v.metric == "job:rate:sum5m"
+    v = parse_query(":node_memory:sum")
+    assert v.metric == ":node_memory:sum"
+    # spaced subquery colon parses too
+    e = parse_query("avg_over_time(m[30m : 1m])")
+    (sq,) = e.args
+    assert sq.range_ms == 1_800_000 and sq.step_ms == 60_000
+
+
+def test_subquery_typed_rejections():
+    with pytest.raises(ParseError, match="step must be positive"):
+        parse_query("m[5m:0s]")
+    with pytest.raises(ParseError, match="instant vector"):
+        parse_query("m[5m][1h:1m]")          # subquery of a range selector
+    with pytest.raises(ParseError, match="argument of a range function"):
+        P.query_to_logical_plan("m[5m:1m]", 0, 1000, 10)
+    with pytest.raises(ParseError, match="range must be positive"):
+        P.query_to_logical_plan("avg_over_time(m[0s:1m])", 0, 1000, 10)
+
+
+def test_at_modifier_parse_and_rejections():
+    v = parse_query("m @ 1500.5")
+    assert v.at_ms == 1_500_500
+    with pytest.raises(ParseError, match="unix timestamp"):
+        parse_query("m @ foo")
+    # NUMBER also matches Inf/NaN: typed 422-shaped errors, never 500s
+    for bad in ("Inf", "NaN"):
+        with pytest.raises(ParseError, match="finite unix timestamp"):
+            parse_query(f"m @ {bad}")
+    with pytest.raises(ParseError):          # hex is not a timestamp either
+        parse_query("m @ 0x10")
+    with pytest.raises(ParseError, match="requires a vector selector"):
+        parse_query("sum(m) @ 1500")
+    # typed rule-side rejection names WHY
+    with pytest.raises(ParseError, match="pure function of its evaluation"):
+        reject_at_modifier("sum(m @ 1500)")
+    reject_at_modifier("sum(rate(m[5m]))")   # plain rules stay fine
+
+
+def test_subquery_lowering_grid_alignment():
+    plan = P.query_to_logical_plan("sum_over_time(m[10m:1m])",
+                                   START + 605_000, START + 905_000, 30_000)
+    assert isinstance(plan, L.SubqueryWithWindowing)
+    inner = plan.inner
+    assert isinstance(inner, L.PeriodicSeries)
+    # inner grid: absolute multiples of the sub-step, first point strictly
+    # inside (start - range, ...], last at or before end
+    assert inner.start_ms % 60_000 == 0 and inner.end_ms % 60_000 == 0
+    assert inner.start_ms > START + 605_000 - 600_000
+    assert inner.start_ms - 60_000 <= START + 605_000 - 600_000
+    assert inner.end_ms <= START + 905_000
+    assert plan.window_ms == 600_000 and plan.sub_step_ms == 60_000
+
+
+# -- execution parity ---------------------------------------------------------
+
+def _oracle_subquery(engine, inner_q, fn, start, end, step, rng, sub):
+    inner_start = ((start - rng) // sub + 1) * sub
+    inner_end = (end // sub) * sub
+    inner = engine.query_range(inner_q, inner_start, inner_end, sub)
+    sub_ts = inner.matrix.out_ts
+    vals = np.asarray(inner.matrix.values)
+    out_ts = np.arange(start, end + 1, step)
+    want = np.full((vals.shape[0], len(out_ts)), np.nan)
+    for j, t in enumerate(out_ts):
+        m = (sub_ts > t - rng) & (sub_ts <= t)
+        for i in range(vals.shape[0]):
+            w = vals[i, m]
+            w = w[np.isfinite(w)]
+            if len(w):
+                want[i, j] = fn(w)
+    return want
+
+
+@pytest.mark.parametrize("outer,npfn", [
+    ("max_over_time", np.max), ("min_over_time", np.min),
+    ("avg_over_time", np.mean), ("sum_over_time", np.sum),
+    ("count_over_time", len)])
+def test_subquery_parity_vs_nested_oracle(engine, outer, npfn):
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    got = engine.query_range(f"{outer}(rate(m[1m])[5m:1m])", s, e, step)
+    want = _oracle_subquery(engine, "rate(m[1m])", npfn, s, e, step,
+                            300_000, 60_000)
+    gv = np.asarray(got.matrix.values)
+    assert gv.shape == want.shape
+    np.testing.assert_allclose(np.sort(gv, axis=0), np.sort(want, axis=0),
+                               rtol=1e-12, equal_nan=True)
+    assert got.stats.to_dict()["subquery_inner_cells"] > 0
+
+
+def test_aggregate_over_subquery(engine):
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    got = engine.query_range("sum(max_over_time(rate(m[1m])[5m:1m]))",
+                             s, e, step)
+    per_series = engine.query_range("max_over_time(rate(m[1m])[5m:1m])",
+                                    s, e, step)
+    want = np.nansum(np.asarray(per_series.matrix.values), axis=0)
+    (got_row,) = np.asarray(got.matrix.values)
+    np.testing.assert_allclose(got_row, want, rtol=1e-12)
+
+
+def test_subquery_over_binary_expression(engine):
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    got = engine.query_range("avg_over_time((m * 2)[5m:1m])", s, e, step)
+    assert got.matrix.num_series == 3
+    want = _oracle_subquery(engine, "m * 2", np.mean, s, e, step,
+                            300_000, 60_000)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(got.matrix.values), axis=0),
+        np.sort(want, axis=0), rtol=1e-12, equal_nan=True)
+
+
+def test_subquery_cost_estimate_nonzero(engine):
+    plan = P.query_to_logical_plan("avg_over_time(rate(m[1m])[5m:1m])",
+                                   START + 600_000, START + 900_000, 30_000)
+    assert engine.estimate_cost(plan) > 0
+
+
+# -- @ modifier execution -----------------------------------------------------
+
+def test_at_pins_and_broadcasts(engine):
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    at_s = (START + 500_000) / 1000.0
+    got = engine.query_range(f"m @ {at_s}", s, e, step)
+    vals = np.asarray(got.matrix.values)
+    assert vals.shape == (3, 11)
+    assert np.allclose(vals, vals[:, :1])    # step-invariant broadcast
+    pinned = engine.query_instant("m", START + 500_000)
+    want = sorted(float(v[-1]) for _k, _t, v in pinned.matrix.iter_series())
+    assert sorted(vals[:, 0].tolist()) == want
+
+
+def test_at_on_range_selector_and_aggregate(engine):
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    at_s = (START + 500_000) / 1000.0
+    got = engine.query_range(f"sum(rate(m[2m] @ {at_s}))", s, e, step)
+    (row,) = np.asarray(got.matrix.values)
+    assert np.allclose(row, row[0])
+    oracle = engine.query_instant(f"sum(rate(m[2m]))", START + 500_000)
+    (_k, _t, v), = list(oracle.matrix.iter_series())
+    assert row[0] == float(v[-1])            # pinned value, bit-exact
+
+
+def test_at_join_against_live_series(engine):
+    """`m - m @ t`: current value minus the pinned snapshot — the classic
+    'delta since deploy' dashboard shape; the pinned side broadcasts to
+    the query grid so the join aligns per step."""
+    s, e, step = START + 600_000, START + 900_000, 30_000
+    at_ms = START + 500_000
+    got = engine.query_range(f"m - m @ {at_ms / 1000.0}", s, e, step)
+    assert got.matrix.num_series == 3
+    live = engine.query_range("m", s, e, step)
+    pinned = engine.query_instant("m", at_ms)
+    # the join's output keys drop the metric name: compare per host
+    pin = {dict(k.labels)["host"]: float(v[-1])
+           for k, _t, v in pinned.matrix.iter_series()}
+    want = {dict(k.labels)["host"]: np.asarray(v) - pin[dict(k.labels)["host"]]
+            for k, _t, v in live.matrix.iter_series()}
+    for k, _t, v in got.matrix.iter_series():
+        np.testing.assert_allclose(np.asarray(v),
+                                   want[dict(k.labels)["host"]], rtol=1e-12)
