@@ -69,6 +69,13 @@ WIRE_SPEC = {
         # client; a one-sided op is a live flush/recovery protocol desync
         {"module": "filodb_tpu/core/diststore.py", "prefix": "OP_",
          "server_fn": "_serve", "client_class": "RemoteStore"},
+        # the elastic-cluster op family (PR 12): gossip digests, epoch
+        # read/claim/announce, and the REJOIN log sync all live in
+        # cluster/gossip.py with serve_cluster as the one dispatch (brokers
+        # and GossipServers both delegate there) and ClusterLink as the one
+        # sender — a one-sided op desyncs failover or membership
+        {"module": "filodb_tpu/cluster/gossip.py", "prefix": "OP_",
+         "server_fn": "serve_cluster", "client_class": "ClusterLink"},
     ],
     # trace-context carrier parity: every (module, scope) side must
     # reference the symbol — scopes are function OR class names, so the

@@ -58,6 +58,19 @@ def main(argv=None) -> int:
     st.add_argument("--dataset", default=None)
     st.add_argument("--shard", type=int, default=None)
 
+    cu = sub.add_parser("cluster", help="elasticity view: membership table, "
+                                        "per-node epoch/health, shard map, "
+                                        "last-failover info; --rebalance "
+                                        "moves a live shard")
+    cu.add_argument("--host", default="http://127.0.0.1:8080")
+    cu.add_argument("--rebalance", type=int, default=None, metavar="SHARD",
+                    help="move this shard to --to (POSTs "
+                         "/api/v1/cluster/rebalance on the owner)")
+    cu.add_argument("--to", default=None, metavar="NODE",
+                    help="rebalance target node identity")
+    cu.add_argument("--dataset", default="prometheus",
+                    help="dataset of --rebalance")
+
     ds = sub.add_parser("dataset", help="dataset operations (init/list/"
                                         "validateSchemas analogs)")
     dsub = ds.add_subparsers(dest="dscmd", required=True)
@@ -119,6 +132,8 @@ def main(argv=None) -> int:
                           "end": args.end})
     if args.cmd == "status":
         return _status(args)
+    if args.cmd == "cluster":
+        return _cluster(args)
     if args.cmd == "dataset":
         return _dataset(args)
     if args.cmd == "importcsv":
@@ -154,7 +169,8 @@ def _broker(args) -> int:
         replication=cfg["ingest.replication"],
         min_insync=cfg["ingest.min_insync"],
         max_queue=cfg["ingest.max_partition_queue"],
-        fault_plan=plan_from_config(cfg)).start()
+        fault_plan=plan_from_config(cfg),
+        epoch_fencing=cfg["ingest.epoch_fencing"]).start()
     role = "replicated" if len(peers) > 1 and cfg["ingest.replication"] > 1 \
         else "single"
     print(f"filodb_tpu broker ({role}) node {args.node_index} serving "
@@ -259,6 +275,58 @@ def _status(args) -> int:
         print(f"shard {args.shard} not found in dataset {args.dataset!r}",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _cluster(args) -> int:
+    """Elasticity view of GET /api/v1/cluster/status: membership table
+    (gossip state/heartbeats), per-node epochs, the shard map, and the
+    last failover/rebalance event. With --rebalance SHARD --to NODE, POSTs
+    a live shard move to the owner instead."""
+    if args.rebalance is not None:
+        if not args.to:
+            print("--rebalance needs --to NODE", file=sys.stderr)
+            return 2
+        import urllib.parse
+        import urllib.request
+        qs = urllib.parse.urlencode({"dataset": args.dataset,
+                                     "shard": args.rebalance,
+                                     "to": args.to})
+        req = urllib.request.Request(
+            f"{args.host}/api/v1/cluster/rebalance?{qs}", method="POST",
+            data=b"")
+        with urllib.request.urlopen(req) as r:
+            print(json.dumps(json.load(r), indent=2))
+        return 0
+    payload = _fetch_json(args.host, "/api/v1/cluster/status")
+    data = payload.get("data", payload)
+    print(f"nodes: {', '.join(data.get('nodes', [])) or '-'}")
+    rows = data.get("membership")
+    if rows:
+        print("\nmembership:")
+        for m in rows:
+            mark = "*" if m.get("self") else " "
+            print(f" {mark} {m['node']:<24} state={m['state']:<8} "
+                  f"hb={m['heartbeat']:<8} inc={m['incarnation']:<3} "
+                  f"stale_rounds={m['stale_rounds']}")
+    epochs = (data.get("epochs") or {}).get("shards")
+    if epochs:
+        print("\nshard epochs (this node's claims):")
+        for s, e in sorted(epochs.items(), key=lambda kv: int(kv[0])):
+            print(f"   shard {s:>4}  epoch={e}")
+    print("\nshard map:")
+    for ds, shards in sorted((data.get("datasets") or {}).items()):
+        for sid, info in sorted(shards.items(), key=lambda kv: int(kv[0])):
+            print(f"   {ds}/{sid:>4}  node={info.get('node', '-')}  "
+                  f"status={info.get('status', '-')}")
+    bad = data.get("known_bad_windows")
+    if bad:
+        print("\nknown-bad windows (buddy-routed):")
+        for key, start in sorted(bad.items()):
+            print(f"   {key}  since_ms={start}")
+    lf = data.get("last_failover")
+    if lf:
+        print(f"\nlast failover: {json.dumps(lf)}")
     return 0
 
 

@@ -235,6 +235,14 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "Deterministic FaultPlan rules for the broker (site/action/nth/"
         "partition/at_offset...; fault-injection tests and soak runs "
         "only)."),
+    "ingest.epoch_fencing": (
+        "bool", False,
+        "Monotonic leadership epochs on the replicated broker tier: "
+        "publishes and replication batches are refused below the "
+        "partition's current epoch, closing the spurious-failover "
+        "split-brain window (clients claim a new epoch on failover; a "
+        "restarted deposed leader truncates its divergent tail and "
+        "catches up on REJOIN)."),
     "ingest.decode_ahead": (
         "int", 2,
         "Containers decoded ahead of the device scatter "
@@ -312,6 +320,34 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "computes the identical assignment."),
     "cluster.join_timeout": ("duration", "30s",
                              "Max wait for min_members at startup."),
+    "cluster.gossip_port": (
+        "int|null", None,
+        "Enables the membership gossip agent on this TCP port (0 = any "
+        "free port; null = registrar-heartbeat liveness only). Peers "
+        "learn the bound address from registrar heartbeats."),
+    "cluster.gossip_interval": (
+        "duration", "1s",
+        "Cadence of the gossip agent's probe rounds (suspicion itself is "
+        "counted in rounds, not wall time)."),
+    "cluster.suspect_after": (
+        "int", 3,
+        "Probe rounds without a heartbeat-counter advance before a peer "
+        "turns SUSPECT (counted, not timed)."),
+    "cluster.dead_after": (
+        "int", 8,
+        "Probe rounds without an advance before a SUSPECT peer is "
+        "declared DEAD and its shards reassign to survivors."),
+    "cluster.shard_fencing": (
+        "bool", False,
+        "Epoch-fence store-ring writers: each owned shard's leadership "
+        "epoch persists in the durable ring and flush/checkpoint writes "
+        "from a deposed owner are refused (requires a durable sink)."),
+    "cluster.buddy_endpoint": (
+        "str|null", None,
+        "Buddy cluster base URL for failure-aware query routing: time "
+        "ranges overlapping a known-bad window (dead node, warming "
+        "shard) steer sub-queries there over the Prometheus HTTP API "
+        "and stitch with local results (null = local-only serving)."),
 }
 
 

@@ -438,6 +438,16 @@ class ReplicatedColumnStore(ChunkSink):
         assert backends, "need at least one backend"
         self.backends = backends
         self.replication = min(replication, len(backends))
+        # optional epoch fence (cluster/epoch.py StoreFence): consulted
+        # before EVERY replica write so a deposed shard owner's flush or
+        # checkpoint raises FencedWriteError instead of corrupting the
+        # shard a replacement node already warmed
+        self.write_guard = None
+
+    def _write(self, dataset, shard, fn_name, *args):
+        if self.write_guard is not None:
+            self.write_guard(dataset, shard, fn_name)
+        return self._write_unguarded(dataset, shard, fn_name, *args)
 
     def _replicas(self, dataset, shard):
         key = f"{dataset}:{shard}".encode()
@@ -450,7 +460,7 @@ class ReplicatedColumnStore(ChunkSink):
         registry.counter(FILODB_RETENTION_REPLICA_FAILOVER,
                          {"op": op}).increment()
 
-    def _write(self, dataset, shard, fn_name, *args):
+    def _write_unguarded(self, dataset, shard, fn_name, *args):
         wrote = 0
         last_err = None
         attempts = (self.WRITE_ATTEMPTS
@@ -580,6 +590,8 @@ class ReplicatedColumnStore(ChunkSink):
         (each rewrites its own view — replicas may hold different frame
         sets after an outage; a per-replica rewrite never copies one
         replica's gaps onto another). Returns the max dropped count."""
+        if self.write_guard is not None:
+            self.write_guard(dataset, shard, "age_out")
         dropped = 0
         for b in self._replicas(dataset, shard):
             if not hasattr(b, "age_out"):

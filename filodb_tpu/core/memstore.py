@@ -912,13 +912,21 @@ class TimeSeriesShard:
         if bus is not None:
             wm = self.group_watermarks.copy()
             start_off = int(wm[wm >= 0].min()) if (wm >= 0).any() else 0
+            next_off = start_off
             for off, container in bus.consume(schemas or Schemas(), start_off):
+                next_off = off + 1
                 if accept is not None and not accept(container):
                     continue
                 before = self.stats.rows_ingested
                 self.ingest(container, off, recovery_watermarks=wm)
                 replayed += self.stats.rows_ingested - before
             self.flush()
+            # the EXACT offset replay reached: the live consumer must resume
+            # here, not at a later end_offset read — frames published between
+            # the replay's end snapshot and that read would be skipped
+            # forever (visible as a permanent gap on an adopted shard that
+            # warms while its partition keeps taking writes)
+            self.recovered_through = next_off
         return replayed
 
     # -- purge (ref: TimeSeriesShard.purgeExpiredPartitions :751) ------------

@@ -81,16 +81,22 @@ class FiloHttpServer:
     datasets (ref: FiloHttpServer / akka-http binding)."""
 
     def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
-                 cluster=None, writers: dict | None = None, scheduler=None):
+                 cluster=None, writers: dict | None = None, scheduler=None,
+                 cluster_ops: dict | None = None):
         """``writers``: dataset -> callable(per_shard: dict[shard, container])
         receiving remote-write batches atomically (bus publish or direct ingest).
         ``scheduler``: optional QueryScheduler — query work runs through its
         priority lanes (ref: QueryActor priority mailbox) instead of directly
-        on the HTTP handler thread."""
+        on the HTTP handler thread.
+        ``cluster_ops``: optional elasticity hooks from the FiloServer —
+        ``extra()`` enriches /api/v1/cluster/status (membership table,
+        epochs, last failover), ``rebalance(dataset, shard, to)`` and
+        ``adopt(dataset, shard)`` drive live shard moves."""
         self.engines = engines
         self.cluster = cluster
         self.writers = writers or {}
         self.scheduler = scheduler
+        self.cluster_ops = cluster_ops or {}
         # rules subsystem handle (RulesManager): serves /api/v1/rules and
         # /api/v1/alerts when the FiloServer configured rule groups
         self.rules = None
@@ -295,6 +301,29 @@ class FiloHttpServer:
                 return
             data = (self.rules.rules_payload() if path.endswith("/rules")
                     else self.rules.alerts_payload())
+            h._send(200, {"status": "success", "data": data})
+            return
+        if path in ("/api/v1/cluster/rebalance", "/api/v1/cluster/adopt") \
+                and h.command == "POST":
+            # live shard moves (cluster/: flush→handoff→catch-up→cutover);
+            # rebalance POSTs to the current owner, adopt is its
+            # server-to-server receiving leg
+            which = path.rsplit("/", 1)[1]
+            hook = self.cluster_ops.get(which)
+            if hook is None:
+                h._send(404, {"status": "error",
+                              "error": f"no {which} hook on this server "
+                                       "(standalone cluster mode only)"})
+                return
+            try:
+                if which == "rebalance":
+                    data = hook(q["dataset"], int(q["shard"]), q["to"])
+                else:
+                    data = hook(q["dataset"], int(q["shard"]))
+            except KeyError as e:
+                raise QueryError(f"missing {which} parameter: {e}") from None
+            except ValueError as e:
+                raise QueryError(f"bad {which} parameter: {e}") from None
             h._send(200, {"status": "success", "data": data})
             return
         if path == "/api/v1/cluster/status" or path.startswith("/api/v1/cluster/"):
@@ -638,4 +667,11 @@ class FiloHttpServer:
                  "numSeries": s.num_series}
                 for ds, e in list(self.engines.items())
                 for s in e.memstore.shards_of(ds)]}
-        return self.cluster.status()
+        data = self.cluster.status()
+        extra = self.cluster_ops.get("extra")
+        if extra is not None:
+            # elasticity surface: membership table, epochs, known-bad
+            # windows, last failover — merged beside nodes/datasets so the
+            # legacy status consumers keep working
+            data = {**data, **extra()}
+        return data

@@ -68,11 +68,13 @@ import time
 from typing import Iterator
 
 from ..core.record import RecordContainer
-from ..utils.metrics import (FILODB_INGEST_FAILOVERS, FILODB_INGEST_RETRIES,
+from ..utils.metrics import (FILODB_CLUSTER_FENCED_REJECTS,
+                             FILODB_CLUSTER_REJOIN_TRUNCATED,
+                             FILODB_INGEST_FAILOVERS, FILODB_INGEST_RETRIES,
                              FILODB_INGEST_PUBLISH_LATENCY_MS,
                              FILODB_INGEST_PUBLISH_SHED, registry)
-from ..utils.tracing import (SPAN_BROKER_APPEND, SPAN_INGEST_PUBLISH, span,
-                             tracer)
+from ..utils.tracing import (SPAN_BROKER_APPEND, SPAN_CLUSTER_REJOIN,
+                             SPAN_INGEST_PUBLISH, span, tracer)
 from .bus import FileBus
 
 log = logging.getLogger("filodb_tpu.broker")
@@ -165,7 +167,8 @@ class BrokerServer:
                  recent_ids_max: int = _RECENT_IDS_MAX,
                  peers: list[str] | None = None, node_index: int = 0,
                  replication: int = 1, min_insync: int = 1,
-                 max_queue: int = 256, fault_plan=None):
+                 max_queue: int = 256, fault_plan=None,
+                 epoch_fencing: bool = False):
         """``recent_ids_max`` below the default weakens the windowed
         publisher's replay idempotence: BrokerBus bounds a pipelined group to
         ``_RECENT_IDS_MAX // 2`` unacked frames on the assumption the server
@@ -177,9 +180,23 @@ class BrokerServer:
         of the peer nodes and publishes ack only at >= min_insync in-sync
         replicas. ``max_queue`` caps concurrent in-flight publishes per
         partition (overload sheds ST_RETRY). ``fault_plan`` wires the
-        deterministic fault-injection hooks (ingest/faults.py)."""
+        deterministic fault-injection hooks (ingest/faults.py).
+
+        ``epoch_fencing`` enables monotonic leadership epochs
+        (cluster/epoch.py, persisted in ``data_dir``): a publish or
+        replication batch below the partition's current epoch is refused,
+        so a deposed leader can never ack after deposition, and
+        ``start()`` runs the REJOIN repair (truncate a divergent tail,
+        catch up from the current leader) before serving."""
         from .replication import PubIdJournal, Replicator
         os.makedirs(data_dir, exist_ok=True)
+        self.peers = list(peers or [])
+        self.node_index = int(node_index)
+        self.epochs = None
+        if epoch_fencing:
+            from ..cluster.epoch import PartitionEpochs
+            self.epochs = PartitionEpochs(os.path.join(data_dir,
+                                                       "epochs.json"))
         self._parts = [FileBus(os.path.join(data_dir, f"partition{p}.log"))
                        for p in range(num_partitions)]
         # publish idempotence: recent publish-id -> offset per partition, so a
@@ -254,8 +271,14 @@ class BrokerServer:
 
     def _serve(self, op: int, part: int, offset: int, plen: int,
                payload: bytes) -> bytes | None:
+        from ..cluster.gossip import CLUSTER_OPS, serve_cluster
         from .replication import OP_REPLICATE, serve_replication
         try:
+            if op in CLUSTER_OPS:
+                # membership/epoch/sync control plane (cluster/gossip.py);
+                # partition bounds are checked per-op there (OP_EPOCH_* may
+                # address partitions this node only replicates)
+                return serve_cluster(self, op, part, payload)
             if not 0 <= part < len(self._parts):
                 raise ValueError(f"no partition {part}")
             bus = self._parts[part]
@@ -308,6 +331,9 @@ class BrokerServer:
         replicate to quorum before acking."""
         jrnl = self._journals[part]
         with self._publish_locks[part]:
+            fenced = self._fence_resp(part)
+            if fenced is not None:
+                return fenced
             recent = self._recent_ids[part]
             if op == OP_PUBLISH:
                 pub_id = offset             # request offset field = publish id
@@ -378,10 +404,32 @@ class BrokerServer:
             if self._repl is not None:
                 ok, hint = self._repl.ensure(part, bus.end_offset,
                                              fresh=appended or None)
+                # a follower may have fenced us DURING ensure (we adopted
+                # its higher epoch and stepped down): the ack must be
+                # refused, not retried — the client fails over and replays
+                # with the same pub-ids at the real leader
+                fenced = self._fence_resp(part)
+                if fenced is not None:
+                    return fenced
                 if not ok:
                     self._shed.increment()
                     return _RESP.pack(ST_RETRY, hint, 0)
             return resp
+
+    def _fence_resp(self, part: int) -> bytes | None:
+        """ST_ERR fenced refusal when this node is not the partition's
+        current epoch owner (epoch 0 = unclaimed: legacy convention
+        leadership still applies). Caller holds the publish lock."""
+        if self.epochs is None:
+            return None
+        e, owner = self.epochs.get(part)
+        if e == 0 or owner == self.self_addr:
+            return None
+        from ..cluster.gossip import fence_message
+        registry.counter(FILODB_CLUSTER_FENCED_REJECTS,
+                         {"site": "publish"}).increment()
+        msg = fence_message(part, e, owner)
+        return _RESP.pack(ST_ERR, 0, len(msg)) + msg.encode()
 
     def _admit(self, part: int) -> bool:
         with self._admit_lock:
@@ -444,6 +492,20 @@ class BrokerServer:
         return self._server.server_address[1]
 
     @property
+    def self_addr(self) -> str:
+        """This node's cluster identity: its entry in the shared peers
+        list (epoch owners are recorded by this address)."""
+        if self.peers and 0 <= self.node_index < len(self.peers):
+            return self.peers[self.node_index]
+        return f"127.0.0.1:{self.port}"
+
+    def cluster_peers(self, part: int) -> list[str]:
+        """Replica addresses of ``part`` (the epoch claim/announce set)."""
+        if self._repl is not None:
+            return [self.peers[i] for i in self._repl.replica_indexes(part)]
+        return list(self.peers)
+
+    @property
     def num_partitions(self) -> int:
         return len(self._parts)
 
@@ -451,7 +513,134 @@ class BrokerServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="filo-broker")
         self._thread.start()
+        if self.epochs is not None and self.peers:
+            self.rejoin_sync()
+            self._claim_static_leaderships()
         return self
+
+    # -- epoch-fenced lifecycle (cluster/: REJOIN + static claims) -----------
+
+    def _claim_static_leaderships(self) -> None:
+        """Bootstrap claims: the static leader of each still-unclaimed
+        partition claims epoch 1 so fencing is live from the first publish
+        (idempotent; a raced claim from elsewhere just wins by epoch)."""
+        from ..cluster.gossip import ClusterError, lead_partition
+        for part in range(len(self._parts)):
+            if part % len(self.peers) != self.node_index:
+                continue
+            e, _owner = self.epochs.get(part)
+            if e == 0:
+                try:
+                    lead_partition(self, part)
+                except (ConnectionError, OSError, ClusterError):
+                    log.warning("startup epoch claim failed for partition "
+                                "%d; a client failover will claim instead",
+                                part, exc_info=True)
+
+    def rejoin_sync(self) -> dict[int, dict]:
+        """REJOIN after divergence (the PR 6 known-limit repair): for each
+        partition whose current epoch owner is another node, find the
+        first offset where our log diverges from the leader's, truncate
+        our tail there (a dead leader's unreplicated appends), and catch
+        up from the leader's journaled log over OP_SYNC. Returns
+        {partition: {"truncated": n, "appended": m}}."""
+        from ..cluster.gossip import ClusterError, ClusterLink
+        out: dict[int, dict] = {}
+        for part in range(len(self._parts)):
+            if self.node_index not in (
+                    self._repl.replica_indexes(part) if self._repl is not None
+                    else range(len(self.peers))):
+                continue
+            best: tuple[int, str] | None = None
+            for addr in self.cluster_peers(part):
+                if addr == self.self_addr:
+                    continue
+                try:
+                    e, owner = ClusterLink(addr).epoch_read(part)
+                except (ConnectionError, OSError, ClusterError):
+                    continue
+                if e and (best is None or e > best[0]):
+                    best = (e, owner)
+            if best is None:
+                continue
+            self.epochs.adopt(part, *best)
+            e, owner = self.epochs.get(part)
+            if e == 0 or owner == self.self_addr or owner == "":
+                continue
+            with span(SPAN_CLUSTER_REJOIN, partition=part, owner=owner):
+                try:
+                    out[part] = self._repair_from(part, owner)
+                except (ConnectionError, OSError, ClusterError) as e:
+                    log.warning("REJOIN repair of partition %d from %s "
+                                "failed: %s", part, owner, e)
+        return out
+
+    def _repair_from(self, part: int, owner: str) -> dict:
+        """Truncate-and-catch-up against the current leader: stream its
+        journaled log (bounded OP_SYNC chunks), find the first offset
+        where our frames differ byte-for-byte (or where our log runs past
+        the leader's end), truncate there, then append the leader's
+        frames with their pub-ids."""
+        from ..cluster.gossip import ClusterLink
+        link = ClusterLink(owner, timeout_s=5.0)
+        bus = self._parts[part]
+        jrnl = self._journals[part]
+        with self._publish_locks[part]:
+            my_end = bus.end_offset
+            # walk the leader's log against a streaming local cursor (both
+            # are offset-ordered and contiguous, so one pass holds one
+            # bounded sync chunk + one local frame — never the whole log);
+            # divergence = first byte mismatch
+            mine = bus.frames_from(0)
+            div = None
+            off = 0
+            leader_end, entries = link.sync(part, 0)
+            while True:
+                for loff, _pid, lframe in entries:
+                    if loff >= my_end:
+                        break
+                    _moff, mframe = next(mine, (None, None))
+                    if mframe != lframe:    # mismatch (or torn local tail)
+                        div = loff
+                        break
+                off = entries[-1][0] + 1 if entries else leader_end
+                if div is not None or not entries \
+                        or off >= min(my_end, leader_end):
+                    break
+                leader_end, entries = link.sync(part, off)
+            if div is None and my_end > leader_end:
+                div = leader_end        # our extra tail: the leader never
+                # saw it, so it is the diverged unreplicated remainder
+            truncated = 0
+            if div is not None and div < my_end:
+                truncated = bus.truncate(div)
+                jrnl.truncate_from(div)
+                recent = self._recent_ids[part]
+                for pid, r_off in list(recent.items()):
+                    if r_off >= div:
+                        del recent[pid]
+                registry.counter(FILODB_CLUSTER_REJOIN_TRUNCATED,
+                                 {"partition": str(part)}).increment(
+                    float(truncated))
+                log.warning("REJOIN: truncated %d divergent frames of "
+                            "partition %d at offset %d", truncated, part,
+                            div)
+            # catch up [our end, leader end)
+            appended = 0
+            while bus.end_offset < leader_end:
+                leader_end, entries = link.sync(part, bus.end_offset)
+                fresh = [(o, p, f) for o, p, f in entries
+                         if o >= bus.end_offset]
+                if not fresh:
+                    break
+                bus.publish_many_bytes([f for _o, _p, f in fresh])
+                jrnl.append_many([(o, p) for o, p, _f in fresh if p])
+                recent = self._recent_ids[part]
+                for o, p, _f in fresh:
+                    if p:
+                        _remember_id(recent, p, o, self._recent_ids_max)
+                appended += len(fresh)
+        return {"truncated": truncated, "appended": appended}
 
     def stop(self) -> None:
         """Deterministic teardown: stop the acceptor, release the listening
@@ -494,15 +683,23 @@ class BrokerBus:
     def __init__(self, addr, partition: int, publish_window: int = 64,
                  retry_backoff_ms: float = 50.0, max_retries: int = 8,
                  seed: int | None = None, track_acks: bool = False,
-                 fault_plan=None):
+                 fault_plan=None, epoch_fencing: bool = False):
         """``addr``: one ``host:port`` string, or the partition's whole
         replica address list — with >1 address the bus fails over to the
         most-caught-up survivor on connection loss. ``retry_backoff_ms`` /
         ``max_retries`` bound the jittered exponential backoff after
         RETRY sheds and reconnects (``seed`` pins the jitter for tests).
         ``track_acks=True`` records every acked publish id in
-        ``acked_ids`` — the soak audit's client-side ledger."""
+        ``acked_ids`` — the soak audit's client-side ledger.
+
+        ``epoch_fencing=True`` makes the bus honor fenced refusals from
+        epoch-enabled brokers: a refusal naming a reachable owner reroutes
+        there (closing a spurious failover), one naming a dead owner
+        triggers an OP_EPOCH_LEAD claim at the ranked survivor before the
+        replay."""
         addrs = [addr] if isinstance(addr, str) else list(addr)
+        self.epoch_fencing = bool(epoch_fencing)
+        self._addr_strs = list(addrs)
         self._addrs = []
         for a in addrs:
             host, _, port = a.rpartition(":")
@@ -662,12 +859,79 @@ class BrokerBus:
                 st, off, body = self._exchange_locked(op, offset, plen,
                                                       payload)
             if st == ST_ERR:
+                if self.epoch_fencing and body.startswith(b"fenced:"):
+                    # deposed/non-owner broker refused: follow the fence
+                    # (reroute to the named owner, or claim a new epoch at
+                    # the survivor) and replay with the SAME pub-ids
+                    with self._lock:
+                        self._handle_fenced_locked(body)
+                    continue
                 raise RuntimeError(
                     f"broker error: {body.decode(errors='replace')}")
             if st != ST_RETRY:
                 return off, body
             hint_ms = off or 100    # RETRY carries the server's ms hint
         raise BrokerRetry(hint_ms / 1000.0)
+
+    def _probe_end_ok(self, addr: tuple) -> bool:
+        """Quick liveness probe (OP_END over a transient bounded connect)
+        for fence-following: is the named epoch owner actually serving?"""
+        try:
+            with socket.create_connection(addr, timeout=0.75) as s:
+                s.sendall(_REQ.pack(OP_END, self.partition, 0, 0))
+                st, _off, rlen = _RESP.unpack(_recv_exact(s, _RESP.size))
+                if rlen:
+                    _recv_exact(s, rlen)
+            return st == ST_OK
+        except (ConnectionError, OSError):
+            return False
+
+    def _handle_fenced_locked(self, body: bytes) -> None:
+        """React to a fenced refusal: (1) the named owner answers — it IS
+        the leader, move there (a spurious failover snaps home); (2) the
+        owner is provably dead — claim a new epoch at the ranked survivor
+        so the replay lands on a fenced-in leader. An unparseable message
+        or an ALIVE owner outside our address list (configuration skew)
+        triggers a plain re-rank, never a claim — deposing a live leader
+        on a string mismatch would ping-pong leadership forever."""
+        from ..cluster.gossip import ClusterError, ClusterLink, parse_fenced
+        parsed = parse_fenced(body.decode(errors="replace"))
+        owner = parsed[2] if parsed else ""
+        owner_alive = False
+        if owner:
+            host, _, port = owner.rpartition(":")
+            try:
+                owner_alive = self._probe_end_ok((host or "127.0.0.1",
+                                                  int(port)))
+            except ValueError:
+                owner_alive = False     # malformed owner address
+        if owner_alive:
+            if owner in self._addr_strs:
+                i = self._addr_strs.index(owner)
+                if i != self._cur:
+                    self._cur = i
+                    self.failover_count += 1
+                    self._failovers.increment()
+            else:
+                # live owner we cannot dial by our configured list: the
+                # retry surfaces the fenced error instead of deposing it
+                log.warning("fenced by live owner %s not in this bus's "
+                            "address list for partition %d", owner,
+                            self.partition)
+            self._close_locked()
+            return
+        self._failover_locked()     # dead/unknown owner: rank survivors
+        if parsed is not None:
+            try:
+                ClusterLink(self._addr_strs[self._cur]).epoch_lead(
+                    self.partition)
+            except (ConnectionError, OSError, ClusterError):
+                # claim did not land (survivor flapping): the replay's next
+                # fenced/transport error re-drives this handler
+                log.warning("epoch claim at %s for partition %d failed",
+                            self._addr_strs[self._cur], self.partition,
+                            exc_info=True)
+        self._close_locked()
 
     @staticmethod
     def _pub_id() -> int:
@@ -794,7 +1058,7 @@ class BrokerBus:
         # the trace block is identical across replays (same publish span):
         # a failed-over broker's spans join the original trace
         thdr = pack_trace_hdr(tracer.current_context())
-        t_fail = r_shed = 0
+        t_fail = r_shed = fenced_n = 0
         while True:
             try:
                 s = self._conn_locked()
@@ -822,6 +1086,17 @@ class BrokerBus:
                         group_offs.extend(
                             struct.unpack(f"<{len(ch)}Q", body))
                 if err is not None:
+                    if self.epoch_fencing and err.startswith(b"fenced:"):
+                        # the whole group replays at the fenced-in leader
+                        # with the SAME pub-ids: chunks the new leader
+                        # already replicated resolve by id, nothing dups
+                        fenced_n += 1
+                        if fenced_n > self.max_retries:
+                            raise RuntimeError(
+                                "broker error: "
+                                f"{err.decode(errors='replace')}")
+                        self._handle_fenced_locked(err)
+                        continue
                     raise RuntimeError(
                         f"broker error: {err.decode(errors='replace')}")
                 if retry_hint:

@@ -119,6 +119,21 @@ class FileBus:
                     self._positions.append(pos)
                     pos += _FRAME.size + ln
 
+    def truncate(self, end_offset: int) -> int:
+        """Drop every frame at ``end_offset`` and beyond (the REJOIN
+        divergent-tail repair: a restarted deposed leader truncates frames
+        the current leader never saw before catching up). Returns the
+        number of frames dropped."""
+        with self._publish_lock:
+            if end_offset >= len(self._positions):
+                return 0
+            dropped = len(self._positions) - end_offset
+            pos = self._positions[end_offset]
+            with open(self.path, "r+b") as f:
+                f.truncate(pos)
+            del self._positions[end_offset:]
+        return dropped
+
     @property
     def end_offset(self) -> int:
         return len(self._positions)

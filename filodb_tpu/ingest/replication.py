@@ -62,7 +62,33 @@ OP_REPLICATE = 16
 # one replicated frame: offset, publish id, payload crc32, payload length
 _RENTRY = struct.Struct("<QQII")
 
+# leadership-epoch block riding every OP_REPLICATE payload (after the trace
+# block, before the frames): ``u64 epoch + u16 owner_len + owner bytes``.
+# Epoch 0 = unfenced legacy mode. A follower holding a HIGHER epoch refuses
+# the batch with the shared fenced-refusal message (cluster/gossip.py
+# fence_message), which the leader parses to step down — the epoch fence
+# that closes the spurious-failover split-brain window.
+_EPOCH_HDR = struct.Struct("<QH")
+
 _MAX_CATCHUP_BYTES = 4 << 20    # per-OP_REPLICATE payload bound
+
+
+def pack_epoch_hdr(epoch: int, owner: str) -> bytes:
+    raw = owner.encode()
+    return _EPOCH_HDR.pack(int(epoch), len(raw)) + raw
+
+
+def unpack_epoch_hdr(payload: bytes) -> tuple[int, str, bytes]:
+    """(epoch, owner, rest-of-payload). A malformed block degrades to
+    epoch 0 — an unfenced peer's stream still replicates."""
+    try:
+        epoch, ln = _EPOCH_HDR.unpack_from(payload, 0)
+        body = payload[_EPOCH_HDR.size:]
+        if ln > len(body):
+            return 0, "", payload
+        return int(epoch), body[:ln].decode(errors="replace"), body[ln:]
+    except (struct.error, ValueError):
+        return 0, "", payload
 
 
 class ReplicationError(RuntimeError):
@@ -133,6 +159,22 @@ class PubIdJournal:
     def get(self, off: int) -> int:
         return self._ids.get(off, 0)
 
+    def truncate_from(self, off: int) -> int:
+        """Drop records at offsets >= ``off`` and rewrite the file (the
+        journal twin of FileBus.truncate for REJOIN repair; caller holds
+        the partition's publish lock). Returns records dropped."""
+        doomed = [o for o in self._ids if o >= off]
+        for o in doomed:
+            del self._ids[o]
+        if doomed:
+            blob = b"".join(self.REC.pack(o, pid)
+                            for o, pid in self._ids.items())
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.path)
+        return len(doomed)
+
     def items(self) -> list[tuple[int, int]]:
         """(offset, pub_id) pairs in offset order — the audit surface."""
         return sorted(self._ids.items())
@@ -171,6 +213,26 @@ def serve_replication(server, op: int, part: int, payload: bytes) -> bytes:
 
 
 def _serve_replication_traced(server, part: int, payload: bytes) -> bytes:
+    epoch, owner, payload = unpack_epoch_hdr(payload)
+    epochs = getattr(server, "epochs", None)
+    if epochs is not None:
+        known, kowner = epochs.get(part)
+        # LEXICOGRAPHIC (epoch, owner) ordering, matching
+        # PartitionEpochs.adopt: an epoch TIE between two concurrent
+        # claimants resolves to the higher owner address — the lower one
+        # is refused here exactly like a stale epoch, so it steps down
+        if known and (epoch, owner) < (known, kowner):
+            # the sender is a deposed leader: refuse the batch with the
+            # shared fenced message so it steps down instead of skipping
+            from ..cluster.gossip import fence_message
+            from ..utils.metrics import (FILODB_CLUSTER_FENCED_REJECTS,
+                                         registry)
+            registry.counter(FILODB_CLUSTER_FENCED_REJECTS,
+                             {"site": "replicate"}).increment()
+            msg = fence_message(part, known, kowner)
+            return _RESP.pack(ST_ERR, 0, len(msg)) + msg.encode()
+        if (epoch, owner) > (known, kowner):
+            epochs.adopt(part, epoch, owner)
     bus = server._parts[part]
     with server._publish_locks[part]:
         end = bus.end_offset
@@ -241,17 +303,19 @@ class FollowerLink:
             finally:
                 self._sock = None
 
-    def replicate(self, entries) -> int:
-        """Stream [(offset, pub_id, frame)] to the follower; returns (and
-        caches) its watermark. Raises ConnectionError/ReplicationError on
-        transport faults / rejection."""
+    def replicate(self, entries, epoch: int = 0, owner: str = "") -> int:
+        """Stream [(offset, pub_id, frame)] to the follower under the
+        leader's ``epoch``; returns (and caches) its watermark. Raises
+        ConnectionError/ReplicationError on transport faults / rejection
+        (a fenced rejection carries the follower's higher epoch)."""
         with span(SPAN_REPLICATE, partition=self.partition, peer=self.addr,
                   frames=len(entries)):
-            return self._replicate_traced(entries)
+            return self._replicate_traced(entries, epoch, owner)
 
-    def _replicate_traced(self, entries) -> int:
+    def _replicate_traced(self, entries, epoch: int = 0,
+                          owner: str = "") -> int:
         payload = pack_trace_hdr(tracer.current_context()) \
-            + pack_entries(entries)
+            + pack_epoch_hdr(epoch, owner) + pack_entries(entries)
         base = entries[0][0] if entries else 0
         try:
             s = self._conn()
@@ -339,6 +403,11 @@ class Replicator:
         carries the just-appended (offset, pub_id, frame) entries so the
         steady state skips the log re-read."""
         insync = 1                          # self
+        # our leadership epoch rides every batch; followers holding a higher
+        # epoch refuse it and we step down (adopt + report not-acked)
+        epoch, owner = (self.server.epochs.get(part)
+                        if getattr(self.server, "epochs", None) is not None
+                        else (0, ""))
         for idx in self.follower_indexes(part):
             link = self._link(part, idx)
             key = (part, idx)
@@ -361,7 +430,7 @@ class Replicator:
             try:
                 wm = link.watermark
                 if wm is None:
-                    wm = link.replicate([])             # probe
+                    wm = link.replicate([], epoch, owner)   # probe
                 while wm < target:
                     if fresh and fresh[0][0] == wm and \
                             sum(len(f) for _o, _p, f in fresh) \
@@ -375,7 +444,7 @@ class Replicator:
                     if not batch:
                         raise ReplicationError(
                             f"no frames to replicate at watermark {wm}")
-                    new_wm = link.replicate(batch)
+                    new_wm = link.replicate(batch, epoch, owner)
                     if new_wm <= wm:
                         raise ReplicationError(
                             f"follower {link.addr} made no progress "
@@ -387,12 +456,31 @@ class Replicator:
             except (ConnectionError, OSError, ReplicationError) as e:
                 link.fails += 1
                 link.reset()
+                if isinstance(e, ReplicationError):
+                    self._maybe_step_down(part, str(e))
                 log.warning("replication to %s for partition %d failed "
                             "(%d consecutive): %s", self.peers[idx], part,
                             link.fails, e)
             self._lag_gauge(part, link).update(
                 float(target - (link.watermark or 0)))
         return insync >= self.min_insync, 100
+
+    def _maybe_step_down(self, part: int, msg: str) -> None:
+        """A follower refused a batch with a fenced message: adopt the
+        higher epoch so this node's publish path refuses further acks —
+        the deposed leader steps down the moment it learns of its
+        deposition."""
+        epochs = getattr(self.server, "epochs", None)
+        if epochs is None:
+            return
+        from ..cluster.gossip import parse_fenced
+        parsed = parse_fenced(msg)
+        if parsed is None:
+            return
+        fpart, fepoch, fowner = parsed
+        if fpart == part and epochs.adopt(part, fepoch, fowner):
+            log.warning("partition %d: stepped down — follower fenced us at "
+                        "epoch %d (owner %s)", part, fepoch, fowner)
 
     def _lag_gauge(self, part: int, link: FollowerLink):
         return registry.gauge(FILODB_INGEST_REPLICATION_LAG,

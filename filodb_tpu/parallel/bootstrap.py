@@ -80,18 +80,20 @@ class FileRegistrarDiscovery(SeedDiscovery):
         return os.path.join(self.path, f"{safe}.member")
 
     def register(self, addr: str, claims: dict | None = None,
-                 http: str | None = None) -> None:
+                 http: str | None = None, gossip: str | None = None) -> None:
         """Heartbeat, optionally carrying the node's shard ownership claims
-        ({dataset: [shard ids]}) and its HTTP endpoint ("host:port").
-        Claims let a (re)joining node adopt the incumbent assignment instead
-        of computing a fresh one; the endpoint lets peers dispatch query
-        subtrees to this node (query/wire.py) when the member address isn't
-        itself the HTTP address."""
+        ({dataset: [shard ids]}), its HTTP endpoint ("host:port"), and its
+        membership-gossip endpoint. Claims let a (re)joining node adopt the
+        incumbent assignment instead of computing a fresh one; the HTTP
+        endpoint lets peers dispatch query subtrees to this node
+        (query/wire.py); the gossip endpoint is how peers' GossipAgents
+        find each other (cluster/membership.py)."""
         tmp = self._member_file(addr) + ".tmp"
         with self._lock:
             with open(tmp, "w") as f:
                 f.write(json.dumps({"addr": addr, "ts": time.time(),
-                                    "claims": claims or {}, "http": http}))
+                                    "claims": claims or {}, "http": http,
+                                    "gossip": gossip}))
             os.replace(tmp, self._member_file(addr))
 
     heartbeat = register     # a re-registration refreshes the timestamp
@@ -120,6 +122,11 @@ class FileRegistrarDiscovery(SeedDiscovery):
         """Live members' published HTTP endpoints: addr -> "host:port"."""
         return {m["addr"]: m["http"] for m in self._live_entries()
                 if m.get("http")}
+
+    def gossips(self) -> dict[str, str]:
+        """Live members' published gossip endpoints: addr -> "host:port"."""
+        return {m["addr"]: m["gossip"] for m in self._live_entries()
+                if m.get("gossip")}
 
 
 class DnsSrvSeedDiscovery(SeedDiscovery):
@@ -284,12 +291,14 @@ class ConsulSeedDiscovery(SeedDiscovery):
         return json.loads(raw) if raw else None
 
     def register(self, addr: str, claims: dict | None = None,
-                 http: str | None = None) -> None:
+                 http: str | None = None, gossip: str | None = None) -> None:
         host, port_s = addr.rsplit(":", 1)
         meta = {"filodb_ts": str(time.time()),
                 "filodb_claims": json.dumps(claims or {})}
         if http:
             meta["filodb_http"] = http
+        if gossip:
+            meta["filodb_gossip"] = gossip
         self._http("PUT", "/v1/agent/service/register", {
             "Name": self.service, "ID": f"{self.service}-{addr}",
             "Address": host, "Port": int(port_s), "Meta": meta})
@@ -341,6 +350,16 @@ class ConsulSeedDiscovery(SeedDiscovery):
             port = r.get("ServicePort")
             if host and port and meta.get("filodb_http"):
                 out[f"{host}:{port}"] = meta["filodb_http"]
+        return out
+
+    def gossips(self) -> dict[str, str]:
+        """Live members' published gossip endpoints (FileRegistrar twin)."""
+        out = {}
+        for r, meta in self._live_rows():
+            host = r.get("ServiceAddress") or r.get("Address")
+            port = r.get("ServicePort")
+            if host and port and meta.get("filodb_gossip"):
+                out[f"{host}:{port}"] = meta["filodb_gossip"]
         return out
 
 
@@ -431,10 +450,17 @@ class MembershipMonitor(threading.Thread):
         # this node's HTTP endpoint ("host:port"), published with heartbeats
         # so peers can dispatch query subtrees here (query/wire.py)
         self.http_addr: str | None = None
+        # this node's membership-gossip endpoint, published the same way so
+        # peers' GossipAgents can probe it (cluster/membership.py)
+        self.gossip_addr: str | None = None
         # fired when OUR OWN heartbeat gap exceeded stale_s — peers have
         # declared us dead and reassigned our shards, so we must fail-stop
         # (the Akka quarantine analog: a removed-but-alive node restarts)
         self.on_self_stale = on_self_stale
+        # optional per-poll claims reconciliation: fired with (peer, claims)
+        # for every live peer's published shard ownership, so a rebalance
+        # cutover on two nodes propagates to every other node's map
+        self.on_claims = None
         self.interval_s = interval_s
         self._stop_ev = threading.Event()
         self._known: set[str] = set()
@@ -459,9 +485,21 @@ class MembershipMonitor(threading.Thread):
             for fresh in sorted(live - self._known):
                 self.on_up(fresh)
         self._known = live
+        if self.on_claims is not None and hasattr(self.registrar, "claims"):
+            for peer, peer_claims in sorted(self.registrar.claims().items()):
+                if peer != self.self_addr:
+                    self.on_claims(peer, peer_claims)
 
     def _beat(self) -> None:
         claims = self.claims_fn() if self.claims_fn is not None else None
+        if self.gossip_addr is not None:
+            try:
+                self.registrar.heartbeat(self.self_addr, claims,
+                                         http=self.http_addr,
+                                         gossip=self.gossip_addr)
+                return
+            except TypeError:
+                pass     # registrar predating gossip publication
         try:
             self.registrar.heartbeat(self.self_addr, claims,
                                      http=self.http_addr)

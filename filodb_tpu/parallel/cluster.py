@@ -152,6 +152,16 @@ class ShardManager:
         for fn in self._subscribers:
             fn(ev)
 
+    def reassign(self, dataset: str, shard: int, node: str) -> None:
+        """Directly move ONE shard's ownership (live rebalance cutover /
+        peer-claims reconciliation — vs. remove_node's bulk failure path).
+        Fires AssignmentStarted for the new owner, so the owning server's
+        resync starts the shard."""
+        if node not in self.nodes:
+            self.nodes.append(node)
+        self.map[dataset][shard] = (node, ShardStatus.ASSIGNED)
+        self._emit(ShardEvent("AssignmentStarted", dataset, shard, node))
+
     def node_of(self, dataset: str, shard: int) -> str | None:
         return self.map[dataset][shard][0]
 
@@ -185,13 +195,36 @@ class FailureTimeRange:
 class FailureProvider:
     def __init__(self):
         self._failures: list[FailureTimeRange] = []
+        # keyed OPEN windows (node dead, shard warming): end unknown until
+        # recovery closes them — queries treat an open window as extending
+        # through their whole range
+        self._open: dict[str, int] = {}
 
     def record(self, f: FailureTimeRange) -> None:
         self._failures.append(f)
 
+    def open_window(self, key: str, start_ms: int) -> None:
+        """Start a keyed known-bad window (membership on_down / shard
+        takeover): local data from ``start_ms`` on is suspect until
+        ``close_window`` seals it."""
+        self._open.setdefault(key, int(start_ms))
+
+    def close_window(self, key: str, end_ms: int) -> None:
+        """Seal a keyed window (node recovered / shard warmed): the closed
+        range stays routable-around; later data is trusted again."""
+        start = self._open.pop(key, None)
+        if start is not None and end_ms >= start:
+            self._failures.append(FailureTimeRange(start, int(end_ms)))
+
+    def open_windows(self) -> dict[str, int]:
+        return dict(self._open)
+
     def failures_in(self, start_ms: int, end_ms: int) -> list[FailureTimeRange]:
-        return [f for f in self._failures
-                if f.end_ms >= start_ms and f.start_ms <= end_ms]
+        out = [f for f in self._failures
+               if f.end_ms >= start_ms and f.start_ms <= end_ms]
+        out += [FailureTimeRange(s, 1 << 62)
+                for s in self._open.values() if s <= end_ms]
+        return out
 
 
 @dataclass
@@ -300,7 +333,12 @@ def stitch_matrices(parts) -> "ResultMatrix":
 
 class HighAvailabilityEngine:
     """Query engine wrapper: routes failure time ranges to a buddy cluster and
-    stitches results (the reference's dual-cluster HA query path)."""
+    stitches results (the reference's dual-cluster HA query path).
+
+    Drop-in for a QueryEngine: every attribute/method other than
+    ``query_range`` (metadata, instant queries, memstore, caches) passes
+    through to the wrapped engine, so the HTTP layer, rules evaluator and
+    stats scrapers serve through it unchanged."""
 
     def __init__(self, engine, failure_provider: FailureProvider,
                  remote: RemotePromExec | None):
@@ -308,12 +346,19 @@ class HighAvailabilityEngine:
         self.failures = failure_provider
         self.remote = remote
 
-    def query_range(self, promql: str, start_ms: int, end_ms: int, step_ms: int):
+    def __getattr__(self, name):
+        # only missing attrs land here: the wrapper is transparent for
+        # everything it does not explicitly override
+        return getattr(self.engine, name)
+
+    def query_range(self, promql: str, start_ms: int, end_ms: int,
+                    step_ms: int, **kw):
         from ..query.rangevector import QueryResult
         fails = self.failures.failures_in(start_ms, end_ms)
         splits = plan_time_splits(start_ms, end_ms, step_ms, fails)
         if len(splits) == 1 and not splits[0].remote:
-            return self.engine.query_range(promql, start_ms, end_ms, step_ms)
+            return self.engine.query_range(promql, start_ms, end_ms, step_ms,
+                                           **kw)
         parts = []
         for sp in splits:
             if sp.remote:
@@ -322,6 +367,9 @@ class HighAvailabilityEngine:
                 parts.append(self.remote.query_range(promql, sp.start_ms,
                                                      sp.end_ms, step_ms))
             else:
-                r = self.engine.query_range(promql, sp.start_ms, sp.end_ms, step_ms)
+                r = self.engine.query_range(promql, sp.start_ms, sp.end_ms,
+                                            step_ms, **kw)
                 parts.append(r.matrix.to_host())
-        return QueryResult(stitch_matrices(parts))
+        res = QueryResult(stitch_matrices(parts))
+        res.exec_path = "ha-stitched"
+        return res

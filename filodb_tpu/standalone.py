@@ -153,7 +153,13 @@ class IngestionConsumer(threading.Thread):
                         sh.recover(self.bus, self.schemas,
                                    on_chunks_loaded=lambda: self._seed_downsampler(sh),
                                    accept=self.accept)
-                        self._offset = int(self.bus.end_offset)
+                        # resume at the offset replay actually reached —
+                        # reading end_offset here instead would skip frames
+                        # published between the replay's end snapshot and
+                        # this line (a real gap on a shard adopted under
+                        # live publish load)
+                        self._offset = int(getattr(sh, "recovered_through",
+                                                   self.bus.end_offset))
                     break
                 except (ConnectionError, OSError):
                     backoff = min(max(1.0, backoff * 2), 30.0)
@@ -251,6 +257,11 @@ class FiloServer:
         self._rules_buses: dict[int, object] = {}
         self.profiler = None
         self.membership = None
+        self.gossip = None              # cluster/membership.py GossipAgent
+        self.failures = None            # buddy-routing FailureProvider
+        self._fence = None              # cluster/epoch.py StoreFence
+        self.last_failover: dict = {}   # operator surface: the most recent
+        # node-down / takeover / rebalance event on this node
         self._registrar = None
         self._running: set[int] = set()
         self._buses: dict[int, object] = {}
@@ -315,6 +326,12 @@ class FiloServer:
         except ValueError:
             # a retried start after a partial failure: the store exists
             shard = self.memstore.shard(dataset, shard_num)
+        if self._fence is not None:
+            # epoch-fence the store ring BEFORE the consumer starts: our
+            # claim supersedes any deposed owner's, and its straggler
+            # flushes now raise FencedWriteError instead of corrupting the
+            # shard we are warming
+            self._fence.claim(shard_num)
         if self._ds_publish is not None and not shard.schema.is_histogram:
             from .core.downsample import InlineDownsampler
             shard.downsample = (self._ds_res[0],
@@ -336,7 +353,8 @@ class FiloServer:
                                                        64),
                                 retry_backoff_ms=parse_duration_ms(
                                     cfg["ingest.retry_backoff"]),
-                                max_retries=cfg["ingest.publish_retries"])
+                                max_retries=cfg["ingest.publish_retries"],
+                                epoch_fencing=cfg["ingest.epoch_fencing"])
                 if parts < len(self.manager.map[dataset]):
                     accept = self._shard_accept(shard_num)
             else:
@@ -386,7 +404,8 @@ class FiloServer:
                                  publish_window=cfg["ingest.publish_window"],
                                  retry_backoff_ms=parse_duration_ms(
                                      cfg["ingest.retry_backoff"]),
-                                 max_retries=cfg["ingest.publish_retries"])
+                                 max_retries=cfg["ingest.publish_retries"],
+                                 epoch_fencing=cfg["ingest.epoch_fencing"])
                     for s in range(num_shards)}
         if cfg.get("bus_dir"):
             return {s: FileBus(f"{cfg['bus_dir']}/shard{s}.log")
@@ -454,6 +473,11 @@ class FiloServer:
             except OSError:
                 log.warning("bus close failed during quarantine",
                             exc_info=True)
+        if self._fence is not None:
+            # drop our store-ring claims: any straggler flush thread now
+            # fences locally without even a durable read
+            for s in stopped:
+                self._fence.release(s)
         for ds in list(self.engines):
             if ds not in self.manager.map:
                 continue       # downsample-family serving view, not a dataset
@@ -472,6 +496,204 @@ class FiloServer:
                 # publish the takeover immediately: a node joining right now
                 # must see the updated ownership claims
                 self.membership.publish_now()
+
+    # -- elastic cluster (membership, fencing, rebalance — cluster/) ---------
+
+    def _peer_down(self, node: str) -> None:
+        """A peer was declared dead (registrar staleness or gossip counted
+        suspicion): reassign its shards and open a known-bad window so
+        buddy routing covers the takeover gap."""
+        if node not in self.manager.nodes:
+            return                      # both detectors fired: already done
+        self.manager.remove_node(node)
+        if self.failures is not None:
+            self.failures.open_window(f"node-{node}",
+                                      int(time.time() * 1000))
+        self.last_failover = {"event": "node-down", "node": node,
+                              "at": time.time()}
+
+    def _peer_up(self, node: str) -> None:
+        self.manager.add_node(node)
+        if self.failures is not None:
+            self.failures.close_window(f"node-{node}",
+                                       int(time.time() * 1000))
+
+    def _ha_track(self, ev) -> None:
+        """Failure-window bookkeeping for buddy routing: a shard this node
+        is warming (takeover/rebalance) is known-bad until its consumer
+        reaches ACTIVE, and a dead NODE's window seals once none of its
+        shards remain orphaned — a permanently dead node must not steer
+        every later query to the buddy forever."""
+        if self.failures is None:
+            return
+        now_ms = int(time.time() * 1000)
+        if ev.kind == "AssignmentStarted" and ev.node == self.node:
+            self.failures.open_window(f"shard-{ev.dataset}-{ev.shard}",
+                                      now_ms)
+        elif ev.kind == "IngestionStarted" and ev.node == self.node:
+            self.failures.close_window(f"shard-{ev.dataset}-{ev.shard}",
+                                       now_ms)
+            self._maybe_close_node_windows(now_ms)
+
+    def _maybe_close_node_windows(self, now_ms: int) -> None:
+        """Seal every open node-down window once no shard is orphaned
+        (DOWN/UNASSIGNED) and this node has no shard still warming: from
+        here the cluster serves complete data again, and the closed range
+        keeps routing around the actual outage. Claims reconciliation
+        calls this too, so non-adopting nodes converge as peers' takeovers
+        publish."""
+        if self.failures is None:
+            return
+        for shards in self.manager.map.values():
+            for _s, (_n, st) in shards.items():
+                if st in (ShardStatus.DOWN, ShardStatus.UNASSIGNED):
+                    return
+        for key in list(self.failures.open_windows()):
+            if key.startswith("node-"):
+                self.failures.close_window(key, now_ms)
+
+    def _adopt_claims(self, peer: str, claims: dict) -> None:
+        """Reconcile a peer's published shard claims into our map: after a
+        rebalance cutover (or takeover we did not witness), every node
+        converges on the new ownership without a restart. Shards we run
+        live are never ceded here — losing one goes through quarantine."""
+        for ds, shards in (claims or {}).items():
+            if ds not in self.manager.map:
+                continue
+            for s in shards:
+                s = int(s)
+                if not 0 <= s < len(self.manager.map[ds]):
+                    continue
+                cur = self.manager.node_of(ds, s)
+                if cur == peer:
+                    continue
+                with self._shards_lock:
+                    mine = s in self._running
+                if cur == self.node and mine:
+                    continue
+                self.manager.reassign(ds, s, peer)
+        if self.failures is not None:
+            # peers' published takeovers count toward sealing node-down
+            # windows on nodes that adopted nothing themselves
+            self._maybe_close_node_windows(int(time.time() * 1000))
+
+    def _cluster_extra(self) -> dict:
+        """The elasticity surface of GET /api/v1/cluster/status: gossip
+        membership table, per-scope epochs, open known-bad windows, and
+        the last failover/rebalance event on this node."""
+        out: dict = {"node": self.node}
+        if self.gossip is not None:
+            out["membership"] = self.gossip.table.rows()
+        if self._fence is not None:
+            out["epochs"] = {"shards": {str(s): e for s, e
+                                        in self._fence.owned().items()}}
+        if self.failures is not None:
+            out["known_bad_windows"] = self.failures.open_windows()
+        if self.last_failover:
+            out["last_failover"] = self.last_failover
+        return out
+
+    def rebalance_shard(self, dataset: str, shard: int, to_node: str) -> dict:
+        """Operator-triggered live shard move (flush→handoff→catch-up→
+        cutover). This node must own the shard; ``to_node`` warms it from
+        the durable ring + broker replay and takes over ingest. The move
+        is epoch-fenced: the adopter's store-ring claim supersedes ours
+        before its consumer starts, so exactly one owner ever ingests."""
+        import urllib.request
+
+        from .utils.metrics import FILODB_CLUSTER_REBALANCES
+        from .utils.tracing import SPAN_CLUSTER_REBALANCE
+        shard = int(shard)
+        if dataset not in self.manager.map \
+                or not 0 <= shard < len(self.manager.map[dataset]):
+            raise QueryError(f"unknown dataset/shard {dataset}/{shard}")
+        owner = self.manager.node_of(dataset, shard)
+        if owner != self.node:
+            raise QueryError(
+                f"shard {shard} is owned by {owner}, not this node — POST "
+                "the rebalance to the owner")
+        if to_node == self.node:
+            raise QueryError("rebalance target is the current owner")
+        ep = self._resolve_endpoint(to_node)
+        if ep is None:
+            raise QueryError(f"no HTTP endpoint known for node {to_node}")
+        with span(SPAN_CLUSTER_REBALANCE, dataset=dataset, shard=shard,
+                  to=to_node):
+            # 1. pause ingest for the shard: stop its consumer (publishes
+            # keep buffering in the broker; the adopter replays the tail)
+            with self._shards_lock:
+                moving = [c for c in self.consumers
+                          if c.dataset == dataset
+                          and c.shard.shard_num == shard]
+                bus = self._buses.pop(shard, None)
+                for c in moving:
+                    self.consumers.remove(c)
+                self._running.discard(shard)
+            for c in moving:
+                c.stop()
+            if bus is not None:
+                bus.close()             # unblocks a consumer mid-recv
+            for c in moving:
+                c.join(timeout=5)
+            # 2. final flush: everything consumed becomes durable and
+            # checkpointed — the adopter's recovery resumes exactly there
+            sh = self.memstore.shard(dataset, shard)
+            sh.flush()
+            if sh.sink is not None:
+                sh.flush_all_groups()
+            # 3. release our fence claim; the adopter's claim supersedes
+            if self._fence is not None:
+                self._fence.release(shard)
+            # 4. cutover: the adopter claims the epoch, warms from the
+            # ring, replays the bus tail, and starts consuming
+            try:
+                req = urllib.request.Request(
+                    f"http://{ep}/api/v1/cluster/adopt?dataset={dataset}"
+                    f"&shard={shard}", method="POST", data=b"")
+                with urllib.request.urlopen(req, timeout=60.0) as r:
+                    import json as _json
+                    adopted = _json.load(r)
+            except (OSError, ValueError) as e:
+                # aborted handoff: restart the shard locally (re-claims the
+                # fence) so the cluster never has zero owners
+                log.warning("rebalance adopt on %s failed; restarting "
+                            "shard %s locally: %s", to_node, shard, e)
+                self._start_shard(dataset, shard)
+                raise QueryError(
+                    f"rebalance aborted ({e}); shard restarted locally") \
+                    from None
+            # 5. flip our map and publish the new ownership
+            self.manager.reassign(dataset, shard, to_node)
+            if self.membership is not None:
+                self.membership.publish_now()
+            registry.counter(FILODB_CLUSTER_REBALANCES,
+                             {"dataset": dataset}).increment()
+            self.last_failover = {"event": "rebalance", "dataset": dataset,
+                                  "shard": shard, "from": self.node,
+                                  "to": to_node, "at": time.time()}
+        return {"dataset": dataset, "shard": shard, "from": self.node,
+                "to": to_node, "adopted": adopted.get("data")}
+
+    def adopt_shard(self, dataset: str, shard: int) -> dict:
+        """Receiving side of a live rebalance: claim the shard (epoch bump
+        via _start_shard's fence claim), warm it from the durable ring,
+        replay the broker tail, and start consuming. Idempotent."""
+        shard = int(shard)
+        if dataset not in self.manager.map \
+                or not 0 <= shard < len(self.manager.map[dataset]):
+            raise QueryError(f"unknown dataset/shard {dataset}/{shard}")
+        with self._shards_lock:
+            running = shard in self._running
+        if running and self.manager.node_of(dataset, shard) == self.node:
+            return {"dataset": dataset, "shard": shard, "node": self.node,
+                    "already_owned": True}
+        # reassign fires AssignmentStarted -> _on_shard_event starts the
+        # consumer (fence claim + ring recovery + bus replay happen there)
+        self.manager.reassign(dataset, shard, self.node)
+        self.last_failover = {"event": "adopt", "dataset": dataset,
+                              "shard": shard, "node": self.node,
+                              "at": time.time()}
+        return {"dataset": dataset, "shard": shard, "node": self.node}
 
     def start(self) -> "FiloServer":
         cfg = self.config
@@ -542,6 +764,14 @@ class FiloServer:
                 replication=cfg.get("store_replication") or 2)
         else:
             self._sink = FileColumnStore(cfg["data_dir"]) if cfg.get("data_dir") else None
+        if cfg.get("cluster.shard_fencing") and self._sink is not None:
+            # epoch-fence store-ring writers: each owned shard's leadership
+            # epoch persists in the durable ring; a deposed owner's flush or
+            # checkpoint raises FencedWriteError (cluster/epoch.py)
+            from .cluster.epoch import StoreFence
+            self._fence = StoreFence(self._sink, self.node)
+            if hasattr(self._sink, "write_guard"):
+                self._sink.write_guard = self._fence
         self._store_cfg = cfg.store_config()
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
@@ -600,6 +830,20 @@ class FiloServer:
                 lambda res_ms, _ds=dataset: self.engines.get(
                     _fam_of(_ds, res_ms)),
                 dataset=dataset)
+        if cfg.get("cluster.buddy_endpoint"):
+            # failure-aware query routing: time ranges overlapping a
+            # known-bad window (node dead, shard warming) steer sub-queries
+            # to the buddy cluster over its Prometheus HTTP API and stitch
+            # with local results — the reference's FailureProvider/
+            # PromQlExec dual-datacenter, no-SPOF design
+            from .parallel.cluster import (FailureProvider,
+                                           HighAvailabilityEngine,
+                                           RemotePromExec)
+            self.failures = FailureProvider()
+            self.engines[dataset] = HighAvailabilityEngine(
+                self.engines[dataset], self.failures,
+                RemotePromExec(cfg["cluster.buddy_endpoint"], dataset))
+            self.manager.subscribe(self._ha_track)
 
         # remote-write sink: durable bus publish when configured, else direct
         # ingest. The whole batch is validated against owned shards BEFORE
@@ -624,7 +868,11 @@ class FiloServer:
         self.http = FiloHttpServer(self.engines, host=cfg["http.host"],
                                    port=cfg["http.port"], cluster=self.manager,
                                    writers={dataset: writer},
-                                   scheduler=self.scheduler).start()
+                                   scheduler=self.scheduler,
+                                   cluster_ops={
+                                       "extra": self._cluster_extra,
+                                       "rebalance": self.rebalance_shard,
+                                       "adopt": self.adopt_shard}).start()
         if cfg.get("ingest.gateway_port") is not None:
             # Influx line-protocol gateway, config-wired: lines route to ALL
             # broker partitions (owned or not — the broker is global), or
@@ -719,9 +967,12 @@ class FiloServer:
             # (ref: gossip deathwatch -> ShardManager auto-reassignment)
             from .parallel.bootstrap import MembershipMonitor
             self.membership = MembershipMonitor(
-                self._registrar, self.node, on_down=self.manager.remove_node,
-                on_up=self.manager.add_node, on_self_stale=self._quarantine,
+                self._registrar, self.node, on_down=self._peer_down,
+                on_up=self._peer_up, on_self_stale=self._quarantine,
                 interval_s=parse_duration_ms(cfg["cluster.heartbeat_interval"]) / 1000.0)
+            # steady-state ownership reconciliation: peers' published claims
+            # (rebalance cutovers, takeovers) fold into our map each poll
+            self.membership.on_claims = self._adopt_claims
             # publish current ownership with each heartbeat so late joiners
             # adopt the incumbent assignment (rejoin without split-brain)
             # only manager-known datasets claim shards: downsample-family
@@ -740,6 +991,32 @@ class FiloServer:
                 if adv in ("0.0.0.0", "::", ""):
                     adv = self.node.rsplit(":", 1)[0]
             self.membership.http_addr = f"{adv}:{self.http.port}"
+            if cfg.get("cluster.gossip_port") is not None:
+                # membership gossip: counted (not timed) failure detection
+                # over the broker wire framing, alongside the registrar
+                # heartbeats (which remain the discovery/claims substrate).
+                # The agent's bound address publishes with our heartbeat so
+                # peers' agents can probe it.
+                from .cluster.membership import GossipAgent, MembershipTable
+                table = MembershipTable(
+                    self.node,
+                    suspect_after=cfg["cluster.suspect_after"],
+                    dead_after=cfg["cluster.dead_after"],
+                    http=self.membership.http_addr,
+                    on_down=self._peer_down, on_up=self._peer_up,
+                    on_claims=self._adopt_claims)
+
+                def gossip_peers(_reg=self._registrar):
+                    return _reg.gossips() if hasattr(_reg, "gossips") else {}
+
+                self.gossip = GossipAgent(
+                    self.node, gossip_peers, table, host=cfg["http.host"],
+                    port=cfg["cluster.gossip_port"],
+                    interval_s=parse_duration_ms(
+                        cfg["cluster.gossip_interval"]) / 1000.0)
+                self.gossip.claims_fn = self.membership.claims_fn
+                self.gossip.start()
+                self.membership.gossip_addr = f"{adv}:{self.gossip.port}"
             self.membership.poll_once()
             self.membership.start()
         if self._ds_publish is not None:
@@ -984,6 +1261,8 @@ class FiloServer:
             self.scheduler.shutdown()
         if self.membership:
             self.membership.stop()
+        if self.gossip is not None:
+            self.gossip.stop()
         if self.profiler:
             self.profiler.stop()
         if self._zipkin is not None:

@@ -77,6 +77,12 @@ FILODB_RULES_ALERTS_FIRING = "filodb_rules_alerts_firing"
 FILODB_RULES_ALERT_TRANSITIONS = "filodb_rules_alert_transitions"
 FILODB_RULES_NOTIFICATIONS = "filodb_rules_notifications"
 FILODB_RULES_SPOOF_REJECTS = "filodb_rules_spoof_rejects"
+FILODB_CLUSTER_GOSSIP_ROUNDS = "filodb_cluster_gossip_rounds"
+FILODB_CLUSTER_PEER_STATE = "filodb_cluster_peer_state"
+FILODB_CLUSTER_EPOCH = "filodb_cluster_epoch"
+FILODB_CLUSTER_FENCED_REJECTS = "filodb_cluster_fenced_rejects"
+FILODB_CLUSTER_REBALANCES = "filodb_cluster_rebalances"
+FILODB_CLUSTER_REJOIN_TRUNCATED = "filodb_cluster_rejoin_truncated"
 
 METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_INGESTED_ROWS: (
@@ -247,6 +253,31 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "External writes rejected for carrying the reserved "
                    "__rule__ label (tagged site=remote-write|gateway): "
                    "derived-series provenance cannot be forged."),
+    FILODB_CLUSTER_GOSSIP_ROUNDS: (
+        "counter", "Gossip probe rounds run by this node's membership agent "
+                   "(the deterministic round counter suspicion is counted "
+                   "in — no wall clock)."),
+    FILODB_CLUSTER_PEER_STATE: (
+        "gauge", "Membership state per peer: 0=alive, 1=suspect, 2=dead "
+                 "(counted-not-timed transitions at cluster.suspect_after / "
+                 "cluster.dead_after probe rounds)."),
+    FILODB_CLUSTER_EPOCH: (
+        "gauge", "Current leadership epoch per fenced scope (scope="
+                 "partition|shard, id=): bumps on every claim/adoption — a "
+                 "step means a failover or rebalance cutover happened."),
+    FILODB_CLUSTER_FENCED_REJECTS: (
+        "counter", "Writes refused by epoch fencing (tagged site=publish|"
+                   "replicate|store): a deposed leader tried to ack a "
+                   "publish, stream a replication batch, or flush/checkpoint "
+                   "after deposition."),
+    FILODB_CLUSTER_REBALANCES: (
+        "counter", "Operator-triggered live shard rebalances completed by "
+                   "this node (flush→handoff→catch-up→cutover, tagged "
+                   "dataset=)."),
+    FILODB_CLUSTER_REJOIN_TRUNCATED: (
+        "counter", "Divergent log frames a restarted deposed leader "
+                   "truncated on REJOIN before catching up from the current "
+                   "leader (tagged partition=)."),
     "filodb_shard_*": (
         "gauge", "Per-shard ingest/eviction stats exported from the shard's "
                  "IngestStats dataclass fields on each /metrics scrape."),

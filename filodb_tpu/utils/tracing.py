@@ -73,6 +73,10 @@ SPAN_INGEST_CONSUME = "ingest.consume"
 SPAN_QUERY_RETENTION = "query.retention"
 SPAN_ODP_DURABLE = "query.odp.durable"
 SPAN_RULES_EVAL = "rules.eval"
+SPAN_CLUSTER_GOSSIP = "cluster.gossip"
+SPAN_CLUSTER_LEAD = "cluster.epoch.lead"
+SPAN_CLUSTER_REJOIN = "cluster.rejoin"
+SPAN_CLUSTER_REBALANCE = "cluster.rebalance"
 
 TRACE_SPEC: dict[str, str] = {
     SPAN_QUERY: "Root span of one PromQL query (tags: dataset, promql).",
@@ -119,6 +123,18 @@ TRACE_SPEC: dict[str, str] = {
     SPAN_RULES_EVAL: "One rule evaluation inside a scheduler tick (tags: "
                      "group, rule, eval_ts; its PromQL query and derived "
                      "publish spans hang under it).",
+    SPAN_CLUSTER_GOSSIP: "One membership gossip probe round: digest "
+                         "exchange with the scheduled peer (tags: peer, "
+                         "round).",
+    SPAN_CLUSTER_LEAD: "Leadership claim for one partition: read peer "
+                       "epochs, bump, persist, announce (tags: partition, "
+                       "epoch).",
+    SPAN_CLUSTER_REJOIN: "REJOIN repair of a restarted deposed leader: "
+                         "divergent-tail truncation + catch-up from the "
+                         "current leader (tags: partition, owner).",
+    SPAN_CLUSTER_REBALANCE: "Operator-triggered live shard move: "
+                            "flush→handoff→catch-up→cutover (tags: dataset, "
+                            "shard, to).",
 }
 
 

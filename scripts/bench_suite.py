@@ -19,6 +19,8 @@ Suites (reference file in parens):
                 (QueryHiCardInMemoryBenchmark.scala: 15m @ 10s, quarter queried)
   query_ingest  interleaved ingest + query  (QueryAndIngestBenchmark.scala)
   gateway       Influx line-protocol parse throughput  (GatewayBenchmark.scala)
+  elastic       kill-a-node soak, live rebalance under load, split-brain
+                zero-duplicate audit  (ISSUE 12; ClusterRecoverySpec analog)
 
 ``--full`` uses reference-scale sizes (1M index series etc.); default sizes are
 CI-friendly. ``--suite name`` runs one suite. The north-star query benchmark
@@ -1849,7 +1851,265 @@ def bench_rules(full: bool) -> None:
     emit("rules", "soak_wall_s", soak_s, "s")
 
 
+def bench_elastic(full: bool) -> None:
+    """Elastic cluster (ISSUE 12 acceptance): (a) kill-a-node soak —
+    ingest and queries continue with a bounded gap while the survivor
+    warms the dead node's shard from the durable ring at bit parity with
+    the pre-kill oracle; (b) live shard rebalance under publish load at
+    bit parity with the arithmetic oracle; (c) split-brain zero-duplicate
+    audit — an epoch-fenced leader killed mid-window, the failed-over
+    client claims a new epoch, and the acked-id ledger reconciles against
+    the survivor's journal with zero lost / zero duplicated."""
+    import contextlib
+    import tempfile
+    import threading
+    import urllib.request
+
+    from filodb_tpu.config import Config
+    from filodb_tpu.core.diststore import StoreServer
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.ingest.broker import BrokerBus, BrokerServer
+    from filodb_tpu.ingest.faults import FaultPlan, FaultRule
+    from filodb_tpu.standalone import FiloServer
+
+    # ---- (a)+(b): two standalone nodes over a shared ring + broker -----
+    tmp = tempfile.mkdtemp(prefix="filodb-elastic-")
+    store = StoreServer(tmp + "/ring").start()
+    broker = BrokerServer(tmp + "/broker", 2).start()
+    reg = tmp + "/members"
+
+    def node(name):
+        return FiloServer(Config({
+            "num_shards": 2, "bus_addr": f"127.0.0.1:{broker.port}",
+            "http": {"port": 0},
+            "store_nodes": [f"127.0.0.1:{store.port}"],
+            "store_replication": 1,
+            "cluster": {"registrar": reg, "self_addr": name,
+                        # stale_after must clear scheduling hiccups under
+                        # load: a survivor that misses its OWN beat past it
+                        # self-quarantines (the double-ownership guard)
+                        "heartbeat_interval": "200ms", "stale_after": "5s",
+                        "min_members": 2, "join_timeout": "20s",
+                        "shard_fencing": True},
+            "store": {"max_series_per_shard": 64, "samples_per_series": 512,
+                      "flush_batch_size": 10**9},
+        }))
+
+    servers: dict = {}
+    threads = {n: threading.Thread(
+        target=lambda n=n: servers.update({n: node(n).start()}))
+        for n in ("elastic-a:1", "elastic-b:1")}
+    for t in threads.values():
+        t.start()
+    for t in threads.values():
+        t.join(timeout=40)
+    a, b = servers["elastic-a:1"], servers["elastic-b:1"]
+    n_rows = 4000 if full else 800
+    stop_pub = threading.Event()
+    published = {"n": 0}
+    query_errors = {"n": 0, "ok": 0}
+    b_shard = a.manager.shards_of_node("prometheus", "elastic-b:1")[0]
+    try:
+        prod = BrokerBus(f"127.0.0.1:{broker.port}", b_shard,
+                         publish_window=8)
+
+        def load():
+            i = 0
+            while not stop_pub.is_set() and i < n_rows:
+                bld = RecordBuilder(GAUGE)
+                bld.add({"_metric_": "m", "host": f"h{i % 4}"},
+                        BASE + i * 1000, float(i))
+                prod.publish(bld.build())
+                published["n"] += 1
+                i += 1
+                time.sleep(0.002)
+
+        loader = threading.Thread(target=load)
+        loader.start()
+        deadline = time.time() + 60
+        while published["n"] < 50 and loader.is_alive() \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        if published["n"] < 50:
+            raise RuntimeError("elastic: publish load never ramped")
+        # pre-kill oracle on the owner (node b)
+        eng_b = b.engines["prometheus"]
+        deadline = time.time() + 20
+        oracle_n = 0
+        while time.time() < deadline:
+            r = eng_b.query_instant("count(m)", BASE + n_rows * 1000)
+            if r.matrix.num_series:
+                oracle_n = float(np.asarray(r.matrix.values)[0, -1])
+                if oracle_n == 4.0:
+                    break
+            time.sleep(0.1)
+        # KILL node b; survivor must take over its shard and keep serving
+        t_kill = time.perf_counter()
+        b.shutdown()
+        eng_a = a.engines["prometheus"]
+
+        def probe_queries():
+            while not stop_pub.is_set():
+                try:
+                    eng_a.query_instant("count(m)", BASE + n_rows * 1000)
+                    query_errors["ok"] += 1
+                except Exception:  # noqa: BLE001 — continuity accounting
+                    query_errors["n"] += 1
+                time.sleep(0.05)
+
+        prober = threading.Thread(target=probe_queries)
+        prober.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if a.manager.node_of("prometheus", b_shard) == "elastic-a:1" \
+                    and b_shard in a._running:
+                break
+            time.sleep(0.1)
+        takeover_s = time.perf_counter() - t_kill
+        loader.join(timeout=60)
+        stop_pub.set()
+        prober.join(timeout=10)
+        prod.close()
+        total = published["n"]
+        # continuity + parity: every published row served by the survivor
+        want = float(sum(range(total)))
+        got = -1.0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            r = eng_a.query_instant("sum(sum_over_time(m[2h]))",
+                                    BASE + n_rows * 1000)
+            if r.matrix.num_series:
+                got = float(np.asarray(r.matrix.values)[0, -1])
+                if got == want:
+                    break
+            time.sleep(0.2)
+        emit("elastic", "kill_node_takeover_s", takeover_s, "s")
+        emit("elastic", "kill_node_rows_published", total, "rows")
+        emit("elastic", "kill_node_rows_lost",
+             0 if got == want else abs(want - got), "rows")
+        emit("elastic", "kill_node_query_errors_during_takeover",
+             query_errors["n"], "queries")
+        emit("elastic", "kill_node_queries_served", query_errors["ok"],
+             "queries")
+        emit("elastic", "kill_node_warm_parity", float(got == want), "bool")
+
+        # ---- (b) live rebalance back to a fresh node under load --------
+        c = node("elastic-c:1")         # joins the established cluster
+        # (min_members=2 already satisfied; it adopts incumbent claims)
+        c.start()
+        servers["elastic-c:1"] = c
+        stop_pub.clear()
+        published2 = {"n": 0}
+        prod2 = BrokerBus(f"127.0.0.1:{broker.port}", b_shard,
+                          publish_window=8)
+
+        def load2():
+            i = 0
+            while not stop_pub.is_set() and i < (n_rows // 2):
+                bld = RecordBuilder(GAUGE)
+                bld.add({"_metric_": "reb", "host": f"h{i % 4}"},
+                        BASE + i * 1000, float(i))
+                prod2.publish(bld.build())
+                published2["n"] += 1
+                i += 1
+                time.sleep(0.002)
+
+        loader2 = threading.Thread(target=load2)
+        loader2.start()
+        deadline = time.time() + 60
+        while published2["n"] < 25 and loader2.is_alive() \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        if published2["n"] < 25:
+            raise RuntimeError("elastic: rebalance load never ramped")
+        t_move = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{a.http.port}/api/v1/cluster/rebalance"
+            f"?dataset=prometheus&shard={b_shard}&to=elastic-c:1",
+            method="POST", data=b"")
+        with urllib.request.urlopen(req, timeout=90.0) as r:
+            r.read()
+        move_s = time.perf_counter() - t_move
+        loader2.join(timeout=60)
+        stop_pub.set()
+        prod2.close()
+        total2 = published2["n"]
+        want2 = float(sum(range(total2)))
+        got2 = -1.0
+        eng_c = c.engines["prometheus"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            r = eng_c.query_instant("sum(sum_over_time(reb[2h]))",
+                                    BASE + n_rows * 1000)
+            if r.matrix.num_series:
+                got2 = float(np.asarray(r.matrix.values)[0, -1])
+                if got2 == want2:
+                    break
+            time.sleep(0.2)
+        emit("elastic", "rebalance_cutover_s", move_s, "s")
+        emit("elastic", "rebalance_rows_under_load", total2, "rows")
+        emit("elastic", "rebalance_parity", float(got2 == want2), "bool")
+    finally:
+        stop_pub.set()
+        for srv in servers.values():
+            with contextlib.suppress(Exception):
+                srv.shutdown()
+        broker.stop()
+        store.stop()
+
+    # ---- (c) split-brain zero-duplicate audit (epoch-fenced brokers) ---
+    import socket as _socket
+
+    def _port():
+        with _socket.socket() as s:
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    n_frames = 12000 if full else 3000
+    kill_at = n_frames // 3
+    tmp2 = tempfile.mkdtemp(prefix="filodb-splitbrain-")
+    pa, pb = _port(), _port()
+    peers = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+    plan = FaultPlan([FaultRule("append", "kill_server", partition=0,
+                                at_offset=kill_at)])
+    ba = BrokerServer(tmp2 + "/a", 1, port=pa, peers=peers, node_index=0,
+                      replication=2, fault_plan=plan,
+                      epoch_fencing=True).start()
+    bb = BrokerServer(tmp2 + "/b", 1, port=pb, peers=peers, node_index=1,
+                      replication=2, epoch_fencing=True).start()
+    bus = BrokerBus(peers, 0, publish_window=32, retry_backoff_ms=1,
+                    seed=12, track_acks=True, epoch_fencing=True)
+    t0 = time.perf_counter()
+    bld = RecordBuilder(GAUGE)
+    bld.add({"_metric_": "sb", "host": "h"}, BASE, 1.0)
+    frame = bld.build()
+    for _ in range(n_frames):
+        bus.publish_async(frame)
+    bus.flush_publishes()
+    soak_s = time.perf_counter() - t0
+    logged = [pid for _off, pid in bb._journals[0].items() if pid]
+    acked = set(bus.acked_ids)
+    end = bb._parts[0].end_offset
+    epoch, owner = bb.epochs.get(0)
+    bus.close()
+    with contextlib.suppress(Exception):
+        ba.stop()
+    bb.stop()
+    emit("elastic", "splitbrain_frames", n_frames, "frames")
+    emit("elastic", "splitbrain_leader_kills", len(plan.fired), "kills")
+    emit("elastic", "splitbrain_survivor_epoch", epoch, "epoch")
+    emit("elastic", "splitbrain_lost", len(acked - set(logged)), "frames")
+    emit("elastic", "splitbrain_duplicated",
+         len(logged) - len(set(logged)), "frames")
+    emit("elastic", "splitbrain_log_dense", float(end == len(set(logged))),
+         "bool")
+    emit("elastic", "splitbrain_rate", n_frames / soak_s, "frames/s")
+
+
 SUITES = {
+    "elastic": bench_elastic,
     "rules": bench_rules,
     "fused_resident": bench_fused_resident,
     "ingestion": bench_ingestion,

@@ -1,0 +1,42 @@
+"""Bad twin for the cluster op-space wirecheck (WIRE_SPEC op_specs,
+cluster/gossip flavor): the REJOIN sync op OP_SYNC is sent by ClusterLink
+but serve_cluster has no dispatch branch (a restarted deposed leader could
+never repair), the announce op OP_EPOCH_SET is dispatched but never sent
+(claims would stop propagating), and OP_EPOCH_LEAD collides with
+OP_EPOCH_READ's value. Analyzed with a custom WIRE_SPEC whose op_spec names
+this file (tests/test_static_analysis.py)."""
+
+OP_GOSSIP = 17
+OP_EPOCH_READ = 18
+OP_EPOCH_LEAD = 18          # collision with OP_EPOCH_READ
+OP_EPOCH_SET = 20
+OP_SYNC = 21
+
+
+def serve_cluster(host, op, part, payload):
+    if op == OP_GOSSIP:
+        return b"{}"
+    if op == OP_EPOCH_READ:
+        return b""
+    if op == OP_EPOCH_LEAD:
+        return b""
+    if op == OP_EPOCH_SET:
+        return b""
+    raise ValueError(f"unknown cluster op {op}")
+
+
+class ClusterLink:
+    def gossip(self, digest):
+        return self._request(OP_GOSSIP, b"{}")
+
+    def epoch_read(self, part):
+        return self._request(OP_EPOCH_READ, b"")
+
+    def epoch_lead(self, part):
+        return self._request(OP_EPOCH_LEAD, b"")
+
+    def sync(self, part, from_off):
+        return self._request(OP_SYNC, b"")
+
+    def _request(self, op, payload):
+        return op, payload

@@ -1,0 +1,44 @@
+"""Good twin for the cluster op-space wirecheck (WIRE_SPEC op_specs,
+cluster/gossip flavor): every op — gossip digest exchange, the epoch
+read/claim/announce triple, and the REJOIN sync — is dispatched by
+serve_cluster AND sent by ClusterLink, with distinct values."""
+
+OP_GOSSIP = 17
+OP_EPOCH_READ = 18
+OP_EPOCH_LEAD = 19
+OP_EPOCH_SET = 20
+OP_SYNC = 21
+
+
+def serve_cluster(host, op, part, payload):
+    if op == OP_GOSSIP:
+        return b"{}"
+    if op == OP_EPOCH_READ:
+        return b""
+    if op == OP_EPOCH_LEAD:
+        return b""
+    if op == OP_EPOCH_SET:
+        return b""
+    if op == OP_SYNC:
+        return b""
+    raise ValueError(f"unknown cluster op {op}")
+
+
+class ClusterLink:
+    def gossip(self, digest):
+        return self._request(OP_GOSSIP, b"{}")
+
+    def epoch_read(self, part):
+        return self._request(OP_EPOCH_READ, b"")
+
+    def epoch_lead(self, part):
+        return self._request(OP_EPOCH_LEAD, b"")
+
+    def epoch_set(self, part, epoch, owner):
+        return self._request(OP_EPOCH_SET, b"")
+
+    def sync(self, part, from_off):
+        return self._request(OP_SYNC, b"")
+
+    def _request(self, op, payload):
+        return op, payload

@@ -205,6 +205,43 @@ def test_good_store_ops_fixture_is_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+def _cluster_op_findings(module_rel: str):
+    """Op-parity run shaped like the PRODUCTION cluster spec (server
+    ``serve_cluster`` + client class ``ClusterLink``)."""
+    spec = {
+        "wire_module": "<none>",
+        "classifier_module": "<none>",
+        "error_base_modules": [],
+        "codec_pairs": [],
+        "depth_pair": ("_enc_plan", "_dec_plan"),
+        "error_root": "QueryError",
+        "op_specs": [{"module": module_rel, "prefix": "OP_",
+                      "server_fn": "serve_cluster",
+                      "client_class": "ClusterLink"}],
+    }
+    w = WireChecker(spec=spec)
+    w.check_module(module_rel, ast.parse((REPO / module_rel).read_text()))
+    return w.finalize()
+
+
+def test_bad_cluster_ops_fixture_is_flagged():
+    findings = _cluster_op_findings(
+        "tests/fixtures/filolint/bad_cluster_ops.py")
+    details = {f.detail for f in findings}
+    # REJOIN sync sent but never dispatched; announce dispatched but never
+    # sent; the claim op collides with the read op's value
+    assert "op-unserved:OP_SYNC" in details
+    assert "op-unsent:OP_EPOCH_SET" in details
+    assert any(d.startswith("op-collision:") for d in details)
+    assert all(f.rule == "wire-tag-parity" for f in findings)
+
+
+def test_good_cluster_ops_fixture_is_clean():
+    findings = _cluster_op_findings(
+        "tests/fixtures/filolint/good_cluster_ops.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 def _trace_parity_findings(module_rel: str):
     spec = {
         "wire_module": "<none>",
@@ -289,6 +326,29 @@ def test_broker_op_tags_are_exhaustive():
     w = WireChecker()
     w.check_module(rel, ast.parse((REPO / rel).read_text()))
     assert w.finalize() == []
+
+
+def test_cluster_op_tags_are_exhaustive():
+    """The production cluster op family (PR 12): every OP_* constant in
+    cluster/gossip.py — gossip, the epoch read/claim/announce triple, and
+    the REJOIN sync — is dispatched by serve_cluster AND sent by
+    ClusterLink, with distinct values (and clear of OP_REPLICATE's 16)."""
+    import ast as _ast
+    from filodb_tpu.analysis.wirecheck import WIRE_SPEC
+    rel = "filodb_tpu/cluster/gossip.py"
+    assert any(s["module"] == rel for s in WIRE_SPEC["op_specs"])
+    tree = _ast.parse((REPO / rel).read_text())
+    w = WireChecker()
+    w.check_module(rel, tree)
+    findings = w.finalize()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    from filodb_tpu.cluster.gossip import CLUSTER_OPS
+    from filodb_tpu.ingest.broker import (OP_END, OP_FETCH, OP_PUBLISH,
+                                          OP_PUBLISH_BATCH)
+    from filodb_tpu.ingest.replication import OP_REPLICATE
+    taken = {OP_PUBLISH, OP_FETCH, OP_END, OP_PUBLISH_BATCH, OP_REPLICATE}
+    assert not (CLUSTER_OPS & taken), (
+        "cluster ops collide with broker/replication op values")
 
 
 def test_real_wire_module_tags_are_exhaustive():
