@@ -50,6 +50,12 @@ surface, and these rules make drift impossible:
     is a slow memory leak with no operational signal; the PR 8 plan and
     result caches set the contract and this rule keeps every future cache
     honest.
+  * ``surface-cache-unbounded-bytes`` — a ``*Cache`` class that ACCOUNTS
+    bytes (stores an attribute whose name contains "bytes") holds
+    variable-size entries, so an entry-count bound alone does not bound
+    memory: it must also declare a byte capacity (``max_bytes`` /
+    ``capacity_bytes`` parameter or attribute). The PR 13 fragment cache
+    (per-step value columns of wildly varying width) set this contract.
 
 All three surfaces are verified against the docs tables by
 tests/test_static_analysis.py (README tables are generated from the same
@@ -94,6 +100,10 @@ def _fstring_prefix(node: ast.JoinedStr) -> str | None:
 
 
 CACHE_CAP_NAMES = {"capacity", "maxsize", "max_entries", "maxlen"}
+# byte-capacity spellings: required for caches that ACCOUNT bytes (their
+# entries vary in size — an entry-count bound alone does not bound memory)
+CACHE_BYTE_CAP_NAMES = {"max_bytes", "capacity_bytes", "bytes_capacity",
+                        "byte_capacity"}
 
 
 class SurfaceChecker:
@@ -102,7 +112,8 @@ class SurfaceChecker:
              "surface-metric-undeclared", "surface-metric-kind",
              "surface-metric-duplicate", "surface-metric-unused",
              "surface-trace-undeclared", "surface-trace-unused",
-             "surface-cache-unbounded", "surface-cache-no-eviction-metric")
+             "surface-cache-unbounded", "surface-cache-no-eviction-metric",
+             "surface-cache-unbounded-bytes")
 
     def __init__(self):
         self._modules: dict[str, ast.Module] = {}
@@ -130,6 +141,7 @@ class SurfaceChecker:
                     or not node.name.lower().endswith("cache"):
                 continue
             has_cap = has_evict = False
+            has_byte_cap = has_byte_acct = False
             # docstrings don't count as eviction ACCOUNTING — "eviction is
             # handled elsewhere" in prose must not satisfy the rule
             doc_ids = {
@@ -150,6 +162,17 @@ class SurfaceChecker:
                 elif isinstance(sub, ast.keyword) \
                         and sub.arg in ("maxlen", "maxsize"):
                     has_cap = True
+                if isinstance(sub, ast.arg) \
+                        and sub.arg in CACHE_BYTE_CAP_NAMES:
+                    has_byte_cap = True
+                elif isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, ast.Store):
+                    if sub.attr in CACHE_BYTE_CAP_NAMES:
+                        has_byte_cap = True
+                    elif "bytes" in sub.attr.lower():
+                        # byte ACCOUNTING (e.g. self._bytes running total):
+                        # variable-size entries — demands a byte capacity
+                        has_byte_acct = True
                 ident = None
                 if isinstance(sub, ast.Attribute):
                     ident = sub.attr
@@ -176,6 +199,15 @@ class SurfaceChecker:
                     f"cache class {node.name} never accounts evictions (no "
                     "identifier or metric containing 'eviction') — capacity "
                     "pressure must be operationally visible, not silent"))
+            if has_byte_acct and not has_byte_cap:
+                findings.append(Finding(
+                    "surface-cache-unbounded-bytes", path, node.lineno,
+                    node.name, f"bytes:{node.name}",
+                    f"cache class {node.name} accounts bytes (its entries "
+                    "vary in size) but declares no byte capacity "
+                    "(max_bytes/capacity_bytes) — an entry-count bound "
+                    "alone does not bound memory for variable-size "
+                    "entries"))
         return findings
 
     def finalize(self) -> list[Finding]:

@@ -108,6 +108,27 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "duration", "30s",
         "Lifetime of a negative-cache entry — the bound on how long a "
         "newly-appearing series can be masked by a cached empty result."),
+    "query.fragment_cache_size": (
+        "int", 256,
+        "Incremental-serving fragment cache entries per engine, keyed on "
+        "(promql, step, tenant): a shifted dashboard window reuses the "
+        "cached per-step columns still provably valid under the shard "
+        "epoch logs and computes only the new head/tail steps "
+        "(0 disables)."),
+    "query.fragment_cache_bytes": (
+        "int", 67108864,
+        "Total resident bytes admitted to the fragment cache (fragments "
+        "vary wildly in size, so the entry bound alone would not bound "
+        "memory); LRU-evicted with eviction accounting."),
+    "query.fragment_max_steps": (
+        "int", 4096,
+        "Steps kept per fragment entry — older (head) steps trim first, "
+        "exactly the ones a sliding dashboard window evicts; bounds "
+        "per-entry growth under streaming subscriptions."),
+    "query.subscribe_poll": (
+        "duration", "100ms",
+        "Watermark poll cadence between /api/v1/subscribe increments "
+        "(long-poll wait granularity and chunked-stream tick)."),
     "query.fused_kernels": (
         "str", "pallas",
         "Fused compressed-resident kernel tier (ops/fusedresident.py): "
@@ -188,6 +209,13 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "Missed grid ticks re-evaluated after a restart or stall, newest "
         "last; the re-publish dedupes via deterministic (rule, eval_ts) "
         "pub-ids, so catch-up is exactly-once."),
+    "rules.streaming": (
+        "bool", True,
+        "Evaluate rules as streaming-query subscribers (query/"
+        "incremental.py): each tick takes its grid step from a per-rule "
+        "subscription and catch-up spans evaluate as ONE range query "
+        "instead of one full-window evaluation per missed tick (off = "
+        "instant evaluation per tick)."),
     "rules.webhook_url": (
         "str|null", None,
         "Alert notification webhook (POST JSON on firing/resolved "
@@ -460,4 +488,7 @@ class Config:
             negative_cache_size=int(q["negative_cache_size"]),
             negative_cache_ttl_s=parse_duration_ms(
                 q["negative_cache_ttl"]) / 1000.0,
+            fragment_cache_size=int(q["fragment_cache_size"]),
+            fragment_cache_bytes=int(q["fragment_cache_bytes"]),
+            fragment_max_steps=int(q["fragment_max_steps"]),
         )

@@ -21,11 +21,18 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
 log = logging.getLogger(__name__)
+
+# epoch-log sentinel: this visibility bump may have affected data at ANY
+# timestamp (destructive mutations — purge, eviction, retention compaction,
+# durable age-out). Fragments validated against a log containing it
+# invalidate whole (query/incremental.py stable_before).
+EPOCH_AFFECTS_ALL = -(1 << 62)
 
 from .chunkstore import SeriesStore
 from .eviction import BloomFilter, CapacityEvictionPolicy, EvictionPolicy
@@ -143,6 +150,26 @@ class TimeSeriesShard:
         # cached result could diverge from re-execution (query/engine.py
         # QueryResultCache). Served over /api/v1/epochs for peer probes.
         self.data_epoch = 0
+        # per-bump provenance for INCREMENTAL serving (query/incremental.py):
+        # each data_epoch bump appends (new epoch, min affected data ts) —
+        # an append-type bump records the minimum timestamp that became
+        # visible, a destructive bump (purge/eviction/compaction/age-out)
+        # records EPOCH_AFFECTS_ALL. A cached per-step fragment recorded at
+        # epoch e stays provably valid for steps t < min(min_ts of every
+        # bump after e): only data at timestamps <= t can influence step t
+        # (windows and lookback reach strictly backward). Bounded ring; a
+        # gap (too many bumps since e) reads as "unknown" and the fragment
+        # fully invalidates — never a stale serve.
+        self._epoch_log: deque[tuple[int, int]] = deque(maxlen=256)
+        self._stage_min_ts: int | None = None
+        self._stage_max_ts = 0
+        # QUERY-VISIBLE data-time lead: advances when staged rows actually
+        # land on the device store (or recovery loads chunks), unlike
+        # lead_ms which advances at STAGE time. Streaming subscriptions
+        # chase this one — an increment cut at the staged (not yet
+        # visible) lead would serve a step without its samples and never
+        # re-deliver it (the cursor only moves forward)
+        self.visible_lead_ms = 0
         # purged slots available for reuse + membership filter of evicted keys
         # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
         self._free_pids: list[int] = []
@@ -412,6 +439,24 @@ class TimeSeriesShard:
         self.stats.partitions_evicted += int(victims.size)
         return True
 
+    def _bump_epoch_locked(self, min_affected_ms: int) -> None:
+        """Advance the visibility watermark (caller holds the shard lock),
+        recording the minimum data timestamp the mutation can have touched
+        — ``EPOCH_AFFECTS_ALL`` for destructive changes. EVERY data_epoch
+        bump must route through here: the incremental-serving validity rule
+        requires one log entry per bump (a gap reads as full
+        invalidation)."""
+        self.data_epoch += 1
+        self._epoch_log.append((self.data_epoch, int(min_affected_ms)))
+
+    def epoch_state(self) -> tuple[int, list[tuple[int, int]]]:
+        """``(data_epoch, recent (epoch, min affected ts) entries)`` read
+        coherently under the shard lock — the substrate of per-step
+        fragment validity (local probes read this directly; peers serve it
+        over ``/api/v1/epochs?log=1``)."""
+        with self.lock:
+            return self.data_epoch, list(self._epoch_log)
+
     def _release_partitions_locked(self, pids: np.ndarray) -> None:
         """Shared teardown for purge and eviction: drop id maps (recording the
         keys in the evicted-keys filter), tombstone index entries, free HBM
@@ -422,7 +467,9 @@ class TimeSeriesShard:
         pid_list = pids.tolist()
         self.slot_epoch[pids] += 1
         self._release_epoch += 1
-        self.data_epoch += 1           # result-cache watermark: data gone
+        # result-cache watermark: data gone (destructive — a released
+        # series held samples at arbitrary timestamps)
+        self._bump_epoch_locked(EPOCH_AFFECTS_ALL)
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
@@ -579,7 +626,14 @@ class TimeSeriesShard:
         self._stage_pid.append(pids)
         self._stage_ts.append(ts)
         self._stage_val.append(vals)
+        # min staged ts feeds the epoch log at the flush visibility point:
+        # steps older than it stay provably cacheable across the bump
+        batch_min = int(ts.min())
+        if self._stage_min_ts is None or batch_min < self._stage_min_ts:
+            self._stage_min_ts = batch_min
         lead = int(ts.max())
+        if lead > self._stage_max_ts:
+            self._stage_max_ts = lead
         if lead > self.lead_ms:
             self.lead_ms = lead
         self._staged += len(ts)
@@ -608,7 +662,13 @@ class TimeSeriesShard:
         # let a query cached in the stage->flush window validate against a
         # vector that already includes the not-yet-visible rows — a stale
         # hit after the flush (review finding, PR 8)
-        self.data_epoch += 1
+        self._bump_epoch_locked(self._stage_min_ts
+                                if self._stage_min_ts is not None
+                                else EPOCH_AFFECTS_ALL)
+        self._stage_min_ts = None
+        # the staged rows become query-visible with this scatter
+        if self._stage_max_ts > self.visible_lead_ms:
+            self.visible_lead_ms = self._stage_max_ts
         pids = np.concatenate(self._stage_pid)
         ts = np.concatenate(self._stage_ts)
         vals = np.concatenate(self._stage_val, axis=0)
@@ -649,7 +709,8 @@ class TimeSeriesShard:
             cutoff = int(self.store.last_ts.max(initial=0)) - self.config.retention_ms
             with self.lock:
                 self.store.compact(cutoff)
-                self.data_epoch += 1   # result-cache watermark: rows aged out
+                # result-cache watermark: rows aged out (destructive)
+                self._bump_epoch_locked(EPOCH_AFFECTS_ALL)
         if residency != "off":
             # adopt/refresh the compressed-resident state AFTER any compact
             # (compact rehydrates — compressing first would be discarded
@@ -897,6 +958,8 @@ class TimeSeriesShard:
                     lead = int(ts.max())
                     if lead > self.lead_ms:
                         self.lead_ms = lead
+                    if lead > self.visible_lead_ms:
+                        self.visible_lead_ms = lead   # loaded = visible
         # between chunk load and replay: replayed rows flow through the
         # normal flush pipeline, so state seeded here (e.g. the streaming
         # downsampler's open buckets) sees each sample exactly once
@@ -1023,7 +1086,8 @@ class TimeSeriesShard:
                                        cutoff_ms))
         if dropped:
             with self.lock:
-                self.data_epoch += 1   # result-cache watermark: rows aged out
+                # result-cache watermark: rows aged out (destructive)
+                self._bump_epoch_locked(EPOCH_AFFECTS_ALL)
             registry.counter(FILODB_RETENTION_AGED_OUT_ROWS,
                              {"dataset": self.dataset,
                               "shard": str(self.shard_num)}).increment(dropped)

@@ -23,8 +23,8 @@ from ..promql.parser import ParseError
 from ..query.engine import QueryEngine, slow_query_log
 from ..query.rangevector import QueryError
 from ..query.scheduler import AdmissionRejected, Priority, SchedulerBusy
-from ..utils.tracing import (SPAN_QUERY_SERVE, SPAN_REMOTE_WRITE, span,
-                             tracer)
+from ..utils.tracing import (SPAN_QUERY_SERVE, SPAN_QUERY_SUBSCRIBE,
+                             SPAN_REMOTE_WRITE, span, tracer)
 
 
 from ..query.rangevector import fmt_value as _fmt  # shared full-precision renderer
@@ -82,7 +82,8 @@ class FiloHttpServer:
 
     def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
                  cluster=None, writers: dict | None = None, scheduler=None,
-                 cluster_ops: dict | None = None):
+                 cluster_ops: dict | None = None,
+                 subscribe_poll_s: float = 0.1):
         """``writers``: dataset -> callable(per_shard: dict[shard, container])
         receiving remote-write batches atomically (bus publish or direct ingest).
         ``scheduler``: optional QueryScheduler — query work runs through its
@@ -110,6 +111,14 @@ class FiloHttpServer:
         # saturated nodes would deadlock), but an unbounded handler-thread
         # free-for-all is a DoS vector; a bounded semaphore gives both
         self._leg_sem = threading.BoundedSemaphore(16)
+        # streaming subscriptions (/api/v1/subscribe): long-poll waits and
+        # chunked streams occupy their handler thread for up to the request
+        # timeout — a separate bounded semaphore keeps them from starving
+        # the peer-leg budget or becoming a thread-exhaustion DoS
+        self._sub_sem = threading.BoundedSemaphore(32)
+        # watermark poll cadence between subscription increments
+        # (query.subscribe_poll)
+        self._subscribe_poll_s = max(float(subscribe_poll_s), 0.005)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -378,12 +387,25 @@ class FiloHttpServer:
                 return
             # ingest-watermark probe for peer result-cache validation:
             # local shards only by construction (each node reports its own
-            # counters), index-free and lock-free — served on the handler
-            # thread like /__health so it never queues behind query work
-            h._send(200, {"status": "success",
-                          "data": {str(s.shard_num): s.data_epoch
-                                   for s in engine.memstore.shards_of(
-                                       engine.dataset)}})
+            # counters), index-free and cheap — served on the handler
+            # thread like /__health so it never queues behind query work.
+            # log=1 (fragment-cache probes) adds each shard's recent
+            # (epoch, min affected ts) bump log — the per-step validity
+            # substrate (query/incremental.stable_before)
+            if q.get("log") == "1":
+                data = {}
+                for s in engine.memstore.shards_of(engine.dataset):
+                    ep, lg = s.epoch_state()
+                    data[str(s.shard_num)] = [ep, [[e, m_] for e, m_ in lg]]
+            else:
+                data = {str(s.shard_num): s.data_epoch
+                        for s in engine.memstore.shards_of(engine.dataset)}
+            h._send(200, {"status": "success", "data": data})
+            return
+
+        m = re.fullmatch(r"/promql/([^/]+)/api/v1/subscribe", path)
+        if m:
+            self._subscribe(h, m.group(1), q)
             return
 
         # local=1 (strictly) marks a peer's metadata fan-out request: answer
@@ -537,8 +559,123 @@ class FiloHttpServer:
                                        "report": prof.report()
                                        if prof is not None else None}})
             return
+        if which == "fragment_cache":
+            # incremental-serving observability: per-engine stats + the
+            # per-entry byte accounting (which fragments are resident, how
+            # many steps/series/bytes each holds)
+            data = {}
+            for ds, e in list(self.engines.items()):
+                cache = getattr(e, "fragment_cache", None)
+                if cache is not None:
+                    data[ds] = {"stats": cache.stats(),
+                                "entries": cache.entries_debug()}
+            h._send(200, {"status": "success", "data": data})
+            return
         h._send(404, {"status": "error",
                       "error": f"unknown debug endpoint {which}"})
+
+    # -- streaming subscriptions (incremental serving) ------------------------
+
+    def _subscribe(self, h, dataset: str, q: dict) -> None:
+        """``/promql/{ds}/api/v1/subscribe?query=...&step=...`` — per-step
+        increments as the shard ingest watermarks advance, powered by the
+        same delta-evaluation machinery as the fragment cache (each
+        increment is a tail-extension range query).
+
+        Stateless long-poll by default: the response carries the steps
+        newly covered past ``since`` (or an empty increment at ``timeout``)
+        plus ``next_since`` for the next request. ``mode=stream`` keeps the
+        connection open and writes one ND-JSON line per increment until
+        ``timeout`` — the chunked-HTTP form of the same protocol."""
+        import time as _time
+
+        from ..query.incremental import data_lead_ms, poll_increment
+        from ..utils.metrics import FILODB_QUERY_SUBSCRIBE_INCREMENTS, registry
+        engine = self.engines.get(dataset)
+        if engine is None:
+            h._send(404, {"status": "error", "error": f"no dataset {dataset}"})
+            return
+        expr = q.get("query")
+        if not expr:
+            raise QueryError("subscribe requires a query= expression")
+        step = _parse_step(q["step"]) if q.get("step") else 15_000
+        tenant = h.headers.get("X-Filo-Tenant") or q.get("tenant") or None
+        if q.get("since"):
+            since = _parse_time(q["since"])
+        else:
+            # default cursor: one step behind the VISIBLE lead's grid point,
+            # so the first increment delivers exactly the newest complete
+            # step; an empty dataset floors at 0 and the poll loop waits
+            # for the first real sample (poll_increment's span clamp keeps
+            # the eventual catch-up bounded)
+            since = max((data_lead_ms(engine) // step) * step - step, 0)
+        wait_s = min(float(q.get("timeout") or 30.0), 300.0)
+        stream = q.get("mode") == "stream"
+        if not self._sub_sem.acquire(blocking=False):
+            raise SchedulerBusy("subscription capacity saturated; retry later")
+        try:
+            deadline = _time.monotonic() + wait_s
+            counter = registry.counter(FILODB_QUERY_SUBSCRIBE_INCREMENTS,
+                                       {"dataset": dataset})
+
+            def one_increment():
+                with span(SPAN_QUERY_SUBSCRIBE, dataset=dataset) as tags:
+                    res, nxt = poll_increment(engine, expr, step, since,
+                                              tenant=tenant)
+                    if res is not None:
+                        tags["steps"] = len(res.matrix.out_ts)
+                        counter.increment()
+                    return res, nxt
+
+            if not stream:
+                while True:
+                    res, nxt = one_increment()
+                    if res is not None or _time.monotonic() >= deadline:
+                        body = {"status": "success",
+                                "since": since / 1000.0,
+                                "next_since": nxt / 1000.0,
+                                "data": (matrix_to_prom_json(res)
+                                         if res is not None else None)}
+                        if res is not None and res.stats is not None:
+                            body["stats"] = res.stats.to_dict()
+                        h._send(200, body)
+                        return
+                    if _time.monotonic() + self._subscribe_poll_s > deadline:
+                        _time.sleep(max(deadline - _time.monotonic(), 0.0))
+                    else:
+                        _time.sleep(self._subscribe_poll_s)
+            # chunked-style stream: no Content-Length — one ND-JSON line per
+            # increment until the timeout; the connection close delimits
+            h.send_response(200)
+            h.send_header("Content-Type", "application/x-ndjson")
+            h.send_header("Cache-Control", "no-cache")
+            h.end_headers()
+            while _time.monotonic() < deadline:
+                try:
+                    res, nxt = one_increment()
+                except Exception as e:  # noqa: BLE001 — headers are out:
+                    # the JSON error handlers can't run; close the stream
+                    # with a terminal error line instead
+                    err = json.dumps({"error": f"{type(e).__name__}: {e}"})
+                    try:
+                        h.wfile.write((err + "\n").encode())
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        pass
+                    return
+                if res is not None:
+                    line = json.dumps(
+                        {"since": since / 1000.0, "next_since": nxt / 1000.0,
+                         "data": matrix_to_prom_json(res)},
+                        separators=(",", ":")) + "\n"
+                    try:
+                        h.wfile.write(line.encode())
+                        h.wfile.flush()
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        return            # subscriber went away
+                    since = nxt
+                _time.sleep(self._subscribe_poll_s)
+        finally:
+            self._sub_sem.release()
 
     # -- cross-node plan execution (ref: PlanDispatcher receiving side) -------
 

@@ -32,8 +32,9 @@ from ..utils.metrics import (FILODB_QUERY_LATENCY_MS,
                              FILODB_QUERY_SLOW, registry)
 from ..promql import parser as promql
 from ..utils.tracing import (SPAN_QUERY, SPAN_QUERY_ADMIT,
-                             SPAN_QUERY_EXECUTE, SPAN_QUERY_PARSE,
-                             SPAN_QUERY_PLAN, span, tracer)
+                             SPAN_QUERY_EXECUTE, SPAN_QUERY_FRAGMENT,
+                             SPAN_QUERY_PARSE, SPAN_QUERY_PLAN, span,
+                             tracer)
 from . import logical as L
 from .exec import QueryContext, group_keys_of
 from .planner import QueryPlanner
@@ -135,6 +136,12 @@ class QueryConfig:
     # the library default; FiloServer turns it on from config)
     negative_cache_size: int = 0
     negative_cache_ttl_s: float = 30.0
+    # incremental serving: per-step fragment cache entries per engine
+    # (query.fragment_cache_size; 0 disables — the library default), with a
+    # total byte bound and a per-entry step bound (query.fragment_cache_*)
+    fragment_cache_size: int = 0
+    fragment_cache_bytes: int = 64 << 20
+    fragment_max_steps: int = 4096
 
 
 class QueryResultCache:
@@ -395,6 +402,17 @@ class QueryEngine:
             self.config.negative_cache_size,
             self.config.negative_cache_ttl_s, tags={"dataset": dataset})
             if self.config.negative_cache_size else None)
+        # incremental serving: per-step fragment cache — a shifted dashboard
+        # window extends its cached fragment (only new tail steps execute)
+        # instead of recomputing the whole range (query/incremental.py)
+        if self.config.fragment_cache_size:
+            from .incremental import FragmentCache
+            self.fragment_cache = FragmentCache(
+                self.config.fragment_cache_size,
+                self.config.fragment_cache_bytes,
+                self.config.fragment_max_steps, tags={"dataset": dataset})
+        else:
+            self.fragment_cache = None
         # a failed peer epoch probe arms this cooldown: until it passes,
         # _epoch_vector returns None without scattering (caching fail-opens
         # to miss), so a blackholed peer stalls at most one query per
@@ -445,11 +463,15 @@ class QueryEngine:
     def query_range(self, promql_text: str, start_ms: int, end_ms: int,
                     step_ms: int, tenant: str | None = None,
                     resolution: str | None = None,
-                    _skip_routing: bool = False) -> QueryResult:
+                    _skip_routing: bool = False,
+                    min_window_ms: int | None = None) -> QueryResult:
         """``resolution`` (&resolution= / filo-cli --resolution) overrides
         the retention router's decision for the whole range; it requires
         routing to be configured (unknown values fail with the available
-        list). ``_skip_routing`` is the router's own raw-tail leg."""
+        list). ``_skip_routing`` is the router's own raw-tail leg.
+        ``min_window_ms`` (the retention router's serving-resolution floor)
+        auto-widens windowed functions narrower than the downsample family's
+        resolution — without it they silently return empty/wrong data."""
         if self.retention is not None and not _skip_routing:
             routed = self.retention.route_range(
                 self, promql_text, int(start_ms), int(end_ms), int(step_ms),
@@ -465,7 +487,7 @@ class QueryEngine:
             lambda: promql.query_to_logical_plan(promql_text, start_ms,
                                                  end_ms, step_ms),
             range_key=(int(start_ms), int(end_ms), int(step_ms)),
-            tenant=tenant)
+            tenant=tenant, min_window_ms=min_window_ms)
         if self.retention is not None and res.stats is not None \
                 and res.stats.resolution is None:
             res.stats.resolution = "raw"   # routing ran and chose raw
@@ -473,7 +495,8 @@ class QueryEngine:
 
     def query_instant(self, promql_text: str, time_ms: int,
                       tenant: str | None = None,
-                      resolution: str | None = None) -> QueryResult:
+                      resolution: str | None = None,
+                      min_window_ms: int | None = None) -> QueryResult:
         if self.retention is not None:
             routed = self.retention.route_instant(self, promql_text,
                                                   int(time_ms), tenant,
@@ -489,13 +512,14 @@ class QueryEngine:
             promql_text,
             lambda: promql.query_to_logical_plan(promql_text, time_ms,
                                                  time_ms, 1),
-            tenant=tenant)
+            tenant=tenant, min_window_ms=min_window_ms)
         res.result_type = "vector"
         return res
 
     def _query_traced(self, promql_text: str, to_plan,
                       range_key: tuple | None = None,
-                      tenant: str | None = None) -> QueryResult:
+                      tenant: str | None = None,
+                      min_window_ms: int | None = None) -> QueryResult:
         """Shared query entry: ONE root span per query (every stage and
         every participating node's spans hang off its trace id), the
         end-to-end latency histogram (exemplar-tagged with that trace id),
@@ -506,10 +530,13 @@ class QueryEngine:
 
         Serving fast path, in order: (1) the result cache answers a
         repeated range query without parsing or executing when its ingest
-        watermark vector still matches; (2) cost-based admission sheds
-        what the budget cannot afford BEFORE it executes; (3) execution
-        populates the cache with the PRE-execution watermark vector, so a
-        concurrent ingest invalidates the entry rather than racing it."""
+        watermark vector still matches; (2) the fragment cache serves a
+        SHIFTED range incrementally — the provably-valid overlap from
+        cached per-step columns, only the head/tail delta executed; (3)
+        cost-based admission sheds what the budget cannot afford BEFORE
+        it executes; (4) execution populates both caches with the
+        PRE-execution watermark vector, so a concurrent ingest
+        invalidates the affected steps rather than racing them."""
         ctx = self._ctx()
         t0 = time.perf_counter_ns()
         tctx = None
@@ -525,21 +552,50 @@ class QueryEngine:
                     neg_key = (promql_text, tenant)
                     if self.negative_cache.hit(neg_key, range_key):
                         return self._negative_hit(range_key, ctx)
-                cache_key = epochs = None
+                cache_key = epochs = elogs = frag_key = None
+                frag = (self.fragment_cache if range_key is not None
+                        else None)
+                if range_key is not None and (self.result_cache is not None
+                                              or frag is not None):
+                    epochs, elogs = self._epoch_state(
+                        with_logs=frag is not None)
                 if range_key is not None and self.result_cache is not None:
-                    cache_key = (promql_text, *range_key, tenant)
-                    epochs = self._epoch_vector()
+                    # min_window rides every cache key: the router's widened
+                    # plan and a direct family query share promql text but
+                    # not semantics
+                    cache_key = (promql_text, *range_key, tenant,
+                                 min_window_ms)
                     hit = self._result_cache_probe(cache_key, epochs, ctx)
                     if hit is not None:
                         return hit
+                if frag is not None and epochs is not None:
+                    frag_key = (promql_text, range_key[2], tenant,
+                                min_window_ms)
+                    served = self._fragment_serve(
+                        frag_key, promql_text, range_key, tenant,
+                        min_window_ms, epochs, elogs, ctx)
+                    if served is not None:
+                        if cache_key is not None:
+                            self.result_cache.put(
+                                cache_key,
+                                (served.matrix, served.result_type,
+                                 list(served.warnings), ctx.stats.to_dict(),
+                                 ctx.exec_path), epochs)
+                        return served
                 with span(SPAN_QUERY_PARSE), ctx.stats.stage("parse"):
                     plan = to_plan()
+                plan, widen_warn = self._widen_plan(plan, min_window_ms, ctx)
                 res = self._exec_admitted(plan, ctx, tenant)
+                if widen_warn is not None and widen_warn not in res.warnings:
+                    res.warnings.append(widen_warn)
                 if cache_key is not None:
                     self.result_cache.put(
                         cache_key,
                         (res.matrix, res.result_type, list(res.warnings),
                          ctx.stats.to_dict(), ctx.exec_path), epochs)
+                if frag_key is not None:
+                    self._fragment_store(frag_key, plan, res, range_key,
+                                         epochs)
                 if (neg_key is not None and ctx.stats.series_matched == 0
                         and res.matrix.num_series == 0):
                     # the SELECTION was provably empty cluster-wide (peer
@@ -587,6 +643,130 @@ class QueryEngine:
         res.stats = ctx.stats
         res.exec_path = ctx.exec_path
         return res
+
+    def _widen_plan(self, plan: L.LogicalPlan, min_window_ms: int | None,
+                    ctx: QueryContext):
+        """Auto-widen windowed functions narrower than the serving
+        resolution (retention-routed family queries only — min_window_ms
+        is the family's resolution): a window that cannot cover one
+        downsample bucket silently returns empty/wrong data. Returns
+        ``(plan, warning | None)``; the count lands in QueryStats and the
+        per-dataset metric."""
+        if not min_window_ms:
+            return plan, None
+        from ..utils.metrics import FILODB_QUERY_WINDOWS_WIDENED
+        from .retention import resolution_label, widen_windows
+        plan, n = widen_windows(plan, int(min_window_ms))
+        if not n:
+            return plan, None
+        label = resolution_label(int(min_window_ms))
+        ctx.stats.add("windows_widened", n)
+        registry.counter(FILODB_QUERY_WINDOWS_WIDENED,
+                         {"dataset": self.dataset,
+                          "resolution": label}).increment(n)
+        return plan, (f"{n} window(s) narrower than the {label} serving "
+                      "resolution were widened to cover it")
+
+    def _build_range_plan(self, promql_text: str, start_ms: int, end_ms: int,
+                          step_ms: int, min_window_ms: int | None,
+                          ctx: QueryContext):
+        """Parse + widen one (sub-)range — the fragment path's delta legs
+        build their head/tail plans through the same pipeline as the full
+        execution, so extension is bit-identical by construction."""
+        with span(SPAN_QUERY_PARSE), ctx.stats.stage("parse"):
+            plan = promql.query_to_logical_plan(promql_text, start_ms,
+                                                end_ms, step_ms)
+        return self._widen_plan(plan, min_window_ms, ctx)
+
+    def _fragment_serve(self, frag_key: tuple, promql_text: str,
+                        range_key: tuple, tenant: str | None,
+                        min_window_ms: int | None, epochs, elogs,
+                        ctx: QueryContext) -> QueryResult | None:
+        """Incremental (delta) evaluation off the fragment cache: reuse the
+        entry's provably-valid per-step columns, execute ONLY the missing
+        head/tail sub-ranges, stitch, and store the merged fragment back
+        (recorded against the PRE-execution epoch vector — a concurrent
+        ingest invalidates the affected steps on the next probe instead of
+        racing this one). None => no usable fragment; caller executes the
+        full range."""
+        start, end, step = range_key
+        hit = self.fragment_cache.probe(frag_key, start, end, step,
+                                        epochs, elogs)
+        if hit is None:
+            return None
+        from ..parallel.cluster import stitch_matrices
+        from .exec import check_sample_limit
+        with span(SPAN_QUERY_FRAGMENT, dataset=self.dataset,
+                  reused=hit.reused_steps) as tags:
+            parts = [ResultMatrix(hit.keep_ts, hit.keep_vals, hit.keys)]
+            warnings = list(hit.warnings)
+            n_new = 0
+            for lo, hi in hit.missing:
+                plan, widen_warn = self._build_range_plan(
+                    promql_text, lo, hi, step, min_window_ms, ctx)
+                sub = self._exec_admitted(plan, ctx, tenant)
+                # dedup against the entry's recorded warnings: the SAME
+                # widen warning re-arises on every extension and would
+                # otherwise accumulate one copy per refresh in the stored
+                # fragment (and in every response)
+                if widen_warn is not None and widen_warn not in warnings:
+                    warnings.append(widen_warn)
+                for w in sub.warnings:
+                    if w not in warnings:
+                        warnings.append(w)
+                m = sub.matrix.to_host()
+                parts.append(ResultMatrix(
+                    np.asarray(m.out_ts, np.int64),
+                    np.asarray(m.values, np.float64), list(m.keys)))
+                n_new += len(m.out_ts)
+            tags["computed"] = n_new
+            merged = stitch_matrices(parts) if len(parts) > 1 else parts[0]
+            m_ts = np.asarray(merged.out_ts)
+            mask = (m_ts >= start) & (m_ts <= end)
+            served_m = ResultMatrix(m_ts[mask],
+                                    np.asarray(merged.values)[:, mask],
+                                    list(merged.keys))
+            check_sample_limit(served_m.num_series, len(served_m.out_ts),
+                               self.config.sample_limit)
+            ctx.stats.add("fragment_steps_reused", hit.reused_steps)
+            self._set_path(
+                ctx,
+                f"incremental[reused={hit.reused_steps},computed={n_new}]"
+                if hit.missing else "fragment-cache[full]")
+            # merged fragment replaces the entry: the evicted head trims via
+            # the cache's per-entry step bound, the new tail extends it
+            self.fragment_cache.store(
+                frag_key, merged.out_ts, np.asarray(merged.values),
+                merged.keys, warnings, epochs, step,
+                extended=bool(hit.missing) and hit.reused_steps > 0)
+        res = QueryResult(served_m, "matrix", warnings)
+        res.stats = ctx.stats
+        res.exec_path = ctx.exec_path
+        return res
+
+    def _fragment_store(self, frag_key: tuple, plan: L.LogicalPlan,
+                        res: QueryResult, range_key: tuple, epochs) -> None:
+        """Seed the fragment cache from a full execution — only plans whose
+        steps are provably time-local (query/incremental.plan_cacheable)
+        and scalar-columnar results qualify."""
+        from .incremental import plan_cacheable
+        if res.result_type != "matrix" or res.matrix.bucket_les is not None:
+            return
+        if not plan_cacheable(plan):
+            return
+        host = res.matrix.to_host()
+        vals = np.asarray(host.values)
+        if vals.ndim != 2:
+            return
+        if vals.shape[0] > len(host.keys):
+            vals = vals[:len(host.keys)]   # padded leaf rows carry no series
+        elif vals.shape[0] < len(host.keys):
+            return
+        self.fragment_cache.store(frag_key,
+                                  np.asarray(host.out_ts, np.int64),
+                                  np.asarray(vals, np.float64),
+                                  list(host.keys), res.warnings, epochs,
+                                  range_key[2])
 
     def _exec_admitted(self, plan: L.LogicalPlan, ctx: QueryContext,
                        tenant: str | None) -> QueryResult:
@@ -642,24 +822,40 @@ class QueryEngine:
             plan, series_of, self.config.stale_sample_after_ms)
 
     def _epoch_vector(self) -> tuple | None:
-        """The cluster ingest-watermark vector for this dataset: every
-        shard's data_epoch mutation counter — local shards read directly,
-        peer-owned topologies probed over /api/v1/epochs (one concurrent
-        scatter; a hit served off a matching vector is provably identical
-        to re-execution). None when any peer is unreachable — callers then
-        treat the lookup as a miss and skip caching — and a failure arms
-        a cooldown during which the scatter is skipped entirely."""
-        vec = [("local", sh.shard_num, sh.data_epoch)
-               for sh in self.memstore.shards_of(self.dataset)]
+        """The cluster ingest-watermark vector (see :meth:`_epoch_state`)."""
+        return self._epoch_state()[0]
+
+    def _epoch_state(self, with_logs: bool = False):
+        """``(vector, logs)`` of the cluster ingest-watermark state for this
+        dataset: the vector is every shard's data_epoch mutation counter —
+        local shards read directly, peer-owned topologies probed over
+        /api/v1/epochs (one concurrent scatter; a hit served off a matching
+        vector is provably identical to re-execution). With ``with_logs``
+        each shard's recent (epoch, min affected ts) bump log rides along
+        (``?log=1`` on the peer probe) — the substrate of PER-STEP fragment
+        validity (query/incremental.stable_before). ``(None, None)`` when
+        any peer is unreachable — callers then treat the lookup as a miss
+        and skip caching — and a failure arms a cooldown during which the
+        scatter is skipped entirely."""
+        vec = []
+        logs: dict = {}
+        for sh in self.memstore.shards_of(self.dataset):
+            if with_logs:
+                ep, lg = sh.epoch_state()
+                logs[("local", str(sh.shard_num))] = lg
+            else:
+                ep = sh.data_epoch
+            vec.append(("local", sh.shard_num, ep))
         if self._has_remote_shards():
             if time.monotonic() < self._epoch_probe_down_until:
-                return None
+                return None, None
             import json as _json
             import urllib.request
+            sfx = "&log=1" if with_logs else ""
 
             def fetch(ep: str) -> dict:
                 url = (f"http://{ep}/promql/{self.dataset}/api/v1/epochs"
-                       "?local=1")
+                       f"?local=1{sfx}")
                 with urllib.request.urlopen(url, timeout=2.0) as r:
                     return _json.load(r).get("data") or {}
 
@@ -668,10 +864,16 @@ class QueryEngine:
                 if isinstance(res, Exception):
                     self._epoch_probe_down_until = (
                         time.monotonic() + self._epoch_probe_cooldown_s)
-                    return None
-                vec.extend((ep, str(k), int(v))
-                           for k, v in sorted(res.items()))
-        return tuple(sorted(vec, key=str))
+                    return None, None
+                for k, v in sorted(res.items()):
+                    if isinstance(v, (list, tuple)):
+                        # log form: [epoch, [[epoch_i, min_ts_i], ...]]
+                        vec.append((ep, str(k), int(v[0])))
+                        logs[(ep, str(k))] = [(int(a), int(b))
+                                              for a, b in v[1]]
+                    else:
+                        vec.append((ep, str(k), int(v)))
+        return tuple(sorted(vec, key=str)), logs
 
     def _note_query_done(self, promql_text: str, ctx: QueryContext,
                          dur_ms: float, tctx: dict | None,

@@ -178,6 +178,26 @@ class VectorOfScalar(PeriodicSeriesPlan):
     scalar: LogicalPlan = None
 
 
+def child_plans(node):
+    """Yield ``(field_name, child_plan)`` for every LogicalPlan held by a
+    direct dataclass field of ``node`` — including members of tuple/list
+    fields. THE one child traversal the plan walkers share
+    (query/retention.widen_windows, query/incremental.plan_cacheable): a
+    future node type that nests children differently is covered here once
+    instead of in every hand-rolled walk."""
+    import dataclasses
+    if not dataclasses.is_dataclass(node):
+        return
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, LogicalPlan):
+            yield f.name, v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, LogicalPlan):
+                    yield f.name, x
+
+
 # ---- metadata plans ---------------------------------------------------------
 
 @dataclass(frozen=True)

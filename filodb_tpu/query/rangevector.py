@@ -124,7 +124,8 @@ class QueryStats:
     FIELDS = ("series_matched", "blocks_narrow", "blocks_raw",
               "rows_paged_in", "result_cells", "result_cache_hits",
               "negative_cache_hits", "fused_kernels", "admission_shed",
-              "subquery_inner_cells")
+              "subquery_inner_cells", "fragment_steps_reused",
+              "windows_widened")
 
     def __init__(self):
         self.series_matched = 0        # series selected by leaf filters
@@ -140,6 +141,10 @@ class QueryStats:
         self.admission_shed = 0        # shed by cost-based admission
         self.subquery_inner_cells = 0  # inner-grid cells a subquery's
                                        # nested evaluation materialized
+        self.fragment_steps_reused = 0  # request steps served from the
+                                        # incremental fragment cache
+        self.windows_widened = 0       # windowed fns auto-widened to the
+                                       # serving family's resolution
         # serving resolution the retention router picked ("raw" / "1m" /
         # "1h+raw" for a stitched range); None when routing is off — a
         # label, not a counter, so merge() keeps the top-level value

@@ -35,6 +35,73 @@ log = logging.getLogger(__name__)
 
 RAW = 0     # the sentinel resolution of the raw tier
 
+# range functions that need >= 2 samples in the window (the kernels' cnt>=2
+# presence rule): their widened floor is TWO downsample buckets; the
+# *_over_time family needs one, so its floor is the resolution itself
+TWO_SAMPLE_FNS = frozenset({"rate", "increase", "delta", "irate", "idelta",
+                            "deriv", "predict_linear"})
+
+
+def widen_windows(plan, resolution_ms: int):
+    """``(plan', n_widened)``: windowed functions whose window is narrower
+    than the serving ``resolution_ms`` widen to cover it — without this, a
+    ``rate(m[1m])`` routed to a 5m downsample family finds < 2 samples per
+    window and silently returns empty/wrong data (the named ROADMAP item 3
+    gap). The inner raw selector's lookback range widens by the same delta
+    (the parser derived it as ``start - window``), so the leaf actually
+    reads the extra cells. Widening changes the window semantics — callers
+    surface it as a response warning + QueryStats.windows_widened."""
+    import dataclasses
+
+    from . import logical as L
+
+    def walk(node):
+        if not dataclasses.is_dataclass(node):
+            return node, 0
+        n = 0
+        changes = {}
+        # the shared child traversal (logical.child_plans) defines what a
+        # "child" is; replacement here handles both direct plan fields and
+        # tuple/list container fields member-wise
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, L.LogicalPlan):
+                nv, k = walk(v)
+                if k:
+                    changes[f.name] = nv
+                    n += k
+            elif isinstance(v, (list, tuple)) \
+                    and any(isinstance(x, L.LogicalPlan) for x in v):
+                new_members = []
+                k_sum = 0
+                for x in v:
+                    if isinstance(x, L.LogicalPlan):
+                        nx, k = walk(x)
+                        new_members.append(nx)
+                        k_sum += k
+                    else:
+                        new_members.append(x)
+                if k_sum:
+                    changes[f.name] = type(v)(new_members)
+                    n += k_sum
+        if isinstance(node, L.PeriodicSeriesWithWindowing):
+            floor = resolution_ms * (2 if node.function in TWO_SAMPLE_FNS
+                                     else 1)
+            if node.window_ms < floor:
+                delta = floor - node.window_ms
+                raw = changes.get("series", node.series)
+                sel = raw.range_selector
+                changes["series"] = dataclasses.replace(
+                    raw, range_selector=L.IntervalSelector(
+                        sel.from_ms - delta, sel.to_ms))
+                changes["window_ms"] = floor
+                n += 1
+        if changes:
+            node = dataclasses.replace(node, **changes)
+        return node, n
+
+    return walk(plan)
+
 
 def resolution_label(res_ms: int) -> str:
     """Canonical spelling of a resolution ("raw", "90s", "1m", "1h")."""
@@ -237,12 +304,14 @@ class RetentionRouter:
             self._count(label)
             if dec.seam_ms is None:
                 out = fam.query_range(promql, start_ms, end_ms, step_ms,
-                                      tenant=tenant)
+                                      tenant=tenant,
+                                      min_window_ms=dec.resolution_ms)
                 return self._tag(out, label)
             # stitched: downsampled body up to the seam, raw tail from it —
             # the raw leg bypasses routing (it IS the raw tier's share)
             body = fam.query_range(promql, start_ms, dec.seam_ms - step_ms,
-                                   step_ms, tenant=tenant)
+                                   step_ms, tenant=tenant,
+                                   min_window_ms=dec.resolution_ms)
             tail = engine.query_range(promql, dec.seam_ms, end_ms, step_ms,
                                       tenant=tenant, _skip_routing=True)
             from ..parallel.cluster import stitch_matrices
@@ -279,5 +348,6 @@ class RetentionRouter:
         with span(SPAN_QUERY_RETENTION, dataset=self.dataset,
                   resolution=label, stitched=False):
             self._count(label)
-            out = fam.query_instant(promql, time_ms, tenant=tenant)
+            out = fam.query_instant(promql, time_ms, tenant=tenant,
+                                    min_window_ms=override)
             return self._tag(out, label)

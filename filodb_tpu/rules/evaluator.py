@@ -30,13 +30,55 @@ RULES_TENANT = "__rules__"
 
 
 class RuleEvaluator:
-    def __init__(self, engine, publisher=None, alert_manager=None):
+    def __init__(self, engine, publisher=None, alert_manager=None,
+                 streaming: bool = False):
         self.engine = engine
         self.publisher = publisher
         self.alert_manager = alert_manager
+        # rules.streaming: rules consume per-step increments from a
+        # QuerySubscription (query/incremental.py) — the degenerate
+        # subscriber of the streaming-query machinery. Each tick takes its
+        # grid step; a catch-up span prefetches as ONE range query instead
+        # of one full-window evaluation per missed tick. Per-step
+        # independence makes the step bit-identical to the instant query
+        # it replaces; anything unbuffered falls back to the instant path.
+        self.streaming = bool(streaming)
+        self._subs: dict[str, object] = {}
         # rule uid -> {"health", "last_error", "last_eval_ms",
         #              "last_duration_ms"} for the /api/v1/rules payload
         self.status: dict[str, dict] = {}
+
+    def _sub_for(self, rule: RuleSpec, interval_ms: int):
+        sub = self._subs.get(rule.uid)
+        if sub is None or sub.step_ms != int(interval_ms):
+            from ..query.incremental import QuerySubscription
+            sub = QuerySubscription(self.engine, rule.expr, int(interval_ms),
+                                    tenant=RULES_TENANT)
+            self._subs[rule.uid] = sub
+        return sub
+
+    def prefetch(self, group: RuleGroupSpec, ticks: list[int]) -> None:
+        """Catch-up batcher (called by the scheduler before a multi-tick
+        span): buffer every pending step of every rule in one range query
+        per rule — the whole point of rules-as-subscribers."""
+        if not self.streaming or len(ticks) < 2:
+            return
+        for rule in group.rules:
+            self._sub_for(rule, group.interval_ms).prefetch(ticks[0],
+                                                            ticks[-1])
+
+    def _eval_series(self, rule: RuleSpec, eval_ts: int,
+                     interval_ms: int | None) -> list[tuple[dict, float]]:
+        """(labels, value) pairs at ``eval_ts`` — from the rule's streaming
+        subscription when enabled (bit-identical to the instant query by
+        per-step independence), else an instant query."""
+        if self.streaming and interval_ms:
+            got = self._sub_for(rule, interval_ms).take(int(eval_ts))
+            if got is not None:
+                return [(dict(key.labels), v) for key, v in got]
+        res = self.engine.query_instant(rule.expr, int(eval_ts),
+                                        tenant=RULES_TENANT)
+        return self._series_of(res, eval_ts)
 
     def _series_of(self, result, eval_ts: int) -> list[tuple[dict, float]]:
         """Instant-vector output as (labels, value) pairs; NaN points are
@@ -72,7 +114,8 @@ class RuleEvaluator:
             out.append((d, value))
         return out
 
-    def evaluate_rule(self, rule: RuleSpec, eval_ts: int) -> int:
+    def evaluate_rule(self, rule: RuleSpec, eval_ts: int,
+                      interval_ms: int | None = None) -> int:
         """Evaluate one rule at ``eval_ts``; returns derived rows written
         (0 for alerts). Failures count and re-raise — the group loop
         decides whether the tick's watermark advances."""
@@ -80,9 +123,7 @@ class RuleEvaluator:
         try:
             with span(SPAN_RULES_EVAL, group=rule.group, rule=rule.name,
                       eval_ts=int(eval_ts)):
-                res = self.engine.query_instant(rule.expr, int(eval_ts),
-                                                tenant=RULES_TENANT)
-                series = self._series_of(res, eval_ts)
+                series = self._eval_series(rule, eval_ts, interval_ms)
                 n = 0
                 if rule.kind == "record":
                     if self.publisher is not None:
@@ -119,7 +160,8 @@ class RuleEvaluator:
         failures = 0
         for rule in group.rules:
             try:
-                rows += self.evaluate_rule(rule, eval_ts)
+                rows += self.evaluate_rule(rule, eval_ts,
+                                           interval_ms=group.interval_ms)
             except Exception:  # noqa: BLE001 — counted per rule above; one
                 # bad rule must not starve the rest of its group
                 failures += 1

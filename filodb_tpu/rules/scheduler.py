@@ -116,6 +116,13 @@ class RuleGroupScheduler:
                 # so N groups spread over the interval instead of storming
                 # the engine together at the grid tick
                 if ticks and now >= ticks[0] + stagger:
+                    prefetch = getattr(self.evaluator, "prefetch", None)
+                    if len(ticks) > 1 and prefetch is not None:
+                        # catch-up span: one range query per rule buffers
+                        # every pending step (rules-as-subscribers) — the
+                        # per-tick loop below then consumes buffered steps,
+                        # keeping the per-tick watermark/pub-id discipline
+                        prefetch(group, ticks)
                     failed = False
                     for ts in ticks:
                         if self._stop_ev.is_set():

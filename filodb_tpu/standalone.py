@@ -14,6 +14,8 @@ import queue
 import threading
 import time
 
+import numpy as np
+
 from .config import Config, parse_duration_ms
 from .core.memstore import TimeSeriesMemStore
 from .core.store import FileColumnStore
@@ -872,7 +874,10 @@ class FiloServer:
                                    cluster_ops={
                                        "extra": self._cluster_extra,
                                        "rebalance": self.rebalance_shard,
-                                       "adopt": self.adopt_shard}).start()
+                                       "adopt": self.adopt_shard},
+                                   subscribe_poll_s=parse_duration_ms(
+                                       cfg["query.subscribe_poll"]) / 1000.0
+                                   ).start()
         if cfg.get("ingest.gateway_port") is not None:
             # Influx line-protocol gateway, config-wired: lines route to ALL
             # broker partitions (owned or not — the broker is global), or
@@ -1052,14 +1057,48 @@ class FiloServer:
                                         "downsample load failed for %s "
                                         "shard %s", fam, s)
                             if ms.shards_of(fam):
+                                # loaded-state fingerprint: when the durable
+                                # family data is UNCHANGED since the last
+                                # refresh, keep the serving engine (and its
+                                # warm result/fragment caches — the stitched
+                                # downsampled body stays cached across
+                                # dashboard ticks; a swap would reset the
+                                # epoch baseline and void every entry). The
+                                # value SUM makes it sensitive to in-place
+                                # bucket rewrites (late raw samples
+                                # re-downsampled into existing buckets keep
+                                # counts and lead unchanged); any surprise
+                                # reading it falls back to a plain swap —
+                                # staleness is the failure mode to avoid,
+                                # a dropped cache is just a warm-up
+                                fp = None
+                                try:
+                                    fp = tuple(sorted(
+                                        (s.shard_num, s.num_series,
+                                         int(getattr(s, "lead_ms", 0)),
+                                         int(s.store.n_host.sum()),
+                                         float(np.nansum(np.asarray(
+                                             s.store.snapshot_arrays()[1],
+                                             np.float64))))
+                                        for s in ms.shards_of(fam)
+                                        if s.store is not None))
+                                except Exception:  # noqa: BLE001 — see above
+                                    fp = None
+                                cur = self.engines.get(fam)
+                                if fp is not None and cur is not None \
+                                        and getattr(cur, "_serve_fingerprint",
+                                                    None) == fp:
+                                    continue
                                 # cluster-aware like the raw engine: leaves
                                 # for peer-owned shards dispatch to the peer's
                                 # serving view of the same family
-                                self.engines[fam] = QueryEngine(
+                                eng = QueryEngine(
                                     ms, fam, _mapper, cfg.query_config(),
                                     cluster=self.manager, node=self.node,
                                     endpoint_resolver=self._resolve_endpoint,
                                     route_dataset=_ds)
+                                eng._serve_fingerprint = fp
+                                self.engines[fam] = eng
                     except Exception:  # noqa: BLE001
                         log.exception("downsample serving refresh failed")
                     if self._ds_serve_stop.wait(serve_s):

@@ -62,6 +62,17 @@ FILODB_QUERY_FUSED_FALLBACK = "filodb_query_fused_fallback"
 FILODB_QUERY_NEGATIVE_CACHE_HITS = "filodb_query_negative_cache_hits"
 FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS = \
     "filodb_query_negative_cache_evictions"
+FILODB_QUERY_FRAGMENT_CACHE_HITS = "filodb_query_fragment_cache_hits"
+FILODB_QUERY_FRAGMENT_CACHE_MISSES = "filodb_query_fragment_cache_misses"
+FILODB_QUERY_FRAGMENT_CACHE_EXTENSIONS = \
+    "filodb_query_fragment_cache_extensions"
+FILODB_QUERY_FRAGMENT_CACHE_EVICTIONS = \
+    "filodb_query_fragment_cache_evictions"
+FILODB_QUERY_FRAGMENT_CACHE_INVALIDATIONS = \
+    "filodb_query_fragment_cache_invalidations"
+FILODB_QUERY_FRAGMENT_CACHE_BYTES = "filodb_query_fragment_cache_bytes"
+FILODB_QUERY_WINDOWS_WIDENED = "filodb_query_windows_widened"
+FILODB_QUERY_SUBSCRIBE_INCREMENTS = "filodb_query_subscribe_increments"
 FILODB_INGEST_PUBLISH_LATENCY_MS = "filodb_ingest_publish_latency_ms"
 FILODB_TRACE_SPANS = "filodb_trace_spans"
 FILODB_RETENTION_ROUTED_QUERIES = "filodb_retention_routed_queries"
@@ -198,6 +209,40 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
     FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS: (
         "counter", "Negative-cache entries dropped by TTL expiry or the "
                    "capacity bound (query.negative_cache_size)."),
+    FILODB_QUERY_FRAGMENT_CACHE_HITS: (
+        "counter", "Range queries that reused at least one provably-valid "
+                   "cached per-step column from the incremental fragment "
+                   "cache (query/incremental.py)."),
+    FILODB_QUERY_FRAGMENT_CACHE_MISSES: (
+        "counter", "Fragment-cache probes that reused nothing: no entry, "
+                   "off-grid request, a coverage gap, or every cached step "
+                   "past the stable-before bound."),
+    FILODB_QUERY_FRAGMENT_CACHE_EXTENSIONS: (
+        "counter", "Fragment entries extended by a delta evaluation: only "
+                   "the new head/tail steps executed, the overlap served "
+                   "from cache (the dashboard-refresh fast path)."),
+    FILODB_QUERY_FRAGMENT_CACHE_EVICTIONS: (
+        "counter", "Fragment entries dropped by the entry-count "
+                   "(query.fragment_cache_size) or total-byte "
+                   "(query.fragment_cache_bytes) bound."),
+    FILODB_QUERY_FRAGMENT_CACHE_INVALIDATIONS: (
+        "counter", "Fragment entries dropped because per-step validity "
+                   "could not be proven: destructive mutation "
+                   "(purge/eviction/age-out), an epoch-log gap, or a "
+                   "topology change since the entry's vector."),
+    FILODB_QUERY_FRAGMENT_CACHE_BYTES: (
+        "gauge", "Resident bytes of the fragment cache's per-step value "
+                 "columns (per-entry detail at "
+                 "/api/v1/debug/fragment_cache)."),
+    FILODB_QUERY_WINDOWS_WIDENED: (
+        "counter", "Windowed functions auto-widened on retention-routed "
+                   "queries because their window was narrower than the "
+                   "serving family's resolution (tagged dataset + "
+                   "resolution; also in per-query stats)."),
+    FILODB_QUERY_SUBSCRIBE_INCREMENTS: (
+        "counter", "Per-step increments served by the streaming "
+                   "subscription surface (/api/v1/subscribe long-poll and "
+                   "chunked modes), tagged by dataset."),
     FILODB_INGEST_PUBLISH_LATENCY_MS: (
         "histogram", "BrokerBus pipelined publish-group round trip per "
                      "partition, exemplar-tagged with the publish trace "

@@ -71,6 +71,8 @@ SPAN_REPLICATE = "ingest.replicate"
 SPAN_REPLICATE_SERVE = "ingest.replicate.serve"
 SPAN_INGEST_CONSUME = "ingest.consume"
 SPAN_QUERY_RETENTION = "query.retention"
+SPAN_QUERY_FRAGMENT = "query.fragment"
+SPAN_QUERY_SUBSCRIBE = "query.subscribe"
 SPAN_ODP_DURABLE = "query.odp.durable"
 SPAN_RULES_EVAL = "rules.eval"
 SPAN_CLUSTER_GOSSIP = "cluster.gossip"
@@ -118,6 +120,13 @@ TRACE_SPEC: dict[str, str] = {
                           "resolution decision and its routed/stitched "
                           "leg queries hang under it (tags: dataset, "
                           "resolution, stitched).",
+    SPAN_QUERY_FRAGMENT: "Incremental (delta) evaluation of one range "
+                         "query off the fragment cache: reused per-step "
+                         "columns + head/tail sub-executions hang under it "
+                         "(tags: dataset, reused, computed).",
+    SPAN_QUERY_SUBSCRIBE: "One streaming-subscription increment: the steps "
+                          "newly covered by the ingest watermarks since "
+                          "the subscriber's cursor (tags: dataset, steps).",
     SPAN_ODP_DURABLE: "Durable-tier chunk scan of one ODP page-in batch "
                       "(tags: shard, tier=local|remote, rows).",
     SPAN_RULES_EVAL: "One rule evaluation inside a scheduler tick (tags: "

@@ -1,0 +1,21 @@
+"""Fixture: a ``*Cache`` class with byte ACCOUNTING (variable-size
+entries) but no byte capacity must trip surface-cache-unbounded-bytes —
+its entry-count bound alone does not bound memory (the PR 13 fragment
+cache set the byte-bound contract)."""
+
+
+class BlobCache:
+    def __init__(self, capacity=32, evictions_counter=None):
+        self.capacity = capacity
+        self._evictions = evictions_counter
+        self._entries = {}
+        self._bytes = 0               # accounting without a bound
+
+    def put(self, key, blob):
+        self._entries[key] = blob
+        self._bytes += len(blob)
+        while len(self._entries) > self.capacity:
+            _, old = self._entries.popitem()
+            self._bytes -= len(old)
+            if self._evictions is not None:
+                self._evictions.increment()

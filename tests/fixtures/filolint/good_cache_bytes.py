@@ -1,0 +1,23 @@
+"""Fixture twin: the byte-accounting cache also declares a byte capacity
+and evicts against BOTH bounds — surface-cache-unbounded-bytes stays
+quiet."""
+
+
+class BlobCache:
+    def __init__(self, capacity=32, max_bytes=1 << 20,
+                 evictions_counter=None):
+        self.capacity = capacity
+        self.max_bytes = max_bytes
+        self._evictions = evictions_counter
+        self._entries = {}
+        self._bytes = 0
+
+    def put(self, key, blob):
+        self._entries[key] = blob
+        self._bytes += len(blob)
+        while len(self._entries) > self.capacity \
+                or self._bytes > self.max_bytes:
+            _, old = self._entries.popitem()
+            self._bytes -= len(old)
+            if self._evictions is not None:
+                self._evictions.increment()

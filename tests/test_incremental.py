@@ -1,0 +1,692 @@
+"""Incremental serving (ISSUE 14 tentpole): delta evaluation of cached
+per-step results + streaming queries.
+
+Covers: the stable_before per-step validity rule over shard epoch logs,
+FragmentCache probe/extension/bounds semantics, engine-level extension at
+bit parity with full re-execution — including under concurrent ingest
+landing MID-extension and across the raw/downsample stitch seam — plan
+gating (@ / sort never cached), auto-widened sub-resolution windows on
+routed queries, the epochs?log=1 peer surface, streaming increments
+(poll_increment / QuerySubscription / the /api/v1/subscribe endpoint),
+and the rules evaluator as a degenerate subscriber."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import (EPOCH_AFFECTS_ALL, StoreConfig,
+                                      TimeSeriesMemStore)
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.http.api import FiloHttpServer
+from filodb_tpu.query.engine import QueryConfig, QueryEngine
+from filodb_tpu.query.incremental import (FragmentCache, QuerySubscription,
+                                          STABLE_FOREVER, data_lead_ms,
+                                          plan_cacheable, poll_increment,
+                                          stable_before)
+
+START = 1_000_000
+IV = 10_000
+DS = "incr"
+
+
+def _cfg(**kw):
+    d = dict(max_series_per_shard=32, samples_per_series=512,
+             flush_batch_size=10**9, dtype="float64")
+    d.update(kw)
+    return StoreConfig(**d)
+
+
+def _ingest(ms, i, t0, n, metric="m", dataset=DS, shard=0):
+    b = RecordBuilder(GAUGE)
+    for t in range(t0, t0 + n):
+        b.add({"_metric_": metric, "host": f"h{i}", "dc": f"dc{i % 2}"},
+              START + t * IV, float(100.0 * (i + 1) + t))
+    ms.ingest(dataset, shard, b.build())
+
+
+def _single_node(n_series=4, cells=60, frag=16, **qkw):
+    ms = TimeSeriesMemStore()
+    ms.setup(DS, GAUGE, 0, _cfg())
+    for i in range(n_series):
+        _ingest(ms, i, 0, cells)
+    ms.flush_all()
+    eng = QueryEngine(ms, DS, config=QueryConfig(fragment_cache_size=frag,
+                                                 **qkw))
+    return ms, eng
+
+
+def _rendered(res):
+    """Per-series rendered output (what the HTTP layer serializes): NaN
+    points dropped, values compared at full f64 precision — the delta
+    path serves f64 copies of the same f32/f64 kernel outputs."""
+    return sorted(
+        (k.labels, ts.tolist(), np.asarray(v, np.float64).tolist())
+        for k, ts, v in res.matrix.to_host().iter_series())
+
+
+# ---------------------------------------------------------------- validity
+
+def test_stable_before_rules():
+    rec = (("local", 0, 3), ("local", 1, 5))
+    logs = {("local", "0"): [(3, 500)], ("local", "1"): [(5, 900)]}
+    # equal vectors: everything valid
+    assert stable_before(rec, rec, {}) == STABLE_FOREVER
+    # one append bump on shard 0 at min ts 700: steps < 700 stay valid
+    cur = (("local", 0, 4), ("local", 1, 5))
+    logs0 = {("local", "0"): [(3, 500), (4, 700)]}
+    assert stable_before(rec, cur, logs0) == 700
+    # bumps on BOTH shards: the minimum wins
+    cur2 = (("local", 0, 4), ("local", 1, 6))
+    logs2 = {("local", "0"): [(4, 700)], ("local", "1"): [(6, 650)]}
+    assert stable_before(rec, cur2, logs2) == 650
+    # a log gap (bump 4 missing) proves nothing
+    cur3 = (("local", 0, 5), ("local", 1, 5))
+    assert stable_before(rec, cur3, {("local", "0"): [(5, 700)]}) is None
+    # destructive bump: nothing provable
+    logs4 = {("local", "0"): [(4, EPOCH_AFFECTS_ALL)]}
+    assert stable_before(rec, cur, logs4) is None
+    # epoch went backward (restart) or topology changed
+    assert stable_before(rec, (("local", 0, 2), ("local", 1, 5)), logs0) \
+        is None
+    assert stable_before(rec, (("local", 0, 3),), logs0) is None
+
+
+def test_plan_cacheable_gates_at_and_sort():
+    from filodb_tpu.promql import parser as promql
+    ok = promql.query_to_logical_plan("sum(rate(m[2m]))", START,
+                                      START + 10 * IV, IV)
+    assert plan_cacheable(ok)
+    pinned = promql.query_to_logical_plan(f"sum(m @ {START // 1000})",
+                                          START, START + 10 * IV, IV)
+    assert not plan_cacheable(pinned)
+    srt = promql.query_to_logical_plan("sort(sum by (dc) (m))", START,
+                                       START + 10 * IV, IV)
+    assert not plan_cacheable(srt)
+
+
+# ---------------------------------------------------------------- cache unit
+
+def _entry_vec(e=1):
+    return (("local", 0, e),)
+
+
+def test_fragment_cache_probe_and_extension_shapes():
+    fc = FragmentCache(capacity=4)
+    step = 10
+    ts = np.arange(100, 200, step, dtype=np.int64)        # [100..190]
+    vals = np.arange(10, dtype=np.float64).reshape(1, 10)
+    fc.store(("q", step, None, None), ts, vals, [], [], _entry_vec(), step)
+    # shifted window [130, 240): overlap [130..190], tail [200, 240]
+    hit = fc.probe(("q", step, None, None), 130, 240, step, _entry_vec(), {})
+    assert hit is not None and hit.reused_steps == 7
+    assert hit.missing == [(200, 240)]
+    assert hit.keep_ts[0] == 100 and hit.keep_ts[-1] == 190
+    # off-grid phase: miss, entry kept
+    assert fc.probe(("q", step, None, None), 131, 240, step,
+                    _entry_vec(), {}) is None
+    assert len(fc) == 1
+    # gap past the entry: miss (a merged fragment would have a hole)
+    assert fc.probe(("q", step, None, None), 250, 300, step,
+                    _entry_vec(), {}) is None
+    # adjacency with zero overlap still extends (rules-subscriber growth)
+    hit = fc.probe(("q", step, None, None), 200, 200, step, _entry_vec(), {})
+    assert hit is not None and hit.reused_steps == 0
+    assert hit.missing == [(200, 200)]
+    # head-missing request older than the entry
+    hit = fc.probe(("q", step, None, None), 50, 150, step, _entry_vec(), {})
+    assert hit is not None and hit.missing == [(50, 90)]
+    # append bump invalidating steps >= 160: valid prefix [100..150]
+    cur = (("local", 0, 2),)
+    logs = {("local", "0"): [(2, 160)]}
+    hit = fc.probe(("q", step, None, None), 100, 190, step, cur, logs)
+    assert hit is not None
+    assert hit.keep_ts[-1] == 150 and hit.missing == [(160, 190)]
+    # destructive bump: entry dropped + invalidation counted
+    inv0 = fc.stats()["invalidations"]
+    logs = {("local", "0"): [(2, EPOCH_AFFECTS_ALL)]}
+    assert fc.probe(("q", step, None, None), 100, 190, step, cur,
+                    logs) is None
+    assert fc.stats()["invalidations"] == inv0 + 1
+    assert len(fc) == 0
+
+
+def test_fragment_cache_bounds_and_byte_accounting():
+    fc = FragmentCache(capacity=2, max_bytes=1 << 20, max_steps=8)
+    step = 10
+    for k in range(3):
+        ts = np.arange(0, 200, step, dtype=np.int64)
+        fc.store((f"q{k}", step, None, None), ts,
+                 np.zeros((2, 20)), [], [], _entry_vec(), step)
+    st = fc.stats()
+    assert st["size"] == 2 and st["evictions"] >= 1
+    # max_steps trims the HEAD (the sliding window's evicted side)
+    hit = fc.probe(("q2", step, None, None), 0, 190, step, _entry_vec(), {})
+    assert hit is not None and len(hit.keep_ts) == 8
+    assert hit.keep_ts[-1] == 190 and hit.keep_ts[0] == 120
+    # the byte bound evicts independently of the entry bound
+    fc2 = FragmentCache(capacity=16, max_bytes=2000)
+    for k in range(4):
+        fc2.store((f"b{k}", step, None, None),
+                  np.arange(0, 100, step, dtype=np.int64),
+                  np.zeros((1, 10)), [], [], _entry_vec(), step)
+    st2 = fc2.stats()
+    assert st2["bytes"] <= 2000 and st2["evictions"] >= 1
+    # an oversized single fragment is refused outright, old entry kept
+    fc2.store(("big", step, None, None),
+              np.arange(0, 10000, step, dtype=np.int64),
+              np.zeros((8, 1000)), [], [], _entry_vec(), step)
+    assert fc2.probe(("big", step, None, None), 0, 9990, step,
+                     _entry_vec(), {}) is None
+
+
+# ---------------------------------------------------------------- engine
+
+def test_extension_bit_parity_and_head_drop():
+    ms, eng = _single_node()
+    q = "sum by (dc) (rate(m[2m]))"
+    step = 30_000
+    s1, e1 = START + 300_000, START + 500_000
+    r1 = eng.query_range(q, s1, e1, step)
+    assert not (r1.exec_path or "").startswith("incremental")
+    # tail ingest, then the shifted window: head drops, only the tail runs
+    for i in range(4):
+        _ingest(ms, i, 60, 30)
+    ms.flush_all()
+    s2, e2 = s1 + 60_000, START + 800_000
+    r2 = eng.query_range(q, s2, e2, step)
+    assert (r2.exec_path or "").startswith("incremental["), r2.exec_path
+    assert r2.stats.to_dict()["fragment_steps_reused"] > 0
+    oracle = QueryEngine(ms, DS)
+    assert _rendered(r2) == _rendered(oracle.query_range(q, s2, e2, step))
+    st = eng.fragment_cache.stats()
+    assert st["hits"] >= 1 and st["extensions"] == 1
+    # an identical repeat with no ingest serves fully from the fragment
+    r3 = eng.query_range(q, s2, e2, step)
+    assert r3.exec_path == "fragment-cache[full]"
+    assert _rendered(r3) == _rendered(r2)
+
+
+def test_concurrent_ingest_mid_extension_stays_provable():
+    """The acceptance fixture: ingest lands MID-extension (after the epoch
+    state was captured, before the tail executed). The extension must not
+    record the racing rows as covered — the NEXT query re-validates
+    against the post-race epochs and must equal a cache-free oracle
+    bit-for-bit."""
+    ms, eng = _single_node()
+    q = "sum by (dc) (rate(m[2m]))"
+    step = 30_000
+    s1, e1 = START + 300_000, START + 500_000
+    eng.query_range(q, s1, e1, step)
+    for i in range(4):
+        _ingest(ms, i, 60, 10)
+    ms.flush_all()
+
+    fired = {"n": 0}
+    real = eng._exec_admitted
+
+    def racing_exec(plan, ctx, tenant):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            # a racing flush lands a NEW series whose samples fall inside
+            # the REUSED region — the cached steps the extension is about
+            # to serve are stale the instant this commits
+            _ingest(ms, 99, 30, 20)
+            ms.flush_all()
+        return real(plan, ctx, tenant)
+
+    eng._exec_admitted = racing_exec
+    s2, e2 = s1 + 60_000, START + 750_000
+    try:
+        r_mid = eng.query_range(q, s2, e2, step)
+    finally:
+        eng._exec_admitted = real
+    assert fired["n"] == 1
+    assert (r_mid.exec_path or "").startswith("incremental[")
+    # quiesced: the next query must invalidate whatever the race touched
+    # and land bit-identical to a cache-free engine over the final store
+    oracle = QueryEngine(ms, DS)
+    want = oracle.query_range(q, s2, e2, step)
+    r_after = eng.query_range(q, s2, e2, step)
+    assert _rendered(r_after) == _rendered(want)
+    # the race really changed the cached steps (else the test is vacuous):
+    # the mid-race serve reflects the pre-race capture, and the follow-up
+    # RE-COMPUTED the invalidated steps instead of serving the entry whole
+    assert _rendered(r_mid) != _rendered(want)
+    assert r_after.exec_path != "fragment-cache[full]"
+
+
+def test_destructive_mutation_invalidates_whole_entry():
+    ms, eng = _single_node()
+    q = "sum(rate(m[2m]))"
+    step = 30_000
+    s1, e1 = START + 300_000, START + 500_000
+    eng.query_range(q, s1, e1, step)
+    sh = ms.shard(DS, 0)
+    with sh.lock:
+        sh._release_partitions_locked(np.asarray([0], np.int32))
+    inv0 = eng.fragment_cache.stats()["invalidations"]
+    r = eng.query_range(q, s1 + 30_000, e1 + 30_000, step)
+    assert not (r.exec_path or "").startswith("incremental")
+    assert eng.fragment_cache.stats()["invalidations"] == inv0 + 1
+    oracle = QueryEngine(ms, DS)
+    assert _rendered(r) == _rendered(
+        oracle.query_range(q, s1 + 30_000, e1 + 30_000, step))
+
+
+def test_at_and_sort_results_never_stored():
+    _ms, eng = _single_node()
+    step = 30_000
+    s1, e1 = START + 300_000, START + 500_000
+    eng.query_range(f"sum(m @ {(START + 400_000) // 1000})", s1, e1, step)
+    eng.query_range("sort(sum by (dc) (m))", s1, e1, step)
+    assert len(eng.fragment_cache) == 0
+    eng.query_range("sum by (dc) (m)", s1, e1, step)
+    assert len(eng.fragment_cache) == 1
+
+
+def test_epoch_log_rides_the_epochs_endpoint():
+    ms, eng = _single_node()
+    srv = FiloHttpServer({DS: eng}, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/promql/{DS}/api/v1/epochs"
+        with urllib.request.urlopen(base) as r:
+            plain = json.load(r)["data"]
+        with urllib.request.urlopen(base + "?log=1") as r:
+            logged = json.load(r)["data"]
+        sh = ms.shard(DS, 0)
+        assert plain == {"0": sh.data_epoch}
+        ep, log = logged["0"]
+        assert ep == sh.data_epoch
+        assert [tuple(x) for x in log] == sh.epoch_state()[1]
+        assert log and log[-1][0] == ep
+        # append bumps record the staged batch's min data timestamp
+        _ingest(ms, 0, 60, 5)
+        ms.flush_all()
+        with urllib.request.urlopen(base + "?log=1") as r:
+            ep2, log2 = json.load(r)["data"]["0"]
+        assert ep2 == ep + 1
+        assert log2[-1] == [ep2, START + 60 * IV]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------- streaming
+
+def test_poll_increment_matches_posthoc_range():
+    ms, eng = _single_node(cells=30)
+    q = "sum by (dc) (rate(m[2m]))"
+    step = 30_000
+    since = (data_lead_ms(eng) // step) * step - step
+    first_since = since
+    pieces = []
+    for burst in range(3):
+        res, since = poll_increment(eng, q, step, since)
+        assert res is not None
+        pieces.append(res)
+        # no new data => no increment, cursor unchanged
+        res2, s2 = poll_increment(eng, q, step, since)
+        assert res2 is None and s2 == since
+        for i in range(4):
+            _ingest(ms, i, 30 + burst * 9, 9)
+        ms.flush_all()
+    res, since = poll_increment(eng, q, step, since)
+    pieces.append(res)
+    # concatenated increments == one post-hoc range query, bit-for-bit
+    oracle = QueryEngine(ms, DS)
+    want = oracle.query_range(q, first_since + step, since, step)
+    got = {}
+    for p in pieces:
+        for k, ts, v in p.matrix.to_host().iter_series():
+            a, b = got.setdefault(k.labels, ([], []))
+            a.extend(ts.tolist())
+            b.extend(np.asarray(v, np.float64).tolist())
+    want_d = {k.labels: (ts.tolist(),
+                         np.asarray(v, np.float64).tolist())
+              for k, ts, v in want.matrix.to_host().iter_series()}
+    assert got == want_d
+
+
+def test_subscription_watermark_is_query_visible_only():
+    """Staged-but-unflushed rows must NOT advance the streaming watermark:
+    an increment cut at the staged lead would serve its step without the
+    staged samples, and the forward-only cursor would never re-deliver."""
+    ms, eng = _single_node(cells=30)
+    lead0 = data_lead_ms(eng)
+    _ingest(ms, 0, 30, 10)            # staged only (huge flush_batch_size)
+    sh = ms.shard(DS, 0)
+    assert sh.lead_ms > lead0         # the STAGED lead did advance...
+    assert data_lead_ms(eng) == lead0  # ...but the visible one did not
+    ms.flush_all()
+    assert data_lead_ms(eng) == sh.lead_ms
+
+
+def test_poll_increment_clamps_stale_cursor():
+    """A zero/stale cursor (e.g. the empty-dataset default) must not
+    trigger an epoch-spanning range query: the increment is clamped to
+    the newest POLL_MAX_STEPS steps and the cursor skips the gap."""
+    from filodb_tpu.query.incremental import POLL_MAX_STEPS
+    ms, eng = _single_node(cells=30)
+    res, nxt = poll_increment(eng, "sum(m)", 30_000, 0)
+    assert res is not None
+    assert len(res.matrix.out_ts) <= POLL_MAX_STEPS
+    assert nxt == (data_lead_ms(eng) // 30_000) * 30_000
+    # and an empty dataset yields no increment at all — the poll waits
+    empty = TimeSeriesMemStore()
+    empty.setup(DS, GAUGE, 0, _cfg())
+    eng2 = QueryEngine(empty, DS)
+    assert poll_increment(eng2, "sum(m)", 30_000, 0) == (None, 0)
+
+
+def test_http_subscribe_longpoll_and_stream():
+    ms, eng = _single_node(cells=30)
+    srv = FiloHttpServer({DS: eng}, port=0, subscribe_poll_s=0.01).start()
+    try:
+        base = (f"http://127.0.0.1:{srv.port}/promql/{DS}/api/v1/subscribe"
+                "?query=sum(rate(m[2m]))&step=30")
+        with urllib.request.urlopen(base + "&timeout=5") as r:
+            body = json.load(r)
+        assert body["status"] == "success" and body["data"] is not None
+        assert body["data"]["resultType"] == "matrix"
+        nxt = body["next_since"]
+        # no new data: the long-poll returns an EMPTY increment at timeout
+        with urllib.request.urlopen(base + f"&since={nxt}&timeout=0.05") as r:
+            empty = json.load(r)
+        assert empty["data"] is None and empty["next_since"] == nxt
+        # new data arrives -> the next poll carries exactly the new steps,
+        # equal to the engine's own range query over them
+        for i in range(4):
+            _ingest(ms, i, 30, 6)
+        ms.flush_all()
+        with urllib.request.urlopen(base + f"&since={nxt}&timeout=5") as r:
+            inc = json.load(r)
+        assert inc["data"]["result"], inc
+        want = eng.query_range("sum(rate(m[2m]))", int(nxt * 1000) + 30_000,
+                               int(inc["next_since"] * 1000), 30_000)
+        from filodb_tpu.http.api import matrix_to_prom_json
+        assert inc["data"] == matrix_to_prom_json(want)
+        # chunked-style stream: ND-JSON lines as increments land
+        for i in range(4):
+            _ingest(ms, i, 36, 6)
+        ms.flush_all()
+        with urllib.request.urlopen(
+                base + f"&since={inc['next_since']}&timeout=0.5&mode=stream"
+                ) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            line = json.loads(r.readline())
+        assert line["data"]["result"]
+        assert line["next_since"] > inc["next_since"]
+    finally:
+        srv.stop()
+
+
+def test_query_subscription_take_prefetch_and_fallback():
+    ms, eng = _single_node(cells=60)
+    q = "sum by (dc) (m)"
+    step = 30_000
+    sub = QuerySubscription(eng, q, step, buffer_steps=8)
+    t0 = (data_lead_ms(eng) // step) * step
+    got = sub.take(t0)
+    want = eng.query_instant(q, t0)
+    assert sorted((k.labels, v) for k, v in got) == sorted(
+        (k.labels, float(np.asarray(vv)[-1]))
+        for k, _ts, vv in want.matrix.to_host().iter_series())
+    # prefetch buffers a catch-up span in ONE range query
+    calls = {"n": 0}
+    real = eng.query_range
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    eng.query_range = counting
+    try:
+        ticks = [t0 - 5 * step + k * step for k in range(5)]
+        sub2 = QuerySubscription(eng, q, step)
+        sub2.prefetch(ticks[0], ticks[-1])
+        assert calls["n"] == 1
+        for t in ticks:
+            assert sub2.take(t) is not None
+        assert calls["n"] == 1          # every tick came from the buffer
+    finally:
+        eng.query_range = real
+    # a step older than the (tiny) buffer falls back to None
+    for k in range(12):
+        sub.take(t0 - (11 - k) * step)
+    assert sub.take(t0 - 11 * step) is None
+
+
+def test_rules_streaming_evaluator_matches_instant():
+    from filodb_tpu.rules import DerivedSeriesPublisher, load_groups
+    from filodb_tpu.rules.evaluator import RuleEvaluator
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+    ms, eng = _single_node(cells=60)
+    groups = load_groups([{
+        "name": "g", "interval": "30s",
+        "rules": [{"record": "m:sum", "expr": "sum by (dc) (rate(m[2m]))"}],
+    }], 30_000)
+    rows_by_mode = {}
+    for streaming in (False, True):
+        rows = []
+
+        def pub(shard, container, pub_id, _rows=rows):
+            _rows.append((pub_id, sorted(
+                (tuple(sorted(ls.items())), float(v))
+                for ls, ts, v in zip(
+                    np.asarray(container.label_sets, dtype=object)[
+                        container.part_idx],
+                    container.ts, container.values))))
+
+        publisher = DerivedSeriesPublisher(GAUGE, ShardMapper(1), pub,
+                                           dataset=DS)
+        ev = RuleEvaluator(eng, publisher=publisher, streaming=streaming)
+        ticks = [START + 400_000 + k * 30_000 for k in range(4)]
+        if streaming:
+            ev.prefetch(groups[0], ticks)
+        for t in ticks:
+            ev.evaluate_group(groups[0], t)
+        rows_by_mode[streaming] = rows
+    # identical derived rows AND identical deterministic pub-ids tick by
+    # tick — the subscriber path preserves exactly-once replay semantics
+    assert rows_by_mode[True] == rows_by_mode[False]
+    assert rows_by_mode[True]
+
+
+# ------------------------------------------------------- retention seam
+
+M1, H1 = 60_000, 3_600_000
+
+
+def _tiers(tmp_path, frag=16):
+    """Raw + 1h downsample family with fragment caches on both engines
+    (the test_retention fixture shape, fragment-enabled)."""
+    from filodb_tpu.core.downsample import ds_family
+    from filodb_tpu.core.store import FileColumnStore
+    from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
+                                                   run_batch_downsample)
+    from filodb_tpu.query.retention import RetentionPolicy, RetentionRouter
+    sink = FileColumnStore(str(tmp_path / "chunks"))
+    n = 24 * 120
+    cfg = _cfg(samples_per_series=1 << 16, groups_per_shard=2)
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+    ts_arr = np.int64(START) + np.arange(n, dtype=np.int64) * 30_000
+    b = RecordBuilder(GAUGE)
+    for s in range(4):
+        b.add_batch({"_metric_": "m", "host": f"h{s}"}, ts_arr,
+                    np.cumsum(np.full(n, 1.0 + s)))
+    shard.ingest(b.build(), offset=0)
+    shard.flush_all_groups()
+    run_batch_downsample(sink, "prometheus", 0, H1)
+    fms = TimeSeriesMemStore()
+    load_downsampled(sink, "prometheus", 0, H1, "dAvg", fms)
+    fam = QueryEngine(fms, ds_family("prometheus", H1),
+                      config=QueryConfig(fragment_cache_size=frag))
+    raw = QueryEngine(ms, "prometheus",
+                      config=QueryConfig(fragment_cache_size=frag))
+    raw.retention = RetentionRouter(
+        RetentionPolicy([H1], raw_window_ms=2 * H1),
+        lambda r: fam if r == H1 else None, dataset="prometheus")
+    return ms, raw, fam, n
+
+
+def test_stitch_seam_body_stays_cached_while_tail_refreshes(tmp_path):
+    ms, raw, fam, n = _tiers(tmp_path)
+    lead = START + (n - 1) * 30_000
+    q = "avg_over_time(m[2h])"
+    s1, e1 = START + 2 * H1, lead
+    r1 = raw.query_range(q, s1, e1, H1)
+    assert "stitch" in (r1.exec_path or ""), r1.exec_path
+    # live tail ingest, then the slid window: the downsampled BODY serves
+    # from the family engine's fragment cache, only raw-side legs re-run
+    ts2 = np.int64(lead) + np.arange(1, 61, dtype=np.int64) * 30_000
+    b = RecordBuilder(GAUGE)
+    for s in range(4):
+        b.add_batch({"_metric_": "m", "host": f"h{s}"}, ts2,
+                    np.cumsum(np.full(60, 1.0 + s)) + (n * (1.0 + s)))
+    ms.shard("prometheus", 0).ingest(b.build(), offset=1)
+    ms.flush_all()
+    lead2 = int(ts2[-1])
+    s2, e2 = s1 + H1, lead2
+    fam_hits0 = fam.fragment_cache.stats()["hits"]
+    r2 = raw.query_range(q, s2, e2, H1)
+    assert "stitch" in (r2.exec_path or "")
+    assert fam.fragment_cache.stats()["hits"] > fam_hits0, \
+        "the downsampled body must reuse its cached fragment"
+    # bit parity vs a cache-free router over the SAME stores (a rebuilt
+    # fixture would miss the live tail ingested above)
+    from filodb_tpu.query.retention import RetentionRouter
+    oracle = QueryEngine(raw.memstore, "prometheus")
+    oracle.retention = RetentionRouter(
+        raw.retention.policy,
+        lambda r: (QueryEngine(fam.memstore, fam.dataset) if r == H1
+                   else None), dataset="prometheus")
+    want = oracle.query_range(q, s2, e2, H1)
+    assert _rendered(r2) == _rendered(want)
+
+
+# ---------------------------------------------------------- window widening
+
+def test_widen_windows_plan_transform():
+    from filodb_tpu.promql import parser as promql
+    from filodb_tpu.query import logical as L
+    from filodb_tpu.query.retention import widen_windows
+    plan = promql.query_to_logical_plan("sum(rate(m[1m]))", START,
+                                        START + 10 * H1, H1)
+    out, k = widen_windows(plan, H1)
+    assert k == 1
+    win = out.vectors
+    assert isinstance(win, L.PeriodicSeriesWithWindowing)
+    # two-sample fn: floor = TWO downsample buckets, selector range widened
+    assert win.window_ms == 2 * H1
+    orig = plan.vectors
+    assert win.series.range_selector.from_ms == \
+        orig.series.range_selector.from_ms - (2 * H1 - M1)
+    # one-sample fn floor = the resolution itself
+    plan2 = promql.query_to_logical_plan("avg_over_time(m[1m])", START,
+                                         START + 10 * H1, H1)
+    out2, k2 = widen_windows(plan2, H1)
+    assert k2 == 1 and out2.window_ms == H1
+    # already-wide windows untouched
+    plan3 = promql.query_to_logical_plan("sum(rate(m[4h]))", START,
+                                         START + 10 * H1, H1)
+    out3, k3 = widen_windows(plan3, H1)
+    assert k3 == 0 and out3 is plan3
+
+
+def test_routed_sub_resolution_window_auto_widens(tmp_path):
+    _ms, raw, _fam, n = _tiers(tmp_path)
+    lead = START + (n - 1) * 30_000
+    s, e = START + 2 * H1, lead - 3 * H1     # fully below the horizon
+    # a 1m rate window on a 1h family: before widening this was silently
+    # empty (zero samples per window on 1h-spaced data)
+    r = raw.query_range("sum(rate(m[1m]))", s, e, H1)
+    assert r.stats.resolution == "1h"
+    assert r.matrix.num_series > 0, "widening must un-empty the result"
+    assert r.stats.to_dict()["windows_widened"] == 1
+    assert any("widened" in w for w in r.warnings)
+    # equal to asking for the widened window explicitly
+    want = raw.query_range("sum(rate(m[2h]))", s, e, H1)
+    assert _rendered(r) == _rendered(want)
+    # the resolution override path widens instant queries the same way
+    ri = raw.query_instant("sum(rate(m[1m]))", e, resolution="1h")
+    assert ri.matrix.num_series > 0
+    assert ri.stats.to_dict()["windows_widened"] == 1
+
+
+# ------------------------------------------------------------- cluster form
+
+def test_peer_epoch_logs_validate_fragments():
+    """Two nodes: node a's fragment entries validate through node b's
+    ?log=1 epoch surface — peer-side append bumps keep old steps valid,
+    and an unreachable peer fails open to a miss."""
+    from filodb_tpu.parallel.cluster import ShardManager
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset(DS, 2)
+    owner = {s: mgr.node_of(DS, s) for s in (0, 1)}
+    if len(set(owner.values())) != 2:
+        pytest.skip("strategy assigned both shards to one node")
+    stores = {nn: TimeSeriesMemStore() for nn in ("a", "b")}
+    for s in (0, 1):
+        for nn in ("a", "b"):
+            stores[nn].setup(DS, GAUGE, s, _cfg())
+    for i in range(8):
+        for nn in ("a", "b"):
+            _ingest(stores[nn], i, 0, 60, shard=i % 2)
+    for msn in stores.values():
+        msn.flush_all()
+    eps: dict[str, str] = {}
+    engines = {
+        "a": QueryEngine(stores["a"], DS, ShardMapper(2), cluster=mgr,
+                         node="a", endpoint_resolver=eps.get,
+                         config=QueryConfig(fragment_cache_size=8)),
+        "b": QueryEngine(stores["b"], DS, ShardMapper(2), cluster=mgr,
+                         node="b", endpoint_resolver=eps.get),
+    }
+    servers = {nn: FiloHttpServer({DS: engines[nn]}, port=0).start()
+               for nn in ("a", "b")}
+    for nn, srv in servers.items():
+        eps[nn] = f"127.0.0.1:{srv.port}"
+    try:
+        eng = engines["a"]
+        q = "sum by (dc) (rate(m[2m]))"
+        step = 30_000
+        s1, e1 = START + 300_000, START + 500_000
+        eng.query_range(q, s1, e1, step)
+        vec, logs = eng._epoch_state(with_logs=True)
+        assert any(part[0] not in ("local",) for part in vec)
+        assert any(k[0] != "local" for k in logs)
+        # tail ingest on BOTH replicas of every shard (the two-store
+        # convention of the remote-exec fixtures) — peer epochs advance,
+        # but the new samples are provably newer than the cached steps
+        for i in range(8):
+            for nn in ("a", "b"):
+                _ingest(stores[nn], i, 60, 20, shard=i % 2)
+        for msn in stores.values():
+            msn.flush_all()
+        s2, e2 = s1 + 60_000, START + 700_000
+        r2 = eng.query_range(q, s2, e2, step)
+        assert (r2.exec_path or "").startswith("incremental["), r2.exec_path
+        oracle_ms = TimeSeriesMemStore()
+        for s in (0, 1):
+            oracle_ms.setup(DS, GAUGE, s, _cfg())
+        for i in range(8):
+            _ingest(oracle_ms, i, 0, 60, shard=i % 2)
+            _ingest(oracle_ms, i, 60, 20, shard=i % 2)
+        oracle_ms.flush_all()
+        oracle = QueryEngine(oracle_ms, DS, ShardMapper(2))
+        assert _rendered(r2) == _rendered(
+            oracle.query_range(q, s2, e2, step))
+        # unreachable peer: the state is unverifiable — (None, None), which
+        # every cache layer treats as a miss (probe() unit-covers that) and
+        # nothing stores against
+        eng.endpoint_resolver = lambda node: "127.0.0.1:1"
+        assert eng._epoch_state(with_logs=True) == (None, None)
+    finally:
+        for srv in servers.values():
+            srv.stop()

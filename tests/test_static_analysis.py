@@ -69,6 +69,10 @@ BAD_FIXTURES = {
     # bound and eviction accounting (plan cache / result cache set the bar)
     "bad_bounded_cache.py": {"surface-cache-unbounded",
                              "surface-cache-no-eviction-metric"},
+    # PR 13: byte-bound extension — a cache that accounts bytes holds
+    # variable-size entries and must also declare a byte capacity (the
+    # incremental fragment cache set this contract)
+    "bad_cache_bytes.py": {"surface-cache-unbounded-bytes"},
 }
 
 
