@@ -14,6 +14,7 @@ from pathlib import Path
 from .callgraph import PackageIndex
 from .exceptcheck import ExceptChecker
 from .findings import Baseline, Finding, is_suppressed, load_suppressions
+from .indexcheck import IndexChecker
 from .jitcheck import JitChecker
 from .lockcheck import LockChecker
 from .resourcecheck import ResourceChecker
@@ -26,7 +27,7 @@ DEFAULT_EXCLUDES = ("remote_storage_pb2.py",)
 ALL_RULES = tuple(sorted(
     set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)
     | set(ResourceChecker.rules) | set(ExceptChecker.rules)
-    | set(SurfaceChecker.rules)))
+    | set(SurfaceChecker.rules) | set(IndexChecker.rules)))
 
 DEFAULT_BASELINE = "filolint_baseline.json"
 
@@ -100,7 +101,7 @@ def _default_checkers(wire_spec: dict | None = None, full_scope: bool = True):
     surface = SurfaceChecker()
     surface.full_scope = full_scope
     return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec),
-            ResourceChecker(), ExceptChecker(), surface]
+            ResourceChecker(), ExceptChecker(), IndexChecker(), surface]
 
 
 def _finalize(checkers, modules: dict) -> list[Finding]:

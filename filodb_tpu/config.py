@@ -66,6 +66,30 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "bool", False,
         "Keep an i16 mirror ALONGSIDE raw f32 (bandwidth, not capacity); "
         "ignored when compressed_residency is active."),
+    "index.persist": (
+        "bool", True,
+        "Persist the part-key index as columnar time-bucket frames "
+        "(index.log, CRC-verified) beside the JSON part-key log, so a "
+        "restarted shard recovers the index with bulk array loads instead "
+        "of a per-key rebuild."),
+    "index.time_bucket": (
+        "duration", "6h",
+        "Granularity of persisted index time buckets (creations group by "
+        "series start time; tombstones ride a dedicated bucket)."),
+    "index.max_series_per_tenant": (
+        "int|null", None,
+        "Per-tenant ACTIVE-series quota: a tenant at the limit cannot "
+        "birth new part keys — the shard sheds the new series (typed "
+        "RETRY at the gateway, 429 + Retry-After at remote-write) while "
+        "samples for existing series always land (null = unlimited)."),
+    "index.tenant_label": (
+        "str", "_ws_",
+        "Label whose value is the tenant identity for cardinality "
+        "governance (the workspace label by default)."),
+    "index.quota_retry_after": (
+        "duration", "30s",
+        "Retry-After hint returned with a cardinality-quota 429 (series "
+        "churn out on purge/eviction, so retries eventually land)."),
     "query.stale_sample_after": ("duration", "5m",
                                  "Prometheus staleness window."),
     "query.sample_limit": ("int", 1_000_000,

@@ -65,7 +65,7 @@ _MAX_HEADER = 1 << 16             # refuse absurd frames instead of OOMing
 _MAX_PAYLOAD = 256 << 20
 
 _ALLOWED = {"chunks.log", "partkeys.log", "meta.json", "checkpoint.json",
-            "chunks.log.rewrite"}
+            "chunks.log.rewrite", "index.log"}
 
 
 class StoreServer:
@@ -289,6 +289,18 @@ class RemoteStore(ChunkSink):
             for pid, labels, start in entries)
         self._request(OP_APPEND, dataset, shard, "partkeys.log", lines.encode())
 
+    def write_index_bucket(self, dataset, shard, frame: bytes):
+        # CRC-verified append: a frame damaged in flight is refused by the
+        # server, and the frame's OWN crc (inside the payload) still guards
+        # the at-rest bytes at recovery time
+        self._request(OP_APPEND_CRC, dataset, shard, "index.log", frame,
+                      crc=zlib.crc32(frame))
+
+    def read_index_frames(self, dataset, shard):
+        from .store import iter_index_frames
+        blob = self._request(OP_GET, dataset, shard, "index.log")
+        yield from iter_index_frames(io.BytesIO(blob))
+
     def write_meta(self, dataset, shard, meta: dict):
         self._request(OP_PUT, dataset, shard, "meta.json",
                       json.dumps(meta).encode())
@@ -427,12 +439,14 @@ class ReplicatedColumnStore(ChunkSink):
 
     WRITE_ATTEMPTS = 2     # per-replica retries before the write is skipped
     # writes safe to re-send to the SAME replica: meta/checkpoint replace
-    # atomically and part-key events dedup at recovery (latest-per-pid wins).
+    # atomically, and part-key / index-bucket events dedup at recovery
+    # (latest-per-pid wins, so a duplicated frame replays identically).
     # Chunk appends are NOT here — a lost response after a server-side apply
     # would duplicate the frame in that replica's log; they get one attempt
     # per replica and rely on cross-replica failover instead
     _IDEMPOTENT_WRITES = frozenset({"write_meta", "write_checkpoint",
-                                    "write_part_keys"})
+                                    "write_part_keys",
+                                    "write_index_bucket"})
 
     def __init__(self, backends: list, replication: int = 2):
         assert backends, "need at least one backend"
@@ -570,6 +584,55 @@ class ReplicatedColumnStore(ChunkSink):
     def read_part_keys(self, dataset, shard):
         results = self._read_all(dataset, shard, "read_part_keys")
         return max((res or [] for _b, res in results), key=len)
+
+    def write_index_bucket(self, dataset, shard, frame: bytes):
+        self._write(dataset, shard, "write_index_bucket", frame)
+
+    def read_index_frames(self, dataset, shard):
+        """Best-replica read of the index time buckets, trust-aware: a
+        replica's log is only usable when a GENESIS frame follows its last
+        RETIRE marker, and reachable replicas must AGREE on that — a
+        sibling that missed a RETIRE write (gappy outage) could otherwise
+        win the entry-count race and resurrect a stale log. On
+        disagreement this returns an empty list, which recovery treats as
+        untrusted (partkeys.log fallback — never a silent loss). Among
+        agreeing-trusted replicas, the one holding the most index EVENTS
+        wins."""
+        from .store import INDEX_GENESIS_BUCKET, INDEX_RETIRE_BUCKET
+        backends = [b for b in self._replicas(dataset, shard)
+                    if hasattr(b, "read_index_frames")]
+        if not backends:
+            return []
+        results = []
+        last_err = None
+        for b in backends:
+            try:
+                results.append(list(b.read_index_frames(dataset, shard)))
+            except Exception as e:  # noqa: BLE001 - fail over
+                last_err = e
+                self._count_failover("read_index_frames")
+                log.warning("replica index read failed on %r: %s", b, e)
+        if not results:
+            raise IOError("all replicas failed") from last_err
+
+        def trusted(fr) -> bool:
+            gen_at = retire_at = -1
+            for i, frame in enumerate(fr):
+                if frame[0] == INDEX_GENESIS_BUCKET:
+                    gen_at = i
+                elif frame[0] == INDEX_RETIRE_BUCKET:
+                    retire_at = i
+            return gen_at >= 0 and gen_at > retire_at
+
+        verdicts = [trusted(fr) for fr in results]
+        if not all(verdicts):
+            if any(verdicts):
+                log.warning("index.log replicas disagree on trust anchors "
+                            "for %s shard %s; forcing partkeys.log fallback",
+                            dataset, shard)
+            return []
+        return max(results,
+                   key=lambda fr: sum(len(frame[1]) for frame in fr))
 
     def read_meta(self, dataset, shard) -> dict:
         for _b, res in self._read_all(dataset, shard, "read_meta"):

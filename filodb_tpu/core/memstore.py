@@ -39,12 +39,29 @@ from .eviction import BloomFilter, CapacityEvictionPolicy, EvictionPolicy
 from .filters import Filter
 from .partkey_index import PartKeyIndex
 from .record import RecordContainer
-from .schemas import Schema, Schemas, part_key_of
-from .store import ChunkSetRecord, ChunkSink
+from .schemas import Schema, Schemas, part_key_bytes, part_key_of
+from .store import (INDEX_FLAG_UNPARSEABLE, INDEX_GENESIS_BUCKET,
+                    INDEX_RETIRE_BUCKET, INDEX_TOMBSTONE_BUCKET,
+                    ChunkSetRecord, ChunkSink, encode_index_bucket,
+                    labels_from_blob)
 from ..utils.diagnostics import TimedRLock, assert_owned
-from ..utils.metrics import (FILODB_RETENTION_AGED_OUT_ROWS,
+from ..utils.metrics import (FILODB_INDEX_PERSISTED_BUCKETS,
+                             FILODB_INDEX_RECOVER_MS,
+                             FILODB_RETENTION_AGED_OUT_ROWS,
                              FILODB_RETENTION_ODP_ROWS, registry)
 from ..utils.tracing import SPAN_ODP_DURABLE, span
+
+# _create_series_locked outcome distinct from "blocked, stage prefix first"
+# (None): the tenant's cardinality quota shed this NEW series — the caller
+# skips its samples (existing series are never affected)
+SHED_PID = -2
+
+# default granularity of persisted index time buckets (index.time_bucket)
+DEFAULT_INDEX_BUCKET_MS = 6 * 3600 * 1000
+
+# dense live runs at least this long load via ONE columnar bulk add at
+# recovery; shorter runs stay per-key (bulk setup costs more than it saves)
+RECOVER_BULK_MIN = 256
 
 
 @dataclass
@@ -104,6 +121,9 @@ class ShardStats:
     partitions_purged: int = 0
     partitions_evicted: int = 0
     evicted_part_key_reingests: int = 0
+    # NEW series births shed by the per-tenant cardinality limiter (their
+    # samples dropped WITH the birth; existing-series samples always land)
+    series_quota_shed: int = 0
 
 
 class TimeSeriesShard:
@@ -170,6 +190,11 @@ class TimeSeriesShard:
         # visible) lead would serve a step without its samples and never
         # re-deliver it (the cursor only moves forward)
         self.visible_lead_ms = 0
+        # True while recover() is rebuilding this shard (queries are
+        # admitted during recovery, but an empty selection seen in the
+        # window must not be CACHED as proof of emptiness — the negative
+        # cache consults this; ref: RecoveryInProgress status)
+        self.recovering = False
         # purged slots available for reuse + membership filter of evicted keys
         # (ref: TimeSeriesShard evictedPartKeys bloom :93-96, checked on ingest :1092)
         self._free_pids: list[int] = []
@@ -247,6 +272,21 @@ class TimeSeriesShard:
         # inline downsampling at flush (ref: ShardDownsampler + DownsamplePublisher):
         # (resolution_ms, callback(shard, {agg: (pids, ts, vals)}))
         self.downsample: tuple | None = None
+        # ingest cardinality governance (core/cardinality.py): per-tenant
+        # active-series accounting + birth limiter, shared per dataset; the
+        # shard consults it under its own lock at every series creation
+        self.governor = None
+        # durable index time buckets (index.time_bucket; 0 disables): the
+        # part-key log drain also appends columnar index frames so a
+        # restarted shard recovers the index from the ring instead of
+        # rebuilding per key (ref: persisted Lucene time-bucket blobs)
+        self.index_bucket_ms = DEFAULT_INDEX_BUCKET_MS
+        # True once index.log carries a GENESIS snapshot covering this
+        # shard's full history (written at the first drain of a fresh
+        # shard, or after a recovery that had to fall back to
+        # partkeys.log) — recovery only trusts the log from its last
+        # genesis marker, so an upgraded/toggled shard never loses series
+        self._index_log_seeded = False
         self.stats = ShardStats()
 
     # -- partition resolution ----------------------------------------------
@@ -293,7 +333,8 @@ class TimeSeriesShard:
                     if pid is None:
                         return j   # blocked on this container's own series
                 mapping[j] = pid
-                protected.add(pid)
+                if pid >= 0:       # SHED_PID: quota-shed birth, no slot
+                    protected.add(pid)
                 i = j + 1
                 if self._release_epoch != epoch0 and i < n_sets:
                     break          # eviction ran: re-probe the tail
@@ -331,6 +372,18 @@ class TimeSeriesShard:
         # may repeat a key — the per-key path dedups those; bulk cannot
         if len(set(new_keys)) != len(new_keys):
             return False
+        gov_tenant = None
+        if self.governor is not None and self.governor.limit is not None:
+            # all-or-nothing block reservation; mixed-tenant batches (or a
+            # batch that does not fit) take the per-key path, which sheds
+            # series-precisely
+            tenants = {self.governor.tenant_of(label_sets[seg + int(j)])
+                       for j in miss}
+            if len(tenants) != 1:
+                return False
+            gov_tenant = tenants.pop()
+            if not self.governor.admit_block(gov_tenant, len(miss)):
+                return False
         # columnar fast path: the builder's per-label columns skip pair-bytes
         # parsing entirely (one dict probe per value); only valid when the
         # whole container is new series (columns align 1:1 with the misses)
@@ -346,6 +399,8 @@ class TimeSeriesShard:
                                       count=len(miss))
             if not self.index.add_part_keys_bulk(new_pids, new_keys, first_ts,
                                                  counts_hint=counts_hint):
+                if gov_tenant is not None:   # reservation rolls back with us
+                    self.governor.retire(gov_tenant, len(miss))
                 return False
         pid_list = new_pids.tolist()
         self._part_key_to_id.update(zip(new_keys, pid_list))
@@ -392,8 +447,27 @@ class TimeSeriesShard:
         pid = self._part_key_to_id.get(pk)
         if pid is not None:
             return pid
+        gov_tenant = None
+        if self.governor is not None:
+            # series-birth limiter: a tenant at quota sheds the NEW part key
+            # (and only it) — samples for existing series are unaffected,
+            # which is the whole multi-tenant point (a noisy tenant's label
+            # explosion must not evict everyone else's series). Checked
+            # BEFORE eviction work so an over-quota birth never evicts
+            # someone else's series to make room it will not use.
+            gov_tenant = self.governor.tenant_of(labels)
+            if not self.governor.admit(gov_tenant):
+                self.stats.series_quota_shed += 1
+                self.governor.count_shed("shard", gov_tenant)
+                return SHED_PID
         if not self._free_pids and len(self.index) >= S:
             if not self._ensure_free_space_locked(protected):
+                # creation BLOCKED (caller stages its prefix and retries,
+                # re-admitting then): the reservation must roll back or
+                # every blocked attempt permanently inflates the tenant's
+                # active count
+                if gov_tenant is not None:
+                    self.governor.retire(gov_tenant)
                 return None
         if pk in self._evicted_keys:
             self.stats.evicted_part_key_reingests += 1
@@ -470,6 +544,12 @@ class TimeSeriesShard:
         # result-cache watermark: data gone (destructive — a released
         # series held samples at arbitrary timestamps)
         self._bump_epoch_locked(EPOCH_AFFECTS_ALL)
+        if self.governor is not None:
+            # labels still resolve here (the index tombstones below):
+            # churned-out series release their tenant's quota slots
+            for pid in pid_list:
+                self.governor.retire(
+                    self.governor.tenant_of(self.index.labels_of(pid)))
         for pid in pid_list:
             pk = self._part_key_of_id.pop(pid, None)
             if pk is not None:
@@ -535,6 +615,13 @@ class TimeSeriesShard:
                         pid, seq, i, start = e
                         labels = seq[i]
                     rows.append((int(pid), labels, int(start)))
+                # index time buckets FIRST, then the JSON part-key log: a
+                # crash between the two leaves index.log AHEAD (extra events
+                # replay idempotently, latest-per-pid wins), never behind —
+                # so recovery may trust the columnar log whenever present.
+                # A failed write requeues the whole batch; the retry's
+                # duplicate frames dedup the same way.
+                self._persist_index_buckets(rows)
                 self.sink.write_part_keys(self.dataset, self.shard_num, rows)
             except Exception:
                 # transient sink failure: the events must survive for retry —
@@ -542,6 +629,72 @@ class TimeSeriesShard:
                 with self.lock:
                     self._partkey_log = log + self._partkey_log
                 raise
+
+    @staticmethod
+    def _index_entry(pid: int, labels: dict, start: int) -> tuple:
+        """(pid, start, blob, flags) for one index.log entry. Labels the
+        pair encoding cannot represent (NUL in a name/value, the pair
+        separator in a name) get the UNPARSEABLE flag — recovery then
+        refuses the whole frames path instead of loading split garbage."""
+        for k, v in labels.items():
+            if "\x00" in k or "\x00" in v or "\x01" in k:
+                return (pid, start, b"", INDEX_FLAG_UNPARSEABLE)
+        return (pid, start, part_key_bytes(sorted(labels.items()), ()), 0)
+
+    def _write_index_genesis(self) -> None:
+        """Append a GENESIS frame: a complete live-series snapshot, the
+        trust anchor recovery applies the log from. Written once per shard
+        lifetime — at the first drain of a fresh shard, or right after a
+        recovery that had to rebuild from partkeys.log (upgraded shard,
+        persistence toggled back on). Caller holds ``_sink_lock`` or is
+        single-threaded recovery; takes the shard lock for the snapshot
+        (sink < shard is the declared order)."""
+        with self.lock:
+            snapshot = [self._index_entry(pid, self.index.labels_of(pid),
+                                          self.index.start_time(pid))
+                        for pid in sorted(self._part_key_of_id)]
+        self.sink.write_index_bucket(
+            self.dataset, self.shard_num,
+            encode_index_bucket(INDEX_GENESIS_BUCKET, snapshot))
+        self._index_log_seeded = True
+
+    def _persist_index_buckets(self, rows) -> None:
+        """Append columnar index frames for one part-key drain batch,
+        grouped into CONSECUTIVE same-bucket runs (dict-grouping could
+        reorder a tombstone past a slot-reusing re-creation inside one
+        batch — event order is what last-entry-wins recovery relies on).
+        Creations bucket by their start time; tombstones ride the
+        dedicated tombstone pseudo-bucket."""
+        if not self.index_bucket_ms \
+                or not hasattr(self.sink, "write_index_bucket"):
+            return
+        if not self._index_log_seeded:
+            self._write_index_genesis()
+        frames: list[bytes] = []
+        cur_bucket: int | None = None
+        cur: list[tuple] = []
+        for pid, labels, start in rows:
+            if labels:
+                entry = self._index_entry(pid, labels, start)
+                bucket = (start // self.index_bucket_ms) \
+                    * self.index_bucket_ms
+            else:
+                entry = (pid, start, b"", 0)
+                bucket = INDEX_TOMBSTONE_BUCKET
+            if bucket != cur_bucket and cur:
+                frames.append(encode_index_bucket(cur_bucket, cur))
+                cur = []
+            cur_bucket = bucket
+            cur.append(entry)
+        if cur:
+            frames.append(encode_index_bucket(cur_bucket, cur))
+        for frame in frames:
+            self.sink.write_index_bucket(self.dataset, self.shard_num, frame)
+        if frames:
+            registry.counter(FILODB_INDEX_PERSISTED_BUCKETS,
+                             {"dataset": self.dataset,
+                              "shard": str(self.shard_num)}) \
+                .increment(len(frames))
 
     # -- ingest -------------------------------------------------------------
 
@@ -617,6 +770,11 @@ class TimeSeriesShard:
             sel = (container.part_idx >= start) & (container.part_idx < done)
             pids = mapping[container.part_idx[sel]]
             ts, vals = container.ts[sel], container.values[sel]
+        if len(pids) and pids.min() < 0:
+            # quota-shed births (SHED_PID): drop exactly their samples —
+            # every other series in the container lands normally
+            keep = pids >= 0
+            pids, ts, vals = pids[keep], ts[keep], vals[keep]
         if recovery_watermarks is not None:
             keep = recovery_watermarks[pids % self.config.groups_per_shard] < offset
             if not keep.all():
@@ -876,6 +1034,17 @@ class TimeSeriesShard:
         ``accept(container)`` filters replayed containers when several
         shards share one broker partition (IngestionConsumer demux)."""
         assert self.sink is not None and len(self.index) == 0
+        # queries admitted mid-recovery see a PARTIAL shard: flagged so the
+        # serving layer never caches an in-window empty selection as proof
+        # of emptiness (the TTL negative cache would otherwise mask the
+        # recovered data for its whole TTL — a restart-then-404 incident)
+        self.recovering = True
+        try:
+            return self._recover_inner(bus, schemas, on_chunks_loaded, accept)
+        finally:
+            self.recovering = False
+
+    def _recover_inner(self, bus, schemas, on_chunks_loaded, accept) -> int:
         if self.store is None and (self.schema.is_histogram
                                    or self.schema.is_multi_column):
             meta = self.sink.read_meta(self.dataset, self.shard_num) \
@@ -893,13 +1062,72 @@ class TimeSeriesShard:
                     self.store = self._make_store()
                     self.store.owner_lock = self.lock
         # 1. part keys -> index (ids dense in creation order; a purged slot may
-        #    have been re-persisted under a new series — the last entry wins)
-        latest: dict[int, tuple[dict, int]] = {}
-        last_live_pk: dict[int, bytes] = {}   # most recent real owner of a slot
-        for pid, labels, start in self.sink.read_part_keys(self.dataset, self.shard_num) or ():
-            latest[pid] = (labels, start)
-            if labels:
-                last_live_pk[pid] = part_key_of(labels, self.schema.options)
+        #    have been re-persisted under a new series — the last entry wins).
+        #    The durable index time buckets (index.log) are the FAST path:
+        #    columnar frames load back through bulk array adds; partkeys.log
+        #    (per-key JSON) stays the fallback for sinks/logs without them.
+        #    Either way the duration lands in filodb_index_recover_ms.
+        import time as _time
+        t0_index = _time.perf_counter()
+        # pid -> (labels | None, label blob | None, start); blobs parse
+        # lazily — the bulk load consumes them as canonical key bytes
+        latest: dict[int, tuple[dict | None, bytes | None, int]] = {}
+        last_live: dict[int, tuple[dict | None, bytes | None]] = {}
+        frames_reader = getattr(self.sink, "read_index_frames", None)
+        used_frames = False
+        if frames_reader is not None and self.index_bucket_ms:
+            try:
+                frames = list(frames_reader(self.dataset,
+                                            self.shard_num) or ())
+                # trust window: the log is authoritative only from its
+                # LAST genesis snapshot, and only when no RETIRE marker
+                # (a persistence-off recovery ran since) supersedes it —
+                # an upgraded or toggled shard whose log misses history
+                # must fall back, never silently lose series
+                gen_at = retire_at = -1
+                for fi, fr in enumerate(frames):
+                    if fr[0] == INDEX_GENESIS_BUCKET:
+                        gen_at = fi
+                    elif fr[0] == INDEX_RETIRE_BUCKET:
+                        retire_at = fi
+                trusted = gen_at >= 0 and gen_at > retire_at
+                for fr in (frames[gen_at:] if trusted else ()):
+                    _bucket, fpids, fstarts, fblobs, fflags = fr
+                    if len(fflags) \
+                            and (fflags & INDEX_FLAG_UNPARSEABLE).any():
+                        trusted = False     # placeholder entries: the pair
+                        break               # encoding could not hold them
+                    for pid, start, blob in zip(fpids.tolist(),
+                                                fstarts.tolist(), fblobs):
+                        latest[pid] = (None, blob, start)
+                        if blob:
+                            last_live[pid] = (None, blob)
+                if trusted and latest:
+                    used_frames = True
+                    self._index_log_seeded = True
+                else:
+                    latest.clear()
+                    last_live.clear()
+            except Exception:
+                log.warning("index.log recovery failed; rebuilding from "
+                            "partkeys.log", exc_info=True)
+                latest.clear()
+                last_live.clear()
+        if not used_frames:
+            for pid, labels, start in self.sink.read_part_keys(
+                    self.dataset, self.shard_num) or ():
+                latest[pid] = (labels, None, start)
+                if labels:
+                    last_live[pid] = (labels, None)
+        opts = self.schema.options
+
+        def _pk_and_labels(labels, blob):
+            if labels is None:
+                labels = labels_from_blob(blob)
+            if blob and not opts.ignore_shard_key_tags:
+                return blob, labels      # full-label blob IS the part key
+            return part_key_of(labels, opts), labels
+
         # queries are admitted while recovery streams in (the reference serves
         # partial data during RecoveryInProgress), so index and store
         # mutations take the shard lock like any ingest would — an unlocked
@@ -907,23 +1135,67 @@ class TimeSeriesShard:
         # has already captured
         with self.lock:
             recovered_keys: list[tuple[int, bytes]] = []
-            for pid in sorted(latest):
+            items = [(pid,) + latest[pid] for pid in sorted(latest)]
+            # bulk-loadable only when the blob doubles as the canonical key
+            # (no ignored tags: add_part_keys_bulk derives index labels FROM
+            # the key bytes, which must then carry every label)
+            can_bulk = used_frames and not opts.ignore_shard_key_tags
+            i = 0
+            while i < len(items):
+                pid, labels, blob, start = items[i]
                 while len(self.index) < pid:   # gap: entry lost; free hole
                     hole = len(self.index)
                     self.index.add_part_key(hole, {}, 0, end_time=-1)
                     self._free_pids.append(hole)
-                labels, start = latest[pid]
-                if not labels:             # purge tombstone won: slot is free
+                if not labels and not blob:    # tombstone won: slot is free
                     self.index.add_part_key(pid, {}, 0, end_time=-1)
                     self._free_pids.append(pid)
-                    if pid in last_live_pk:   # returning-series detection
-                        self._evicted_keys.add(last_live_pk[pid])
+                    prev = last_live.get(pid)
+                    if prev is not None:       # returning-series detection
+                        self._evicted_keys.add(_pk_and_labels(*prev)[0])
+                    i += 1
                     continue
-                pk = part_key_of(labels, self.schema.options)
+                # dense live run -> ONE columnar bulk add (the recover-ms
+                # lever: no per-key dict builds or python add loops)
+                j = i
+                while (can_bulk and j < len(items) and items[j][2]
+                       and items[j][0] == pid + (j - i)):
+                    j += 1
+                if j - i >= RECOVER_BULK_MIN and \
+                        len({items[k][2] for k in range(i, j)}) == j - i and \
+                        self.index.add_part_keys_bulk(
+                            np.arange(pid, pid + (j - i)),
+                            [items[k][2] for k in range(i, j)], 0,
+                            start_times=np.asarray(
+                                [items[k][3] for k in range(i, j)],
+                                np.int64)):
+                    if self.governor is not None:
+                        # batched adoption: one cheap key-bytes extraction
+                        # per key and ONE adopt per distinct tenant — a
+                        # per-key dict build + lock + gauge update would
+                        # hand back much of the bulk path's win
+                        tenants: dict[str, int] = {}
+                        for k in range(i, j):
+                            t = self.governor.tenant_from_key_bytes(
+                                items[k][2])
+                            tenants[t] = tenants.get(t, 0) + 1
+                        for t, cnt in tenants.items():
+                            self.governor.adopt(t, cnt)
+                    for k in range(i, j):
+                        rpid, _rl, rblob, _rs = items[k]
+                        self._part_key_to_id[rblob] = rpid
+                        self._part_key_of_id[rpid] = rblob
+                        recovered_keys.append((rpid, rblob))
+                    i = j
+                    continue
+                pk, labels = _pk_and_labels(labels, blob)
                 self._part_key_to_id[pk] = pid
                 self._part_key_of_id[pid] = pk
                 recovered_keys.append((pid, pk))
                 self.index.add_part_key(pid, labels, start)
+                if self.governor is not None:
+                    self.governor.adopt(self.governor.tenant_of(labels))
+                i += 1
             if self._native_ps is not None and recovered_keys:
                 # one native batch hash + ONE batch insert (per-key ctypes
                 # calls cost ~10us each — material at 100k recovered series)
@@ -934,11 +1206,34 @@ class TimeSeriesShard:
                      for (pid, pk), h in zip(recovered_keys, hashes)])
                 for (pid, _pk), h in zip(recovered_keys, hashes):
                     self._pid_hash[pid] = h
+        registry.gauge(FILODB_INDEX_RECOVER_MS,
+                       {"dataset": self.dataset,
+                        "shard": str(self.shard_num)}) \
+            .update((_time.perf_counter() - t0_index) * 1000.0)
+        if hasattr(self.sink, "write_index_bucket"):
+            # re-anchor the index log's trust: a fallback rebuild appends a
+            # fresh GENESIS snapshot (fast path restored next restart), a
+            # persistence-off recovery appends a RETIRE marker so a later
+            # persistence-on restart cannot trust the now-stale content.
+            # Best-effort — a failed write just defers seeding to the next
+            # drain (seeded stays False) or the next recovery
+            try:
+                if self.index_bucket_ms and not used_frames:
+                    self._write_index_genesis()
+                elif not self.index_bucket_ms:
+                    self.sink.write_index_bucket(
+                        self.dataset, self.shard_num,
+                        encode_index_bucket(INDEX_RETIRE_BUCKET, []))
+            except Exception:
+                log.warning("index.log trust re-anchor failed; the next "
+                            "drain or recovery retries", exc_info=True)
         # 2. chunks -> device store (batched appends, flush order == time order).
         #    Chunks of purged partitions are skipped; for a reused slot, samples
         #    older than the current owner's start time belong to the purged
         #    predecessor and are dropped.
-        own_start = {pid: start for pid, (labels, start) in latest.items() if labels}
+        own_start = {pid: start
+                     for pid, (labels, blob, start) in latest.items()
+                     if labels or blob}
         start_of = np.full(len(self.index) + 1, 1 << 62, np.int64)
         for pid, start in own_start.items():
             start_of[pid] = start
@@ -955,6 +1250,11 @@ class TimeSeriesShard:
             if len(pids):
                 with self.lock:   # append donates the store buffers
                     self.store.append(pids, ts, vals)
+                    # loaded chunks change query-visible data exactly like
+                    # a flush would: the epoch-validated caches must see
+                    # the bump (a result cached mid-recovery would
+                    # otherwise validate against a pre-load vector forever)
+                    self._bump_epoch_locked(int(ts.min()))
                     lead = int(ts.max())
                     if lead > self.lead_ms:
                         self.lead_ms = lead

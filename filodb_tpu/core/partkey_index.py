@@ -5,10 +5,13 @@ of part-key tags with startTime/endTime per partition, regex/prefix filters, top
 label values, and partIdsEndedBefore for purge.
 
 TPU-native design: the index is host-side (tag matching has no device analog) and
-must not bottleneck 1M-series workloads (ref bar: PartKeyIndexBenchmark). Postings
-are kept as append lists compacted lazily into sorted int32 numpy arrays; filter
-evaluation is numpy set algebra (intersect/union/setdiff) over postings, with regex
-applied per *distinct label value* (not per series).
+must not bottleneck 1M-series workloads (ref bar: PartKeyIndexBenchmark). The
+postings plane is the columnar engine of ``index_columnar.py``: per label, a
+sorted term dictionary with CSR postings over u64 ``(vid << 32) | pid`` keys,
+staged appends batch-folded on first read (the Lucene NRT-refresh analog —
+the ingest hot path never pays a rebuild), dense u64-word bitmaps for
+multi-matcher set algebra, and a trigram pre-filter so regex matchers compile
+once and confirm only the terms that carry the pattern's mandatory literals.
 
 Label storage is dictionary-encoded (ref: DictUTF8Vector/UTF8Vector,
 memory/.../format/vectors/DictUTF8Vector.scala): each distinct label name and
@@ -22,11 +25,12 @@ queries is a zero-copy slice, not a 1M-element list conversion.
 from __future__ import annotations
 
 from array import array
-from collections import Counter, defaultdict
+from collections import Counter
 
 import numpy as np
 
 from .filters import Equals, EqualsRegex, Filter, In, NotEquals, NotEqualsRegex
+from .index_columnar import LabelPostings, SelectionBitmap, TrigramIndex
 
 _EMPTY = np.empty(0, dtype=np.int32)
 
@@ -48,40 +52,6 @@ def _intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     ok = pos < len(b)
     ok[ok] = b[pos[ok]] == a[ok]
     return a[ok]
-
-
-class _Postings:
-    """Append-friendly posting list with lazy sorted-array compaction."""
-
-    __slots__ = ("_new", "_arr", "vid", "nid", "dropped")
-
-    def __init__(self, vid: int = 0, nid: int = 0):
-        self._new: list[int] = []
-        self._arr: np.ndarray = _EMPTY
-        self.vid = vid                   # id of this value in its name's pool
-        self.nid = nid                   # id of its label name (arena pair)
-        self.dropped = False             # detached from _inv by a removal
-
-    def add(self, part_id: int) -> None:
-        self._new.append(part_id)
-
-    def array(self) -> np.ndarray:
-        if self._new:
-            fresh = np.asarray(self._new, dtype=np.int32)
-            # part ids are usually assigned in increasing order (presorted); slot
-            # reuse after a purge can break that, so re-sort only when needed
-            arr = np.concatenate([self._arr, fresh]) if len(self._arr) else fresh
-            if len(arr) > 1 and not (np.diff(arr) > 0).all():
-                arr = np.unique(arr)
-            self._arr = arr
-            self._new = []
-        return self._arr
-
-    def remove(self, part_ids: np.ndarray) -> None:
-        self._arr = np.setdiff1d(self.array(), part_ids, assume_unique=False)
-
-    def __len__(self) -> int:
-        return len(self._arr) + len(self._new)
 
 
 class _I64Vec:
@@ -126,9 +96,15 @@ class _I64Vec:
 class PartKeyIndex:
     """Inverted index over one shard's partitions."""
 
+    # bitmap algebra engages when the smallest positive union is DENSE —
+    # at least this many ids AND at least 1/8 of the pid space. Sparse
+    # selections stay on the galloping searchsorted intersect (measured:
+    # at 100k series a 10k x 100k galloping AND runs ~4x faster than the
+    # scatter/packbits round-trip, while word-parallel AND/ANDNOT wins
+    # once every operand covers most of the space)
+    BITMAP_MIN_UNION = 4096
+
     def __init__(self):
-        # label name -> label value -> postings (value str stored once, here)
-        self._inv: dict[str, dict[str, _Postings]] = defaultdict(dict)
         # dictionary encoding pools (ref: DictUTF8Vector)
         self._name_id: dict[str, int] = {}
         self._name_pool: list[str] = []
@@ -136,6 +112,10 @@ class PartKeyIndex:
         # value -> vid survives postings removal so churned values re-intern
         # under their original vid (no duplicate pool entries under churn)
         self._vid_of: list[dict[str, int]] = []
+        # the columnar postings plane: name_id -> LabelPostings (CSR over
+        # (vid << 32) | pid keys with staged batch-fold; index_columnar.py)
+        self._cols: list[LabelPostings] = []
+        self._tri: list[TrigramIndex | None] = []   # lazy regex pre-filters
         self._dead_pairs = 0                   # arena pairs orphaned by purge
         # per-partition label pairs in one shared arena of u32
         self._arena = array("I")
@@ -149,15 +129,17 @@ class PartKeyIndex:
         self._max_start = -(1 << 62)
         self._num_ended = 0
         # regex fast path (ref: PartKeyLuceneIndex automata over TERMS, :34):
-        # matchers evaluate against each label's DISTINCT value pool, not per
-        # key. The pool is scanned as one newline-joined blob with a single
-        # compiled (?m)^(...)$ pass (C-speed), and matches are cached per
-        # (label, pattern) keyed by the pool version — pools only grow on
-        # NEW distinct values, so dashboards re-running the same matcher hit
+        # matchers evaluate against each label's DISTINCT value pool, never
+        # per series. The trigram pre-filter narrows to terms carrying the
+        # pattern's mandatory literals; patterns with no extractable literal
+        # scan the pool as one newline-joined blob with a single compiled
+        # (?m)^(...)$ pass (C-speed). Matches are cached per (label,
+        # pattern) keyed by the pool version — pools only grow on NEW
+        # distinct values, so dashboards re-running the same matcher hit
         # the cache even while postings churn.
         self._pool_version: list[int] = []     # name_id -> bumped per new value
         self._pool_blob: dict[int, tuple[int, str, np.ndarray, bool]] = {}
-        self._regex_cache: dict[tuple[str, str], tuple[int, list[str]]] = {}
+        self._regex_cache: dict[tuple[str, str], tuple[int, np.ndarray]] = {}
         # name_id -> bumped whenever any posting of that label changes; keys
         # the cached regex UNION (the matcher's expanded pid set)
         self._postings_epoch: list[int] = []
@@ -171,18 +153,11 @@ class PartKeyIndex:
         self._epoch = 0
         self._filter_cache: dict[tuple, tuple[int, np.ndarray]] = {}
         # registration hot path: raw pair bytes (b"name\x01value") -> its
-        # _Postings, so the bulk add does ONE dict probe per label pair
-        # instead of two nested gets + string decodes (entries whose postings
-        # a removal detached carry dropped=True and re-intern on next hit)
-        self._pair_cache: dict[bytes, _Postings] = {}
-        # deferred postings (the Lucene NRT-buffer analog: addPartKey returns
-        # after buffering; readers see the docs because every read path
-        # drains first). The columnar bulk add's all-new values park here as
-        # (values, vid_base, pid_list) segments; _drain builds their
-        # _Postings in one batched pass on the first read/mutation that
-        # touches the name. Pools and vid maps are ALWAYS eager — only the
-        # per-value postings objects are deferred.
-        self._pending_cols: dict[str, list] = {}
+        # (nid, vid) identity, so the bulk add does ONE dict probe per label
+        # pair instead of two nested gets + string decodes. (nid, vid) stays
+        # a valid identity across removal — vids survive churn — and the
+        # cache only clears wholesale when compaction renumbers vids.
+        self._pair_cache: dict[bytes, tuple[int, int]] = {}
 
     LIVE_END = np.iinfo(np.int64).max
 
@@ -196,47 +171,21 @@ class PartKeyIndex:
             self._name_pool.append(name)
             self._val_pool.append([])
             self._vid_of.append({})
+            self._cols.append(LabelPostings())
+            self._tri.append(None)
             self._pool_version.append(0)
             self._postings_epoch.append(0)
         return nid
 
-    def _drain(self, name: str) -> None:
-        """Materialize deferred postings segments for one label name; every
-        path that reads or mutates a name's postings calls this first."""
-        segs = self._pending_cols.pop(name, None)
-        if not segs:
-            return
-        nid = self._name_id[name]
-        vals = self._inv[name]
-        pool = self._val_pool[nid]
-        for col, vid_base, pid_list in segs:
-            ps = list(map(_Postings, range(vid_base, vid_base + len(col)),
-                          [nid] * len(col)))
-            for p, pid in zip(ps, pid_list):
-                p._new.append(pid)
-            # pooled (canonical) string instances key _inv
-            vals.update(zip(pool[vid_base:vid_base + len(col)], ps))
-
-    def _drain_all(self) -> None:
-        for name in list(self._pending_cols):
-            self._drain(name)
-
-    def _intern(self, name: str, value: str) -> tuple[int, int, _Postings]:
+    def _intern(self, name: str, value: str) -> tuple[int, int]:
         nid = self._intern_name(name)
-        if self._pending_cols:
-            self._drain(name)
-        vals = self._inv[name]
-        p = vals.get(value)
-        if p is None:
-            vid = self._vid_of[nid].get(value)
-            if vid is None:
-                pool = self._val_pool[nid]
-                vid = self._vid_of[nid][value] = len(pool)
-                pool.append(value)
-                self._pool_version[nid] += 1
-            # reuse the pooled (canonical) string instance as the _inv key
-            p = vals[self._val_pool[nid][vid]] = _Postings(vid, nid)
-        return nid, p.vid, p
+        vid = self._vid_of[nid].get(value)
+        if vid is None:
+            pool = self._val_pool[nid]
+            vid = self._vid_of[nid][value] = len(pool)
+            pool.append(value)
+            self._pool_version[nid] += 1
+        return nid, vid
 
     def _bulk_preamble(self, part_ids: np.ndarray, n: int,
                        start_time: int) -> np.ndarray | None:
@@ -253,9 +202,11 @@ class PartKeyIndex:
         return pids
 
     def _bulk_columns_commit(self, n: int, L: int, nid_row, vid_mat,
-                             start_time: int) -> None:
+                             start_time, starts: np.ndarray | None) -> None:
         """Append arena/offset/time columns for ``n`` keys of ``L`` labels
-        each, from per-label nid/vid columns — pure numpy, no per-key work."""
+        each, from per-label nid/vid columns — pure numpy, no per-key work.
+        ``starts`` (per-key first-sample times) overrides the scalar
+        ``start_time`` — the columnar recovery path carries real ones."""
         base_off = len(self._arena) // 2
         arena_mat = np.empty((n, L, 2), np.uint32)
         arena_mat[:, :, 0] = nid_row
@@ -264,7 +215,8 @@ class PartKeyIndex:
         offs = base_off + L * np.arange(n, dtype=np.uint64)
         self._off.frombytes(offs.tobytes())
         self._cnt.frombytes(np.full(n, L, np.uint32).tobytes())
-        self._start.extend(np.full(n, start_time, np.int64))
+        self._start.extend(starts if starts is not None
+                           else np.full(n, start_time, np.int64))
         self._end.extend(np.full(n, self.LIVE_END, np.int64))
 
     def add_part_keys_columnar(self, part_ids: np.ndarray, fixed: dict,
@@ -272,10 +224,12 @@ class PartKeyIndex:
                                start_time: int) -> bool:
         """Columnar bulk add: label values arrive as per-name COLUMNS (the
         builder's add_series_batch shape), so interning needs one dict probe
-        per value — no pair-bytes building or parsing at all — and the label
-        arena assembles as one [n, L, 2] numpy write. The fastest
-        registration path (ref: PartKeyLuceneIndex.addPartKey bulk ingest,
-        jmh PartKeyIndexBenchmark is the bar); per-key equivalent to
+        per value — no pair-bytes building or parsing at all — the label
+        arena assembles as one [n, L, 2] numpy write, and postings stage as
+        whole array segments (one ``add_bulk`` per column) folded into the
+        columnar structure on first read. The fastest registration path
+        (ref: PartKeyLuceneIndex.addPartKey bulk ingest, jmh
+        PartKeyIndexBenchmark is the bar); per-key equivalent to
         add_part_key. Dense pid appends only — returns False untouched
         otherwise."""
         n = len(part_ids)
@@ -287,70 +241,64 @@ class PartKeyIndex:
         pids = self._bulk_preamble(part_ids, n, start_time)
         if pids is None:
             return False
-        pid_list = pids.tolist()
+        pid_arr = pids
         nid_row = np.empty(L, np.uint32)
         vid_mat = np.empty((n, L), np.uint32)
         touched: list[int] = []
         ci = 0
         for name, value in fixed.items():
-            nid, vid, p = self._intern(name, value)
-            p._new.extend(pid_list)
+            nid, vid = self._intern(name, value)
+            self._cols[nid].add_run(vid, pid_arr)
             nid_row[ci] = nid
             vid_mat[:, ci] = vid
             touched.append(nid)
             ci += 1
         for name, col in zip(vary, cols):
             nid = self._intern_name(name)
-            vals = self._inv[name]
             vd = self._vid_of[nid]
             pool = self._val_pool[nid]
             # all-new-distinct subpath (the registration shape: every series
             # brings a fresh value): dedup + overlap checks are C-speed set
-            # ops, pools/vid maps extend in bulk, and per value only the
-            # postings object itself is built
+            # ops, pools/vid maps extend in bulk, and the postings stage as
+            # ONE contiguous (vids, pids) segment
             dedup = dict.fromkeys(col)
             if len(dedup) == n and not (dedup.keys() & vd.keys()):
                 base_vid = len(pool)
                 pool.extend(col)
                 vd.update(zip(col, range(base_vid, base_vid + n)))
-                # postings deferred (NRT buffer): readers drain on access
-                self._pending_cols.setdefault(name, []).append(
-                    (col, base_vid, pid_list))
                 self._pool_version[nid] += n
-                vid_mat[:, ci] = np.arange(base_vid, base_vid + n,
-                                           dtype=np.uint32)
+                vids_col = np.arange(base_vid, base_vid + n, dtype=np.uint32)
+                self._cols[nid].add_bulk(vids_col, pid_arr)
+                vid_mat[:, ci] = vids_col
             else:
-                self._drain(name)     # the general loop probes _inv directly
-                get = vals.get
+                get = vd.get
                 vids: list[int] = []
                 vap = vids.append
                 new_pool = 0
-                for v, pid in zip(col, pid_list):
-                    p = get(v)
-                    if p is None:
-                        vid = vd.get(v)
-                        if vid is None:
-                            vid = vd[v] = len(pool)
-                            pool.append(v)
-                            new_pool += 1
-                        # pooled (canonical) string instance keys _inv
-                        p = vals[pool[vid]] = _Postings(vid, nid)
-                    p._new.append(pid)
-                    vap(p.vid)
+                for v in col:
+                    vid = get(v)
+                    if vid is None:
+                        vid = vd[v] = len(pool)
+                        pool.append(v)
+                        new_pool += 1
+                    vap(vid)
                 if new_pool:
                     self._pool_version[nid] += new_pool
-                vid_mat[:, ci] = vids
+                vids_col = np.asarray(vids, np.uint32)
+                self._cols[nid].add_bulk(vids_col, pid_arr)
+                vid_mat[:, ci] = vids_col
             nid_row[ci] = nid
             touched.append(nid)
             ci += 1
         for nid in touched:
             self._postings_epoch[nid] += 1
-        self._bulk_columns_commit(n, L, nid_row, vid_mat, start_time)
+        self._bulk_columns_commit(n, L, nid_row, vid_mat, start_time, None)
         return True
 
     def add_part_keys_bulk(self, part_ids: np.ndarray, keys: list[bytes],
                            start_time: int,
-                           counts_hint: np.ndarray | None = None) -> bool:
+                           counts_hint: np.ndarray | None = None,
+                           start_times: np.ndarray | None = None) -> bool:
         """Vectorized add of many NEW part keys parsed straight from the
         canonical key bytes (``name\\x01value`` pairs joined by ``\\x00`` —
         schemas.part_key_bytes; the v3 container wire already carries them).
@@ -365,7 +313,9 @@ class PartKeyIndex:
         False (with NO state mutated) so the caller falls back to per-key
         ``add_part_key`` otherwise. ``counts_hint`` (labels per key, from the
         caller's label dicts) guards against values containing the separator
-        byte — a mismatch rejects the batch before any mutation."""
+        byte — a mismatch rejects the batch before any mutation.
+        ``start_times`` carries per-key first-sample times (the columnar
+        recovery path); the scalar ``start_time`` covers registration."""
         n = len(keys)
         if n == 0:
             return True
@@ -375,26 +325,32 @@ class PartKeyIndex:
             return False
         if min(len(k) for k in keys) == 0:
             return False                       # label-less key: per-key path
-        pids = self._bulk_preamble(part_ids, n, start_time)
+        eff_start = (int(start_times.max()) if start_times is not None
+                     and len(start_times) else start_time)
+        pids = self._bulk_preamble(part_ids, n, eff_start)
         if pids is None:
             return False
         pairs = b"\x00".join(keys).split(b"\x00")
         cache = self._pair_cache
         arena_ext = array("I")
         ap = arena_ext.append
-        touched: set[int] = set()
+        touched: dict[int, tuple[list, list]] = {}
         for pair, pid in zip(pairs, np.repeat(pids, counts).tolist()):
-            p = cache.get(pair)
-            if p is None or p.dropped:
+            ident = cache.get(pair)
+            if ident is None:
                 nm, _, val = pair.partition(b"\x01")
-                _nid, _vid, p = self._intern(nm.decode(), val.decode())
-                p.dropped = False
-                cache[pair] = p
-            ap(p.nid)
-            ap(p.vid)
-            p._new.append(pid)
-            touched.add(p.nid)
-        for nid in touched:
+                ident = cache[pair] = self._intern(nm.decode(), val.decode())
+            nid, vid = ident
+            ap(nid)
+            ap(vid)
+            stage = touched.get(nid)
+            if stage is None:
+                stage = touched[nid] = ([], [])
+            stage[0].append(vid)
+            stage[1].append(pid)
+        for nid, (svids, spids) in touched.items():
+            self._cols[nid].add_bulk(np.asarray(svids, np.uint32),
+                                     np.asarray(spids, np.int64))
             self._postings_epoch[nid] += 1
         if len(cache) > (1 << 22):
             # backstop: the cache re-warms from _intern; unbounded growth on
@@ -405,7 +361,9 @@ class PartKeyIndex:
         offs = base_off + np.concatenate(([0], np.cumsum(counts[:-1])))
         self._off.frombytes(offs.astype(np.uint64).tobytes())
         self._cnt.frombytes(counts.astype(np.uint32).tobytes())
-        self._start.extend(np.full(n, start_time, np.int64))
+        self._start.extend(np.asarray(start_times, np.int64)
+                           if start_times is not None
+                           else np.full(n, start_time, np.int64))
         self._end.extend(np.full(n, self.LIVE_END, np.int64))
         return True
 
@@ -434,24 +392,20 @@ class PartKeyIndex:
             self._start[part_id] = start_time
             self._end[part_id] = end_time
         # hot loop (1M-series registration is bound here): the common case is
-        # a dict hit on an existing (name, value) postings object, which
-        # carries its own (nid, vid) — two dict gets and three appends per
-        # label, no helper calls (ref bar: PartKeyIndexBenchmark add rate)
-        inv = self._inv
+        # two dict hits resolving (nid, vid) and three O(1) appends per label
+        # — the staged postings fold in batch on the first read
+        # (ref bar: PartKeyIndexBenchmark add rate)
         arena = self._arena
         pe = self._postings_epoch
-        pending = self._pending_cols
+        name_id = self._name_id
         for name, value in labels.items():
-            if pending and name in pending:
-                self._drain(name)
-            vals = inv.get(name)
-            p = vals.get(value) if vals is not None else None
-            if p is None:
-                _nid, _vid, p = self._intern(name, value)
-            nid = p.nid
+            nid = name_id.get(name)
+            vid = self._vid_of[nid].get(value) if nid is not None else None
+            if vid is None:
+                nid, vid = self._intern(name, value)
             arena.append(nid)
-            arena.append(p.vid)
-            p._new.append(part_id)
+            arena.append(vid)
+            self._cols[nid].add(vid, part_id)
             pe[nid] += 1
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
@@ -488,60 +442,102 @@ class PartKeyIndex:
                 + self._cnt.itemsize * len(self._cnt)
                 + 16 * self._start.n + pools)
 
+    def postings_bytes(self) -> int:
+        """Columnar postings footprint (CSR keys + staged overlays)."""
+        return sum(c.nbytes() for c in self._cols)
+
     # ---- queries ----------------------------------------------------------
 
-    def _postings_for(self, f: Filter) -> np.ndarray:
-        """Union of postings whose label value satisfies the (positive) filter."""
-        if self._pending_cols:
-            self._drain(f.label)
-        vals = self._inv.get(f.label)
-        if not vals:
+    def _filter_union(self, f: Filter) -> np.ndarray:
+        """SORTED-unique pids whose label value satisfies the (positive)
+        filter — slices/gathers off the columnar structure, never a
+        per-value dict walk."""
+        nid = self._name_id.get(f.label)
+        if nid is None:
             return _EMPTY
+        col = self._cols[nid]
         if isinstance(f, Equals):
-            p = vals.get(f.value)
-            return p.array() if p else _EMPTY
+            vid = self._vid_of[nid].get(f.value)
+            return col.ids_of(vid) if vid is not None else _EMPTY
         if isinstance(f, In):
-            arrs = [vals[v].array() for v in f.values if v in vals]
-        elif isinstance(f, (EqualsRegex, NotEqualsRegex)):
-            # applied per distinct value; NotEqualsRegex handled by caller via
-            # complement. The expanded union is cached until the label's pool
-            # or postings change (stable between series churn events)
-            nid = self._name_id.get(f.label)
+            vd = self._vid_of[nid]
+            # dedup: a repeated In value must not duplicate its postings
+            # (downstream set algebra assumes unique ids)
+            vids = list(dict.fromkeys(vd[v] for v in f.values if v in vd))
+            if not vids:
+                return _EMPTY
+            u = col.gather(col.term_indices(np.asarray(vids, np.int64)))
+            return np.sort(u)
+        if isinstance(f, (EqualsRegex, NotEqualsRegex)):
+            # applied per distinct value; NotEqualsRegex handled by caller
+            # via complement. The expanded union is cached until the label's
+            # pool or postings change (stable between series churn events)
             ckey = (f.label, f.pattern)
             cur = (self._pool_version[nid], self._postings_epoch[nid])
             hit = self._regex_union_cache.get(ckey)
             if hit is not None and hit[:2] == cur:
                 return hit[2]
-            matched = self._regex_values(f.label, f.pattern)
-            arrs = [vals[v].array() for v in matched if v in vals]
-            u = (np.unique(np.concatenate(arrs)) if len(arrs) > 1
-                 else (arrs[0] if arrs else _EMPTY))
+            vids = self._regex_vids(f.label, f.pattern)
+            u = np.sort(col.gather(col.term_indices(vids)))
             if len(self._regex_union_cache) > 1024:
                 self._regex_union_cache.clear()
             self._regex_union_cache[ckey] = cur + (u,)
             return u
-        elif isinstance(f, NotEquals):
-            arrs = [p.array() for v, p in vals.items() if v != f.value]
-        else:  # pragma: no cover
-            raise TypeError(f)
-        if not arrs:
-            return _EMPTY
-        return np.unique(np.concatenate(arrs)) if len(arrs) > 1 else arrs[0]
+        if isinstance(f, NotEquals):
+            # every pid carrying the label, minus the one excluded term
+            vid = self._vid_of[nid].get(f.value)
+            everyone = col.all_ids()
+            if vid is None:
+                return everyone
+            return np.setdiff1d(everyone, col.ids_of(vid), assume_unique=True)
+        raise TypeError(f)  # pragma: no cover
 
-    def _regex_values(self, label: str, pattern: str) -> list[str]:
-        """Distinct pool values fullmatching ``pattern`` — one compiled
-        multiline scan over the newline-joined pool, cached per (label,
+    def _regex_vids(self, label: str, pattern: str) -> np.ndarray:
+        """Distinct pool vids whose value fullmatches ``pattern``: trigram
+        pre-filter (mandatory literals -> candidate terms) then ONE compiled
+        confirm over the survivors; patterns with no extractable literal
+        scan the whole pool via the multiline blob. Cached per (label,
         pattern) until a NEW distinct value extends the pool."""
         import re
         nid = self._name_id.get(label)
         if nid is None:
-            return []
+            return _EMPTY
         version = self._pool_version[nid]
         key = (label, pattern)
         hit = self._regex_cache.get(key)
         if hit is not None and hit[0] == version:
             return hit[1]
         pool = self._val_pool[nid]
+        tri = self._tri[nid]
+        if tri is None:
+            tri = self._tri[nid] = TrigramIndex()
+        cand = tri.candidates(pattern, pool)
+        if cand is not None:
+            try:
+                pat = re.compile(pattern)
+            except re.error:
+                matched = _EMPTY
+            else:
+                fm = pat.fullmatch
+                matched = np.asarray(
+                    [int(v) for v in cand.tolist() if fm(pool[int(v)])],
+                    np.int64)
+        else:
+            values = self._regex_values_scan(nid, pattern)
+            vd = self._vid_of[nid]
+            matched = np.asarray([vd[v] for v in values], np.int64)
+        if len(self._regex_cache) > 4096:
+            self._regex_cache.clear()
+        self._regex_cache[key] = (version, matched)
+        return matched
+
+    def _regex_values_scan(self, nid: int, pattern: str) -> list[str]:
+        """Full-pool regex scan (no usable trigrams): one compiled multiline
+        pass over the newline-joined pool blob, falling back to per-value
+        fullmatch for newline-y pools or cross-line-capable patterns."""
+        import re
+        pool = self._val_pool[nid]
+        version = self._pool_version[nid]
         blob = self._pool_blob.get(nid)
         if blob is None or blob[0] != version:
             text = "\n".join(pool)
@@ -564,7 +560,7 @@ class PartKeyIndex:
                 pat = None
                 safe = False
         if safe:
-            out: list[str] = []
+            out: list[str] | None = []
             for m in pat.finditer(text):
                 i = int(np.searchsorted(starts, m.start()))
                 # a pattern atom that can consume '\n' (e.g. \s*) could span
@@ -579,9 +575,6 @@ class PartKeyIndex:
         if matched is None:   # newline-y pool or cross-line-capable pattern
             pat = re.compile(pattern)
             matched = [v for v in pool if pat.fullmatch(v)]
-        if len(self._regex_cache) > 4096:
-            self._regex_cache.clear()
-        self._regex_cache[key] = (version, matched)
         return matched
 
     def part_ids_from_filters(self, filters: list[Filter], start_time: int,
@@ -606,36 +599,45 @@ class PartKeyIndex:
         return result.astype(np.int32)
 
     def _eval_filters(self, filters: list[Filter]) -> np.ndarray:
-        """Postings algebra for a filter set (no time masking — results are
-        cached across query windows by part_ids_from_filters)."""
+        """Postings set algebra for a filter set (no time masking — results
+        are cached across query windows by part_ids_from_filters). Small
+        equals-chains intersect by galloping binary search; anything with
+        large unions runs dense u64 bitmap AND/ANDNOT over the pid space —
+        the columnar multi-matcher plane."""
         negations: list[Filter] = []
         pos: list[np.ndarray] = []
         for f in filters:
             if isinstance(f, (NotEquals, NotEqualsRegex)):
                 negations.append(f)
                 continue
-            p = self._postings_for(f)
+            p = self._filter_union(f)
             if len(p) == 0:
                 return _EMPTY
             pos.append(p)
+        neg_unions = [self._filter_union(
+            Equals(f.label, f.value) if isinstance(f, NotEquals)
+            else EqualsRegex(f.label, f.pattern)) for f in negations]
+        S = len(self._off)
         if pos:
-            # postings are sorted-unique (see _Postings.array): intersect by
-            # binary search from the smallest list outward — intersect1d
-            # would re-SORT the largest postings (e.g. a metric matching 1M
-            # series) on every query
             pos.sort(key=len)
+            if len(pos) > 1 and \
+                    len(pos[0]) >= max(S >> 3, self.BITMAP_MIN_UNION):
+                bm = SelectionBitmap.from_ids(pos[0], S)
+                for p in pos[1:]:
+                    bm.iand_ids(p)
+                for neg in neg_unions:
+                    if len(neg):
+                        bm.iandnot_ids(neg)
+                return bm.to_ids()
             result = pos[0]
             for p in pos[1:]:
                 result = _intersect_sorted(result, p)
                 if len(result) == 0:
                     return _EMPTY
         else:
-            result = np.arange(len(self._off), dtype=np.int32)
-        for f in negations:
+            result = np.arange(S, dtype=np.int32)
+        for neg in neg_unions:
             # series *lacking* the label entirely also match a negative filter
-            neg = self._postings_for(
-                Equals(f.label, f.value) if isinstance(f, NotEquals)
-                else EqualsRegex(f.label, f.pattern))
             result = np.setdiff1d(result, neg, assume_unique=True)
         return result
 
@@ -654,32 +656,21 @@ class PartKeyIndex:
             return
         self._epoch += 1                 # invalidate cached filter results
         removed = np.asarray(part_ids, np.int32)
-        touched: dict[str, set[str]] = defaultdict(set)
+        arena = self._arena
+        touched: set[int] = set()
         for pid in removed.tolist():
-            for name, value in self.labels_of(pid).items():
-                touched[name].add(value)
+            o = self._off[pid] * 2
+            for i in range(o, o + 2 * self._cnt[pid], 2):
+                touched.add(arena[i])
             self._dead_pairs += self._cnt[pid]
             self._cnt[pid] = 0
             self._start[pid] = 0
             if self._end[pid] == self.LIVE_END:
                 self._num_ended += 1     # disables the all-live fast path
             self._end[pid] = -1          # matches no [start, end] overlap query
-        for name, values in touched.items():
-            self._drain(name)
-            nid = self._name_id.get(name)
-            if nid is not None:
-                self._postings_epoch[nid] += 1   # invalidate cached unions
-            for value in values:
-                p = self._inv[name].get(value)
-                if p is not None:
-                    p.remove(removed)
-                    if not len(p):
-                        p.dropped = True       # invalidate _pair_cache entry
-                        del self._inv[name][value]
-                        # value string stays in the pool: vids are stable and a
-                        # re-added value re-interns under a fresh vid
-            if not self._inv[name]:
-                del self._inv[name]
+        for nid in touched:
+            self._cols[nid].remove(removed)
+            self._postings_epoch[nid] += 1   # invalidate cached unions
         self.maybe_compact_arena()
 
     def maybe_compact_arena(self, min_dead_ratio: float = 0.5) -> bool:
@@ -692,18 +683,21 @@ class PartKeyIndex:
         total = len(self._arena) // 2
         if self._dead_pairs == 0 or self._dead_pairs <= total * min_dead_ratio:
             return False
-        self._drain_all()      # the rebuild below walks every _inv entry
-        # re-pool: keep only values that still have live postings; vids renumber
-        new_pools: list[list[str]] = [[] for _ in self._name_pool]
-        new_vid_of: list[dict[str, int]] = [{} for _ in self._name_pool]
-        vid_map: list[dict[int, int]] = [{} for _ in self._name_pool]
-        for name, vals in self._inv.items():
-            nid = self._name_id[name]
-            for value, p in vals.items():
-                new_vid = new_vid_of[nid][value] = len(new_pools[nid])
-                new_pools[nid].append(value)
-                vid_map[nid][p.vid] = new_vid
-                p.vid = new_vid
+        # re-pool: keep only values that still have live postings (the term
+        # index prunes emptied terms on remove, so a column's term vids ARE
+        # the live set); vids renumber densely
+        vid_maps: list[np.ndarray] = []
+        for nid in range(len(self._name_pool)):
+            col = self._cols[nid]
+            live_vids = col.term_vids().astype(np.int64)
+            vid_map = np.full(len(self._val_pool[nid]), -1, np.int64)
+            vid_map[live_vids] = np.arange(len(live_vids))
+            old_pool = self._val_pool[nid]
+            new_pool = [old_pool[int(v)] for v in live_vids]
+            self._val_pool[nid] = new_pool
+            self._vid_of[nid] = {v: i for i, v in enumerate(new_pool)}
+            col.remap_vids(vid_map)
+            vid_maps.append(vid_map)
         fresh = array("I")
         arena = self._arena
         for pid in range(len(self._off)):
@@ -714,21 +708,17 @@ class PartKeyIndex:
             self._off[pid] = len(fresh) // 2
             for i in range(o, o + 2 * c, 2):
                 fresh.append(arena[i])
-                fresh.append(vid_map[arena[i]][arena[i + 1]])
+                fresh.append(int(vid_maps[arena[i]][arena[i + 1]]))
         self._arena = fresh
-        self._val_pool = new_pools
-        self._vid_of = new_vid_of
         self._dead_pairs = 0
-        # churn reclaim extends to the pair cache: dropped entries would
-        # otherwise pin dead values' bytes + postings forever
-        self._pair_cache = {k: p for k, p in self._pair_cache.items()
-                            if not p.dropped}
-        # pools rebuilt: every cached blob/match/union is stale (decoding a
-        # stale blob's line offsets against the new pool would return the
-        # WRONG values' postings)
+        # vids renumbered: every cached identity/blob/match/union is stale
+        # (decoding a stale blob's line offsets against the new pool would
+        # return the WRONG values' postings)
+        self._pair_cache = {}
         for nid in range(len(self._pool_version)):
             self._pool_version[nid] += 1
             self._postings_epoch[nid] += 1
+            self._tri[nid] = None       # rebuilt lazily over the new pool
         self._pool_blob.clear()
         self._regex_cache.clear()
         self._regex_union_cache.clear()
@@ -736,27 +726,31 @@ class PartKeyIndex:
 
     def _label_value_counter(self, label: str, filters, start_time,
                              end_time) -> Counter:
-        if self._pending_cols:
-            self._drain(label)
-        vals = self._inv.get(label)
-        if not vals:
+        nid = self._name_id.get(label)
+        if nid is None:
+            return Counter()
+        col = self._cols[nid]
+        term_vids, counts = col.counts()
+        if not len(term_vids):
             return Counter()
         if filters:
-            matching = self.part_ids_from_filters(filters, start_time, end_time)
-            counts = Counter()
-            for v, p in vals.items():
-                c = len(np.intersect1d(p.array(), matching, assume_unique=True))
-                if c:
-                    counts[v] = c
-        else:
-            counts = Counter({v: len(p) for v, p in vals.items()})
-        return counts
+            matching = self.part_ids_from_filters(filters, start_time,
+                                                  end_time)
+            counts = col.counts_within(matching, len(self._off))
+        pool = self._val_pool[nid]
+        live = counts > 0
+        return Counter({pool[int(v)]: int(c)
+                        for v, c in zip(term_vids[live].tolist(),
+                                        counts[live].tolist())})
 
     def label_values(self, label: str, filters: list[Filter] | None = None,
                      start_time: int = 0, end_time: int = 1 << 62,
                      top_k: int | None = None) -> list[str]:
         """Distinct values of ``label``; top-k by series count when requested
-        (ref: PartKeyLuceneIndex indexValues top-k terms)."""
+        (ref: PartKeyLuceneIndex indexValues top-k terms). Counts read
+        straight off the columnar structure — CSR offset diffs unfiltered,
+        posting-bitmap popcounts / one membership pass filtered — never a
+        per-value series scan."""
         counts = self._label_value_counter(label, filters, start_time, end_time)
         if top_k is not None:
             return [v for v, _ in counts.most_common(top_k)]
@@ -777,7 +771,8 @@ class PartKeyIndex:
     def label_names(self, filters: list[Filter] | None = None,
                     start_time: int = 0, end_time: int = 1 << 62) -> list[str]:
         if not filters:
-            return sorted(self._inv)
+            return sorted(n for n, nid in self._name_id.items()
+                          if self._cols[nid].n_postings > 0)
         matching = self.part_ids_from_filters(filters, start_time, end_time)
         names: set[str] = set()
         for pid in matching.tolist():

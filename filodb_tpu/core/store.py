@@ -243,6 +243,104 @@ def encode_age_out(chunksets, cutoff_ms: int) -> tuple[bytes, int]:
     return b"".join(frames), dropped
 
 
+# ---------------------------------------------------------------------------
+# Part-key index time buckets (ref: the reference persists its Lucene index
+# as time-bucket blobs and recovers from them instead of re-indexing raw
+# part keys — SURVEY §5 "Checkpoint / resume"). One frame per touched bucket
+# per flush drain, appended to index.log in event order; every frame carries
+# its own CRC so a torn or damaged tail truncates instead of poisoning
+# recovery. Entries are COLUMNAR: pid/start arrays plus length-prefixed
+# label blobs (the full label set in part-key pair encoding), so recovery
+# rebuilds the index with bulk array loads, not per-key JSON parsing.
+# ---------------------------------------------------------------------------
+
+_INDEX_HDR = struct.Struct("<qII")     # bucket_start_ms, payload_len, crc32
+
+# tombstone entries (releases) ride a dedicated pseudo-bucket: event order
+# within the log is what resolves slot reuse, not the bucket tag
+INDEX_TOMBSTONE_BUCKET = -1
+# GENESIS: this frame's entries are a COMPLETE live-series snapshot — the
+# log is trustworthy from the LAST genesis onward (written at shard birth,
+# and re-written after any recovery that had to fall back to partkeys.log,
+# so an upgraded or persistence-toggled shard never loses pre-log series)
+INDEX_GENESIS_BUCKET = -2
+# RETIRE: everything before this marker is STALE (appended by a recovery
+# running with index persistence OFF — events will accrue only in
+# partkeys.log from here, so a later persistence-on restart must not trust
+# the pre-marker content; a fresh genesis after it restores trust)
+INDEX_RETIRE_BUCKET = -3
+
+# per-entry flags: bit0 = labels not representable in the pair encoding
+# (separator bytes) — the entry is a placeholder and recovery must fall
+# back to partkeys.log for the whole shard
+INDEX_FLAG_UNPARSEABLE = 1
+
+
+def encode_index_bucket(bucket_start_ms: int, entries) -> bytes:
+    """One index.log frame: ``entries`` is [(pid, start_ms, label_blob)] or
+    [(pid, start_ms, label_blob, flags)]; a tombstone entry carries an
+    empty blob and start -1."""
+    import zlib
+    pids = np.asarray([e[0] for e in entries], np.int64)
+    starts = np.asarray([e[1] for e in entries], np.int64)
+    blobs = [e[2] for e in entries]
+    flags = np.asarray([(e[3] if len(e) > 3 else 0) for e in entries],
+                       np.uint8)
+    lens = np.asarray([len(b) for b in blobs], np.uint32)
+    payload = zlib.compress(
+        struct.pack("<I", len(entries)) + pids.tobytes() + starts.tobytes()
+        + lens.tobytes() + flags.tobytes() + b"".join(blobs), 1)
+    return _INDEX_HDR.pack(int(bucket_start_ms), len(payload),
+                           zlib.crc32(payload)) + payload
+
+
+def iter_index_frames(f):
+    """Parse an index.log stream: yields (bucket_start_ms, pids, starts,
+    blobs, flags) per frame in append (= event) order. A torn tail or a
+    CRC mismatch truncates (WAL semantics) — recovery falls back to the
+    per-key partkeys.log rebuild for anything the index log cannot prove."""
+    import zlib
+    while True:
+        hdr = f.read(_INDEX_HDR.size)
+        if len(hdr) < _INDEX_HDR.size:
+            return
+        try:
+            bucket, plen, crc = _INDEX_HDR.unpack(hdr)
+            payload = f.read(plen)
+            if len(payload) < plen or zlib.crc32(payload) != crc:
+                return
+            raw = zlib.decompress(payload)
+            (n,) = struct.unpack_from("<I", raw, 0)
+            off = 4
+            pids = np.frombuffer(raw, np.int64, count=n, offset=off)
+            off += 8 * n
+            starts = np.frombuffer(raw, np.int64, count=n, offset=off)
+            off += 8 * n
+            lens = np.frombuffer(raw, np.uint32, count=n, offset=off)
+            off += 4 * n
+            flags = np.frombuffer(raw, np.uint8, count=n, offset=off)
+            off += n
+            blobs = []
+            for ln in lens.tolist():
+                blobs.append(raw[off:off + ln])
+                off += ln
+        except (struct.error, ValueError, zlib.error, IndexError):
+            return
+        yield bucket, pids, starts, blobs, flags
+
+
+def labels_from_blob(blob: bytes) -> dict[str, str]:
+    """Inverse of the part-key pair encoding (schemas.part_key_bytes over
+    the FULL label set)."""
+    if not blob:
+        return {}
+    out = {}
+    for pair in blob.split(b"\x00"):
+        k, _, v = pair.partition(b"\x01")
+        out[k.decode()] = v.decode()
+    return out
+
+
 class FileColumnStore(ChunkSink):
     """Durable columnar chunk store on local disk (the Cassandra-equivalent)."""
 
@@ -325,6 +423,21 @@ class FileColumnStore(ChunkSink):
                 except ValueError:
                     return            # torn tail line from a crashed append
                 yield e["id"], e["labels"], e["start"]
+
+    def write_index_bucket(self, dataset, shard, frame: bytes) -> None:
+        """Append one pre-encoded index time-bucket frame (CRC inside the
+        frame; torn tails truncate at read)."""
+        with open(os.path.join(self._dir(dataset, shard), "index.log"),
+                  "ab") as f:
+            f.write(frame)
+
+    def read_index_frames(self, dataset, shard):
+        """Yield (bucket_start_ms, pids, starts, blobs) in event order."""
+        path = os.path.join(self._dir(dataset, shard), "index.log")
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            yield from iter_index_frames(f)
 
     def write_meta(self, dataset, shard, meta: dict):
         path = os.path.join(self._dir(dataset, shard), "meta.json")

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..core import filters as F
+from ..core.cardinality import SeriesQuotaExceeded
 from ..ingest.broker import BrokerRetry
 from ..promql.parser import ParseError
 from ..query.engine import QueryEngine, slow_query_log
@@ -83,7 +84,8 @@ class FiloHttpServer:
     def __init__(self, engines: dict[str, QueryEngine], host="127.0.0.1", port=8080,
                  cluster=None, writers: dict | None = None, scheduler=None,
                  cluster_ops: dict | None = None,
-                 subscribe_poll_s: float = 0.1):
+                 subscribe_poll_s: float = 0.1,
+                 governors: dict | None = None):
         """``writers``: dataset -> callable(per_shard: dict[shard, container])
         receiving remote-write batches atomically (bus publish or direct ingest).
         ``scheduler``: optional QueryScheduler — query work runs through its
@@ -98,6 +100,10 @@ class FiloHttpServer:
         self.writers = writers or {}
         self.scheduler = scheduler
         self.cluster_ops = cluster_ops or {}
+        # dataset -> (CardinalityGovernor, series_known) for the remote-write
+        # fast-shed edge (new series of over-quota tenants answer 429 +
+        # Retry-After AFTER the kept samples published)
+        self.governors = governors or {}
         # rules subsystem handle (RulesManager): serves /api/v1/rules and
         # /api/v1/alerts when the FiloServer configured rule groups
         self.rules = None
@@ -162,6 +168,17 @@ class FiloHttpServer:
                     # retryable, with the broker's hint as Retry-After —
                     # remote-write clients re-send the batch after it
                     self._send(429, {"status": "error", "errorType": "busy",
+                                     "error": str(e)},
+                               headers={"Retry-After": str(max(
+                                   1, int(e.retry_after_s + 0.999)))})
+                except SeriesQuotaExceeded as e:
+                    # cardinality governance: NEW series of an over-quota
+                    # tenant were shed — existing-series samples landed
+                    # before this was raised, so a resend after churn (or a
+                    # raised quota) loses nothing (duplicates dedup at the
+                    # store). 429 like backpressure, distinct errorType.
+                    self._send(429, {"status": "error",
+                                     "errorType": "too_many_series",
                                      "error": str(e)},
                                headers={"Retry-After": str(max(
                                    1, int(e.retry_after_s + 0.999)))})
@@ -788,11 +805,18 @@ class FiloHttpServer:
         # the remote-write edge joins the sender's trace when the request
         # carries the trace header; the publish path below (bus/broker)
         # propagates it onward over PUBLISH_BATCH
+        gov, known = self.governors.get(dataset) or (None, None)
         with tracer.activate(self._trace_ctx(h)), \
                 span(SPAN_REMOTE_WRITE, dataset=dataset):
-            per_shard = remote.write_request_to_containers(body, schema,
-                                                           engine.mapper)
+            per_shard, shed, shed_tenants = remote.write_governed(
+                body, schema, engine.mapper, governor=gov, series_known=known)
             writer(per_shard)
+        if shed:
+            # the kept samples ARE published above — only the over-quota NEW
+            # series were dropped; the typed 429 tells the client which
+            # tenant(s) and when to retry
+            raise SeriesQuotaExceeded(",".join(shed_tenants), shed,
+                                      retry_after_s=gov.retry_after_s)
         h.send_response(204)
         h.send_header("Content-Length", "0")
         h.end_headers()

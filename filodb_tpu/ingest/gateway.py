@@ -28,6 +28,7 @@ import socketserver
 import threading
 import time
 
+from ..core.cardinality import SeriesQuotaExceeded
 from ..core.record import RecordBuilder, fnv1a64
 from ..core.schemas import GAUGE, Schema, part_key_of, shard_key_of
 from ..parallel.shardmapper import ShardMapper
@@ -173,20 +174,31 @@ class GatewayServer:
     def __init__(self, publish, num_shards: int = 4, spread: int = 0,
                  schema: Schema = GAUGE, host="127.0.0.1", port=0,
                  flush_lines: int = 1000, flush_interval_ms: int = 500,
-                 strict: bool = False, route_memo_max: int = 1 << 18):
+                 strict: bool = False, route_memo_max: int = 1 << 18,
+                 governor=None, series_known=None):
         """``publish(shard, container)`` delivers a built container (e.g. to a
         FileBus per shard or straight into a memstore). ``flush_lines`` is the
         size bound per (connection, shard) batch; ``flush_interval_ms`` the
         time bound (0 disables the timed flusher). ``strict`` re-raises
         malformed lines instead of counting them (tests); the default counts
         drops in ``filodb_gateway_parse_errors`` and keeps the latest offender
-        in ``last_parse_error``."""
+        in ``last_parse_error``.
+
+        ``governor``/``series_known(shard, key) -> bool``: the cardinality
+        fast-shed edge (core/cardinality.py). A line that would BIRTH a new
+        series for an over-quota tenant sheds here with the typed
+        SeriesQuotaExceeded RETRY (strict mode) or a counted drop — but only
+        when ``series_known`` proves the series is new; an unprovable case
+        passes through and the shard-level limiter stays authoritative, so
+        the edge can never drop samples for an existing series."""
         self.publish = publish
         self.mapper = ShardMapper(num_shards, spread)
         self.schema = schema
         self.flush_lines = flush_lines
         self.flush_interval_ms = flush_interval_ms
         self.strict = strict
+        self._governor = governor
+        self._series_known = series_known
         # optional shutdown hook: stop() calls it after the final builder
         # flush so windowed bus publishers drain their sub-window remainder
         # (no acked-but-unflushed lines on shutdown); owners wire it to
@@ -236,7 +248,7 @@ class GatewayServer:
                                 outer.ingest_line(line, st)
                     if pending.strip():
                         outer.ingest_line(pending.decode(errors="replace"), st)
-                except InfluxParseError:
+                except (InfluxParseError, SeriesQuotaExceeded):
                     # strict mode: the bad line drops the connection — count
                     # the severed connection so operators see the drop rate
                     registry.counter(FILODB_SWALLOWED_ERRORS,
@@ -381,6 +393,17 @@ class GatewayServer:
         shard = self.mapper.shard_of(
             fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
             fnv1a64(part_key_of(labels, opts)))
+        if self._governor is not None:
+            # a memo miss is the only place a NEW series can first appear:
+            # shed it typed (RETRY) when the tenant is over quota AND the
+            # series is provably unknown — never on an unprovable probe
+            tenant = self._governor.tenant_of(labels)
+            if self._governor.over_limit(tenant) \
+                    and self._series_known is not None \
+                    and not self._series_known(shard, labels):
+                self._governor.count_shed("gateway", tenant)
+                raise SeriesQuotaExceeded(
+                    tenant, retry_after_s=self._governor.retry_after_s)
         route = (shard, labels, tuple(sorted(labels.items())))
         if head is not None:
             with self._memo_lock:
@@ -444,7 +467,13 @@ class GatewayServer:
             for fname, fval in fields.items():
                 route = None if routes is None else routes.get(fname)
                 if route is None:
-                    route = self._resolve_route(head, measurement, tags, fname)
+                    try:
+                        route = self._resolve_route(head, measurement, tags,
+                                                    fname)
+                    except SeriesQuotaExceeded:
+                        if self.strict:
+                            raise       # typed RETRY to the caller
+                        continue        # counted; only the NEW series drops
                 shard, labels, key = route
                 b = st.builders.get(shard)
                 if b is None:

@@ -442,8 +442,10 @@ class Parser:
                 elif op == "!=":
                     matchers.append(NotEquals(lname, val))
                 elif op == "=~":
+                    validate_matcher_regex(lname, val, negated=False)
                     matchers.append(EqualsRegex(lname, val))
                 elif op == "!~":
+                    validate_matcher_regex(lname, val, negated=True)
                     matchers.append(NotEqualsRegex(lname, val))
                 else:
                     raise ParseError(f"bad matcher op {op!r}")
@@ -456,6 +458,32 @@ class Parser:
 def _unquote(s: str) -> str:
     body = s[1:-1]
     return body.encode().decode("unicode_escape")
+
+
+# regex matchers longer than this are refused at parse time: the index
+# compiles and caches matcher patterns, and a multi-KB pattern is a typo or
+# a hostile payload, not a selector (the reference bounds query sizes the
+# same way — a fiat limit, typed at the edge)
+MAX_MATCHER_PATTERN_LEN = 1024
+
+
+def validate_matcher_regex(label: str, pattern: str,
+                           negated: bool = False) -> None:
+    """Compile a matcher regex ONCE at parse time (re's compile cache makes
+    later index-side compiles free) with a bounded pattern length, raising a
+    typed ParseError naming the offending matcher — an invalid or
+    catastrophic pattern must be a 422 at the edge, never a 500 from the
+    middle of a shard select."""
+    op = "!~" if negated else "=~"
+    if len(pattern) > MAX_MATCHER_PATTERN_LEN:
+        raise ParseError(
+            f"regex in matcher {label}{op}... is {len(pattern)} chars "
+            f"(max {MAX_MATCHER_PATTERN_LEN})")
+    try:
+        re.compile(pattern)
+    except re.error as e:
+        raise ParseError(
+            f"invalid regex in matcher {label}{op}{pattern!r}: {e}") from None
 
 
 def parse_query(text: str) -> Expr:

@@ -23,10 +23,18 @@ _MATCHER_TO_FILTER = {
 
 
 def matchers_to_filters(matchers) -> list:
-    """LabelMatcher protobufs -> index filters (__name__ -> metric column)."""
-    return [_MATCHER_TO_FILTER[m.type](
-                "_metric_" if m.name == "__name__" else m.name, m.value)
-            for m in matchers]
+    """LabelMatcher protobufs -> index filters (__name__ -> metric column).
+    Regex matchers validate here — compile once, bounded pattern length —
+    so a bad pattern is a typed client error naming the matcher, never a
+    500 from deep inside a shard select."""
+    from .parser import validate_matcher_regex
+    out = []
+    for m in matchers:
+        label = "_metric_" if m.name == "__name__" else m.name
+        if m.type in (pb.LabelMatcher.RE, pb.LabelMatcher.NRE):
+            validate_matcher_regex(label, m.value)
+        out.append(_MATCHER_TO_FILTER[m.type](label, m.value))
+    return out
 
 
 def read_request(body: bytes, engine, local_only: bool = False) -> bytes:
@@ -102,14 +110,29 @@ def _peer_read_fetch(body: bytes, engine):
     return fetch
 
 
-def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
+def write_request_to_containers(body: bytes, schema: Schema, mapper,
+                                governor=None, series_known=None) -> dict:
     """snappy(WriteRequest) -> {shard: RecordContainer} routed like the gateway
     (shard-key hash selects the shard group, part hash spreads within it).
 
     The reserved ``__rule__`` label is REJECTED here (typed 422): it marks
     recording-rule output, which publishes through the rules subsystem's
     own deterministic-pub-id path — an external write carrying it would
-    forge derived-series provenance."""
+    forge derived-series provenance.
+
+    ``governor``/``series_known(shard, labels) -> bool`` arm the
+    cardinality fast-shed edge: a series that is over its tenant's quota
+    AND provably new is dropped from the batch and counted; the HTTP edge
+    then answers 429 + Retry-After AFTER publishing the kept samples —
+    existing-series samples always land (``write_governed`` returns the
+    shed count)."""
+    return write_governed(body, schema, mapper, governor, series_known)[0]
+
+
+def write_governed(body: bytes, schema: Schema, mapper,
+                   governor=None, series_known=None):
+    """write_request_to_containers plus (shed count, shed tenant names) —
+    the 429-deciding signal at the HTTP write edge."""
     from ..query.rangevector import QueryError
     from ..rules.spec import RULE_LABEL
     from ..utils.metrics import FILODB_RULES_SPOOF_REJECTS, registry
@@ -117,6 +140,8 @@ def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
     req.ParseFromString(snappy.decompress(body))
     builders: dict[int, RecordBuilder] = {}
     opts = schema.options
+    shed = 0
+    shed_tenants: set[str] = set()
     for series in req.timeseries:
         labels = {("_metric_" if lp.name == "__name__" else lp.name): lp.value
                   for lp in series.labels}
@@ -130,9 +155,21 @@ def write_request_to_containers(body: bytes, schema: Schema, mapper) -> dict:
         shard = mapper.shard_of(
             fnv1a64(shard_key_of(labels, opts)) & 0xFFFFFFFF,
             fnv1a64(part_key_of(labels, opts)))
+        if governor is not None:
+            # shed only what is provably a NEW series of an over-quota
+            # tenant; anything unprovable passes through — the shard-level
+            # limiter stays authoritative and existing samples never drop
+            tenant = governor.tenant_of(labels)
+            if governor.over_limit(tenant) and series_known is not None \
+                    and not series_known(shard, labels):
+                governor.count_shed("remote-write", tenant)
+                shed += 1
+                shed_tenants.add(tenant)
+                continue
         b = builders.get(shard)
         if b is None:
             b = builders[shard] = RecordBuilder(schema)
         for s in series.samples:
             b.add(labels, int(s.timestamp_ms), float(s.value))
-    return {shard: b.build() for shard, b in builders.items()}
+    return ({shard: b.build() for shard, b in builders.items()}, shed,
+            sorted(shed_tenants))

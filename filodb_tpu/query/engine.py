@@ -597,11 +597,18 @@ class QueryEngine:
                     self._fragment_store(frag_key, plan, res, range_key,
                                          epochs)
                 if (neg_key is not None and ctx.stats.series_matched == 0
-                        and res.matrix.num_series == 0):
+                        and res.matrix.num_series == 0
+                        and ctx.stats.recovering_shards == 0
+                        and not self._any_recovering()):
                     # the SELECTION was provably empty cluster-wide (peer
                     # legs merge their series_matched into ctx.stats): the
                     # next refresh skips the whole pipeline until the TTL
-                    # admits newly-appearing series
+                    # admits newly-appearing series. An empty seen while
+                    # ANY shard is still RECOVERING proves nothing — local
+                    # shards via the flag, peer shards via the
+                    # recovering_shards stat riding the /exec wire — the
+                    # series may simply not have loaded yet, and a cached
+                    # empty would mask them for the whole TTL
                     self.negative_cache.put(neg_key, range_key)
                 return res
         except BaseException as e:
@@ -820,6 +827,11 @@ class QueryEngine:
 
         return self.planner.estimate_cost(
             plan, series_of, self.config.stale_sample_after_ms)
+
+    def _any_recovering(self) -> bool:
+        """True while any LOCAL shard is mid-recovery (partial data)."""
+        return any(getattr(sh, "recovering", False)
+                   for sh in self.memstore.shards_of(self.dataset))
 
     def _epoch_vector(self) -> tuple | None:
         """The cluster ingest-watermark vector (see :meth:`_epoch_state`)."""

@@ -1144,6 +1144,11 @@ class SelectRawPartitionsExec(ExecPlan):
         # kernel dispatch: a concurrent ingest flush donates (invalidates) the
         # store buffers (see TimeSeriesShard.lock)
         shard, _col = self._shard_of(ctx)
+        if getattr(shard, "recovering", False):
+            # partial data: the count crosses the peer wire with the other
+            # stats, so the ROOT node knows an empty selection proves
+            # nothing (its negative cache must skip this query)
+            ctx.stats.add("recovering_shards")
         # step-varying scalar operands resolve BEFORE the lock: their
         # subplans take other shards' locks (nested acquisition would ABBA-
         # deadlock two concurrent mirror-image queries)

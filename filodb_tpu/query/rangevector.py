@@ -125,7 +125,7 @@ class QueryStats:
               "rows_paged_in", "result_cells", "result_cache_hits",
               "negative_cache_hits", "fused_kernels", "admission_shed",
               "subquery_inner_cells", "fragment_steps_reused",
-              "windows_widened")
+              "windows_widened", "recovering_shards")
 
     def __init__(self):
         self.series_matched = 0        # series selected by leaf filters
@@ -145,6 +145,12 @@ class QueryStats:
                                         # incremental fragment cache
         self.windows_widened = 0       # windowed fns auto-widened to the
                                        # serving family's resolution
+        self.recovering_shards = 0     # leaf selects served by a shard
+                                       # mid-recovery (partial data):
+                                       # crosses the peer wire with the
+                                       # other counters, so the caller
+                                       # knows an empty answer proves
+                                       # nothing (negative cache skips it)
         # serving resolution the retention router picked ("raw" / "1m" /
         # "1h+raw" for a stitched range); None when routing is off — a
         # label, not a counter, so merge() keeps the top-level value

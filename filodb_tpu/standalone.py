@@ -328,6 +328,10 @@ class FiloServer:
         except ValueError:
             # a retried start after a partial failure: the store exists
             shard = self.memstore.shard(dataset, shard_num)
+        # cardinality governance + durable index time buckets, wired BEFORE
+        # the consumer starts (recovery adopts tenants and reads index.log)
+        shard.governor = self._governor
+        shard.index_bucket_ms = self._index_bucket_ms
         if self._fence is not None:
             # epoch-fence the store ring BEFORE the consumer starts: our
             # claim supersedes any deposed owner's, and its straggler
@@ -775,6 +779,36 @@ class FiloServer:
             if hasattr(self._sink, "write_guard"):
                 self._sink.write_guard = self._fence
         self._store_cfg = cfg.store_config()
+        # ingest cardinality governance (index.max_series_per_tenant): ONE
+        # governor per dataset shared by every local shard and both ingest
+        # edges — shard-level birth checks are authoritative, the edges
+        # fast-shed what they can prove is a new over-quota series
+        self._governor = None
+        if cfg.get("index.max_series_per_tenant") is not None:
+            from .core.cardinality import CardinalityGovernor
+            self._governor = CardinalityGovernor(
+                int(cfg["index.max_series_per_tenant"]),
+                tenant_label=cfg["index.tenant_label"], dataset=dataset,
+                retry_after_s=parse_duration_ms(
+                    cfg["index.quota_retry_after"]) / 1000.0)
+        self._index_bucket_ms = (parse_duration_ms(cfg["index.time_bucket"])
+                                 if cfg.get("index.persist") else 0)
+
+        def series_known(shard_num: int, labels, _ds=dataset) -> bool:
+            """Edge probe: is this label set an EXISTING series of a LOCAL
+            shard? Unknown/remote shards answer True (never shed on an
+            unprovable probe — the shard-level limiter is authoritative)."""
+            from .core.schemas import part_key_of as _pk_of
+            try:
+                sh = self.memstore.shard(_ds, shard_num)
+            except KeyError:
+                return True
+            pk = _pk_of(dict(labels) if not isinstance(labels, dict)
+                        else labels, sh.schema.options)
+            with sh.lock:
+                return pk in sh._part_key_to_id
+
+        self._series_known = series_known
         health = ShardHealthStats(dataset)
         self.manager.subscribe(lambda ev: health.update(self.manager.snapshot(dataset)))
         # inline downsampling publisher (ref: ShardDownsampler at flush); the
@@ -876,7 +910,11 @@ class FiloServer:
                                        "rebalance": self.rebalance_shard,
                                        "adopt": self.adopt_shard},
                                    subscribe_poll_s=parse_duration_ms(
-                                       cfg["query.subscribe_poll"]) / 1000.0
+                                       cfg["query.subscribe_poll"]) / 1000.0,
+                                   governors=(
+                                       {dataset: (self._governor,
+                                                  self._series_known)}
+                                       if self._governor is not None else None)
                                    ).start()
         if cfg.get("ingest.gateway_port") is not None:
             # Influx line-protocol gateway, config-wired: lines route to ALL
@@ -902,7 +940,9 @@ class FiloServer:
                 schema=self.memstore.schemas[cfg["schema"]],
                 host=cfg["http.host"], port=cfg["ingest.gateway_port"],
                 flush_lines=cfg["ingest.gateway_flush_lines"],
-                flush_interval_ms=gw_iv_ms).start()
+                flush_interval_ms=gw_iv_ms,
+                governor=self._governor,
+                series_known=self._series_known).start()
 
             def gw_drain():
                 # gateway.stop() parity: the windowed publishers' sub-window

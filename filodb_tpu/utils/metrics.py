@@ -88,6 +88,10 @@ FILODB_RULES_ALERTS_FIRING = "filodb_rules_alerts_firing"
 FILODB_RULES_ALERT_TRANSITIONS = "filodb_rules_alert_transitions"
 FILODB_RULES_NOTIFICATIONS = "filodb_rules_notifications"
 FILODB_RULES_SPOOF_REJECTS = "filodb_rules_spoof_rejects"
+FILODB_INDEX_RECOVER_MS = "filodb_index_recover_ms"
+FILODB_INDEX_PERSISTED_BUCKETS = "filodb_index_persisted_buckets"
+FILODB_TENANT_ACTIVE_SERIES = "filodb_tenant_active_series"
+FILODB_TENANT_SERIES_SHED = "filodb_tenant_series_shed"
 FILODB_CLUSTER_GOSSIP_ROUNDS = "filodb_cluster_gossip_rounds"
 FILODB_CLUSTER_PEER_STATE = "filodb_cluster_peer_state"
 FILODB_CLUSTER_EPOCH = "filodb_cluster_epoch"
@@ -298,6 +302,24 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "External writes rejected for carrying the reserved "
                    "__rule__ label (tagged site=remote-write|gateway): "
                    "derived-series provenance cannot be forged."),
+    FILODB_INDEX_RECOVER_MS: (
+        "gauge", "Wall milliseconds the last shard restart spent recovering "
+                 "the part-key index (per dataset/shard): columnar load "
+                 "from persisted index.log time buckets when available, "
+                 "else the per-key partkeys.log rebuild."),
+    FILODB_INDEX_PERSISTED_BUCKETS: (
+        "counter", "Index time-bucket frames persisted to the durable tier "
+                   "(CRC-verified appends to index.log; recovery loads "
+                   "these columnar instead of rebuilding per key)."),
+    FILODB_TENANT_ACTIVE_SERIES: (
+        "gauge", "Active (resident) series per dataset and tenant — the "
+                 "quantity index.max_series_per_tenant bounds; births "
+                 "increment, purge/eviction/release decrement."),
+    FILODB_TENANT_SERIES_SHED: (
+        "counter", "NEW series births shed by the per-tenant cardinality "
+                   "limiter, tagged site=shard|gateway|remote-write — "
+                   "samples for existing series are never counted here "
+                   "(they always land)."),
     FILODB_CLUSTER_GOSSIP_ROUNDS: (
         "counter", "Gossip probe rounds run by this node's membership agent "
                    "(the deterministic round counter suspicion is counted "

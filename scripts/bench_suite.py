@@ -169,42 +169,258 @@ def bench_encoding(full: bool) -> None:
         emit("encoding", "native_unpack_doubles", n * 8 * it / dt / 1e6, "MB/s")
 
 
+class _PurePythonIndex:
+    """The seed-era index shape — dicts of sets, per-value regex loops — the
+    baseline the columnar engine's >= 10x acceptance bar measures against
+    (bit-identical results asserted)."""
+
+    def __init__(self):
+        self.inv: dict = {}              # name -> value -> set(pid)
+
+    def add(self, pid, labels):
+        for k, v in labels.items():
+            self.inv.setdefault(k, {}).setdefault(v, set()).add(pid)
+
+    def query(self, filters):
+        import re
+
+        from filodb_tpu.core import filters as F
+        result = None
+        for f in filters:
+            vals = self.inv.get(f.label, {})
+            if isinstance(f, F.Equals):
+                ids = set(vals.get(f.value, ()))
+            elif isinstance(f, F.EqualsRegex):
+                pat = re.compile(f.pattern)
+                ids = set()
+                for v, s in vals.items():
+                    if pat.fullmatch(v):
+                        ids |= s
+            elif isinstance(f, F.NotEquals):
+                ids = set()
+                for v, s in vals.items():
+                    if v != f.value:
+                        ids |= s
+            else:
+                raise TypeError(f)
+            result = ids if result is None else (result & ids)
+        return np.asarray(sorted(result or ()), np.int32)
+
+    def topk(self, label, k):
+        from collections import Counter
+        c = Counter({v: len(s) for v, s in self.inv.get(label, {}).items()})
+        return [v for v, _ in c.most_common(k)]
+
+
 def bench_partkey_index(full: bool) -> None:
-    """Ref PartKeyIndexBenchmark: 1M part keys, 20-filter lookup batches."""
+    """Ref PartKeyIndexBenchmark: the columnar index at 100k (and 1M with
+    --full) — build rate, equals/regex/multi-matcher select latency with
+    COLD select caches (the filter/union/match caches cleared per batch, so
+    the rows measure the columnar set algebra, not a memo), top-k
+    label_values, recover-ms from a 2-replica durable ring, ingest p99 with
+    the cardinality limiter armed, and the >= 10x bar vs the pure-Python
+    dicts-of-sets baseline at bit-identical results."""
     from filodb_tpu.core import filters as F
     from filodb_tpu.core.partkey_index import PartKeyIndex
 
-    n = 1_000_000 if full else 100_000
-    idx = PartKeyIndex()
-    now = BASE
+    def labels_of(i):
+        return {"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app",
+                "job": f"App-{i % 100}", "host": f"H{i % 1000}",
+                "instance": f"I{i:07d}"}
+
+    def build_columnar(n):
+        idx = PartKeyIndex()
+        t0 = time.perf_counter()
+        ok = idx.add_part_keys_columnar(
+            np.arange(n),
+            {"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app"},
+            ["job", "host", "instance"],
+            [[f"App-{i % 100}" for i in range(n)],
+             [f"H{i % 1000}" for i in range(n)],
+             [f"I{i:07d}" for i in range(n)]], BASE)
+        assert ok
+        # readers fold the staged columns: include it in the build cost
+        idx.part_ids_from_filters([F.Equals("_metric_", "heap_usage")],
+                                  0, 1 << 62)
+        return idx, time.perf_counter() - t0
+
+    def filter_batches():
+        return [
+            ("equals", [[F.Equals("job", f"App-{i}"), F.Equals("host", "H0"),
+                         F.Equals("_metric_", "heap_usage")]
+                        for i in range(20)]),
+            ("regex", [[F.Equals("_metric_", "heap_usage"),
+                        F.EqualsRegex("instance", f"I00000{i % 10}.*")]
+                       for i in range(20)]),
+            ("multi_matcher", [[F.Equals("_metric_", "heap_usage"),
+                                F.EqualsRegex("host", f"H{i % 10}.*"),
+                                F.NotEquals("job", "App-0")]
+                               for i in range(20)]),
+            # every operand dense (covers most of the pid space): the
+            # u64-word bitmap AND/ANDNOT plane
+            ("dense_multi", [[F.Equals("_metric_", "heap_usage"),
+                              F.Equals("_ws_", "demo"),
+                              F.NotEquals("job", f"App-{i % 100}")]
+                             for i in range(20)]),
+        ]
+
+    def cold(idx):
+        # measure the select plane, not the memo layer: dashboards DO hit
+        # these caches, but the acceptance bar is the cold set algebra
+        idx._filter_cache.clear()
+        idx._regex_union_cache.clear()
+        idx._regex_cache.clear()
+
+    sizes = [100_000, 1_000_000] if full else [100_000]
+    results_100k: dict[str, list] = {}
+    for n in sizes:
+        tag = "1m" if n >= 1_000_000 else "100k"
+        idx, build_s = build_columnar(n)
+        emit("partkey_index", f"build_columnar_rate_{tag}", n / build_s,
+             "keys/s")
+        for name, batches in filter_batches():
+            def run(idx=idx, batches=batches):
+                cold(idx)
+                for flt in batches:
+                    idx.part_ids_from_filters(list(flt), 0, 1 << 62)
+            dt, it = timed(run, max_iters=20)
+            emit("partkey_index", f"{name}_ms_{tag}",
+                 dt / (it * len(batches)) * 1000, "ms")
+            if n == 100_000:
+                cold(idx)
+                results_100k[name] = [
+                    idx.part_ids_from_filters(list(flt), 0, 1 << 62)
+                    for flt in batches]
+        dt, it = timed(lambda idx=idx: idx.label_value_counts("job",
+                                                              top_k=10),
+                       max_iters=50)
+        emit("partkey_index", f"labelvalues_topk_ms_{tag}", dt / it * 1000,
+             "ms")
+        filt = [F.EqualsRegex("host", "H1.*")]
+        dt, it = timed(lambda idx=idx, filt=filt: idx.label_value_counts(
+            "job", list(filt), top_k=10), max_iters=20)
+        emit("partkey_index", f"labelvalues_topk_filtered_ms_{tag}",
+             dt / it * 1000, "ms")
+        emit("partkey_index", f"label_storage_{tag}",
+             idx.arena_bytes() / n, "bytes/series")
+        emit("partkey_index", f"postings_storage_{tag}",
+             idx.postings_bytes() / n, "bytes/series")
+        if n == 100_000:
+            idx_100k = idx
+
+    # ---- >= 10x bar vs the pure-Python baseline (100k, bit-identical) ----
+    n = 100_000
+    pure = _PurePythonIndex()
     t0 = time.perf_counter()
     for i in range(n):
-        idx.add_part_key(i, {"__name__": "heap_usage", "job": f"App-{i % 100}",
-                             "host": f"H{i % 1000}", "instance": f"I{i}"}, now)
-    add_s = time.perf_counter() - t0
-    emit("partkey_index", "add_rate", n / add_s, "keys/s")
+        pure.add(i, labels_of(i))
+    emit("partkey_index", "pure_build_rate_100k",
+         n / (time.perf_counter() - t0), "keys/s")
+    for name, batches in filter_batches():
+        def run_pure(batches=batches):
+            for flt in batches:
+                pure.query(list(flt))
+        dt, it = timed(run_pure, min_s=0.5, max_iters=5)
+        pure_ms = dt / (it * len(batches)) * 1000
+        emit("partkey_index", f"pure_{name}_ms_100k", pure_ms, "ms")
+        # bit-identical results: same sorted pid arrays per batch entry
+        parity = all(
+            np.array_equal(got, pure.query(list(flt)))
+            for got, flt in zip(results_100k[name], batches))
+        emit("partkey_index", f"{name}_parity_vs_pure", float(parity), "bool")
 
-    def equals_lookup():
-        for i in range(20):
-            idx.part_ids_from_filters(
-                [F.Equals("job", f"App-{i}"), F.Equals("host", "H0"),
-                 F.Equals("__name__", "heap_usage")], now, now + 1000)
+    # ---- recover-ms from the durable ring --------------------------------
+    import shutil
+    import tempfile
 
-    dt, it = timed(equals_lookup)
-    emit("partkey_index", "equals_lookup", 20 * it / dt, "lookups/s")
+    from filodb_tpu.core.diststore import (RemoteStore,
+                                           ReplicatedColumnStore,
+                                           StoreServer)
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import GAUGE
+    from filodb_tpu.utils.metrics import FILODB_INDEX_RECOVER_MS, registry
+    for n in sizes:
+        tag = "1m" if n >= 1_000_000 else "100k"
+        root = tempfile.mkdtemp(prefix="pkib-")
+        servers = [StoreServer(f"{root}/n{i}").start() for i in range(2)]
+        try:
+            ring = ReplicatedColumnStore(
+                [RemoteStore(f"127.0.0.1:{s.port}") for s in servers],
+                replication=2)
+            cfg = StoreConfig(max_series_per_shard=max(n, 1 << 20),
+                              samples_per_series=4, flush_batch_size=10**9,
+                              dtype="float64")
+            ms = TimeSeriesMemStore()
+            sh = ms.setup("pkib", GAUGE, 0, cfg, sink=ring)
+            step = 200_000
+            for base_i in range(0, n, step):
+                b = RecordBuilder(GAUGE)
+                m = min(step, n - base_i)
+                b.add_series_batch(
+                    {"_metric_": "heap_usage", "_ws_": "demo", "_ns_": "app",
+                     "job": [f"App-{(base_i + i) % 100}" for i in range(m)],
+                     "host": [f"H{(base_i + i) % 1000}" for i in range(m)],
+                     "instance": [f"I{base_i + i:07d}" for i in range(m)]},
+                    BASE, 1.0)
+                sh.ingest(b.build())
+            sh.flush_all_groups()
+            ms2 = TimeSeriesMemStore()
+            sh2 = ms2.setup("pkib", GAUGE, 0, cfg, sink=ring)
+            t0 = time.perf_counter()
+            sh2.recover()
+            total_s = time.perf_counter() - t0
+            assert sh2.num_series == n
+            idx_ms = registry.gauge(FILODB_INDEX_RECOVER_MS,
+                                    {"dataset": "pkib", "shard": "0"}).value
+            emit("partkey_index", f"recover_index_ms_{tag}", idx_ms, "ms")
+            emit("partkey_index", f"recover_total_ms_{tag}", total_s * 1000,
+                 "ms")
+            emit("partkey_index", f"recover_rate_{tag}",
+                 n / max(idx_ms / 1000.0, 1e-9), "keys/s")
+        finally:
+            for s in servers:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(root, ignore_errors=True)
 
-    def regex_lookup():
-        for i in range(20):
-            idx.part_ids_from_filters(
-                [F.Equals("job", f"App-{i}"), F.EqualsRegex("host", "H[0-9]"),
-                 F.Equals("__name__", "heap_usage")], now, now + 1000)
-
-    dt, it = timed(regex_lookup, max_iters=20)
-    emit("partkey_index", "regex_lookup", 20 * it / dt, "lookups/s")
-
-    dt, it = timed(lambda: idx.label_values("job", top_k=10), max_iters=20)
-    emit("partkey_index", "labelvalues_topk", it / dt, "ops/s")
-    emit("partkey_index", "label_storage", idx.arena_bytes() / n, "bytes/series")
+    # ---- ingest p99 with the limiter armed -------------------------------
+    from filodb_tpu.core.cardinality import CardinalityGovernor
+    p99s = {}
+    for governed in (False, True):
+        cfg = StoreConfig(max_series_per_shard=1 << 16,
+                          samples_per_series=256, flush_batch_size=10**9,
+                          dtype="float64")
+        ms = TimeSeriesMemStore()
+        sh = ms.setup("pkg", GAUGE, 0, cfg)
+        if governed:
+            sh.governor = CardinalityGovernor(50_000, dataset="pkg")
+        n_series, per = 5000, 1000
+        b = RecordBuilder(GAUGE)
+        b.add_series_batch(
+            {"_metric_": "m", "_ws_": "demo", "_ns_": "app",
+             "host": [f"h{i}" for i in range(n_series)]}, BASE, 1.0)
+        sh.ingest(b.build())          # registration: every later row exists
+        lat = []
+        for t in range(60):
+            b = RecordBuilder(GAUGE)
+            b.add_series_batch(
+                {"_metric_": "m", "_ws_": "demo", "_ns_": "app",
+                 "host": [f"h{i}" for i in range(per)]},
+                BASE + (t + 1) * 10_000, float(t))
+            c = b.build()
+            t0 = time.perf_counter()
+            sh.ingest(c)
+            lat.append((time.perf_counter() - t0) * 1000)
+        p99 = sorted(lat)[int(len(lat) * 0.99) - 1]
+        p99s[governed] = p99
+        emit("partkey_index",
+             "ingest_p99_governed_ms" if governed else "ingest_p99_plain_ms",
+             p99, "ms")
+    emit("partkey_index", "ingest_p99_governed_ratio",
+         p99s[True] / max(p99s[False], 1e-9), "x")
 
 
 def bench_hist_ingest(full: bool) -> None:

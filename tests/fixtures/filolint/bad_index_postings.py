@@ -1,0 +1,26 @@
+"""BAD: per-element Python loops over posting arrays inside an index
+module (basename matches the ``index*.py`` hot-module scope) — the
+``index-pure-python-postings`` rule must flag every loop shape."""
+
+import numpy as np
+
+
+def intersect(postings_a, postings_b):
+    out = []
+    for pid in postings_a:                   # flagged: for over postings
+        if pid in postings_b:
+            out.append(pid)
+    return np.asarray(out, np.int32)
+
+
+def count_live(self_postings):
+    return sum(1 for _p in self_postings)    # flagged: genexp over postings
+
+
+class Index:
+    def __init__(self):
+        self._postings = np.empty(0, np.uint64)
+
+    def values(self):
+        # flagged: listcomp over an attribute posting array (via .tolist())
+        return [int(k) & 0xFFFFFFFF for k in self._postings.tolist()]

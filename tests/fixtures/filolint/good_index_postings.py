@@ -1,0 +1,30 @@
+"""GOOD twin: the same operations as vectorized numpy ops — no
+interpreter loop ever touches a posting array; iterating STAGED SEGMENT
+LISTS (lists of whole arrays) is fine."""
+
+import numpy as np
+
+
+def intersect(postings_a, postings_b):
+    return postings_a[np.isin(postings_a, postings_b, assume_unique=True)]
+
+
+def count_live(self_postings):
+    return int(len(self_postings))
+
+
+class Index:
+    def __init__(self):
+        self._postings = np.empty(0, np.uint64)
+        self._segs = []
+
+    def values(self):
+        return (self._postings & np.uint64(0xFFFFFFFF)).astype(np.int32)
+
+    def fold(self):
+        # iterating the SEGMENT LIST (whole arrays per element) is not a
+        # per-element posting loop
+        parts = [np.asarray(s, np.uint64) for s in self._segs]
+        if parts:
+            self._postings = np.sort(np.concatenate(parts))
+            self._segs = []

@@ -1,0 +1,250 @@
+"""Ingest cardinality governance: per-tenant active-series gauges and the
+series-birth limiter — shard-authoritative shedding that NEVER drops samples
+for existing series, typed RETRY at the gateway, 429 + Retry-After at
+remote-write."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import filters as F
+from filodb_tpu.core.cardinality import (CardinalityGovernor,
+                                         SeriesQuotaExceeded)
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+
+BASE = 1_700_000_000_000
+
+
+def _store(limit=None, n=256, **gov_kw):
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=n, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    sh = ms.setup("prometheus", GAUGE, 0, cfg)
+    gov = None
+    if limit is not None:
+        gov = CardinalityGovernor(limit, dataset="prometheus", **gov_kw)
+        sh.governor = gov
+    return ms, sh, gov
+
+
+def _container(tenant, names, ts=BASE, value=1.0):
+    b = RecordBuilder(GAUGE)
+    for nm in names:
+        b.add({"_metric_": "m", "_ws_": tenant, "_ns_": "app", "host": nm},
+              ts, value)
+    return b.build()
+
+
+# -- governor unit -----------------------------------------------------------
+
+def test_governor_admit_retire_over_limit():
+    gov = CardinalityGovernor(2, dataset="d")
+    assert gov.admit("t") and gov.admit("t")
+    assert not gov.admit("t") and gov.over_limit("t")
+    gov.retire("t")
+    assert not gov.over_limit("t") and gov.admit("t")
+    # adopt bypasses the limit (recovery owns its data)
+    gov.adopt("t", 5)
+    assert gov.active("t") == 7
+    assert not gov.admit_block("u", 3)
+    gov2 = CardinalityGovernor(None)
+    assert gov2.admit("anyone") and not gov2.over_limit("anyone")
+
+
+def test_tenant_identity_from_labels_and_tuples():
+    gov = CardinalityGovernor(1, tenant_label="_ws_")
+    assert gov.tenant_of({"_ws_": "acme", "x": "1"}) == "acme"
+    assert gov.tenant_of((("_ws_", "acme"), ("x", "1"))) == "acme"
+    assert gov.tenant_of({"x": "1"}) == "default"
+
+
+# -- shard-authoritative birth shedding --------------------------------------
+
+def test_shard_sheds_new_series_never_existing_samples():
+    ms, sh, gov = _store(limit=3)
+    sh.ingest(_container("acme", [f"h{i}" for i in range(3)]))
+    assert sh.num_series == 3 and gov.active("acme") == 3
+    # over quota: the batch mixes 3 EXISTING series + 2 new — the new ones
+    # shed, every existing-series sample lands
+    mixed = _container("acme", [f"h{i}" for i in range(5)], ts=BASE + 10_000)
+    sh.ingest(mixed)
+    sh.flush()
+    assert sh.num_series == 3
+    assert sh.stats.series_quota_shed == 2
+    pids = sh.part_ids_from_filters([F.Equals("_metric_", "m")], 0, 1 << 62)
+    for pid in pids.tolist():
+        ts, _ = sh.store.series_snapshot(pid)
+        assert len(ts) == 2          # both rounds of samples present
+    # another tenant is unaffected
+    sh.ingest(_container("beta", ["b0"]))
+    assert sh.num_series == 4 and gov.active("beta") == 1
+
+
+def test_shard_release_frees_quota():
+    ms, sh, gov = _store(limit=2)
+    sh.ingest(_container("acme", ["h0", "h1"]))
+    sh.flush()
+    assert not gov.admit("acme")
+    gov.retire("acme", 0)            # no-op sanity
+    sh.purge_expired_partitions(BASE + 10**9)   # everything ends -> purged
+    assert gov.active("acme") == 0
+    sh.ingest(_container("acme", ["h2"], ts=BASE + 2 * 10**9))
+    assert gov.active("acme") == 1 and sh.stats.series_quota_shed == 0
+
+
+def test_bulk_create_respects_block_reservation():
+    ms, sh, gov = _store(limit=600, n=4096)
+    b = RecordBuilder(GAUGE)
+    b.add_series_batch({"_metric_": "m", "_ws_": "acme",
+                        "host": [f"h{i}" for i in range(1000)]}, BASE, 1.0)
+    sh.ingest(b.build())             # bulk declines; per-key sheds precisely
+    assert sh.num_series == 600
+    assert gov.active("acme") == 600
+    assert sh.stats.series_quota_shed == 400
+    # a fitting bulk batch for another tenant takes the block reservation
+    b2 = RecordBuilder(GAUGE)
+    b2.add_series_batch({"_metric_": "m", "_ws_": "beta",
+                         "host": [f"b{i}" for i in range(600)]}, BASE, 1.0)
+    sh.ingest(b2.build())
+    assert gov.active("beta") == 600 and sh.num_series == 1200
+
+
+def test_recovery_adopts_tenants_without_limiting(tmp_path):
+    from filodb_tpu.core.store import FileColumnStore
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=64, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float64")
+    sh = ms.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+    sh.ingest(_container("acme", [f"h{i}" for i in range(5)]))
+    sh.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup("prometheus", GAUGE, 0, cfg, sink=sink)
+    gov = CardinalityGovernor(2, dataset="prometheus")   # BELOW existing
+    sh2.governor = gov
+    sh2.recover()
+    assert sh2.num_series == 5
+    assert gov.active("acme") == 5   # adopted past the limit
+    # but new births still shed
+    sh2.ingest(_container("acme", ["fresh"]))
+    assert sh2.num_series == 5 and sh2.stats.series_quota_shed == 1
+
+
+# -- gateway edge ------------------------------------------------------------
+
+def test_gateway_typed_retry_and_counted_drop():
+    from filodb_tpu.ingest.gateway import GatewayServer
+    ms, sh, gov = _store(limit=1)
+    known = {}
+
+    def series_known(shard, labels):
+        d = dict(labels) if not isinstance(labels, dict) else labels
+        return d.get("host") in known
+
+    published = []
+    gw = GatewayServer(lambda s, c: published.append((s, c)), num_shards=1,
+                       flush_lines=1, strict=True, governor=gov,
+                       series_known=series_known)
+    gw.ingest_line("m,host=h0 value=1.0 1000000000")
+    known["h0"] = True
+    gov.adopt("default")             # h0 is now the tenant's one series
+    # existing series always passes, even over limit
+    gw.ingest_line("m,host=h0 value=2.0 2000000000")
+    assert len(published) == 2
+    # a NEW series for the over-limit tenant: typed RETRY in strict mode
+    with pytest.raises(SeriesQuotaExceeded) as ei:
+        gw.ingest_line("m,host=h1 value=1.0 3000000000")
+    assert ei.value.retry_after_s > 0
+    assert len(published) == 2       # nothing published for the shed line
+    # non-strict: counted drop, the line vanishes, later lines flow
+    gw.strict = False
+    gw.ingest_line("m,host=h2 value=1.0 4000000000")
+    gw.ingest_line("m,host=h0 value=3.0 5000000000")
+    gw.flush()
+    assert sum(len(c) for _s, c in published) == 3   # h2's sample dropped
+
+
+# -- remote-write edge (429 + Retry-After) -----------------------------------
+
+def _write_body(tenant, hosts, ts=BASE):
+    from filodb_tpu.promql import remote_storage_pb2 as pb
+    from filodb_tpu.utils import snappy
+    req = pb.WriteRequest()
+    for h in hosts:
+        s = req.timeseries.add()
+        for k, v in (("__name__", "m"), ("_ws_", tenant), ("_ns_", "app"),
+                     ("host", h)):
+            s.labels.add(name=k, value=v)
+        s.samples.add(value=1.0, timestamp_ms=ts)
+    return snappy.compress(req.SerializeToString())
+
+
+def test_remote_write_429_sheds_only_new_series():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from filodb_tpu.http.api import FiloHttpServer
+    from filodb_tpu.query.engine import QueryEngine
+
+    ms, sh, gov = _store(limit=2, retry_after_s=7.0)
+    eng = QueryEngine(ms, "prometheus")
+
+    def writer(per_shard):
+        for shard, c in per_shard.items():
+            ms.ingest("prometheus", shard, c)
+
+    def series_known(shard_num, labels):
+        from filodb_tpu.core.schemas import part_key_of
+        pk = part_key_of(labels, sh.schema.options)
+        with sh.lock:
+            return pk in sh._part_key_to_id
+
+    srv = FiloHttpServer({"prometheus": eng}, port=0,
+                         writers={"prometheus": writer},
+                         governors={"prometheus": (gov, series_known)})
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/promql/prometheus/api/v1/write"
+
+        def post(body):
+            rq = urllib.request.Request(url, data=body, method="POST")
+            return urllib.request.urlopen(rq, timeout=10)
+
+        assert post(_write_body("acme", ["h0", "h1"])).status == 204
+        assert gov.active("acme") == 2
+        # mixed batch over quota: 429 + Retry-After, existing samples LAND
+        try:
+            post(_write_body("acme", ["h0", "h1", "h2"], ts=BASE + 10_000))
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert int(e.headers["Retry-After"]) >= 7
+            payload = json.loads(e.read())
+            assert payload["errorType"] == "too_many_series"
+            assert "acme" in payload["error"]
+        sh.flush()
+        assert sh.num_series == 2
+        pids = sh.part_ids_from_filters([F.Equals("_metric_", "m")],
+                                        0, 1 << 62)
+        for pid in pids.tolist():
+            ts, _ = sh.store.series_snapshot(pid)
+            assert len(ts) == 2      # the over-quota batch's samples landed
+    finally:
+        srv.stop()
+
+
+def test_governor_gauges_and_shed_counters_exported():
+    from filodb_tpu.utils.metrics import (FILODB_TENANT_ACTIVE_SERIES,
+                                          FILODB_TENANT_SERIES_SHED,
+                                          registry)
+    ms, sh, gov = _store(limit=1)
+    sh.ingest(_container("gauged", ["h0", "h1"]))
+    g = registry.gauge(FILODB_TENANT_ACTIVE_SERIES,
+                       {"dataset": "prometheus", "tenant": "gauged"})
+    assert g.value == 1.0
+    c = registry.counter(FILODB_TENANT_SERIES_SHED,
+                         {"dataset": "prometheus", "site": "shard",
+                          "tenant": "gauged"})
+    assert c.value == 1.0

@@ -1,0 +1,370 @@
+"""Durable part-key index time buckets: CRC-framed columnar persistence to
+the local store and the replicated ring, columnar recovery through
+Shard.recover with the filodb_index_recover_ms metric, torn-frame and
+missing-log fallbacks, and slot-reuse event ordering."""
+
+import io
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import filters as F
+from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import GAUGE
+from filodb_tpu.core.store import (FileColumnStore, encode_index_bucket,
+                                   iter_index_frames, labels_from_blob)
+
+BASE = 1_700_000_000_000
+DS = "prometheus"
+
+
+def _cfg(n=4096):
+    return StoreConfig(max_series_per_shard=n, samples_per_series=64,
+                       flush_batch_size=10**9, dtype="float64")
+
+
+def _ingest_series(sh, n, ts=BASE, prefix="h"):
+    b = RecordBuilder(GAUGE)
+    b.add_series_batch({"_metric_": "m", "_ws_": "demo", "_ns_": "app",
+                        "host": [f"{prefix}{i}" for i in range(n)]}, ts, 1.0)
+    sh.ingest(b.build())
+
+
+# -- frame codec -------------------------------------------------------------
+
+def test_index_frame_roundtrip_and_torn_tail():
+    entries = [(0, BASE, b"a\x01x\x00b\x01y"), (1, BASE + 5, b"a\x01z"),
+               (2, -1, b""), (3, BASE, b"", 1)]
+    frame = encode_index_bucket(BASE, entries)
+    got = list(iter_index_frames(io.BytesIO(frame + frame[: len(frame) // 2])))
+    assert len(got) == 1             # torn second frame truncates
+    bucket, pids, starts, blobs, flags = got[0]
+    assert bucket == BASE
+    assert pids.tolist() == [0, 1, 2, 3]
+    assert starts.tolist() == [BASE, BASE + 5, -1, BASE]
+    assert labels_from_blob(blobs[0]) == {"a": "x", "b": "y"}
+    assert blobs[2] == b""
+    assert flags.tolist() == [0, 0, 0, 1]
+    # a flipped payload byte fails the CRC: the frame (and everything after)
+    # is ignored, never half-parsed
+    bad = bytearray(frame)
+    bad[-1] ^= 0xFF
+    assert list(iter_index_frames(io.BytesIO(bytes(bad)))) == []
+
+
+# -- columnar recovery -------------------------------------------------------
+
+def _recover_ms(shard_num=0):
+    from filodb_tpu.utils.metrics import FILODB_INDEX_RECOVER_MS, registry
+    return registry.gauge(FILODB_INDEX_RECOVER_MS,
+                          {"dataset": DS, "shard": str(shard_num)}).value
+
+
+def test_recover_from_index_log_columnar(tmp_path):
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    _ingest_series(sh, 1500)
+    sh.flush_all_groups()
+    assert (tmp_path / DS / "shard0" / "index.log").exists()
+    from filodb_tpu.utils.metrics import (FILODB_INDEX_PERSISTED_BUCKETS,
+                                          registry)
+    assert registry.counter(FILODB_INDEX_PERSISTED_BUCKETS,
+                            {"dataset": DS, "shard": "0"}).value >= 1
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.recover()
+    assert sh2.num_series == 1500
+    assert _recover_ms() > 0.0
+    # query parity with the original shard
+    for filters in ([F.Equals("host", "h7")],
+                    [F.EqualsRegex("host", "h1[0-3].")],
+                    [F.Equals("_metric_", "m"), F.NotEquals("host", "h0")]):
+        a = np.sort(sh.part_ids_from_filters(list(filters), 0, 1 << 62))
+        b = np.sort(sh2.part_ids_from_filters(list(filters), 0, 1 << 62))
+        np.testing.assert_array_equal(a, b)
+    assert sh2.index.labels_of(7) == sh.index.labels_of(7)
+    # resolved ids stable: re-ingesting an existing series does not dup
+    _ingest_series(sh2, 10, ts=BASE + 10_000)
+    assert sh2.num_series == 1500
+
+
+def test_recover_falls_back_without_index_log(tmp_path):
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh.index_bucket_ms = 0           # persistence off: partkeys.log only
+    _ingest_series(sh, 300)
+    sh.flush_all_groups()
+    assert not (tmp_path / DS / "shard0" / "index.log").exists()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.recover()
+    assert sh2.num_series == 300
+
+
+def test_recover_prefers_frames_and_survives_corrupt_index_log(tmp_path):
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    _ingest_series(sh, 400)
+    sh.flush_all_groups()
+    # corrupt the whole index log: recovery must fall back to partkeys.log
+    path = tmp_path / DS / "shard0" / "index.log"
+    path.write_bytes(b"\x00garbage" * 10)
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.recover()
+    assert sh2.num_series == 400
+
+
+def test_slot_reuse_event_order_survives_recovery(tmp_path):
+    """A release tombstone followed by a slot-reusing re-creation in the
+    SAME drain batch must recover as the re-created series (consecutive-run
+    frame grouping preserves event order)."""
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(n=64), sink=sink)
+    _ingest_series(sh, 8)
+    sh.flush_all_groups()
+    # purge everything, then re-create one series in a DIFFERENT bucket
+    sh.purge_expired_partitions(BASE + 10**9)
+    b = RecordBuilder(GAUGE)
+    far = BASE + 12 * 3600 * 1000    # lands in another 6h time bucket
+    b.add({"_metric_": "m", "_ws_": "demo", "_ns_": "app",
+           "host": "reborn"}, far, 2.0)
+    sh.ingest(b.build())
+    sh.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(n=64), sink=sink)
+    sh2.recover()
+    assert sh2.num_series == 1
+    got = sh2.part_ids_from_filters([F.Equals("host", "reborn")], 0, 1 << 62)
+    assert len(got) == 1
+    assert sh2.index.labels_of(int(got[0]))["host"] == "reborn"
+    # the purged predecessors stay gone
+    assert len(sh2.part_ids_from_filters([F.Equals("host", "h0")],
+                                         0, 1 << 62)) == 0
+
+
+def test_upgraded_shard_without_genesis_falls_back(tmp_path):
+    """A shard whose partkeys.log predates index.log (upgrade / toggled
+    persistence) must NOT trust a genesis-less or retired log — and the
+    fallback recovery re-anchors a fresh genesis so the next restart takes
+    the fast path again."""
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh.index_bucket_ms = 0           # "old version": partkeys.log only
+    _ingest_series(sh, 50, prefix="old")
+    sh.flush_all_groups()
+    # "upgrade": persistence on; a later batch writes index.log frames that
+    # do NOT cover the old series — simulate by seeding the flag as if the
+    # log were already anchored (the pre-fix bug shape)
+    sh.index_bucket_ms = 6 * 3600 * 1000
+    sh._index_log_seeded = True      # suppress the genesis snapshot
+    _ingest_series(sh, 10, ts=BASE + 60_000, prefix="new")
+    sh.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.recover()                    # genesis-less log: partkeys fallback
+    assert sh2.num_series == 60      # old series NOT lost
+    assert len(sh2.part_ids_from_filters([F.Equals("host", "old7")],
+                                         0, 1 << 62)) == 1
+    # the fallback re-anchored a genesis: the NEXT restart trusts frames
+    ms3 = TimeSeriesMemStore()
+    sh3 = ms3.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh3.recover()
+    assert sh3.num_series == 60 and sh3._index_log_seeded
+
+
+def test_persistence_off_recovery_retires_stale_log(tmp_path):
+    """persist on -> off -> on across restarts: the off-period recovery
+    appends a RETIRE marker, so the on-period restart refuses the stale
+    log instead of losing the off-period's series."""
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    _ingest_series(sh, 20, prefix="a")      # persist ON: genesis + frames
+    sh.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.index_bucket_ms = 0                 # run 2: persistence OFF
+    sh2.recover()                           # appends the RETIRE marker
+    _ingest_series(sh2, 10, ts=BASE + 60_000, prefix="b")
+    sh2.flush_all_groups()                  # partkeys.log only
+    ms3 = TimeSeriesMemStore()
+    sh3 = ms3.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh3.recover()                           # run 3: persistence ON again
+    assert sh3.num_series == 30             # off-period series NOT lost
+    assert len(sh3.part_ids_from_filters([F.Equals("host", "b3")],
+                                         0, 1 << 62)) == 1
+
+
+def test_separator_labels_survive_persistence(tmp_path):
+    """Label values carrying the part-key separator bytes cannot ride the
+    blob encoding — the entry is flagged UNPARSEABLE and recovery falls
+    back to partkeys.log instead of loading split garbage."""
+    sink = FileColumnStore(str(tmp_path))
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(n=64), sink=sink)
+    b = RecordBuilder(GAUGE)
+    weird = "a\x00b"
+    b.add({"_metric_": "m", "_ws_": "demo", "_ns_": "app", "host": weird},
+          BASE, 1.0)
+    b.add({"_metric_": "m", "_ws_": "demo", "_ns_": "app", "host": "plain"},
+          BASE, 2.0)
+    sh.ingest(b.build())
+    sh.flush_all_groups()
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(n=64), sink=sink)
+    sh2.recover()
+    assert sh2.num_series == 2
+    got = sh2.part_ids_from_filters([F.Equals("host", weird)], 0, 1 << 62)
+    assert len(got) == 1
+    assert sh2.index.labels_of(int(got[0]))["host"] == weird
+
+
+def test_blocked_creation_rolls_back_governor_reservation():
+    """A creation blocked on protected eviction candidates (caller stages
+    its prefix and retries) must not leak a quota slot per attempt."""
+    from filodb_tpu.core.cardinality import CardinalityGovernor
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=4, samples_per_series=16,
+                      flush_batch_size=10**9, dtype="float64")
+    sh = ms.setup(DS, GAUGE, 0, cfg)
+    gov = CardinalityGovernor(100, dataset=DS)
+    sh.governor = gov
+    # 6 series into a 4-slot shard in ONE container: resolution blocks on
+    # its own protected pids mid-way, stages the prefix, and retries
+    b = RecordBuilder(GAUGE)
+    for i in range(6):
+        b.add({"_metric_": "m", "_ws_": "demo", "_ns_": "app",
+               "host": f"h{i}"}, BASE, 1.0)
+    sh.ingest(b.build())
+    # active tracks REAL series: admissions minus evictions, no leaks
+    assert gov.active("demo") == sh.num_series
+
+
+def test_peer_recovering_blocks_negative_cache():
+    """An empty answer whose PEER leg served a mid-recovery shard must not
+    negative-cache: the recovering_shards stat rides the /exec wire."""
+    from filodb_tpu.http.api import FiloHttpServer
+    from filodb_tpu.parallel.cluster import ShardManager
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+    from filodb_tpu.query.engine import QueryConfig, QueryEngine
+    ds = "peerneg"
+    mgr = ShardManager()
+    mgr.add_node("a")
+    mgr.add_node("b")
+    mgr.add_dataset(ds, 2)
+    owner = {s: mgr.node_of(ds, s) for s in (0, 1)}
+    stores = {"a": TimeSeriesMemStore(), "b": TimeSeriesMemStore()}
+    shards = {}
+    for s in (0, 1):
+        shards[s] = stores[owner[s]].setup(ds, GAUGE, s, _cfg(n=64))
+    eps: dict[str, str] = {}
+    engines = {n: QueryEngine(stores[n], ds, ShardMapper(2), cluster=mgr,
+                              node=n, endpoint_resolver=eps.get,
+                              config=QueryConfig(negative_cache_size=8))
+               for n in ("a", "b")}
+    servers = {n: FiloHttpServer({ds: engines[n]}, port=0).start()
+               for n in ("a", "b")}
+    try:
+        for n, srv in servers.items():
+            eps[n] = f"127.0.0.1:{srv.port}"
+        # the PEER-owned shard is mid-recovery; node a's shards are fine
+        peer_shard = shards[0] if owner[0] != "a" else shards[1]
+        peer_shard.recovering = True
+        r = engines["a"].query_range("count(m)", BASE, BASE + 60_000,
+                                     15_000)
+        assert r.matrix.num_series == 0
+        assert r.stats.to_dict()["recovering_shards"] == 1
+        assert len(engines["a"].negative_cache) == 0
+        peer_shard.recovering = False
+        engines["a"].query_range("count(m)", BASE, BASE + 60_000, 15_000)
+        assert len(engines["a"].negative_cache) == 1
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_query_during_recovery_never_poisons_negative_cache(tmp_path):
+    """Queries are admitted mid-recovery; one that sees a still-empty shard
+    must NOT prove emptiness into the TTL negative cache (a restarted node
+    would otherwise 404 its own recovered data for a whole TTL)."""
+    from filodb_tpu.query.engine import QueryConfig, QueryEngine
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg())
+    eng = QueryEngine(ms, DS, config=QueryConfig(negative_cache_size=8))
+    sh.recovering = True             # the recover() in-progress window
+    r = eng.query_range("count(m)", BASE, BASE + 60_000, 15_000)
+    assert r.matrix.num_series == 0
+    assert len(eng.negative_cache) == 0
+    sh.recovering = False
+    r = eng.query_range("count(m)", BASE, BASE + 60_000, 15_000)
+    assert len(eng.negative_cache) == 1
+    # and recover() itself clears the flag even on failure paths
+    sink = FileColumnStore(str(tmp_path))
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=sink)
+    sh2.recover()
+    assert sh2.recovering is False
+
+
+def test_replica_trust_disagreement_forces_fallback(tmp_path):
+    """A replica that missed a RETIRE marker must not win the entry-count
+    race and resurrect a stale index log: when reachable replicas disagree
+    on trust anchors, the replicated read answers UNTRUSTED and recovery
+    rebuilds from partkeys.log."""
+    from filodb_tpu.core.diststore import ReplicatedColumnStore
+    from filodb_tpu.core.store import (INDEX_RETIRE_BUCKET,
+                                       encode_index_bucket)
+    a = FileColumnStore(str(tmp_path / "a"))
+    b = FileColumnStore(str(tmp_path / "b"))
+    ring = ReplicatedColumnStore([a, b], replication=2)
+    ms = TimeSeriesMemStore()
+    sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=ring)
+    _ingest_series(sh, 30)
+    sh.flush_all_groups()            # both replicas: genesis + frames
+    # replica B alone learns of a RETIRE (A "missed the write")
+    b.write_index_bucket(DS, 0, encode_index_bucket(INDEX_RETIRE_BUCKET, []))
+    assert ring.read_index_frames(DS, 0) == []   # disagreement: untrusted
+    ms2 = TimeSeriesMemStore()
+    sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=ring)
+    sh2.recover()                    # partkeys fallback: nothing lost
+    assert sh2.num_series == 30
+
+
+def test_recover_from_replicated_ring(tmp_path):
+    """Index recovery over the durable ring: 2 StoreServer replicas, one
+    killed — the survivor serves the columnar frames."""
+    from filodb_tpu.core.diststore import (RemoteStore,
+                                           ReplicatedColumnStore,
+                                           StoreServer)
+    servers = [StoreServer(str(tmp_path / f"n{i}")).start() for i in range(2)]
+    try:
+        ring = ReplicatedColumnStore(
+            [RemoteStore(f"127.0.0.1:{s.port}") for s in servers],
+            replication=2)
+        ms = TimeSeriesMemStore()
+        sh = ms.setup(DS, GAUGE, 0, _cfg(), sink=ring)
+        _ingest_series(sh, 600)
+        sh.flush_all_groups()
+        servers[0].stop()            # one replica dies
+        ms2 = TimeSeriesMemStore()
+        sh2 = ms2.setup(DS, GAUGE, 0, _cfg(), sink=ring)
+        sh2.recover()
+        assert sh2.num_series == 600
+        a = np.sort(sh.part_ids_from_filters(
+            [F.EqualsRegex("host", "h5.")], 0, 1 << 62))
+        b = np.sort(sh2.part_ids_from_filters(
+            [F.EqualsRegex("host", "h5.")], 0, 1 << 62))
+        np.testing.assert_array_equal(a, b)
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass

@@ -73,6 +73,10 @@ BAD_FIXTURES = {
     # variable-size entries and must also declare a byte capacity (the
     # incremental fragment cache set this contract)
     "bad_cache_bytes.py": {"surface-cache-unbounded-bytes"},
+    # PR 15: vectorized-ops-only contract of the columnar index modules —
+    # a per-element Python loop over posting arrays in core/index*.py is
+    # the 1M-series bottleneck the columnar engine exists to prevent
+    "bad_index_postings.py": {"index-pure-python-postings"},
 }
 
 
