@@ -1,0 +1,138 @@
+"""mesh-sharding-undeclared: explicit boundary shardings on mesh programs.
+
+The one-program mesh query path (ISSUE 16, parallel/distributed.py) jits
+``shard_map`` bodies over GLOBAL sharded store operands. jax will happily
+compile such a call with no ``in_shardings``/``out_shardings`` — or with only
+one side declared — and silently insert resharding/gather transfers at the
+undeclared boundary: the program still answers correctly, but every dispatch
+re-gathers the sharded store blocks through one device, which is exactly the
+host-loop cost the mesh path exists to delete. No unit test notices (results
+match); only the dispatch-floor bench regresses. This rule makes the
+contract structural, inside ``parallel/`` (fixture twins carry a
+``bad_``/``good_`` prefix):
+
+  * a ``jit``/``pjit`` call declaring ONE of ``in_shardings``/
+    ``out_shardings`` is always a finding (the jax_graft pattern —
+    SNIPPETS.md [2] — requires both or neither);
+  * a ``jit``/``pjit`` call declaring NEITHER is a finding when sharded
+    store operands observably cross it: the jitted callable is invoked
+    (directly or via the assigned name) with an argument mentioning a
+    sharded identifier (``slot_*``, ``global_*``, ``*sharded*``,
+    ``dstore``). Bare jit over replicated scalars/step grids stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding
+
+# the mesh-program scope: every module under parallel/ plus the fixture twins
+_MESH_MODULE = re.compile(
+    r"(?:^|/)parallel/[^/]+\.py$"
+    r"|(?:^|/)fixtures/filolint/(?:bad_|good_)mesh_sharding\.py$")
+
+# identifiers that mark a global sharded store operand in this codebase
+_SHARDED = re.compile(r"(?:^|_)(slot|global|sharded|dstore)", re.IGNORECASE)
+
+_JIT_NAMES = ("jit", "pjit")
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name in _JIT_NAMES
+
+
+def _mentions_sharded(expr: ast.expr) -> str | None:
+    """The first sharded-store identifier inside ``expr``, or None."""
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None and _SHARDED.search(name):
+            return name
+    return None
+
+
+class MeshChecker:
+    rules = ("mesh-sharding-undeclared",)
+
+    def __init__(self):
+        self.project = None          # unused; kept for checker symmetry
+
+    def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
+        if not _MESH_MODULE.search(path):
+            return []
+        findings: list[Finding] = []
+        bare_names: set[str] = set()
+        bare_calls: list[ast.Call] = []
+        for node in ast.walk(tree):
+            if not _is_jit_call(node):
+                continue
+            kws = {k.arg for k in node.keywords}
+            has_in = "in_shardings" in kws
+            has_out = "out_shardings" in kws
+            if has_in and has_out:
+                continue
+            if has_in or has_out:
+                missing = "out_shardings" if has_in else "in_shardings"
+                findings.append(Finding(
+                    "mesh-sharding-undeclared", path, node.lineno,
+                    self._enclosing(tree, node), f"half:{missing}",
+                    f"mesh program declares only one boundary sharding — "
+                    f"without {missing} jax infers the other side and "
+                    "silently inserts a re-gather through one device; "
+                    "declare BOTH in_shardings and out_shardings "
+                    "(parallel/distributed.py _sharded_jit)"))
+                continue
+            bare_calls.append(node)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.value in bare_calls:
+                bare_names.add(node.targets[0].id)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            direct = node.func in bare_calls       # jit(f)(slot_...)
+            via_name = (isinstance(node.func, ast.Name)
+                        and node.func.id in bare_names)
+            if not (direct or via_name):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                name = _mentions_sharded(arg)
+                if name is None:
+                    continue
+                findings.append(Finding(
+                    "mesh-sharding-undeclared", path, node.lineno,
+                    self._enclosing(tree, node), f"bare:{name}",
+                    f"sharded store operand {name!r} crosses a jit "
+                    "boundary with NO declared shardings — implicit "
+                    "propagation re-gathers the global array through one "
+                    "device on every dispatch; declare in_shardings and "
+                    "out_shardings (parallel/distributed.py _sharded_jit)"))
+                break
+        return findings
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+    @staticmethod
+    def _enclosing(tree: ast.Module, target: ast.AST) -> str:
+        best = "<module>"
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        best = node.name if best == "<module>" \
+                            else f"{best}.{node.name}"
+                        break
+        return best

@@ -17,6 +17,7 @@ from .findings import Baseline, Finding, is_suppressed, load_suppressions
 from .indexcheck import IndexChecker
 from .jitcheck import JitChecker
 from .lockcheck import LockChecker
+from .meshcheck import MeshChecker
 from .resourcecheck import ResourceChecker
 from .surfacecheck import SurfaceChecker
 from .wirecheck import WireChecker
@@ -27,7 +28,8 @@ DEFAULT_EXCLUDES = ("remote_storage_pb2.py",)
 ALL_RULES = tuple(sorted(
     set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)
     | set(ResourceChecker.rules) | set(ExceptChecker.rules)
-    | set(SurfaceChecker.rules) | set(IndexChecker.rules)))
+    | set(SurfaceChecker.rules) | set(IndexChecker.rules)
+    | set(MeshChecker.rules)))
 
 DEFAULT_BASELINE = "filolint_baseline.json"
 
@@ -101,7 +103,8 @@ def _default_checkers(wire_spec: dict | None = None, full_scope: bool = True):
     surface = SurfaceChecker()
     surface.full_scope = full_scope
     return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec),
-            ResourceChecker(), ExceptChecker(), IndexChecker(), surface]
+            ResourceChecker(), ExceptChecker(), IndexChecker(),
+            MeshChecker(), surface]
 
 
 def _finalize(checkers, modules: dict) -> list[Finding]:

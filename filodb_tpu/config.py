@@ -160,6 +160,18 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "xla = one XLA-fused program per shape (lax.scan over the same "
         "row tiles), pallas = single-pass Pallas kernels (interpret-mode "
         "on CPU, compiled Mosaic on TPU)."),
+    "query.mesh_programs": (
+        "str", "auto",
+        "Mesh dist_* program mode (parallel/distributed.py): pjit = one "
+        "global-view sharded executable per padded query shape, explicit "
+        "NamedSharding in/out boundaries plus operand donation; shard_map "
+        "= the plain jitted per-device path; auto = pjit on a multi-device "
+        "non-CPU backend, shard_map fallback on single-device/CPU CI."),
+    "query.mesh_donation": (
+        "bool", True,
+        "Donate the per-query group-id globals to pjit-mode mesh programs "
+        "so XLA reuses their buffers in place (TPU/GPU only; the CPU "
+        "backend lacks buffer donation and the flag is ignored there)."),
     "query.max_concurrent_cost": (
         "int|null", None,
         "Aggregate estimated query cost (series x steps x window-steps) "

@@ -23,6 +23,7 @@ out-of-order. Flush is driven by size (``flush_lines``) OR a time bound
 from __future__ import annotations
 
 import logging
+import select
 import socket
 import socketserver
 import threading
@@ -286,6 +287,35 @@ class GatewayServer:
         accepted-but-unpublished lines."""
         self._stop_ev.set()
         self._server.shutdown()
+        # the accept-backlog race: a client can connect, send, and close
+        # entirely between two serve_forever polls — its lines are TCP-ACKed
+        # (accepted, from the client's view) but no handler ever ran, and
+        # closing the listener now would drop them. Drain the backlog
+        # synchronously: each pending connection runs its handler inline
+        # under a bounded read timeout, so a still-open straggler cannot
+        # wedge shutdown while fully-sent lines always land.
+        while True:
+            try:
+                ready, _, _ = select.select([self._server.socket], [], [],
+                                            0.05)
+            except (OSError, ValueError):
+                break           # listener already unusable: nothing pending
+            if not ready:
+                break
+            try:
+                request, addr = self._server.socket.accept()
+            except OSError:
+                break
+            request.settimeout(1.0)
+            try:
+                self._server.finish_request(request, addr)
+            except Exception:  # noqa: BLE001 — a straggler's read timeout or
+                # reset must not abort shutdown; whatever it sent in time
+                # already flushed via the handler's exit path
+                log.warning("gateway backlog drain handler failed",
+                            exc_info=True)
+            finally:
+                self._server.shutdown_request(request)
         self._server.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=3)

@@ -39,7 +39,8 @@ def segment_aggregate(op: str, values, group_ids, num_groups: int):
 MATMUL_GROUP_LIMIT = 64   # one-hot [G, S] matmul reduce up to this many groups
 
 
-def partial_aggregate(op: str, values, group_ids, num_groups: int):
+def partial_aggregate(op: str, values, group_ids, num_groups: int,
+                      stable: bool = False):
     """Map phase: per-group partial state tensors, each [G, T] (ref: RowAggregator
     .map/.reduceAggregate). Partials are psum/min/max-combinable across shards.
 
@@ -47,12 +48,20 @@ def partial_aggregate(op: str, values, group_ids, num_groups: int):
     on TPU, so for small group counts (the common dashboard shape: sum()/by(dc))
     sums ride an MXU one-hot matmul [G, S] @ [S, T]; large-G reduces keep
     segment_sum.
+
+    ``stable=True`` forces the segment_sum reduce for every group count: the
+    scatter-add folds rows in ROW ORDER, each output column independently, so
+    the result is invariant under the padded-T step bucket AND under row
+    padding (padded/excluded rows contribute exact 0.0) — the bit-stability
+    the composed two-step path and the mesh reduction schedule require. The
+    one-hot matmul's contraction order is tiling-dependent (it may
+    reassociate with T), which is exactly the PR 13 fold-order caveat.
     """
     present = ~jnp.isnan(values)
     zeroed = jnp.where(present, values, 0.0)
     acc = values.dtype if values.dtype in (jnp.float32, jnp.float64) else jnp.float64
 
-    if num_groups <= MATMUL_GROUP_LIMIT:
+    if not stable and num_groups <= MATMUL_GROUP_LIMIT:
         onehot = (group_ids[None, :] == jnp.arange(num_groups, dtype=group_ids.dtype)[:, None]
                   ).astype(acc)                                   # [G, S]
         def gsum(x):

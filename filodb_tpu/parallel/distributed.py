@@ -5,10 +5,39 @@ QueryActors, partial aggregates reduced on the calling node
 (coordinator/.../queryengine2/QueryEngine.scala:59-67, query/.../exec/ExecPlan.scala
 NonLeafExecPlan.dispatchRemotePlan, client/Serializer.scala Kryo wire).
 
-TPU-native replacement: shards live on mesh devices ("shard" axis); one
-``shard_map``-compiled program evaluates the range function on every shard's
-resident block and reduces partial aggregates with ``psum`` over ICI — the
-collective *is* the scatter-gather. No serialization, no per-shard dispatch.
+TPU-native replacement: shards live on mesh devices ("shard" axis); every
+``dist_*`` collective below is a thin wrapper over ONE global-view sharded
+executable per padded query shape — select -> decode -> window -> segment
+reduce -> cross-shard fold lower as a single program, so XLA overlaps decode
+compute against the reduce collectives. The collective *is* the
+scatter-gather: no serialization, no per-shard dispatch.
+
+Two execution modes (config ``query.mesh_programs``):
+
+  * ``pjit``      — the per-shard body (PR 9's fused tiling plan / the
+                    two-step kernels, unchanged) wraps in ``shard_map`` and
+                    jits with EXPLICIT ``in_shardings``/``out_shardings``
+                    (``NamedSharding`` per operand) plus donation of the
+                    per-query group-id globals. Declaring both sides is
+                    mandatory: implicit propagation would silently re-gather
+                    sharded store operands (filolint
+                    ``mesh-sharding-undeclared`` enforces this statically).
+  * ``shard_map`` — the plain jitted ``shard_map`` path (no declared
+                    boundary shardings); the fallback for single-device CPU
+                    CI, per the jax_graft fallback pattern (SNIPPETS.md [2]).
+  * ``auto``      — ``pjit`` on a multi-device non-CPU backend, else
+                    ``shard_map``.
+
+Reduction schedule: float partial sums do NOT psum — psum's fold order is
+implementation-defined and may reassociate per shape, and an in-program f32
+fold rounds differently from the host reduce's float64 accumulator. Instead
+each device returns its stacked per-slot partial state and the caller folds
+on host in SHARD order (slot-major, device-minor) with the same float64
+accumulation and presenter as the scatter-gather merge
+(exec._merge_partials) — the mesh result is bit-equal to the host path, and
+stable across padded-T step buckets (the PR 13 fold-order caveat, closed
+here together with exec.py's stable segment reduce). Sketch counts remain
+psum'd: they are small integers in f32, exact under any summation order.
 
 The same partial-aggregate format as the in-process path (ops/aggregators.py)
 crosses the collective, so single-chip and multi-chip execution share semantics.
@@ -34,11 +63,99 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import aggregators, fusedgrid, rangefns
 from ..utils import shard_map as _shard_map
+from ..utils.metrics import (FILODB_QUERY_MESH_FALLBACK,
+                             FILODB_QUERY_MESH_SERVED, registry)
 
 
 def make_mesh(devices=None, axis: str = "shard") -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# mesh-program mode (config: query.mesh_programs / query.mesh_donation) —
+# the same module-level dial pattern as ops/fusedresident.set_mode
+# ---------------------------------------------------------------------------
+
+MESH_MODES = ("auto", "pjit", "shard_map")
+_mesh_mode = "auto"
+_mesh_donation = True
+
+
+def mesh_mode() -> str:
+    """The configured mesh-program mode ("auto" | "pjit" | "shard_map")."""
+    return _mesh_mode
+
+
+def set_mesh_mode(m: str) -> None:
+    """Select the mesh-program mode (config: ``query.mesh_programs``)."""
+    global _mesh_mode
+    if m not in MESH_MODES:
+        raise ValueError(f"query.mesh_programs must be one of {MESH_MODES}, "
+                         f"got {m!r}")
+    _mesh_mode = m
+
+
+def set_mesh_donation(flag: bool) -> None:
+    """Enable/disable operand donation (config: ``query.mesh_donation``)."""
+    global _mesh_donation
+    _mesh_donation = bool(flag)
+
+
+def resolved_mesh_mode(mesh: Mesh | None = None) -> str:
+    """The mode a dispatch will actually use: ``auto`` resolves to ``pjit``
+    on a multi-device non-CPU backend and falls back to ``shard_map`` on
+    single-device / CPU CI (the SNIPPETS.md fallback rule)."""
+    if _mesh_mode != "auto":
+        return _mesh_mode
+    ndev = mesh.devices.size if mesh is not None else len(jax.devices())
+    return "pjit" if ndev > 1 and jax.default_backend() != "cpu" \
+        else "shard_map"
+
+
+def _donate_argnums(donate: tuple) -> tuple:
+    """Donation is declared only where XLA can honor it: the CPU backend
+    lacks buffer donation (jax warns and ignores it), so CI keeps clean
+    logs while TPU/GPU runs reuse the per-query group-id buffers."""
+    if not _mesh_donation or jax.default_backend() == "cpu":
+        return ()
+    return donate
+
+
+def count_mesh_served(route: str, mode: str) -> None:
+    registry.counter(FILODB_QUERY_MESH_SERVED,
+                     {"route": route, "mode": mode}).increment()
+
+
+def count_mesh_fallback(reason: str) -> None:
+    """A mesh-eligible dispatch fell back to the host scatter-gather path
+    AFTER eligibility (cold data paging, order-stat caps, ...)."""
+    registry.counter(FILODB_QUERY_MESH_FALLBACK,
+                     {"reason": reason}).increment()
+
+
+def _is_pspec(x) -> bool:
+    return isinstance(x, P)
+
+
+def _sharded_jit(mesh: Mesh, in_specs, out_specs, donate: tuple = ()):
+    """The pjit-mode jit applicator: every ``PartitionSpec`` leaf in the
+    operand trees becomes an explicit ``NamedSharding`` on ``mesh`` and BOTH
+    ``in_shardings`` and ``out_shardings`` are declared (the jax_graft
+    pattern — SNIPPETS.md [2]/[3]: pjit requires both or falls back to
+    shard_map; an implicit side would silently re-gather sharded store
+    operands through host memory)."""
+    def to_shardings(tree):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), tree,
+                            is_leaf=_is_pspec)
+    in_shardings = to_shardings(in_specs)
+    out_shardings = to_shardings(out_specs)
+    donate = _donate_argnums(donate)
+
+    def wrap(fn):
+        return jax.jit(fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+    return wrap
 
 
 class DistributedStore:
@@ -133,7 +250,8 @@ class DistributedStore:
 
     def global_gids(self, group_ids_per_shard):
         """Per-slot global [NDEV, S] gid arrays, device_put to each shard's
-        device (caller passes one [S] array per shard, shard order)."""
+        device (caller passes one [S] array per shard, shard order). Built
+        fresh per dispatch, so pjit-mode programs may DONATE them."""
         out = []
         for j in range(self.slots):
             arrs = []
@@ -156,19 +274,60 @@ def _slot_matrix(fn, slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
         yield mat, gids[0]
 
 
-def _dist_program(kernel: str, statics: tuple, slot_shapes: tuple, build):
+def _stack_parts(slot_parts):
+    """Per-device partial state, stacked [NSLOT, G, T] under a unit shard
+    axis; ``out_specs=P("shard")`` concatenates the devices into one
+    [NDEV, NSLOT, G, T] global per partial key. The cross-shard fold is
+    deliberately NOT a device collective: psum's reduction order is
+    implementation-defined (and shape-dependent), and an in-program f32
+    fold rounds differently from the host reduce's f64 accumulator. The
+    caller (LazyMeshResult.resolve) folds these blocks on host in SHARD
+    order — slot-major, device-minor, shard ``j*ndev + d`` — with the same
+    float64 accumulation as the scatter-gather merge (exec._merge_partials),
+    so the mesh answer is bit-EQUAL to the host-loop path, not merely
+    allclose, and invariant across mesh program shapes."""
+    return {k: jnp.stack([p[k] for p in slot_parts])[None]
+            for k in slot_parts[0]}
+
+
+def _dist_program(kernel: str, statics: tuple, slot_shapes: tuple, build,
+                  mesh: Mesh, in_specs=None, out_specs=None,
+                  donate: tuple = ()):
     """Mesh twin of the in-process kernel routing: every ``dist_*``
-    collective below is a per-key jitted program in the SAME process-global
+    collective below is a per-key program in the SAME process-global
     compiled-plan cache (query/plancache.py), keyed on its statics plus the
-    global-array slot shapes — a dashboard's first mesh query compiles here,
-    every repeat (and every warmup-covered shape) hits."""
+    global-array slot shapes plus the mesh axes AND the resolved mode — a
+    pjit program never aliases a shard_map one, and neither aliases the
+    per-shard in-process entries (distinct kernel names). A dashboard's
+    first mesh query compiles here, every repeat (and every warmup-covered
+    shape) hits.
+
+    In ``pjit`` mode the entry jits with the explicit boundary shardings
+    (and donation) from ``_sharded_jit`` — both spec trees are REQUIRED, the
+    runtime twin of filolint's ``mesh-sharding-undeclared`` rule."""
     from ..query.plancache import plan_cache
-    return plan_cache.program(kernel, statics + slot_shapes, build)
+    mode = resolved_mesh_mode(mesh)
+    wrap = None
+    if mode == "pjit":
+        if in_specs is None or out_specs is None:
+            raise ValueError(
+                f"{kernel}: pjit mode requires both in_specs and out_specs "
+                "(implicit propagation would re-gather sharded operands)")
+        wrap = _sharded_jit(mesh, in_specs, out_specs, donate)
+    key = statics + slot_shapes + ("mesh", mesh.axis_names,
+                                   mesh.devices.size, mode)
+    return plan_cache.program(kernel, key, build, wrap=wrap)
 
 
 def _tvn_shapes(slot_tvn) -> tuple:
     return tuple((tuple(ts.shape), tuple(n.shape), str(val.dtype))
                  for ts, val, n in slot_tvn)
+
+
+# in_shardings prefix trees for the two-step collectives: the call signature
+# is (slot_tvn, slot_gids, out_ts, window_ms, a0, a1) — store operands ride
+# the "shard" axis, step grid and window args replicate
+_TWOSTEP_IN_SPECS = (P("shard"), P("shard"), P(), P(), P(), P())
 
 
 def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
@@ -177,28 +336,25 @@ def dist_aggregate(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
         "dist-agg", (fn, op, num_groups, mesh, int(out_ts.shape[0])),
         _tvn_shapes(slot_tvn),
         lambda: functools.partial(_dist_aggregate_impl, fn, op, num_groups,
-                                  mesh)
+                                  mesh),
+        mesh, in_specs=_TWOSTEP_IN_SPECS, out_specs=P("shard"), donate=(1,)
     )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
 
 
 def _dist_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
                          slot_tvn, slot_gids, out_ts, window_ms, a0, a1):
     """One compiled distributed query step: range function per resident slot
-    block + segment partials combined locally + psum over the shard axis;
-    every device ends with the same [G, T] final matrix (taken from device 0
-    by the caller)."""
+    block + STABLE segment partials per device, stacked for the host-order
+    fold (LazyMeshResult.resolve presents them with the SAME reduce + host
+    presenter the scatter-gather path uses — bit parity by construction)."""
 
     def per_device(slot_tvn, slot_gids):
-        parts = None
+        slot_parts = []
         for mat, gids in _slot_matrix(fn, slot_tvn, slot_gids, out_ts,
                                       window_ms, a0, a1):
-            p = aggregators.partial_aggregate(op, mat, gids, num_groups)
-            parts = (p if parts is None
-                     else aggregators.combine_partials(op, parts, p))
-        parts = {k: jax.lax.psum(v, "shard") if k not in ("min", "max")
-                 else (jax.lax.pmin(v, "shard") if k == "min" else jax.lax.pmax(v, "shard"))
-                 for k, v in parts.items()}
-        return aggregators.present_partials(op, parts)[None]
+            slot_parts.append(aggregators.partial_aggregate(
+                op, mat, gids, num_groups, stable=True))
+        return _stack_parts(slot_parts)
 
     return _shard_map(
         per_device, mesh=mesh,
@@ -213,7 +369,8 @@ def dist_quantile_sketch(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
         "dist-sketch", (fn, num_groups, mesh, int(out_ts.shape[0])),
         _tvn_shapes(slot_tvn),
         lambda: functools.partial(_dist_quantile_sketch_impl, fn, num_groups,
-                                  mesh)
+                                  mesh),
+        mesh, in_specs=_TWOSTEP_IN_SPECS, out_specs=P("shard"), donate=(1,)
     )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
 
 
@@ -225,7 +382,8 @@ def _dist_quantile_sketch_impl(fn: str, num_groups: int, mesh: Mesh,
     Bucketing matches ops/aggregators.quantile_sketch bit-for-bit (same
     gamma/width/edge rules) so the psum'd counts present identically to the
     host merge (ref: AggrOverRangeVectors t-digest partials crossing the
-    reduce, :244)."""
+    reduce, :244). Counts are small integers in f32 — exact under ANY
+    summation order, so psum needs no ordered-fold replacement here."""
     B = aggregators.SKETCH_BUCKETS
     W = aggregators.SKETCH_WIDTH
     lg = float(np.log(aggregators.SKETCH_GAMMA))
@@ -272,7 +430,10 @@ def dist_topk(slot_tvn, slot_gids, out_ts, window_ms, a0, a1,
         (fn, k, bottom, num_groups, mesh, ndev, int(out_ts.shape[0])),
         _tvn_shapes(slot_tvn),
         lambda: functools.partial(_dist_topk_impl, fn, k, bottom, num_groups,
-                                  mesh, ndev)
+                                  mesh, ndev),
+        mesh, in_specs=_TWOSTEP_IN_SPECS,
+        out_specs=(P("shard"), P("shard"), P("shard"), P("shard")),
+        donate=(1,)
     )(slot_tvn, slot_gids, out_ts, window_ms, a0, a1)
 
 
@@ -282,9 +443,11 @@ def _dist_topk_impl(fn: str, k: int, bottom: bool, num_groups: int,
     """Distributed topk/bottomk: per-slot local top-k candidates, then ONE
     all_gather of the fixed-size [G, T, slots*k] candidate blocks and a
     global re-select — only k*shards candidates cross the ICI, never the
-    [S, T] matrices (ref: TopKPartial crossing the reduce node). Returns
-    (values, rows, shard_ids, present) each [G, T, k]; rows are store rows
-    on the owning shard."""
+    [S, T] matrices (ref: TopKPartial crossing the reduce node). all_gather
+    is device-ordered, so the candidate block order equals the host merge's
+    shard order and ties resolve identically (top_k is index-stable).
+    Returns (values, rows, shard_ids, present) each [G, T, k]; rows are
+    store rows on the owning shard."""
     fmax = float(np.finfo(np.float64).max)
     fill = np.inf if bottom else -np.inf
 
@@ -360,6 +523,23 @@ def _fused_map_call(fn: str, needs_sumsq: bool, window_ms: int,
                                   narrow=narrow, c0=c0, Ck=Ck)
 
 
+def _fused_parts(op: str, outs) -> dict:
+    """The fused kernel's (sum, count, sumsq) tuple as a partial dict in the
+    shared ops/aggregators format (count-only ops keep just the count)."""
+    if op in ("count", "group"):
+        return {"count": outs[1]}
+    return dict(zip(("sum", "count", "sumsq"), outs))
+
+
+# fused call signature: (slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi,
+# rel) — resident blocks and gids ride the shard axis; band/edge operands
+# replicate (they are shape-cached per query, NEVER donated)
+_FUSED_IN_SPECS = (P("shard"), P("shard"), P("shard"),
+                   P(), P(), P(), P(), P())
+_FUSED_NARROW_IN_SPECS = (P("shard"), P("shard"), P("shard"), P("shard"),
+                          P("shard"), P(), P(), P(), P(), P())
+
+
 def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
                          fn: str, op: str, num_groups: int, mesh: Mesh,
                          window_ms: int, interval_ms: int,
@@ -372,7 +552,8 @@ def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
         tuple(str(v.dtype) for v in slot_vals),
         lambda: functools.partial(_dist_fused_aggregate_impl, fn, op,
                                   num_groups, mesh, window_ms, interval_ms,
-                                  S, C, Tp, c0, Ck, variant)
+                                  S, C, Tp, c0, Ck, variant),
+        mesh, in_specs=_FUSED_IN_SPECS, out_specs=P("shard"), donate=(2,)
     )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
@@ -382,31 +563,28 @@ def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
                                variant: str,
                                slot_vals, slot_ns, slot_gids, band, ohlo,
                                lo, hi, rel):
-    """Fused single-pass map phase on every resident slot block + psum of the
-    partial-state layout over the shard axis — the multi-chip twin of
+    """Fused single-pass map phase on every resident slot block, partial
+    state stacked for the host-order fold — the multi-chip twin of
     ``fusedgrid.fused_grid_aggregate`` (ref: AggrOverRangeVectors.scala:62 —
     the same AggregateMapReduce map phase runs identically on every data
-    node; the psum IS the reduce node). Band/edge operands are replicated;
-    each device streams only its resident [S, C] blocks, one kernel pass per
-    slot, partials summed locally before the collective."""
+    node; LazyMeshResult.resolve IS the reduce node, in the host merge's
+    shard order and precision). Band/edge operands are replicated; each
+    device streams only its resident [S, C] blocks, one kernel pass per
+    slot."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
     call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
                            S, Sb, C, Tp, num_groups, False, c0, Ck, variant)
 
     def per_device(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel):
-        outs = None
+        slot_parts = []
         for val, n, gids in zip(slot_vals, slot_ns, slot_gids):
             o = call(val[0].astype(jnp.float32),
                      n[0].astype(jnp.int32).reshape(S, 1),
                      gids[0].astype(jnp.int32).reshape(S, 1),
                      band, ohlo, lo, hi, rel)
-            outs = o if outs is None else tuple(a + b for a, b in zip(outs, o))
-        parts = ({"count": jax.lax.psum(outs[1], "shard")}
-                 if op in ("count", "group") else
-                 {k: jax.lax.psum(v, "shard")
-                  for k, v in zip(("sum", "count", "sumsq"), outs)})
-        return aggregators.present_partials(op, parts)[None]
+            slot_parts.append(_fused_parts(op, o))
+        return _stack_parts(slot_parts)
 
     return _shard_map(
         per_device, mesh=mesh,
@@ -432,7 +610,9 @@ def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
         tuple(str(q.dtype) for q in slot_qs),
         lambda: functools.partial(_dist_fused_narrow_impl, fn, op,
                                   num_groups, mesh, window_ms, interval_ms,
-                                  S, C, Tp, c0, Ck, variant)
+                                  S, C, Tp, c0, Ck, variant),
+        mesh, in_specs=_FUSED_NARROW_IN_SPECS, out_specs=P("shard"),
+        donate=(4,)
     )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
       band, ohlo, lo, hi, rel)
 
@@ -446,8 +626,8 @@ def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
     """Narrow twin of :func:`dist_fused_aggregate`: every shard's resident
     i16 quantized state streams straight through the fused map kernel
     (half the HBM bytes, decode in VMEM — ops/narrow.py) and the partial
-    state psums over the shard axis. Compressed-resident stores stay
-    mesh-eligible without ever materializing their f32 blocks."""
+    state folds over the shard axis in shard order. Compressed-resident
+    stores stay mesh-eligible without ever materializing their f32 blocks."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
     call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
@@ -455,19 +635,15 @@ def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
 
     def per_device(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
                    band, ohlo, lo, hi, rel):
-        outs = None
+        slot_parts = []
         for q, vmin, scale, n, gids in zip(slot_qs, slot_vmins, slot_scales,
                                            slot_ns, slot_gids):
             o = call(q[0], vmin[0].reshape(S, 1), scale[0].reshape(S, 1),
                      n[0].astype(jnp.int32).reshape(S, 1),
                      gids[0].astype(jnp.int32).reshape(S, 1),
                      band, ohlo, lo, hi, rel)
-            outs = o if outs is None else tuple(a + b for a, b in zip(outs, o))
-        parts = ({"count": jax.lax.psum(outs[1], "shard")}
-                 if op in ("count", "group") else
-                 {k: jax.lax.psum(v, "shard")
-                  for k, v in zip(("sum", "count", "sumsq"), outs)})
-        return aggregators.present_partials(op, parts)[None]
+            slot_parts.append(_fused_parts(op, o))
+        return _stack_parts(slot_parts)
 
     return _shard_map(
         per_device, mesh=mesh,
@@ -483,17 +659,43 @@ class LazyMeshResult:
     """Device-resident distributed result; ``resolve()`` does the blocking
     host fetch. The engine dispatches under the shard locks but fetches
     outside them (same contract as the in-process leaf: a slow collective
-    must not stall ingest on every shard for its full wall time)."""
+    must not stall ingest on every shard for its full wall time).
 
-    def __init__(self, out, num_groups: int, T: int | None):
-        self._out = out
+    The mesh program returns UNFOLDED partial state (dict of
+    [NDEV, NSLOT, G, T] globals — each device's stacked per-slot partials);
+    resolve() folds them in SHARD order (slot-major, device-minor: shard
+    ``j*ndev + d``) with the same float64 accumulation as the scatter-gather
+    merge (exec._merge_partials), then presents with the SAME
+    ``aggregators.present_partials`` host presenter the host-loop reduce
+    uses — so the presented values carry no device/host dtype-promotion or
+    fold-order skew and match the host path bit-for-bit."""
+
+    def __init__(self, parts: dict, op: str, num_groups: int, T: int | None):
+        self._parts = parts
+        self._op = op
         self._ng = num_groups
         self._T = T
 
     def resolve(self) -> np.ndarray:
-        # all shards hold identical presented results; take shard 0's block
-        r = np.asarray(self._out.addressable_shards[0].data[0])[:self._ng]
-        return r[:, :self._T] if self._T is not None else r
+        host = {k: np.asarray(v) for k, v in self._parts.items()}
+        merged: dict[str, np.ndarray] = {}
+        for name, g in host.items():          # g: [NDEV, NSLOT, G, T]
+            ndev, nslot = g.shape[0], g.shape[1]
+            acc = g[0, 0].astype(np.float64)  # shard 0 seeds, exactly as the
+            for j in range(nslot):            # host merge's first base does
+                for d in range(ndev):
+                    if j == 0 and d == 0:
+                        continue
+                    a = g[d, j]               # shard j*ndev + d
+                    if name == "min":
+                        acc = np.minimum(acc, a)
+                    elif name == "max":
+                        acc = np.maximum(acc, a)
+                    else:
+                        acc = acc + a
+            merged[name] = acc
+        vals = aggregators.present_partials(self._op, merged)[:self._ng]
+        return vals[:, :self._T] if self._T is not None else vals
 
 
 class MeshQueryExecutor:
@@ -505,11 +707,13 @@ class MeshQueryExecutor:
     grid-aligned to one common (base, interval) with a single uniform start
     cohort, and the shapes fit the fused kernel's VMEM gate, the per-shard
     map phase runs the single-pass fused Pallas kernel; otherwise the
-    general two-step kernels. ``last_path`` records the route taken."""
+    general two-step kernels. ``last_path`` records the route taken and
+    ``last_mode`` the resolved mesh-program mode (pjit / shard_map)."""
 
     def __init__(self, dstore: DistributedStore):
         self.dstore = dstore
         self.last_path: str | None = None
+        self.last_mode: str = resolved_mesh_mode(dstore.mesh)
 
     def _fused_grid(self):
         """Common (base_ts, interval_ms) when every shard qualifies for the
@@ -534,6 +738,7 @@ class MeshQueryExecutor:
         slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
         G = _pow2(num_groups)
         S, C, T = self.dstore.S, self.dstore.C, len(out_ts)
+        self.last_mode = resolved_mesh_mode(self.dstore.mesh)
         from ..ops import fusedresident
         variant = fusedresident.mode()
         grid = (self._fused_grid()
@@ -581,7 +786,7 @@ class MeshQueryExecutor:
             sfx = "" if variant == "pallas" else "-xla"
             self.last_path = ("fused-narrow" if narrow is not None
                               else "fused") + sfx
-            res = LazyMeshResult(out, num_groups, T)
+            res = LazyMeshResult(out, op, num_groups, T)
             return res.resolve() if fetch else res
         slot_tvn = tuple(self.dstore.arrays())
         # bucket the step count (pad to a multiple of 32, repeating the last
@@ -594,7 +799,7 @@ class MeshQueryExecutor:
                              jnp.int64(window_ms), jnp.float64(args[0]),
                              jnp.float64(args[1]), fn, op, G, self.dstore.mesh)
         self.last_path = "twostep"
-        res = LazyMeshResult(out, num_groups, T)
+        res = LazyMeshResult(out, op, num_groups, T)
         return res.resolve() if fetch else res
 
     def quantile(self, fn: str, out_ts: np.ndarray, window_ms: int,
@@ -605,6 +810,7 @@ class MeshQueryExecutor:
         the in-process SketchPartial merge)."""
         slot_tvn = tuple(self.dstore.arrays())
         slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
+        self.last_mode = resolved_mesh_mode(self.dstore.mesh)
         from ..query.exec import _pad_steps
         out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
         # pow2-bucket the group count: a churning by() cardinality must not
@@ -632,6 +838,7 @@ class MeshQueryExecutor:
         the caller maps (shard, row) back to series keys."""
         slot_tvn = tuple(self.dstore.arrays())
         slot_gids = tuple(self.dstore.global_gids(group_ids_per_shard))
+        self.last_mode = resolved_mesh_mode(self.dstore.mesh)
         from ..query.exec import _pad_steps
         out_eval, T = _pad_steps(np.asarray(out_ts, np.int64))
         Gp = _pow2(num_groups)    # compile-space bucketing, as aggregate()
@@ -653,6 +860,62 @@ class MeshQueryExecutor:
                         np.moveaxis(r, 2, 1)[:, :, :T],
                         np.moveaxis(ok, 2, 1)[:, :, :T])
         return LazyTopK()
+
+
+def warm_mesh_shape(fn: str, op: str, S: int, C: int, steps: int,
+                    step_ms: int, window_ms: int, interval_ms: int,
+                    groups: int, dtype, grid: bool = True) -> None:
+    """Pre-trace the mesh ``dist_*`` programs for one dashboard shape
+    (``query.warmup_shapes`` entries with ``mesh: true`` — plancache.warmup
+    calls this). Warms the general two-step program always and the fused
+    program (the ACTIVE ``query.fused_kernels`` variant) when the shape
+    qualifies — under the RESOLVED mesh mode, so the warmed executable is
+    the serving executable."""
+    from ..ops import fusedresident
+    from ..query.exec import _pad_steps
+    mesh = make_mesh()
+    ndev = mesh.devices.size
+    if ndev < 2:
+        return
+    sharding = NamedSharding(mesh, P("shard"))
+    devs = list(mesh.devices.ravel())
+
+    def gput(extra_shape, dt):
+        arrs = [jax.device_put(jnp.zeros((1,) + extra_shape, dt), d)
+                for d in devs]
+        return jax.make_array_from_single_device_arrays(
+            (ndev,) + extra_shape, sharding, arrs)
+
+    out_ts = np.int64(window_ms) + np.arange(steps, dtype=np.int64) * step_ms
+    out_eval, _T = _pad_steps(out_ts)
+    Gp = _pow2(groups)
+    val = gput((S, C), dtype)
+    n = gput((S,), jnp.int32)
+    ts = gput((S, C), jnp.int64)
+
+    def gids():
+        # gid globals are donated in pjit mode: build a fresh one per call
+        return gput((S,), jnp.int32)
+
+    dist_aggregate(((ts, val, n),), (gids(),), jnp.asarray(out_eval),
+                   jnp.int64(window_ms), jnp.float64(0.0), jnp.float64(0.0),
+                   fn, op, Gp, mesh)
+    variant = fusedresident.mode()
+    if (grid and variant != "off" and dtype == jnp.float32
+            and fn in fusedgrid.FUSED_FNS | fusedgrid.FUSED_WINDOW_FNS
+            and op in fusedgrid.FUSED_OPS
+            and fusedgrid.fusable(S, C, steps, Gp)):
+        Tp = (max(steps, 1) + 127) // 128 * 128
+        band, ohlo, lo, hi, rel, c0, Ck = fusedgrid._device_operands(
+            C, Tp, np.ascontiguousarray(out_ts).tobytes(), int(window_ms),
+            0, int(interval_ms),
+            "window" if fn in fusedgrid.FUSED_WINDOW_FNS else "rate")
+        from ..utils import enable_x64
+        with enable_x64(False):
+            dist_fused_aggregate(
+                (val,), (n,), (gids(),), band, ohlo, lo, hi, rel,
+                fn, op, Gp, mesh, int(window_ms), int(interval_ms),
+                S, C, Tp, c0, Ck, variant)
 
 
 def _pow2(n: int, floor: int = 8) -> int:

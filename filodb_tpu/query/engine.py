@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core.memstore import TimeSeriesMemStore
+from ..parallel import distributed
 from ..parallel.shardmapper import ShardMapper
 from ..utils.metrics import (FILODB_QUERY_LATENCY_MS,
                              FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS,
@@ -1227,7 +1228,9 @@ class QueryEngine:
                 # the host path must not double-count its own leaf counts)
                 pids = sh.part_ids_from_filters(filters, from_ms, to_ms)
                 if sh.needs_paging(pids, from_ms):
-                    return None          # cold data: host ODP path handles it
+                    # cold data: host ODP path handles it
+                    distributed.count_mesh_fallback("paging")
+                    return None
                 matched_total += len(pids)
                 g = np.full(sh.store.S, _EXCLUDED_GID, np.int32)
                 if len(pids):
@@ -1263,12 +1266,14 @@ class QueryEngine:
                 if (G > AggregateMapReduce.ORDER_STAT_MAX_GROUPS
                         or _pow2(G) * _agg.SKETCH_WIDTH
                         * (len(out_ts) + 31) * 4 > _SKETCH_BYTES_CAP):
+                    distributed.count_mesh_fallback("order_stat_caps")
                     return None
                 lazy = ex.quantile(fn, out_ts, window, gids_list, G,
                                    float(plan.params[0]), args=(a0, a1))
             elif op in ("topk", "bottomk"):
                 k = max(int(plan.params[0]), 0)
                 if k == 0 or G > MESH_TOPK_MAX_GROUPS:
+                    distributed.count_mesh_fallback("topk_caps")
                     return None
                 lazy = ex.topk(fn, out_ts, window, gids_list, G, k,
                                op == "bottomk", args=(a0, a1))
@@ -1284,7 +1289,13 @@ class QueryEngine:
                     # stats symmetry with the in-process fused route
                     # (exec.py): cluster stats equal the single-node oracle
                     ctx.stats.add("fused_kernels")
-        self._set_path(ctx, f"mesh-{ex.last_path}")
+        # pjit-mode programs carry the mode in the exec path so dashboards
+        # (and the parity tests) can tell WHICH executable served; the
+        # shard_map fallback keeps the historical bare "mesh-" tag
+        tag = (f"mesh[pjit]-{ex.last_path}" if ex.last_mode == "pjit"
+               else f"mesh-{ex.last_path}")
+        self._set_path(ctx, tag)
+        distributed.count_mesh_served(ex.last_path, ex.last_mode)
         if op in ("topk", "bottomk"):
             m = self._present_mesh_topk(lazy, shards, epochs, out_ts,
                                         list(uniq))

@@ -930,13 +930,18 @@ class AggPartial:
 def _segment_partial(op, values, gids, num_groups):
     """Segment reduce via the explicit compiled-plan cache: keyed on
     (op, pow2 group bucket, value shape/dtype) — the in-process map phase's
-    half of the compile space (PSM's kernels carry the other half)."""
+    half of the compile space (PSM's kernels carry the other half).
+
+    Runs the STABLE reduce (row-order segment_sum, column-independent): the
+    composed two-step result is bit-identical across padded-T step buckets
+    and row paddings, and matches the mesh program's per-shard partials
+    bit-for-bit (the PR 13 fold-order caveat, closed by ISSUE 16)."""
     from .plancache import plan_cache
     prog = plan_cache.program(
         "segment",
-        (op, num_groups, tuple(values.shape), str(values.dtype)),
+        (op, num_groups, tuple(values.shape), str(values.dtype), "stable"),
         lambda: functools.partial(aggregators.partial_aggregate, op,
-                                  num_groups=num_groups))
+                                  num_groups=num_groups, stable=True))
     return prog(values, gids)
 
 

@@ -75,11 +75,19 @@ class CompiledPlanCache:
         with self._lock:
             self.traces += 1
 
-    def program(self, kernel: str, key: tuple, build):
+    def program(self, kernel: str, key: tuple, build, wrap=None):
         """The cached program for ``(kernel, *key)``; on miss, ``build()``
         returns the pure Python callable (statics pre-bound) this entry
         jits. The returned callable's FIRST invocation runs under the
-        ``query.compile`` span — trace + compile + first execution."""
+        ``query.compile`` span — trace + compile + first execution.
+
+        ``wrap`` overrides the default ``jax.jit`` applicator: the mesh
+        ``dist_*`` programs pass a sharded-jit closure (explicit
+        ``in_shardings``/``out_shardings`` + donation, built where the mesh
+        is known — parallel/distributed.py) so the global-view executable
+        still rides this cache's hit/trace/span accounting. The CALLER must
+        key such entries distinctly (mode/mesh in ``key``): the cache
+        cannot see that two builds wrap differently."""
         import jax
         full = (kernel, *key)
         with self._lock:
@@ -98,7 +106,7 @@ class CompiledPlanCache:
             note()                 # executes at TRACE time only
             return pyfn(*a, **k)
 
-        jitted = jax.jit(probe)
+        jitted = (wrap or jax.jit)(probe)
         e = _Entry()
 
         def call(*a, **k):
@@ -170,7 +178,10 @@ def warmup(shapes: list) -> dict:
     ``dd_dtype`` "int16"/"int8"). Fused-tier shapes warm the variant the
     ACTIVE ``query.fused_kernels`` mode will serve (pallas or the XLA
     twin) — set_mode runs before warmup at server startup exactly so the
-    warmed program is the serving program. Returns
+    warmed program is the serving program. ``mesh`` (True warms the mesh
+    ``dist_*`` programs for the shape too, under the RESOLVED
+    ``query.mesh_programs`` mode — ``series`` then means rows PER SHARD;
+    no-op on a single-device process). Returns
     ``{"programs": <new traces>, "ms": <wall>}``.
     """
     import numpy as np
@@ -247,5 +258,9 @@ def warmup(shapes: list) -> dict:
         # before the segment partial, so warm the unpadded T
         _segment_partial(op, jnp.zeros((R, T), jnp.float64),
                          jnp.asarray(gids), Gp)
+        if spec.get("mesh"):
+            from ..parallel.distributed import warm_mesh_shape
+            warm_mesh_shape(fn, op, R, C, steps, step_ms, window, iv,
+                            groups, dtype, grid=bool(spec.get("grid", True)))
     return {"programs": plan_cache.traces - before,
             "ms": round((time.perf_counter() - t0) * 1000.0, 3)}

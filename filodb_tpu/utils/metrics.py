@@ -59,6 +59,8 @@ FILODB_QUERY_ADMISSION_OVERSIZED = "filodb_query_admission_oversized"
 FILODB_QUERY_ADMISSION_COST = "filodb_query_admission_cost"
 FILODB_QUERY_FUSED_SERVED = "filodb_query_fused_served"
 FILODB_QUERY_FUSED_FALLBACK = "filodb_query_fused_fallback"
+FILODB_QUERY_MESH_SERVED = "filodb_query_mesh_served"
+FILODB_QUERY_MESH_FALLBACK = "filodb_query_mesh_fallback"
 FILODB_QUERY_NEGATIVE_CACHE_HITS = "filodb_query_negative_cache_hits"
 FILODB_QUERY_NEGATIVE_CACHE_EVICTIONS = \
     "filodb_query_negative_cache_evictions"
@@ -205,6 +207,15 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "Queries that matched a fused shape but fell back to "
                    "the composed two-step path (shape gate, group cap, "
                    "off-grid store), tagged by shape."),
+    FILODB_QUERY_MESH_SERVED: (
+        "counter", "Queries served by a mesh dist_* collective, tagged by "
+                   "route (fused / fused-narrow / twostep / sketch / topk) "
+                   "and resolved program mode (query.mesh_programs: pjit / "
+                   "shard_map)."),
+    FILODB_QUERY_MESH_FALLBACK: (
+        "counter", "Mesh-eligible queries that fell back to the host "
+                   "scatter-gather path after eligibility, tagged by reason "
+                   "(paging / order_stat_caps / topk_caps)."),
     FILODB_QUERY_NEGATIVE_CACHE_HITS: (
         "counter", "Range queries answered from the TTL-bounded negative "
                    "result cache: a recent execution proved the selection "

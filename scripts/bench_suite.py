@@ -21,6 +21,8 @@ Suites (reference file in parens):
   gateway       Influx line-protocol parse throughput  (GatewayBenchmark.scala)
   elastic       kill-a-node soak, live rebalance under load, split-brain
                 zero-duplicate audit  (ISSUE 12; ClusterRecoverySpec analog)
+  mesh_query    one-program mesh vs host shard loop dispatch floor, bit
+                parity + warmup compile-count audit  (ISSUE 16)
 
 ``--full`` uses reference-scale sizes (1M index series etc.); default sizes are
 CI-friendly. ``--suite name`` runs one suite. The north-star query benchmark
@@ -2335,12 +2337,14 @@ def bench_dashboard_soak(full: bool) -> None:
     provably newer than every cached step — the epoch log proves it) and
     only the step-completing refresh computes ONE new step. Measured: effective qps of the
     delta path vs the PR 8 serving stack re-executing the full range, at
-    bit parity of the rendered series on every refresh — the fixture
-    stays on the FUSED serving tier, whose [G, Tp] fold is bit-stable
-    across the step-bucket shapes this mix exercises (the composed
-    path's [G,R]x[R,T] reduce may differ in the last ulp across T
-    buckets — fold order, the caveat PR 9's suite documents).
-    Acceptance bar: >= 10x effective qps (ISSUE 14)."""
+    bit parity of the rendered series on every refresh — on the FUSED
+    serving tier and, since PR 16, the composed two-step path too: its
+    segment reduce is segment_sum-stable and the cross-shard fold runs
+    on host in f64 shard order, so the [G,R]x[R,T] reduce no longer
+    shifts in the last ulp across T pad buckets (the caveat PR 9's
+    suite documented; closed by the bit-stability sweeps in
+    tests/test_distributed.py). Acceptance bar: >= 10x effective qps
+    (ISSUE 14)."""
     from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
     from filodb_tpu.core.record import RecordBuilder
     from filodb_tpu.core.schemas import PROM_COUNTER
@@ -2459,7 +2463,142 @@ def bench_dashboard_soak(full: bool) -> None:
     emit("dashboard_soak", "fragment_bytes", st["bytes"], "bytes")
 
 
+def bench_mesh_query(full: bool) -> None:
+    """ISSUE 16: per-query dispatch floor of the one-program mesh path vs
+    the host shard loop, at the hicard fixture sharded 8 ways (full:
+    8 shards x 2048 series x 48 samples f32 counter = 16384x48). Two
+    engines over bit-identical ingests — one mesh-configured (shards
+    device-placed on the mesh), one plain (the scatter-gather host loop
+    dispatches 8 per-shard programs and merges partials on host) — serve
+    the same sum(rate) dashboard query. Emitted: p50 ms per query for the
+    host loop, the shard_map mesh program, and the forced-pjit global-view
+    program; the pjit/host ratio (acceptance bar: <= 0.7); bit_parity
+    (EXACT equality of all three rendered matrices — the host-order f64
+    fold contract, not allclose); and warm_compile_count — the traces a
+    first mesh query costs AFTER ``plancache.warmup`` with a ``mesh: true``
+    spec of this shape, proving warmup covers the mesh variants (bar: 0).
+    Skips (one row) on a single-device process, where make_mesh has no
+    second device to program."""
+    import jax
+
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_COUNTER
+    from filodb_tpu.parallel import distributed
+    from filodb_tpu.parallel.distributed import make_mesh
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.plancache import plan_cache, warmup
+
+    if len(jax.devices()) < 2:
+        emit("mesh_query", "skipped_single_device", 1.0, "bool")
+        return
+    n_shards = 8
+    per_shard = 2048 if full else 256
+    n_samples = 48
+    rng = np.random.default_rng(16)
+    cfg = StoreConfig(max_series_per_shard=per_shard, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    mesh = make_mesh()
+    devs = mesh.devices.ravel()
+    mesh_ms, host_ms = TimeSeriesMemStore(), TimeSeriesMemStore()
+    for s in range(n_shards):
+        mesh_ms.setup("meshq", PROM_COUNTER, s, cfg,
+                      device=devs[s % len(devs)])
+        host_ms.setup("meshq", PROM_COUNTER, s, cfg)
+    ts_arr = BASE + np.arange(n_samples, dtype=np.int64) * IV
+    for s in range(n_shards * per_shard):
+        vals = np.cumsum(rng.exponential(5.0, n_samples))
+        for ms in (mesh_ms, host_ms):
+            b = RecordBuilder(PROM_COUNTER)
+            b.add_batch({"_metric_": "request_total", "instance": f"i{s}"},
+                        ts_arr, vals)
+            ms.ingest("meshq", s % n_shards, b.build())
+    mesh_ms.flush_all()
+    host_ms.flush_all()
+    mesh_eng = QueryEngine(mesh_ms, "meshq", mesh=mesh)
+    host_eng = QueryEngine(host_ms, "meshq")
+    query = 'sum(rate(request_total[1m]))'
+    start, end, step = BASE + 120_000, BASE + 460_000, 20_000
+    steps = (end - start) // step + 1
+
+    # warmup FIRST, then the very first mesh query: its trace delta is the
+    # falsifiable form of "query.warmup_shapes covers the mesh variants"
+    warmup([{"fn": "rate", "op": "sum", "series": per_shard, "samples": 64,
+             "steps": steps, "step_ms": step, "window_ms": 60_000,
+             "interval_ms": IV, "groups": 1, "mesh": True}])
+    t0 = plan_cache.traces
+    r_mesh = mesh_eng.query_range(query, start, end, step)
+    emit("mesh_query", "warm_compile_count", plan_cache.traces - t0,
+         "programs")
+    assert r_mesh.exec_path.startswith("mesh"), r_mesh.exec_path
+
+    def render(r):
+        return sorted((k.labels, ts.tobytes(),
+                       np.asarray(v, np.float64).tobytes())
+                      for k, ts, v in r.matrix.iter_series())
+
+    out = {}
+
+    def run(eng, tag):
+        def q():
+            r = eng.query_range(query, start, end, step)
+            np.asarray(r.matrix.values)   # force the fold/fetch: the mesh
+            out[tag] = r                  # result is lazy until rendered
+        dt, it = timed(q, max_iters=30)
+        return dt / it * 1000
+
+    host_ms_q = run(host_eng, "host")
+    results = {"host_loop_p50": host_ms_q}
+    try:
+        for mode, tag in (("shard_map", "mesh_shard_map_p50"),
+                          ("pjit", "mesh_pjit_p50")):
+            distributed.set_mesh_mode(mode)
+            results[tag] = run(mesh_eng, mode)
+    finally:
+        distributed.set_mesh_mode("auto")
+
+    # the leaf compute EVERY orchestration must execute: the same fused
+    # kernel over each shard's resident block, dispatched back-to-back with
+    # no per-shard fetch, blocked once. Subtracting it isolates per-query
+    # ORCHESTRATION overhead — the dispatch floor the one-program path
+    # attacks. (On 1-core CI the serialized kernel compute dominates the
+    # total identically in both paths; on a rig it overlaps across chips.)
+    from filodb_tpu.ops import fusedgrid, fusedresident
+    out_ts_arr = np.arange(start, end + 1, step, dtype=np.int64)
+    leaf_shards = [host_ms.shard("meshq", s) for s in range(n_shards)]
+
+    def floor_q():
+        pps = []
+        for sh in leaf_shards:
+            st = sh.store
+            pps.append(fusedresident.scalar_aggregate(
+                "sum", "rate", st.value_block(), st.n,
+                fusedgrid.zero_gids(st.S), 1, out_ts_arr, 60_000, BASE, IV,
+                fetch=False))
+        jax.block_until_ready([p._outs for p in pps])
+
+    dt, it = timed(floor_q, max_iters=30)
+    floor = dt / it * 1000
+    emit("mesh_query", "shards", n_shards, "count")
+    emit("mesh_query", "series", n_shards * per_shard, "count")
+    emit("mesh_query", "samples", n_samples, "count")
+    for tag, v in results.items():
+        emit("mesh_query", tag, v, "ms")
+    emit("mesh_query", "leaf_compute_floor_p50", floor, "ms")
+    over = {t: max(v - floor, 0.0) for t, v in results.items()}
+    emit("mesh_query", "host_loop_overhead_p50", over["host_loop_p50"], "ms")
+    emit("mesh_query", "mesh_pjit_overhead_p50", over["mesh_pjit_p50"], "ms")
+    emit("mesh_query", "mesh_vs_host_total_ratio",
+         results["mesh_pjit_p50"] / results["host_loop_p50"], "x")
+    emit("mesh_query", "mesh_vs_host_ratio",
+         over["mesh_pjit_p50"] / max(over["host_loop_p50"], 1e-9), "x")
+    emit("mesh_query", "bit_parity",
+         float(render(out["host"]) == render(out["pjit"])
+               == render(out["shard_map"])), "bool")
+
+
 SUITES = {
+    "mesh_query": bench_mesh_query,
     "dashboard_soak": bench_dashboard_soak,
     "elastic": bench_elastic,
     "rules": bench_rules,

@@ -1,0 +1,17 @@
+"""MUST flag mesh-sharding-undeclared: a half-declared pjit boundary and a
+bare jit dispatch over sharded store operands."""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def half_declared(mesh, body, slot_vals):
+    # BAD: in_shardings without out_shardings — jax infers the output side
+    # and silently re-gathers the result through one device
+    step = jax.jit(body, in_shardings=NamedSharding(mesh, P("shard")))
+    return step(slot_vals)
+
+
+def bare_dispatch(body, slot_vals, slot_gids):
+    # BAD: no boundary shardings at all on sharded store operands — every
+    # dispatch re-gathers the global arrays before the program runs
+    return jax.jit(body)(slot_vals, slot_gids)

@@ -1,0 +1,19 @@
+"""Clean twin: mesh programs declare BOTH boundary shardings; bare jit is
+fine over replicated (non-sharded) operands."""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def declared(mesh, body, slot_vals):
+    # both sides explicit: the executable consumes the sharded operands in
+    # place and leaves the folded result distributed
+    step = jax.jit(body,
+                   in_shardings=NamedSharding(mesh, P("shard")),
+                   out_shardings=NamedSharding(mesh, P("shard")))
+    return step(slot_vals)
+
+
+def replicated_only(body, out_ts, window_ms):
+    # bare jit over the step grid and window scalars — nothing sharded
+    # crosses the boundary, no declaration needed
+    return jax.jit(body)(out_ts, window_ms)

@@ -309,3 +309,57 @@ def test_mesh_two_shards_per_device():
         for k in exp:
             np.testing.assert_allclose(got[k], exp[k], rtol=2e-4, atol=1e-4,
                                        equal_nan=True)
+
+
+# -- PR 16: composed two-step reduce is bit-stable across step buckets --------
+#
+# PR 13's fold-order caveat (documented in bench_suite.bench_dashboard_soak):
+# the composed path's [G,R]x[R,T] segment reduce could differ in the last
+# ulp across padded-T step buckets — XLA was free to reassociate the matmul
+# fold per output shape. Closed by (a) the row-order stable segment reduce
+# (ops/aggregators.partial_aggregate(stable=True), shared by the host
+# composed path and the mesh per-shard map) and (b) the host-order f64
+# cross-shard fold (no in-program psum). These sweeps pin it down: the same
+# data queried at step counts landing in DIFFERENT _pad_steps buckets must
+# return bit-IDENTICAL values on the shared step prefix.
+
+# 7 / 40 / 100 steps pad to 32 / 64 / 128 — three distinct compile buckets
+_SWEEP_STEPS = (7, 40, 100)
+
+
+def test_mesh_twostep_fold_bit_stable_across_step_buckets():
+    mesh, ms, shards, _series = build_store()          # f64 twostep route
+    dstore = DistributedStore(mesh, shards)
+    ex = MeshQueryExecutor(dstore)
+    gids = [np.arange(16, dtype=np.int32) % 4 for _ in range(8)]
+    got = {}
+    for steps in _SWEEP_STEPS:
+        out_ts = START + 300_000 + np.arange(steps, dtype=np.int64) * 5_000
+        got[steps] = np.asarray(ex.aggregate("avg_over_time", "sum", out_ts,
+                                             60_000, gids, 4))
+        assert ex.last_path == "twostep"
+        assert got[steps].shape[1] == steps
+    for steps in _SWEEP_STEPS[:-1]:
+        np.testing.assert_array_equal(got[steps], got[100][:, :steps])
+
+
+def test_host_composed_reduce_bit_stable_across_step_buckets():
+    """The in-process serving twin of the sweep above: the engine's composed
+    (non-fused) segment reduce through exec._segment_partial."""
+    from filodb_tpu.query.engine import QueryEngine
+
+    _mesh, ms, _shards, _series = build_store()        # f64: composed path
+    eng = QueryEngine(ms, "prometheus")
+    step = 4_000                  # 100 steps stay inside the ingested range
+    start = START + 150_000
+    got = {}
+    for steps in _SWEEP_STEPS:
+        r = eng.query_range('sum by (grp) (avg_over_time(m[1m]))',
+                            start, start + (steps - 1) * step, step)
+        assert not r.exec_path.startswith("mesh"), r.exec_path
+        got[steps] = {k: np.asarray(v) for k, _t, v in r.matrix.iter_series()}
+        assert all(len(v) == steps for v in got[steps].values())
+    assert set(got[7]) == set(got[40]) == set(got[100])
+    for steps in _SWEEP_STEPS[:-1]:
+        for k, v in got[steps].items():
+            np.testing.assert_array_equal(v, got[100][k][:steps])

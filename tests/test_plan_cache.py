@@ -95,6 +95,95 @@ def test_second_identical_query_compiles_nothing_on_mesh():
                                       np.asarray(r2.matrix.values))
 
 
+def _mesh_store(dataset="meshiso"):
+    from filodb_tpu.parallel.distributed import make_mesh
+    mesh = make_mesh()
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(max_series_per_shard=16, samples_per_series=64,
+                      flush_batch_size=10**9, dtype="float32")
+    for i, dev in enumerate(mesh.devices.ravel()):
+        ms.setup(dataset, GAUGE, i, cfg, device=dev)
+    rng = np.random.default_rng(5)
+    for i in range(24):
+        b = RecordBuilder(GAUGE)
+        vals = np.cumsum(rng.exponential(5.0, 60))
+        for t in range(60):
+            b.add({"_metric_": "m", "host": f"h{i}", "grp": f"g{i % 4}"},
+                  BASE + t * IV, float(vals[t]))
+        ms.ingest(dataset, i % 8, b.build())
+    ms.flush_all()
+    return mesh, ms
+
+
+def test_mesh_programs_never_alias_per_shard_or_other_mode_entries():
+    """ISSUE 16 key audit: the mesh dist_* programs are keyed on (padded
+    shape, mesh axes, resolved mode) — a pjit-mode program must neither
+    reuse nor overwrite the shard_map-mode entry for the same query shape
+    (nor any per-shard in-process entry), and each mode's second identical
+    query still traces 0."""
+    from filodb_tpu.parallel import distributed
+    mesh, ms = _mesh_store()
+    eng = QueryEngine(ms, "meshiso", mesh=mesh)
+    start, end, step = BASE + 300_000, BASE + 500_000, 20_000
+    q = 'sum(rate(m[5m]))'
+    try:
+        distributed.set_mesh_mode("shard_map")
+        r_sm = eng.query_range(q, start, end, step)
+        assert r_sm.exec_path.startswith("mesh-"), r_sm.exec_path
+        size_sm, t_sm = len(plan_cache), plan_cache.traces
+        # switching mode must COMPILE A DISTINCT PROGRAM (no aliasing): the
+        # cache grows and real traces happen for the same query shape
+        distributed.set_mesh_mode("pjit")
+        r_pj = eng.query_range(q, start, end, step)
+        assert r_pj.exec_path.startswith("mesh[pjit]-"), r_pj.exec_path
+        assert len(plan_cache) > size_sm, \
+            "pjit-mode program must be a NEW cache entry, not an alias"
+        assert plan_cache.traces > t_sm
+        # identical pjit query: warm, traces nothing
+        t0 = plan_cache.traces
+        r_pj2 = eng.query_range(q, start, end, step)
+        assert plan_cache.traces == t0
+        # flipping BACK must hit the original shard_map entry (it was never
+        # overwritten) — still zero traces
+        distributed.set_mesh_mode("shard_map")
+        r_sm2 = eng.query_range(q, start, end, step)
+        assert plan_cache.traces == t0, \
+            "shard_map entry must survive the pjit compile untouched"
+        # and all four answers are bit-identical (the ordered-fold contract)
+        for r in (r_pj, r_pj2, r_sm2):
+            assert (np.asarray(r.matrix.values).tolist()
+                    == np.asarray(r_sm.matrix.values).tolist())
+    finally:
+        distributed.set_mesh_mode("auto")
+
+
+def test_warmup_covers_mesh_variants():
+    """query.warmup_shapes with ``mesh: true`` pre-traces the mesh dist_*
+    programs under the RESOLVED query.mesh_programs mode: the first real
+    mesh query of the warmed shape compiles nothing — in BOTH modes."""
+    from filodb_tpu.parallel import distributed
+    mesh, ms = _mesh_store("meshwarm")
+    eng = QueryEngine(ms, "meshwarm", mesh=mesh)
+    start, end, step = BASE + 300_000, BASE + 500_000, 20_000
+    steps = (end - start) // step + 1
+    spec = {"fn": "rate", "op": "sum", "series": 16, "samples": 64,
+            "steps": steps, "step_ms": step, "window_ms": 300_000,
+            "interval_ms": IV, "groups": 1, "mesh": True}
+    try:
+        for mode, tag in (("shard_map", "mesh-"), ("pjit", "mesh[pjit]-")):
+            distributed.set_mesh_mode(mode)
+            warmup([spec])
+            tracer.drain()
+            t0 = plan_cache.traces
+            r = eng.query_range('sum(rate(m[5m]))', start, end, step)
+            assert r.exec_path.startswith(tag), r.exec_path
+            assert plan_cache.traces == t0, \
+                f"warmed {mode} mesh shape must not compile at serve time"
+            assert _compile_spans() == []
+    finally:
+        distributed.set_mesh_mode("auto")
+
+
 def test_warmup_pretraces_the_dashboard_shape():
     """query.warmup_shapes contract: after warming the (fn, op, series,
     samples, steps, window, interval) bucket, the first real dashboard query

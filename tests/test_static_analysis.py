@@ -77,6 +77,10 @@ BAD_FIXTURES = {
     # a per-element Python loop over posting arrays in core/index*.py is
     # the 1M-series bottleneck the columnar engine exists to prevent
     "bad_index_postings.py": {"index-pure-python-postings"},
+    # PR 16: one-program mesh queries — a jit/pjit boundary in parallel/
+    # crossed by sharded store operands must declare BOTH in_shardings and
+    # out_shardings, or jax silently re-gathers the globals per dispatch
+    "bad_mesh_sharding.py": {"mesh-sharding-undeclared"},
 }
 
 

@@ -191,3 +191,203 @@ def test_kill_one_node_splits_shards_and_replans(three_node):
     assert got2_res.exec_path == "local"
     # unreferenced, but documents the window: the dead endpoint is gone
     assert dead_ep not in eps.values()
+
+
+# -- PR 16: one-program mesh queries vs the host-loop path --------------------
+#
+# The dist_* collectives now fold shard partials in HOST SHARD ORDER (an
+# all_gather + static left fold replaces psum/pmin/pmax) and hand the folded
+# partial dicts to the same numpy presenter the scatter-gather path uses —
+# so the mesh answer is bit-identical to the host loop BY CONSTRUCTION, not
+# within a tolerance. This grid proves it end to end: every dist_* shape,
+# on raw f32 and i16 narrow-resident gauge stores, pjit mesh == three-node
+# host loop == single-node oracle under exact `_as_comparable` equality.
+#
+# The third residency tier of the matrix — i8 — exists only as the 2D-delta
+# histogram form (`compressed_residency="all"`; gauge narrow blocks are
+# always i16, ops/narrow.py build_narrow). Histogram stores are host-merged
+# by design (engine._mesh_executor refuses bucketed stores), so the i8 leg
+# asserts the CLEAN FALLBACK plus exact parity instead of a mesh tag.
+
+MESH_IV = 10_000
+MESH_N = 64
+
+# per-residency query plans: route coverage × what each leaf kernel can
+# answer BIT-equally on both sides of the comparison. Grid-aligned f32/i16
+# drive the fused map phase for the windowed functions (the host loop serves
+# those through the identical fusedgrid kernel); their twostep/topk/sketch
+# legs use instant selectors, whose leaf values are exact sample COPIES on
+# either path. The f64 leg jitters the timestamps OFF the grid so both the
+# host leaf and the mesh leaf evaluate windowed functions through the same
+# periodic-samples kernel — covering rate/avg_over_time through twostep,
+# topk and sketch with real window arithmetic.
+MESH_PARITY_QUERIES = {
+    "f32": ('sum(rate(m[2m]))', 'avg by (grp) (rate(m[2m]))',
+            'stddev by (grp) (rate(m[2m]))', 'max by (grp) (m)',
+            'topk(2, m)', 'quantile(0.5, m)'),
+    "i16": ('sum(rate(m[2m]))', 'avg by (grp) (rate(m[2m]))',
+            'stddev by (grp) (rate(m[2m]))', 'max by (grp) (m)',
+            'topk(2, m)', 'quantile(0.5, m)'),
+    "f64": ('sum(sum_over_time(m[2m]))', 'max by (grp) (avg_over_time(m[2m]))',
+            'topk(2, rate(m[2m]))', 'quantile(0.5, rate(m[2m]))'),
+}
+
+
+def _mesh_parity_rows():
+    rng = np.random.default_rng(16)
+    # integer cumsums: exactly representable in f32 AND in the i16 narrow
+    # quantization's (q, vmin, scale) round-trip domain checked at flush
+    return [np.cumsum(rng.integers(1, 50, MESH_N)).astype(np.float64)
+            for _ in range(24)]
+
+
+def _mesh_parity_fill(ms, rows, jitter=None):
+    from filodb_tpu.core.record import RecordBuilder
+    for i, vals in enumerate(rows):
+        b = RecordBuilder(GAUGE)
+        for t in range(MESH_N):
+            ts = START + t * MESH_IV + (int(jitter[i][t]) if jitter is not None
+                                        else 0)
+            b.add({"_metric_": "m", "host": f"h{i}", "grp": f"g{i % 4}"},
+                  ts, float(vals[t]))
+        ms.ingest(DATASET, i % NSHARDS, b.build())
+    ms.flush_all()
+
+
+@pytest.mark.parametrize("residency", ["f32", "i16", "f64"])
+def test_mesh_bit_parity_grid_vs_host_loop_and_oracle(residency):
+    """ISSUE 16 satellite: every dist_* shape (fused / fused-narrow,
+    twostep, topk, sketch), pjit mesh == 3-node host loop == single-node
+    oracle, EXACT equality, exec path tagged mesh[pjit]-*."""
+    from filodb_tpu.core.memstore import StoreConfig
+    from filodb_tpu.parallel import distributed
+    from filodb_tpu.parallel.distributed import make_mesh
+
+    def cfg():
+        return StoreConfig(max_series_per_shard=16, samples_per_series=MESH_N,
+                           flush_batch_size=10**9,
+                           dtype="float64" if residency == "f64"
+                           else "float32",
+                           narrow_resident=(residency == "i16"))
+
+    rows = _mesh_parity_rows()
+    jitter = (np.random.default_rng(17).integers(0, MESH_IV // 2,
+                                                 (24, MESH_N))
+              if residency == "f64" else None)
+    mesh = make_mesh()
+    mesh_ms = TimeSeriesMemStore()
+    for s, dev in enumerate(mesh.devices.ravel()):
+        mesh_ms.setup(DATASET, GAUGE, s, cfg(), device=dev)
+    _mesh_parity_fill(mesh_ms, rows, jitter)
+    mesh_eng = QueryEngine(mesh_ms, DATASET, ShardMapper(NSHARDS), mesh=mesh)
+
+    oracle_ms = TimeSeriesMemStore()
+    mgr = ShardManager()
+    for n in NODES:
+        mgr.add_node(n)
+    mgr.add_dataset(DATASET, NSHARDS)
+    stores = {n: TimeSeriesMemStore() for n in NODES}
+    for s in range(NSHARDS):
+        oracle_ms.setup(DATASET, GAUGE, s, cfg())
+        for n in NODES:
+            stores[n].setup(DATASET, GAUGE, s, cfg())
+    _mesh_parity_fill(oracle_ms, rows, jitter)
+    for n in NODES:
+        _mesh_parity_fill(stores[n], rows, jitter)
+    if residency == "i16":
+        assert all(sh.store.is_narrow_resident
+                   for sh in mesh_ms.shards_of(DATASET))
+
+    eps: dict[str, str] = {}
+    engines = {n: QueryEngine(stores[n], DATASET, ShardMapper(NSHARDS),
+                              cluster=mgr, node=n, endpoint_resolver=eps.get)
+               for n in NODES}
+    servers = {n: FiloHttpServer({DATASET: engines[n]}, port=0).start()
+               for n in NODES}
+    for n, srv in servers.items():
+        eps[n] = f"127.0.0.1:{srv.port}"
+    oracle = QueryEngine(oracle_ms, DATASET, ShardMapper(NSHARDS))
+
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    queries = MESH_PARITY_QUERIES[residency]
+    tags = set()
+    distributed.set_mesh_mode("pjit")
+    try:
+        for q in queries:
+            rm = mesh_eng.query_range(q, start, end, step)
+            assert rm.exec_path.startswith("mesh[pjit]-"), (q, rm.exec_path)
+            tags.add(rm.exec_path)
+            want = _as_comparable(oracle.query_range(q, start, end, step))
+            got_loop = _as_comparable(
+                engines["a"].query_range(q, start, end, step))
+            got_mesh = _as_comparable(rm)
+            assert got_loop == want, f"host loop diverged from oracle: {q!r}"
+            assert got_mesh == want, f"mesh diverged from oracle: {q!r}"
+    finally:
+        distributed.set_mesh_mode("auto")
+        for srv in servers.values():
+            srv.stop()
+    if residency != "f64":
+        fused_tag = ("mesh[pjit]-fused-narrow" if residency == "i16"
+                     else "mesh[pjit]-fused")
+        assert fused_tag in tags, tags
+    assert {"mesh[pjit]-twostep", "mesh[pjit]-topk",
+            "mesh[pjit]-sketch"} <= tags, tags
+
+
+def test_mesh_engine_i8_hist_residency_host_merges_with_parity():
+    """The i8 leg of the residency matrix: 2D-delta histogram blocks
+    (`compressed_residency=\"all\"`, quiet rows take the i8 tier) are the
+    only i8-resident form, and engine._mesh_executor refuses bucketed
+    stores — the mesh-configured engine must fall back to the host merge
+    CLEANLY (no mesh tag, fallback metric ticks via the eligibility gate)
+    and match a no-mesh oracle over the identical ingests bit-for-bit."""
+    from filodb_tpu.core.memstore import StoreConfig
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import PROM_HISTOGRAM
+    from filodb_tpu.parallel import distributed
+    from filodb_tpu.parallel.distributed import make_mesh
+
+    B = 8
+    les = np.concatenate([2.0 ** np.arange(B - 1), [np.inf]])
+
+    def build(device_mesh):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=16, samples_per_series=128,
+                          flush_batch_size=10**9, dtype="float32",
+                          compressed_residency="all")
+        devs = (list(device_mesh.devices.ravel()) if device_mesh is not None
+                else [None] * NSHARDS)
+        for s in range(NSHARDS):
+            ms.setup(DATASET, PROM_HISTOGRAM, s, cfg, device=devs[s])
+        rng = np.random.default_rng(7)
+        for i in range(16):
+            b = RecordBuilder(PROM_HISTOGRAM, bucket_les=les)
+            c = np.cumsum(np.cumsum(rng.poisson(0.4, (96, B)), axis=0),
+                          axis=1).astype(np.float64)
+            for t in range(96):
+                b.add({"_metric_": "h", "host": f"x{i}"},
+                      START + t * MESH_IV, c[t])
+            ms.ingest(DATASET, i % NSHARDS, b.build())
+        ms.flush_all()
+        return ms
+
+    mesh = make_mesh()
+    ms_mesh = build(mesh)
+    ms_host = build(None)
+    assert any(sh.store._nhist[0].dtype == np.int8
+               for sh in ms_mesh.shards_of(DATASET)
+               if sh.store.is_narrow_resident)
+    em = QueryEngine(ms_mesh, DATASET, ShardMapper(NSHARDS), mesh=mesh)
+    eo = QueryEngine(ms_host, DATASET, ShardMapper(NSHARDS))
+    start, end, step = START + 300_000, START + 800_000, 30_000
+    distributed.set_mesh_mode("pjit")
+    try:
+        for q in ('histogram_quantile(0.9, sum(rate(h[2m])))',
+                  'sum(rate(h[2m]))'):
+            rm = em.query_range(q, start, end, step)
+            assert not rm.exec_path.startswith("mesh"), (q, rm.exec_path)
+            assert _as_comparable(rm) \
+                == _as_comparable(eo.query_range(q, start, end, step)), q
+    finally:
+        distributed.set_mesh_mode("auto")
