@@ -1,0 +1,110 @@
+"""surface-decode-variant-twin: every decode variant declares BOTH backends.
+
+The fused compressed-resident tier (ISSUE 17, ops/decodereg.py) streams
+narrow blocks through TWO kernel backends built from one tiling plan — the
+Pallas body and its XLA scan twin — and ``query.fused_kernels`` picks the
+serving one at runtime. A decode variant registered with only one backend
+twin compiles and passes every single-backend test, then silently breaks
+variant parity the first time the OTHER mode serves it (the runtime guard in
+``register_variant`` raises, but only on the import that registers — a
+``pallas=None`` placeholder or a missing keyword reaches production as a
+server that cannot flip modes). This rule makes the two-twin contract
+structural, inside ``ops/decodereg.py`` (fixture twins carry a
+``bad_``/``good_`` prefix):
+
+  * every ``register_variant(...)`` call must pass BOTH ``pallas=`` and
+    ``xla=`` keywords;
+  * neither may be the literal ``None`` (the "wire it later" placeholder
+    that defeats the runtime ValueError until the deferred import runs).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding
+
+# the decode-registry scope: the registry module plus the fixture twins
+_DECODE_MODULE = re.compile(
+    r"(?:^|/)ops/decodereg\.py$"
+    r"|(?:^|/)fixtures/filolint/(?:bad_|good_)decode_variant\.py$")
+
+_REQUIRED = ("pallas", "xla")
+
+
+def _is_register_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    return name == "register_variant"
+
+
+def _variant_name(node: ast.Call) -> str:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    for k in node.keywords:
+        if k.arg == "name" and isinstance(k.value, ast.Constant) \
+                and isinstance(k.value.value, str):
+            return k.value.value
+    return "<dynamic>"
+
+
+class DecodeChecker:
+    rules = ("surface-decode-variant-twin",)
+
+    def __init__(self):
+        self.project = None          # unused; kept for checker symmetry
+
+    def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
+        if not _DECODE_MODULE.search(path):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not _is_register_call(node):
+                continue
+            vname = _variant_name(node)
+            kws = {k.arg: k.value for k in node.keywords}
+            for side in _REQUIRED:
+                other = _REQUIRED[1 - _REQUIRED.index(side)]
+                val = kws.get(side)
+                if val is None and side not in kws:
+                    findings.append(Finding(
+                        "surface-decode-variant-twin", path, node.lineno,
+                        self._enclosing(tree, node),
+                        f"missing:{vname}:{side}",
+                        f"decode variant {vname!r} is registered without a "
+                        f"{side}= twin — a variant only the {other} backend "
+                        "can serve silently breaks fused variant parity "
+                        "when query.fused_kernels selects the other mode; "
+                        "declare BOTH backend twins (ops/decodereg.py "
+                        "register_variant)"))
+                elif isinstance(val, ast.Constant) and val.value is None:
+                    findings.append(Finding(
+                        "surface-decode-variant-twin", path, node.lineno,
+                        self._enclosing(tree, node),
+                        f"none:{vname}:{side}",
+                        f"decode variant {vname!r} passes {side}=None — the "
+                        "placeholder defeats the register-time guard until "
+                        "the deferred import runs in production; wire a "
+                        "real decode twin for both backends"))
+        return findings
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+    @staticmethod
+    def _enclosing(tree: ast.Module, target: ast.AST) -> str:
+        best = "<module>"
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        best = node.name if best == "<module>" \
+                            else f"{best}.{node.name}"
+                        break
+        return best

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .callgraph import PackageIndex
+from .decodecheck import DecodeChecker
 from .exceptcheck import ExceptChecker
 from .findings import Baseline, Finding, is_suppressed, load_suppressions
 from .indexcheck import IndexChecker
@@ -29,7 +30,7 @@ ALL_RULES = tuple(sorted(
     set(LockChecker.rules) | set(JitChecker.rules) | set(WireChecker.rules)
     | set(ResourceChecker.rules) | set(ExceptChecker.rules)
     | set(SurfaceChecker.rules) | set(IndexChecker.rules)
-    | set(MeshChecker.rules)))
+    | set(MeshChecker.rules) | set(DecodeChecker.rules)))
 
 DEFAULT_BASELINE = "filolint_baseline.json"
 
@@ -104,7 +105,7 @@ def _default_checkers(wire_spec: dict | None = None, full_scope: bool = True):
     surface.full_scope = full_scope
     return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec),
             ResourceChecker(), ExceptChecker(), IndexChecker(),
-            MeshChecker(), surface]
+            MeshChecker(), DecodeChecker(), surface]
 
 
 def _finalize(checkers, modules: dict) -> list[Finding]:

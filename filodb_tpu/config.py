@@ -60,8 +60,14 @@ CONFIG_SPEC: dict[str, tuple[str, Any, str]] = {
         "backfilled workloads behave like live ones."),
     "store.compressed_residency": (
         "str", "off",
-        "Compressed-resident store shape: off (raw f32/i64), gauge (i16 "
-        "quantized scalars), all (+ i8/i16 2D-delta histogram blocks)."),
+        "Compressed-resident store shape: off (raw f32/i64), gauge "
+        "(narrowest scalar decode variant: delta8/quant16/delta16), all "
+        "(+ i8/i16 2D-delta histogram blocks)."),
+    "store.narrow_cohort_gate": (
+        "float", 0.25,
+        "Max fraction of live rows allowed in the raw cohort pool before "
+        "a store declines compressed residency (and counts a "
+        "residency-fallback)."),
     "store.narrow_mirror": (
         "bool", False,
         "Keep an i16 mirror ALONGSIDE raw f32 (bandwidth, not capacity); "
@@ -503,6 +509,7 @@ class Config:
             retention_ms=parse_duration_ms(s["retention"]),
             dtype=s["dtype"],
             compressed_residency=s.get("compressed_residency", "off"),
+            narrow_cohort_gate=float(s.get("narrow_cohort_gate", 0.25)),
             narrow_mirror=bool(s.get("narrow_mirror", False)),
         )
 

@@ -117,11 +117,22 @@ def _pad_size(m: int) -> int:
 
 @jax.jit
 def _decode_narrow(q, vmin, scale, pool, pool_rows):
-    """Reconstruct the f32 value block from the narrow-resident state:
-    quantized rows decode as vmin + (q + 32768) * scale (bit-exact for rows
-    the encoder marked ok — ops/narrow.py contract); raw-pool rows overlay
-    their exact f32 values (pool pad rows carry row index S -> dropped)."""
+    """Reconstruct the f32 value block from the quant16 narrow-resident
+    state: quantized rows decode as vmin + (q + 32768) * scale (bit-exact
+    for rows the encoder marked ok — ops/narrow.py contract); raw-pool rows
+    overlay their exact f32 values (pool pad rows carry row index S ->
+    dropped)."""
     v = vmin[:, None] + (q.astype(jnp.float32) + 32768.0) * scale[:, None]
+    return v.at[pool_rows].set(pool, mode="drop")
+
+
+@jax.jit
+def _decode_delta(dv, anchor, pool, pool_rows):
+    """Reconstruct the f32 value block from the delta16/delta8 scalar state
+    (ops/narrow.py build_narrow_delta): v = anchor + cumsum(dv), bit-exact
+    for ok rows (integer deltas, |prefix| <= 2^23); raw-pool rows overlay
+    their exact f32 values."""
+    v = anchor[:, None] + jnp.cumsum(dv.astype(jnp.float32), axis=1)
     return v.at[pool_rows].set(pool, mode="drop")
 
 
@@ -201,6 +212,17 @@ def _decode_narrow_rows(q, vmin, scale, pool, pool_slot, rid):
     return jnp.where((slot >= 0)[:, None], pv, v)
 
 
+@jax.jit
+def _decode_delta_rows(dv, anchor, pool, pool_slot, rid):
+    """Row-wise delta16/delta8 decode with pool-value overlay — the delta
+    twin of :func:`_decode_narrow_rows`."""
+    dvg = jnp.take(dv, rid, axis=0).astype(jnp.float32)
+    v = jnp.take(anchor, rid)[:, None] + jnp.cumsum(dvg, axis=1)
+    slot = jnp.take(pool_slot, rid, mode="clip")
+    pv = jnp.take(pool, jnp.maximum(slot, 0), axis=0, mode="clip")
+    return jnp.where((slot >= 0)[:, None], pv, v)
+
+
 # row-wise derivation is the same rule applied to a gathered first/n pair
 _derive_ts_rows = _derive_ts
 
@@ -244,8 +266,10 @@ class DeferredDecode(_Deferred):
         residency since this view was handed out)."""
         st = self._store
         if self._arr is None and st._narrow is not None:
-            q, vmin, scale, pool, _pp, slot, _ok = st._narrow
-            return _decode_narrow_rows(q, vmin, scale, pool, slot, rid)
+            kind, ops, pool, _pp, slot, _ok = st._narrow
+            if kind == "quant16":
+                return _decode_narrow_rows(*ops, pool, slot, rid)
+            return _decode_delta_rows(*ops, pool, slot, rid)
         return jnp.take(self.materialize(), rid, axis=0)
 
 
@@ -377,10 +401,23 @@ class SeriesStore:
         from ..ops.narrow import NarrowMirror
         self.narrow = NarrowMirror()
         # narrow-RESIDENT state (StoreConfig.narrow_resident /
-        # compressed_residency): when set, the i16 quantized form IS the only
+        # compressed_residency): (kind, ops, pool, pp, slot, ok_host) where
+        # kind names the decode variant (ops/decodereg.py: "quant16" |
+        # "delta16" | "delta8") and ops its device operands ((q, vmin,
+        # scale) or (dv, anchor)). When set, the narrow form IS the only
         # resident value copy — self.val is None and f32 views decode on
         # demand (see compress_resident)
         self._narrow = None
+        # ok-contract fallback bookkeeping: when a flush WANTED compression
+        # but every encoding failed the contract/cohort gate, the reason
+        # ("resets" | "non-integer" | "range") lands here for the flush
+        # path's filodb_store_residency_fallback counter — "compressed" and
+        # "tried and fell back" must be distinguishable signals
+        self.residency_decline: str | None = None
+        # cohort-pool gate (StoreConfig.narrow_cohort_gate): the fraction of
+        # live rows allowed to fail the ok-contract before raw f32 is the
+        # cheaper residency
+        self.cohort_gate = 0.25
         # histogram twin: (dd i8/i16 [S,C,B], first_d f32 [S,B], pool, pp,
         # slot, ok_host) — the 2D-delta form of the cumulative bucket block
         # (compressed_residency="all")
@@ -403,11 +440,13 @@ class SeriesStore:
     # compressed form (NibblePack/delta chunks) and decompresses on access
     # (memory/.../format/vectors/DoubleVector.scala:1-60, doc/compression.md)
     # — bytes-per-sample is the capacity lever. TPU analog: after a flush the
-    # value column compresses to i16 (q, vmin, scale) and the f32 array is
-    # FREED; rows that don't round-trip bit-exactly keep their raw f32 in a
-    # small cohort pool. Appends rehydrate (write buffers stay raw in the
-    # reference too); the next flush re-compresses. Queries stream the i16
-    # state in the fused kernel, or decode a transient f32 for general paths.
+    # value column compresses to the narrowest decode variant that carries
+    # it bit-exactly (ops/decodereg.py: delta8 anchor+i8 deltas, quant16
+    # (q, vmin, scale), delta16) and the f32 array is FREED; rows that don't
+    # round-trip bit-exactly keep their raw f32 in a small cohort pool.
+    # Appends rehydrate (write buffers stay raw in the reference too); the
+    # next flush re-compresses. Queries stream the narrow state in the fused
+    # kernel, or decode a transient f32 for general paths.
 
     def mutation_epoch(self) -> tuple:
         """Changes whenever a donating mutation ran (append/compact/free) —
@@ -433,22 +472,62 @@ class SeriesStore:
 
     def _bad_rows(self, ok_host: np.ndarray):
         """Live rows failing the bit-exactness contract, or None when they
-        exceed the 25% cohort gate (raw f32 is then the cheaper residency)."""
+        exceed the cohort gate (StoreConfig.narrow_cohort_gate, default 25%
+        of live rows — raw f32 is then the cheaper residency)."""
         live = self.n_host > 0
         bad = np.nonzero(live & ~ok_host)[0].astype(np.int32)
-        if len(bad) > 0.25 * max(int(live.sum()), 1):
+        if len(bad) > self.cohort_gate * max(int(live.sum()), 1):
             return None
         return bad
 
+    @staticmethod
+    def _majority_reason(live_bad: np.ndarray,
+                         reasons: list[tuple[str, np.ndarray]]) -> str:
+        """Classify a residency decline: the first reason (in precedence
+        order) that explains at least as many failing rows as any later
+        one. ``reasons`` maps tag -> per-row failure mask."""
+        counts = [(tag, int((live_bad & mask).sum())) for tag, mask in reasons]
+        best = max(counts, key=lambda kv: kv[1])
+        return best[0] if best[1] else counts[-1][0]
+
     def _prepare_scalar(self):
-        from ..ops.narrow import build_narrow
-        q, vmin, scale, ok = build_narrow(self.val, self.n)
-        ok_host = np.asarray(ok)
-        bad = self._bad_rows(ok_host)
-        if bad is None:
-            return None    # mostly continuous floats: raw f32 is cheaper
-        pool, pp, slot = self._cohort_pool(bad)
-        return ("q", (q, vmin, scale, pool, pp, slot, ok_host))
+        """Narrow scalar residency, narrowest-first: delta8 (1B/sample),
+        then quant16 (2B but keeps active-column slicing — see
+        ops/decodereg.py full_columns), then delta16 (2B, full columns).
+        Counter-shaped rows (large anchor, small integer increments) fail
+        the quantized contract but carry exactly in the delta form."""
+        from ..ops.narrow import (build_narrow, build_narrow_delta,
+                                  cast_narrow_delta_i8)
+        dv16, anchor, okd16, okd8, integral = build_narrow_delta(
+            self.val, self.n)
+        okd8_host = np.asarray(okd8)
+        bad = self._bad_rows(okd8_host)
+        if bad is not None:
+            pool, pp, slot = self._cohort_pool(bad)
+            dv8 = cast_narrow_delta_i8(dv16)   # donates/frees the i16 block
+            return ("n", ("delta8", (dv8, anchor), pool, pp, slot, okd8_host))
+        q, vmin, scale, okq = build_narrow(self.val, self.n)
+        okq_host = np.asarray(okq)
+        bad = self._bad_rows(okq_host)
+        if bad is not None:
+            pool, pp, slot = self._cohort_pool(bad)
+            return ("n", ("quant16", (q, vmin, scale), pool, pp, slot,
+                          okq_host))
+        okd16_host = np.asarray(okd16)
+        bad = self._bad_rows(okd16_host)
+        if bad is not None:
+            pool, pp, slot = self._cohort_pool(bad)
+            return ("n", ("delta16", (dv16, anchor), pool, pp, slot,
+                          okd16_host))
+        # every encoding breached the cohort gate: classify for the flush
+        # path's fallback counter (non-integer deltas vs integral-but-
+        # out-of-range) — mostly continuous floats keep raw f32
+        live_bad = (self.n_host > 0) & ~okq_host & ~okd16_host
+        integral_host = np.asarray(integral)
+        self.residency_decline = self._majority_reason(
+            live_bad, [("non-integer", ~integral_host),
+                       ("range", integral_host)])
+        return None
 
     def _prepare_hist(self):
         """2D-delta residency for the [S, C, B] bucket block: the narrowest
@@ -456,7 +535,8 @@ class SeriesStore:
         under the gate wins — quiet histograms' delta-of-deltas are near zero,
         so i8 usually carries them at a quarter of the raw f32 bytes."""
         from ..ops.narrow import build_narrow_hist, cast_narrow_hist_i8
-        dd16, first_d, ok16, ok8 = build_narrow_hist(self.val, self.n)
+        dd16, first_d, ok16, ok8, mono, exact = build_narrow_hist(
+            self.val, self.n)
         ok8_host, ok16_host = np.asarray(ok8), np.asarray(ok16)
         bad8 = self._bad_rows(ok8_host)
         if bad8 is not None:
@@ -464,7 +544,16 @@ class SeriesStore:
         else:
             bad16 = self._bad_rows(ok16_host)
             if bad16 is None:
-                return None   # mostly inexact/bursty rows: keep raw f32
+                # mostly inexact/bursty rows: keep raw f32, but say why —
+                # counter resets (mono fail) vs non-integer round-trips vs
+                # integral-but-out-of-range deltas
+                mono_host, exact_host = np.asarray(mono), np.asarray(exact)
+                live_bad = (self.n_host > 0) & ~ok16_host
+                self.residency_decline = self._majority_reason(
+                    live_bad, [("resets", ~mono_host),
+                               ("non-integer", mono_host & ~exact_host),
+                               ("range", mono_host & exact_host)])
+                return None
             dd, bad, ok_host = dd16, bad16, ok16_host
         pool, pp, slot = self._cohort_pool(bad)
         return ("h", (dd, first_d, pool, pp, slot, ok_host))
@@ -476,8 +565,11 @@ class SeriesStore:
         concurrent donating mutation surfaces as RuntimeError (caller retries
         next flush). Returns None when the store/data doesn't qualify
         (multi-column, f64, mostly non-quantizable rows, or a histogram
-        store with ``hist=False`` — the shard's residency-mode gate)."""
+        store with ``hist=False`` — the shard's residency-mode gate).
+        ``residency_decline`` carries the ok-contract failure reason when
+        the data itself (not eligibility) caused the None."""
         prep_val = None
+        self.residency_decline = None
         if self._narrow is None and self._nhist is None:
             if self.dtype != jnp.float32 or self.val is None:
                 return None
@@ -546,8 +638,9 @@ class SeriesStore:
             return
         self._pre_donate("SeriesStore.rehydrate")
         if self._narrow is not None:
-            q, vmin, scale, pool, pp, _slot, _ok = self._narrow
-            self.val = _decode_narrow(q, vmin, scale, pool, pp)
+            kind, ops, pool, pp, _slot, _ok = self._narrow
+            dec = _decode_narrow if kind == "quant16" else _decode_delta
+            self.val = dec(*ops, pool, pp)
             self._narrow = None
         elif self._nhist is not None:
             dd, first_d, pool, pp, _slot, _ok = self._nhist
@@ -563,8 +656,9 @@ class SeriesStore:
         narrow state (not retained — capacity stays at the compressed form +
         pool)."""
         if self._narrow is not None:
-            q, vmin, scale, pool, pp, _slot, _ok = self._narrow
-            return _decode_narrow(q, vmin, scale, pool, pp)
+            kind, ops, pool, pp, _slot, _ok = self._narrow
+            dec = _decode_narrow if kind == "quant16" else _decode_delta
+            return dec(*ops, pool, pp)
         if self._nhist is not None:
             dd, first_d, pool, pp, _slot, _ok = self._nhist
             return _decode_hist(dd, first_d, pool, pp)
@@ -578,12 +672,14 @@ class SeriesStore:
                           jnp.int64(self.grid_interval), self.C)
 
     def narrow_operands(self):
-        """(q, vmin, scale, ok_host) when narrow-resident, else None — the
-        fused kernel's direct-stream operands (same layout as the mirror)."""
+        """(kind, operands, ok_host) when narrow-resident, else None — the
+        fused kernel's direct-stream form: ``kind`` names the decode variant
+        (ops/decodereg.py) and ``operands = (block, *row_operands)`` its
+        device arrays ((q, vmin, scale) or (dv, anchor))."""
         if self._narrow is None:
             return None
-        q, vmin, scale, _pool, _pp, _slot, ok = self._narrow
-        return q, vmin, scale, ok
+        kind, ops, _pool, _pp, _slot, ok = self._narrow
+        return kind, ops, ok
 
     def hist_operands(self):
         """(dd, first_d, ok_host) when hist-resident, else None — the narrow
@@ -600,8 +696,8 @@ class SeriesStore:
     def resident_value_bytes(self) -> int:
         """Resident HBM bytes of the value state (capacity accounting)."""
         if self._narrow is not None:
-            q, vmin, scale, pool, _pp, _slot, _ok = self._narrow
-            return (q.size * 2 + vmin.size * 4 + scale.size * 4
+            _kind, ops, pool, _pp, _slot, _ok = self._narrow
+            return (sum(o.size * o.dtype.itemsize for o in ops)
                     + pool.size * 4)
         if self._nhist is not None:
             dd, first_d, pool, _pp, _slot, _ok = self._nhist
@@ -612,8 +708,8 @@ class SeriesStore:
 
     def resident_sample_bytes(self) -> int:
         """Total resident HBM of the (ts + value) sample state — the
-        retention-per-HBM-byte accounting: ts elision + i16 values take a
-        12B/sample f32 store to ~2B/sample."""
+        retention-per-HBM-byte accounting: ts elision + narrow values take a
+        12B/sample f32 store to ~1-2B/sample (delta8 / quant16)."""
         t = 0 if self._ts_elided or self.ts is None \
             else self.ts.size * self.ts.dtype.itemsize
         return t + self.resident_value_bytes()

@@ -48,7 +48,8 @@ from ..utils.diagnostics import TimedRLock, assert_owned
 from ..utils.metrics import (FILODB_INDEX_PERSISTED_BUCKETS,
                              FILODB_INDEX_RECOVER_MS,
                              FILODB_RETENTION_AGED_OUT_ROWS,
-                             FILODB_RETENTION_ODP_ROWS, registry)
+                             FILODB_RETENTION_ODP_ROWS,
+                             FILODB_STORE_RESIDENCY_FALLBACK, registry)
 from ..utils.tracing import SPAN_ODP_DURABLE, span
 
 # _create_series_locked outcome distinct from "blocked, stage prefix first"
@@ -93,17 +94,30 @@ class StoreConfig:
     # which store shapes adopt the compressed-resident form after flush
     # (server knob: config.py store.compressed_residency):
     #   "off"   — raw f32/i64 blocks stay resident
-    #   "gauge" — scalar f32 single-column stores (i16 quantized + ts elision)
+    #   "gauge" — scalar f32 single-column stores: the narrowest decode
+    #             variant carrying the data bit-exactly (ops/decodereg.py:
+    #             delta8 anchor+i8 deltas for counters, quant16, delta16)
+    #             + ts elision
     #   "all"   — gauge AND [S, C, B] histogram stores (i8/i16 2D-delta bucket
     #             blocks — the reference keeps ALL in-memory data compressed,
     #             histograms most of all: doc/compression.md "Histograms")
     compressed_residency: str = "off"
+    # cohort-pool gate for compressed residency: the fraction of live rows
+    # allowed to fail the bit-exactness contract (kept raw in the cohort
+    # pool) before the store declines compression — beyond it, raw f32 is
+    # the cheaper residency and the decline counts a
+    # filodb_store_residency_fallback
+    narrow_cohort_gate: float = 0.25
 
     def __post_init__(self):
         if self.compressed_residency not in ("off", "gauge", "all"):
             raise ValueError(
                 f"compressed_residency must be off|gauge|all, "
                 f"got {self.compressed_residency!r}")
+        if not 0.0 <= self.narrow_cohort_gate <= 1.0:
+            raise ValueError(
+                f"narrow_cohort_gate must be in [0, 1], "
+                f"got {self.narrow_cohort_gate!r}")
 
     def residency_mode(self) -> str:
         """Effective residency mode ("off" | "gauge" | "all"), folding the
@@ -708,11 +722,13 @@ class TimeSeriesShard:
             nb = width_hint
         layout = (self.schema.col_layout(nb)
                   if self.schema.is_multi_column else None)
-        return SeriesStore(self.config.max_series_per_shard,
-                           self.config.samples_per_series,
-                           dtype=self._dtype, device=self._device,
-                           nbuckets=nb, layout=layout,
-                           default_col=self.schema.value_column)
+        store = SeriesStore(self.config.max_series_per_shard,
+                            self.config.samples_per_series,
+                            dtype=self._dtype, device=self._device,
+                            nbuckets=nb, layout=layout,
+                            default_col=self.schema.value_column)
+        store.cohort_gate = self.config.narrow_cohort_gate
+        return store
 
     def ingest(self, container: RecordContainer, offset: int = -1,
                recovery_watermarks: np.ndarray | None = None) -> None:
@@ -903,6 +919,12 @@ class TimeSeriesShard:
         except RuntimeError:
             return                 # racing donation invalidated the build
         if prep is None:
+            if st.residency_decline is not None:
+                # the store WANTED compression and the data refused the
+                # ok-contract: "tried and fell back" must be a visible
+                # signal, not a silent raw-residency downgrade
+                registry.counter(FILODB_STORE_RESIDENCY_FALLBACK,
+                                 {"reason": st.residency_decline}).increment()
             return
         with self.lock:
             if st.mutation_epoch() == epoch0:

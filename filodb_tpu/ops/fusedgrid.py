@@ -35,7 +35,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import gridfns
+from . import decodereg, gridfns
 
 FUSED_FNS = {"rate", "increase", "delta"}
 # window-aggregation shapes of the fused tier (ISSUE 9): the same one-pass
@@ -125,37 +125,30 @@ def tile_contrib(fn: str, window_ms: int, interval_ms: int, c0: int,
     return jnp.where(ok, scaled, 0.0), ok.astype(f32)
 
 
-def decode_narrow_tile(q, vmin, scale):
-    """u16 mirror decode (ops/narrow.py), shared by both fused backends: the
-    biased i16 mirror stores x = q - 32768 for q = round((v - vmin)/2^e) in
-    [0, 65535]; q * 2^e is exact (q < 2^16, power-of-two scale) and
-    vmin + q * 2^e reproduces the f32 value bit-exactly for rows the encoder
-    verified — HALF the HBM bytes of the raw f32 store stream (ref: the
-    reference decompresses NibblePack chunks on access for the same
-    bandwidth reason). Integers <= 65535 are exact in f32."""
-    return vmin + (q.astype(jnp.float32) + 32768.0) * scale
+# back-compat alias: the quant16 decode now lives in the shared decode-
+# variant registry (ops/decodereg.py) next to its delta/hist siblings
+decode_narrow_tile = decodereg.decode_quant16
 
 
 def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
-                 Sb: int, Ca: int, Tp: int, G: int, narrow: bool, c0: int,
+                 Sb: int, Ca: int, Tp: int, G: int, residency: str, c0: int,
                  *refs):
     """``Ca`` is the streamed column width and ``c0`` its global offset into
     the store: a sub-range query streams (and matmuls) only its active
-    columns (see active_columns); full-range queries have c0=0, Ca=C."""
-    if narrow:
-        (val_ref, vmin_ref, scl_ref, n_ref, gid_ref, band_ref, ohlo_ref,
-         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
-    else:
-        (val_ref, n_ref, gid_ref, band_ref, ohlo_ref,
-         lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs
+    columns (see active_columns); full-range queries have c0=0, Ca=C.
+    ``residency`` names the decode variant (ops/decodereg.py) — the value
+    block plus its per-row operands decode to f32 in VMEM per tile."""
+    var = decodereg.variant(residency)
+    R = var.row_operands
+    val_ref = refs[0]
+    rowrefs = refs[1:1 + R]
+    (n_ref, gid_ref, band_ref, ohlo_ref,
+     lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref, *maybe_sumsq) = refs[1 + R:]
     i = pl.program_id(0)
     f32 = jnp.float32
 
-    if narrow:
-        # decode in VMEM: see decode_narrow_tile
-        v = decode_narrow_tile(val_ref[:], vmin_ref[:], scl_ref[:])  # [Sb, Ca]
-    else:
-        v = val_ref[:]                                        # [Sb, Ca]
+    # decode in VMEM: the registered pallas twin of the residency variant
+    v = var.pallas(val_ref[:], *(r[:] for r in rowrefs))      # [Sb, Ca]
     n = n_ref[:]                                              # [Sb, 1] i32
     # i32 shift: x64 mode would lower an i64 operand, which
     # tpu.dynamic_rotate rejects
@@ -190,25 +183,30 @@ def _kernel_body(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
 @functools.lru_cache(maxsize=64)
 def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                  S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
-                 narrow: bool = False, c0: int = 0, Ck: int = 0):
+                 residency: str = "raw", c0: int = 0, Ck: int = 0):
     """The raw (traceable) fused-kernel pallas_call — also invoked inside
     ``shard_map`` by the mesh executor (parallel/distributed.py), where each
     shard runs this same map phase on its resident block and the partial
     state crosses the ICI collective (ref: AggrOverRangeVectors.scala:62 —
-    the identical map phase runs on every data node). With ``narrow`` the
-    value operand is the u16 quantized mirror plus per-row (vmin, scale).
+    the identical map phase runs on every data node). ``residency`` names
+    the decode variant (ops/decodereg.py): the value operand is that
+    variant's narrow block plus its per-row operands (quant16: vmin/scale;
+    delta16/delta8: anchor), decoded to f32 in VMEM per tile.
 
     ``(c0, Ca)`` describe the active column range (see active_columns): when
     it covers less than the full store, the kernel's value block starts at
     column ``c0`` and spans only ``Ca`` columns — HBM bytes and MXU MACs
     scale with the query's range, not the store's retention — and the band
-    operands arrive pre-sliced to [Ca, Tp]."""
+    operands arrive pre-sliced to [Ca, Tp]. full_columns variants (the
+    delta cumsum telescopes from cell 0) require c0=0."""
+    var = decodereg.variant(residency)
+    assert not var.full_columns or c0 == 0, (residency, c0)
     n_out = 3 if needs_sumsq else 2
     Ca = Ck if Ck else C
     out_shape = tuple(jax.ShapeDtypeStruct((G, Tp), jnp.float32)
                       for _ in range(n_out))
     body = functools.partial(_kernel_body, fn, needs_sumsq, window_ms,
-                             interval_ms, Sb, Ca, Tp, G, narrow, c0)
+                             interval_ms, Sb, Ca, Tp, G, residency, c0)
     acc_spec = pl.BlockSpec((G, Tp), lambda i: (0, 0), memory_space=pltpu.VMEM)
     const = functools.partial(pl.BlockSpec, index_map=lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
@@ -217,8 +215,7 @@ def build_pallas(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     kcol = c0 // Ca                       # active_columns guarantees c0 % Ca == 0
     in_specs = [pl.BlockSpec((Sb, Ca), lambda i: (i, kcol),
                              memory_space=pltpu.VMEM)]
-    if narrow:
-        in_specs += [row((Sb, 1)), row((Sb, 1))]   # vmin, scale
+    in_specs += [row((Sb, 1))] * var.row_operands   # vmin/scale or anchor
     in_specs += [
         row((Sb, 1)), row((Sb, 1)),
         const((Ca, Tp)), const((Ca, Tp)),
@@ -266,15 +263,21 @@ def active_columns(C: int, lo: np.ndarray, hi: np.ndarray) -> tuple[int, int]:
 
 def build_xla_tiles(fn: str, needs_sumsq: bool, window_ms: int,
                     interval_ms: int, S: int, Sb: int, C: int, Tp: int,
-                    G: int, narrow: bool = False, c0: int = 0, Ck: int = 0):
+                    G: int, residency: str = "raw", c0: int = 0, Ck: int = 0):
     """XLA-fused twin of :func:`build_pallas`, built from the SAME tiling
     plan: one ``lax.scan`` walks the identical [Sb, Ca] row tiles through
     the identical :func:`tile_contrib` math and accumulates the same [G, Tp]
     partial state — one compiled program, intermediates bounded by one tile,
     the [S, T] matrix never materializes in HBM. Selected per
     ``query.fused_kernels`` (ops/fusedresident.py); signature-compatible
-    with build_pallas's returned call so the mesh route swaps them freely."""
+    with build_pallas's returned call so the mesh route swaps them freely.
+    ``residency`` picks the registered xla decode twin (ops/decodereg.py)
+    applied per tile — the full [S, C] f32 block never materializes on
+    this variant either."""
     f32 = jnp.float32
+    var = decodereg.variant(residency)
+    assert not var.full_columns or c0 == 0, (residency, c0)
+    R = var.row_operands
     Ca = Ck if Ck else C
     nt = S // Sb
     dn = (((0,), (0,)), ((), ()))
@@ -282,13 +285,9 @@ def build_xla_tiles(fn: str, needs_sumsq: bool, window_ms: int,
     # masked in tile_contrib exactly like pltpu.roll's
 
     def fold(carry, xs, band, ohlo, lo, hi, rel):
-        if narrow:
-            # per-TILE decode, like the Pallas body's VMEM decode: the full
-            # [S, C] f32 block never materializes on this variant either
-            q_t, vmin_t, scl_t, n_t, g_t = xs
-            v = decode_narrow_tile(q_t, vmin_t, scl_t)
-        else:
-            v, n_t, g_t = xs
+        blk_t, *rest = xs
+        v = var.xla(blk_t, *rest[:R])
+        n_t, g_t = rest[R], rest[R + 1]
         contrib, okf = tile_contrib(fn, window_ms, interval_ms, c0,
                                     v, n_t, band, ohlo, lo, hi, rel, roll)
         gcol = jax.lax.broadcasted_iota(jnp.int32, (Sb, G), 1)
@@ -309,48 +308,50 @@ def build_xla_tiles(fn: str, needs_sumsq: bool, window_ms: int,
             lambda c, xs: fold(c, xs, band, ohlo, lo, hi, rel), init, tiles)
         return outs
 
-    if narrow:
-        def call(q, vmin, scl, n2, g2, band, ohlo, lo, hi, rel):
-            tiles = (q[:, c0:c0 + Ca].reshape(nt, Sb, Ca),
-                     vmin.reshape(nt, Sb, 1), scl.reshape(nt, Sb, 1),
-                     n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1))
-            return run_tiles(tiles, band, ohlo, lo, hi, rel)
-    else:
-        def call(val, n2, g2, band, ohlo, lo, hi, rel):
-            # active columns sliced like the pallas block index map
-            tiles = (val[:, c0:c0 + Ca].reshape(nt, Sb, Ca),
-                     n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1))
-            return run_tiles(tiles, band, ohlo, lo, hi, rel)
+    def call(blk, *rest):
+        # rest: R per-row decode operands, n2, g2, then the 5 band/edge ops;
+        # active columns sliced like the pallas block index map
+        rows, n2, g2 = rest[:R], rest[R], rest[R + 1]
+        tiles = ((blk[:, c0:c0 + Ca].reshape(nt, Sb, Ca),)
+                 + tuple(r.reshape(nt, Sb, 1) for r in rows)
+                 + (n2.reshape(nt, Sb, 1), g2.reshape(nt, Sb, 1)))
+        return run_tiles(tiles, *rest[R + 2:])
     return call
 
 
 def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
                 S: int, Sb: int, C: int, Tp: int, G: int, interpret: bool,
-                narrow: bool = False, c0: int = 0, Ck: int = 0,
+                residency: str = "raw", c0: int = 0, Ck: int = 0,
                 variant: str = "pallas"):
     """The compiled fused program via the explicit plan cache (query/
     plancache.py) — its key IS this signature: fn/op statics, the padded
-    [S, C, Tp, G] shape buckets, the residency mode (``narrow``), and the
-    backend ``variant`` ("pallas" | "xla") — the two backends are distinct
-    compiled programs and cache as distinct kernel variants."""
+    [S, C, Tp, G] shape buckets, the ``residency`` decode variant
+    ("raw" | "quant16" | "delta16" | "delta8"), and the backend ``variant``
+    ("pallas" | "xla") — every (residency, backend) pair is a distinct
+    compiled program and caches as a distinct kernel variant."""
     from ..query.plancache import plan_cache
+    R = decodereg.variant(residency).row_operands
 
     def build():
         if variant == "xla":
             call = build_xla_tiles(fn, needs_sumsq, window_ms, interval_ms,
-                                   S, Sb, C, Tp, G, narrow, c0, Ck)
+                                   S, Sb, C, Tp, G, residency, c0, Ck)
         else:
             call = build_pallas(fn, needs_sumsq, window_ms, interval_ms,
-                                S, Sb, C, Tp, G, interpret, narrow, c0, Ck)
+                                S, Sb, C, Tp, G, interpret, residency,
+                                c0, Ck)
 
         # one dispatch per query: dtype casts and [S] -> [S, 1] reshapes live
         # inside the jit — on a tunneled device every extra dispatch is a
         # round-trip (~0.1s measured), dwarfing the kernel itself
-        if narrow:
-            def wrapped(val, vmin, scl, n, gids, *ops):
-                return call(val, vmin.reshape(S, 1), scl.reshape(S, 1),
+        if residency != "raw":
+            def wrapped(blk, *rest):
+                rows = tuple(r.reshape(S, 1) for r in rest[:R])
+                n, gids = rest[R], rest[R + 1]
+                return call(blk, *rows,
                             n.astype(jnp.int32).reshape(S, 1),
-                            gids.astype(jnp.int32).reshape(S, 1), *ops)
+                            gids.astype(jnp.int32).reshape(S, 1),
+                            *rest[R + 2:])
         else:
             def wrapped(val, n, gids, *ops):
                 return call(val.astype(jnp.float32),
@@ -361,7 +362,7 @@ def _build_call(fn: str, needs_sumsq: bool, window_ms: int, interval_ms: int,
     return plan_cache.program(
         "fused-grid",
         (fn, needs_sumsq, window_ms, interval_ms, S, Sb, C, Tp, G,
-         interpret, narrow, c0, Ck, variant), build)
+         interpret, residency, c0, Ck, variant), build)
 
 
 def pad_edges(lo: np.ndarray, hi: np.ndarray, rel: np.ndarray,
@@ -380,7 +381,8 @@ def pad_edges(lo: np.ndarray, hi: np.ndarray, rel: np.ndarray,
 
 
 def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
-                  base_ts: int, interval_ms: int, fn_kind: str = "rate"):
+                  base_ts: int, interval_ms: int, fn_kind: str = "rate",
+                  full_cols: bool = False):
     """Band/one-hot/edge operands as host arrays + active column range:
     (band, ohlo, lo[1,Tp], hi[1,Tp], rel[1,Tp], c0, Ck) — shared by the
     single-chip upload cache below and the mesh path (which replicates them
@@ -389,7 +391,9 @@ def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
     only those store tiles); full-range queries keep [C, Tp] operands.
     ``fn_kind`` picks the band form: "rate" builds the OPEN band the
     increment matmul needs, "window" the CLOSED band of the *_over_time
-    fns (tile_contrib consumes whichever matches its fn)."""
+    fns (tile_contrib consumes whichever matches its fn). ``full_cols``
+    bypasses active-column slicing — required by full_columns decode
+    variants whose per-tile decode telescopes from cell 0."""
     T = len(out_ts)
     lo, hi = gridfns.grid_edges(out_ts, window_ms, base_ts, interval_ms)
     rel = out_ts - base_ts
@@ -399,7 +403,7 @@ def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
                                       np.float32)
     ohlo = np.zeros((C, Tp), np.float32)
     ohlo[:, :T] = gridfns.onehot_matrix(C, np.maximum(lo, 0), np.float32)
-    c0, Ca = active_columns(C, lo, hi)
+    c0, Ca = (0, C) if full_cols else active_columns(C, lo, hi)
     if Ca < C:
         band = np.ascontiguousarray(band[c0:c0 + Ca])
         ohlo = np.ascontiguousarray(ohlo[c0:c0 + Ca])
@@ -408,13 +412,14 @@ def host_operands(C: int, Tp: int, out_ts: np.ndarray, window_ms: int,
 
 @functools.lru_cache(maxsize=32)
 def _device_operands(C: int, Tp: int, out_ts_key: bytes, window_ms: int,
-                     base_ts: int, interval_ms: int, fn_kind: str = "rate"):
+                     base_ts: int, interval_ms: int, fn_kind: str = "rate",
+                     full_cols: bool = False):
     """Band/one-hot/edge operands on device, cached per query shape — the
     upload matters: repeated host->device transfers of the [C, Tp] bands per
     row-batch would dominate over a tunneled device link."""
     out_ts = np.frombuffer(out_ts_key, np.int64)
     *arrs, c0, Ck = host_operands(C, Tp, out_ts, window_ms, base_ts,
-                                  interval_ms, fn_kind)
+                                  interval_ms, fn_kind, full_cols)
     return tuple(jnp.asarray(a) for a in arrs) + (c0, Ck)
 
 
@@ -471,13 +476,20 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     partial-state dict as ``aggregators.partial_aggregate(op, ...)`` with
     [num_groups, T] arrays, combinable via ``combine_partials`` / psum.
     With ``fetch=False`` returns a :class:`PaddedPartials` whose ``resolve()``
-    does the (blocking) host fetch later. ``narrow=(q, vmin, scale)`` streams
-    the u16 quantized mirror (ops/narrow.py) instead of ``val`` — half the
+    does the (blocking) host fetch later. ``narrow=(kind, operands)`` streams
+    a registered narrow block (ops/decodereg.py) instead of ``val``: kind
+    names the decode variant ("quant16" | "delta16" | "delta8") and
+    ``operands = (block, *row_operands)`` its device arrays — 1/4 to 1/2 the
     HBM bytes; the caller must already have zeroed ``n`` for rows whose
-    mirror is not bit-exact.
+    narrow encoding is not bit-exact.
     """
     assert fn in FUSED_FNS | FUSED_WINDOW_FNS and op in FUSED_OPS
-    S, C = val.shape
+    if narrow is not None:
+        kind, nops = narrow
+        S, C = nops[0].shape
+    else:
+        kind, nops = "raw", None
+        S, C = val.shape
     T = len(out_ts)
     assert fusable(S, C, T, num_groups), (S, C, T, num_groups)
     Tp = _roundup(max(T, 1), 128)
@@ -487,21 +499,20 @@ def fused_grid_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
     band, ohlo, lo_d, hi_d, rel_d, c0, Ck = _device_operands(
         C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
         int(window_ms), int(base_ts), int(interval_ms),
-        "window" if fn in FUSED_WINDOW_FNS else "rate")
+        "window" if fn in FUSED_WINDOW_FNS else "rate",
+        decodereg.variant(kind).full_columns)
 
     needs_sumsq = op in ("stddev", "stdvar")
     interpret = jax.default_backend() != "tpu"
     call = _build_call(fn, needs_sumsq, int(window_ms), int(interval_ms),
-                       S, Sb, C, Tp, G, interpret, narrow is not None,
-                       c0, Ck, variant)
+                       S, Sb, C, Tp, G, interpret, kind, c0, Ck, variant)
     # the framework runs with x64 on (int64 timestamps); Mosaic rejects the
     # i64 scalars x64 tracing injects (grid index maps, roll shifts), and the
     # kernel itself is pure f32/i32 — so trace the call with x64 off
     from ..utils import enable_x64
     with enable_x64(False):
-        if narrow is not None:
-            q, vmin, scale = narrow
-            outs = call(q, vmin, scale, jnp.asarray(n), jnp.asarray(gids),
+        if nops is not None:
+            outs = call(*nops, jnp.asarray(n), jnp.asarray(gids),
                         band, ohlo, lo_d, hi_d, rel_d)
         else:
             outs = call(val, jnp.asarray(n), jnp.asarray(gids),

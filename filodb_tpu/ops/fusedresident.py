@@ -49,7 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..utils.metrics import (FILODB_QUERY_FUSED_FALLBACK,
                              FILODB_QUERY_FUSED_SERVED, registry)
-from . import fusedgrid, gridfns
+from . import decodereg, fusedgrid, gridfns
 
 MODES = ("off", "xla", "pallas")
 
@@ -119,7 +119,9 @@ def scalar_aggregate(op: str, fn: str, val, n, gids, num_groups: int,
                      out_ts: np.ndarray, window_ms: int, base_ts: int,
                      interval_ms: int, fetch: bool = True, narrow=None):
     """Mode-routed one-pass ``op(fn(metric[w]))`` partials (see
-    fusedgrid.fused_grid_aggregate for operand contracts). Caller checked
+    fusedgrid.fused_grid_aggregate for operand contracts;
+    ``narrow=(kind, operands)`` streams a registered narrow block —
+    ops/decodereg.py — decoded in VMEM per tile). Caller checked
     eligibility and guarantees ``mode() != "off"``."""
     assert _mode != "off"
     out = fusedgrid.fused_grid_aggregate(
@@ -235,7 +237,8 @@ def _hist_kernel_body(fn: str, window_ms: int, interval_ms: int, Sb: int,
                       dd_ref, fd_ref, n_ref, gid_ref, band_ref, plo_ref,
                       lo_ref, hi_ref, rel_ref, sum_ref, cnt_ref):
     i = pl.program_id(0)
-    ddf = dd_ref[:].astype(jnp.float32)        # i8/i16 decode in VMEM
+    # i8/i16 decode in VMEM via the registered hist twin (ops/decodereg.py)
+    ddf = decodereg.decode_hist(dd_ref[:], fd_ref[:])
     contrib, okf = hist_tile_contrib(fn, window_ms, interval_ms, B,
                                      ddf, fd_ref[:], n_ref[:], band_ref[:],
                                      plo_ref[:], lo_ref[:], hi_ref[:],
@@ -302,7 +305,8 @@ def build_hist_xla_tiles(fn: str, window_ms: int, interval_ms: int, S: int,
         def fold(carry, xs):
             dd_t, fd_t, n_t, g_t = xs
             contrib, okf = hist_tile_contrib(
-                fn, window_ms, interval_ms, B, dd_t.astype(f32), fd_t, n_t,
+                fn, window_ms, interval_ms, B,
+                decodereg.decode_hist(dd_t, fd_t), fd_t, n_t,
                 band, plo, lo, hi, rel)
             psum, pcnt = _hist_fold(Sb, G, g_t, contrib, okf)
             return (carry[0] + psum, carry[1] + pcnt), None

@@ -83,9 +83,14 @@ def build_narrow(val, n):
 @jax.jit
 def build_narrow_hist(val, n):
     """One streaming pass over a [S, C, B] cumulative-bucket block:
-    (dd i16[S, C, B], first_d f32[S, B], ok16 bool[S], ok8 bool[S]).
+    (dd i16[S, C, B], first_d f32[S, B], ok16 bool[S], ok8 bool[S],
+    mono bool[S], exact bool[S]).
 
-    ``okN`` marks rows that BOTH round-trip bit-exactly, stay MONOTONE over
+    ``mono``/``exact`` report the monotonicity and round-trip legs of the
+    contract separately so a declining store can say WHY (counter resets
+    vs non-integer data vs out-of-range deltas — the residency-fallback
+    metric's reason tag). ``okN`` marks rows that BOTH round-trip
+    bit-exactly, stay MONOTONE over
     time, and whose dd fits the N-bit signed range; the caller picks the
     narrowest dtype whose pool stays under the cohort gate. Monotonicity is
     part of the contract because the raw rate/increase kernels clamp negative
@@ -110,12 +115,14 @@ def build_narrow_hist(val, n):
     # counter-reset detection: any negative per-step bucket increment
     # (inc = cumsum_b dd) disqualifies the row — see contract above
     inc = jnp.cumsum(dd, axis=2)
-    mono = jnp.where(pair, inc >= 0.0, True)
-    ok_rt = (jnp.all(jnp.all(exact, axis=2), axis=1)
-             & jnp.all(jnp.all(mono, axis=2), axis=1))
+    mono_row = jnp.all(jnp.all(jnp.where(pair, inc >= 0.0, True),
+                               axis=2), axis=1)
+    exact_row = jnp.all(jnp.all(exact, axis=2), axis=1)
+    ok_rt = exact_row & mono_row
     fit16 = jnp.all(jnp.all((dd >= -32768.0) & (dd <= 32767.0), axis=2), axis=1)
     fit8 = jnp.all(jnp.all((dd >= -128.0) & (dd <= 127.0), axis=2), axis=1)
-    return dd.astype(jnp.int16), first_d, ok_rt & fit16, ok_rt & fit8
+    return (dd.astype(jnp.int16), first_d, ok_rt & fit16, ok_rt & fit8,
+            mono_row, exact_row)
 
 
 @jax.jit
@@ -123,6 +130,68 @@ def cast_narrow_hist_i8(dd16):
     """i16 -> i8 narrowing for stores whose ok rows all fit 8 bits (pool rows
     may wrap — their dd is never read; decodes overlay the pool row-wise)."""
     return dd16.astype(jnp.int8)
+
+
+# ---- scalar delta (counter/gauge) form --------------------------------------
+#
+# Device analog of the wire codec's delta-delta/NibblePack framing
+# (memory/deltadelta.py, ref doc/compression.md): a monotone counter's raw
+# values are huge (1e9-class) but its per-step increments are tiny, so the
+# quantized form above fails its bit-exact contract — span/65535 rounds the
+# low bits away. The delta form stores each row as a f32 ANCHOR (first valid
+# value) plus i16/i8 per-step value deltas; the fused kernels reconstruct
+# v = anchor + cumsum(dv) in VMEM per tile. Unlike the hist form there is NO
+# monotonicity requirement: the decode is the full exact value sequence, so
+# the rate kernels' counter-reset clamp applies to the same numbers it would
+# see raw.
+
+@functools.partial(jax.jit, donate_argnums=())
+def build_narrow_delta(val, n):
+    """One streaming pass: (dv i16[S,C], anchor f32[S], ok16, ok8, integral).
+
+    anchor is each row's first valid value; dv[s,0] = 0 and dv is zero beyond
+    the valid count, so ``anchor + cumsum(dv)`` extends the last frame
+    constantly (consumers mask by ``n``). ``okN`` marks rows that round-trip
+    bit-exactly through the f32 cumsum AND whose every prefix stays within
+    2^23 of the anchor (so per-tile reassociation of the cumsum cannot change
+    the result) AND whose deltas fit the N-bit signed range. ``integral``
+    reports whether the row's deltas were integer-valued at all — callers use
+    it to classify declines (non-integer data vs out-of-range)."""
+    S, C = val.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (S, C), 1)
+    valid = col < n[:, None]
+    v = val.astype(jnp.float32)
+    anchor = jnp.where(valid[:, 0], v[:, 0], 0.0)
+    d = jnp.diff(v, axis=1, prepend=0.0)
+    pair = valid & (col > 0)
+    dvq = jnp.where(pair, jnp.round(d), 0.0)
+    integral = jnp.all(jnp.where(pair, d == dvq, True), axis=1)
+    # bit-exact round trip through the SAME reduction the kernels run
+    prefix = jnp.cumsum(dvq, axis=1)
+    recon = anchor[:, None] + prefix
+    exact = jnp.where(valid, recon == v, True)
+    # reassociation safety: tiles decode cumsum locally then offset by the
+    # previous tile's total; every partial sum must be integer-exact in f32,
+    # which |prefix| <= 2^23 guarantees for integer deltas
+    bound = jnp.all(jnp.where(valid, jnp.abs(prefix) <= 8388608.0, True), axis=1)
+    ok_rt = integral & jnp.all(exact, axis=1) & bound
+    fit16 = jnp.all((dvq >= -32768.0) & (dvq <= 32767.0), axis=1)
+    fit8 = jnp.all((dvq >= -128.0) & (dvq <= 127.0), axis=1)
+    return dvq.astype(jnp.int16), anchor, ok_rt & fit16, ok_rt & fit8, integral
+
+
+@functools.lru_cache(1)
+def _cast_delta_i8_call():
+    # donation declared only where XLA honors it (the CPU backend warns and
+    # ignores it — same gate as parallel/distributed._donate_argnums)
+    donate = () if jax.default_backend() == "cpu" else (0,)
+    return jax.jit(lambda dv16: dv16.astype(jnp.int8), donate_argnums=donate)
+
+
+def cast_narrow_delta_i8(dv16):
+    """i16 -> i8 narrowing when every ok row fits 8 bits; donates (frees) the
+    i16 intermediate — flush-path encode never holds both widths."""
+    return _cast_delta_i8_call()(dv16)
 
 
 class NarrowMirror:

@@ -224,29 +224,38 @@ class DistributedStore:
         return out
 
     def narrow_arrays(self):
-        """Per-slot (q, vmin, scale, n) global arrays of the narrow-resident
-        state, or None unless EVERY shard is narrow-resident with no live
-        cohort-pool rows (a pool row would need a per-shard row-wise fix —
-        those stores take the transient-decode fused route instead)."""
-        per_shard = []
+        """``(kind, slots)`` where slots are per-slot (block, row_operands, n)
+        global arrays of the narrow-resident state, or None unless EVERY
+        shard is narrow-resident with the SAME decode variant and no live
+        cohort-pool rows (a pool row would need a per-shard row-wise fix,
+        and a mixed-variant fleet would need one program per kind — those
+        stores take the transient-decode fused route instead). ``kind`` is
+        the decode-variant name (ops/decodereg.py: quant16/delta16/delta8)
+        and ``row_operands`` its per-series rows (vmin/scale or anchor)."""
+        per_shard, kinds = [], set()
         for sh in self.shards:
             nd = sh.store.narrow_operands()
             if nd is None:
                 return None
-            q, vmin, scale, ok = nd
+            kind, ops, ok = nd
             if (~ok & (sh.store.n_host > 0)).any():
                 return None
-            per_shard.append((q, vmin, scale))
+            kinds.add(kind)
+            per_shard.append(ops)
+        if len(kinds) != 1:
+            return None
+        kind = kinds.pop()
+        nrows = len(per_shard[0]) - 1
         out = []
         for j in range(self.slots):
             ss = self._slot(j)
             ops = per_shard[j * self.ndev:(j + 1) * self.ndev]
             out.append((
-                self._global([q for q, _v, _s in ops], (self.S, self.C), None),
-                self._global([v for _q, v, _s in ops], (self.S,), None),
-                self._global([s for _q, _v, s in ops], (self.S,), None),
+                self._global([o[0] for o in ops], (self.S, self.C), None),
+                tuple(self._global([o[r] for o in ops], (self.S,), None)
+                      for r in range(1, nrows + 1)),
                 self._global([s.store.n for s in ss], (self.S,), jnp.int32)))
-        return out
+        return kind, tuple(out)
 
     def global_gids(self, group_ids_per_shard):
         """Per-slot global [NDEV, S] gid arrays, device_put to each shard's
@@ -508,19 +517,21 @@ def _dist_topk_impl(fn: str, k: int, bottom: bool, num_groups: int,
 
 def _fused_map_call(fn: str, needs_sumsq: bool, window_ms: int,
                     interval_ms: int, S: int, Sb: int, C: int, Tp: int,
-                    G: int, narrow: bool, c0: int, Ck: int, variant: str):
+                    G: int, residency: str, c0: int, Ck: int, variant: str):
     """The per-shard fused map-phase program by backend variant — the
     Pallas kernel or its XLA-fused scan twin (same tiling plan, same
-    tile_contrib math; ops/fusedgrid.py). ``query.fused_kernels`` picks it
-    and the variant rides the dist program's plan-cache key."""
+    tile_contrib math; ops/fusedgrid.py). ``residency`` names the decode
+    variant streamed through the kernel (ops/decodereg.py);
+    ``query.fused_kernels`` picks the backend and both ride the dist
+    program's plan-cache key."""
     if variant == "xla":
         return fusedgrid.build_xla_tiles(fn, needs_sumsq, window_ms,
                                          interval_ms, S, Sb, C, Tp, G,
-                                         narrow=narrow, c0=c0, Ck=Ck)
+                                         residency=residency, c0=c0, Ck=Ck)
     return fusedgrid.build_pallas(fn, needs_sumsq, window_ms, interval_ms,
                                   S, Sb, C, Tp, G,
                                   jax.default_backend() != "tpu",
-                                  narrow=narrow, c0=c0, Ck=Ck)
+                                  residency=residency, c0=c0, Ck=Ck)
 
 
 def _fused_parts(op: str, outs) -> dict:
@@ -536,8 +547,12 @@ def _fused_parts(op: str, outs) -> dict:
 # replicate (they are shape-cached per query, NEVER donated)
 _FUSED_IN_SPECS = (P("shard"), P("shard"), P("shard"),
                    P(), P(), P(), P(), P())
+# narrow call signature: (slot_blocks, slot_rows, slot_ns, slot_gids, band,
+# ohlo, lo, hi, rel) — slot_rows is a NESTED tuple (one row-operand tuple
+# per slot); the P("shard") spec is a pytree prefix that broadcasts over it,
+# so one spec tree serves every decode variant's row count
 _FUSED_NARROW_IN_SPECS = (P("shard"), P("shard"), P("shard"), P("shard"),
-                          P("shard"), P(), P(), P(), P(), P())
+                          P(), P(), P(), P(), P())
 
 
 def dist_fused_aggregate(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel,
@@ -574,7 +589,7 @@ def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
     call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
-                           S, Sb, C, Tp, num_groups, False, c0, Ck, variant)
+                           S, Sb, C, Tp, num_groups, "raw", c0, Ck, variant)
 
     def per_device(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel):
         slot_parts = []
@@ -597,48 +612,50 @@ def _dist_fused_aggregate_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
     )(slot_vals, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
-def dist_fused_aggregate_narrow(slot_qs, slot_vmins, slot_scales, slot_ns,
+def dist_fused_aggregate_narrow(slot_blocks, slot_rows, slot_ns,
                                 slot_gids, band, ohlo, lo, hi, rel,
                                 fn: str, op: str, num_groups: int, mesh: Mesh,
                                 window_ms: int, interval_ms: int,
-                                S: int, C: int, Tp: int, c0: int = 0,
+                                S: int, C: int, Tp: int,
+                                kind: str = "quant16", c0: int = 0,
                                 Ck: int = 0, variant: str = "pallas"):
     return _dist_program(
         "dist-fused-narrow",
-        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, c0, Ck,
-         variant),
-        tuple(str(q.dtype) for q in slot_qs),
+        (fn, op, num_groups, mesh, window_ms, interval_ms, S, C, Tp, kind,
+         c0, Ck, variant),
+        tuple(str(b.dtype) for b in slot_blocks),
         lambda: functools.partial(_dist_fused_narrow_impl, fn, op,
                                   num_groups, mesh, window_ms, interval_ms,
-                                  S, C, Tp, c0, Ck, variant),
+                                  S, C, Tp, kind, c0, Ck, variant),
         mesh, in_specs=_FUSED_NARROW_IN_SPECS, out_specs=P("shard"),
-        donate=(4,)
-    )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
-      band, ohlo, lo, hi, rel)
+        donate=(3,)
+    )(slot_blocks, slot_rows, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
 def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
                             window_ms: int, interval_ms: int,
-                            S: int, C: int, Tp: int, c0: int, Ck: int,
-                            variant: str,
-                            slot_qs, slot_vmins, slot_scales, slot_ns,
+                            S: int, C: int, Tp: int, kind: str,
+                            c0: int, Ck: int, variant: str,
+                            slot_blocks, slot_rows, slot_ns,
                             slot_gids, band, ohlo, lo, hi, rel):
     """Narrow twin of :func:`dist_fused_aggregate`: every shard's resident
-    i16 quantized state streams straight through the fused map kernel
-    (half the HBM bytes, decode in VMEM — ops/narrow.py) and the partial
-    state folds over the shard axis in shard order. Compressed-resident
-    stores stay mesh-eligible without ever materializing their f32 blocks."""
+    narrow state (i16 quantized, or i16/i8 integer deltas off a per-series
+    anchor — ops/decodereg.py names the variant) streams straight through
+    the fused map kernel (1-2 bytes per sample over the HBM bus, decode in
+    VMEM — ops/narrow.py) and the partial state folds over the shard axis
+    in shard order. Compressed-resident stores stay mesh-eligible without
+    ever materializing their f32 blocks."""
     needs_sumsq = op in ("stddev", "stdvar")
     Sb = 512 if S % 512 == 0 else S
     call = _fused_map_call(fn, needs_sumsq, window_ms, interval_ms,
-                           S, Sb, C, Tp, num_groups, True, c0, Ck, variant)
+                           S, Sb, C, Tp, num_groups, kind, c0, Ck, variant)
 
-    def per_device(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
+    def per_device(slot_blocks, slot_rows, slot_ns, slot_gids,
                    band, ohlo, lo, hi, rel):
         slot_parts = []
-        for q, vmin, scale, n, gids in zip(slot_qs, slot_vmins, slot_scales,
-                                           slot_ns, slot_gids):
-            o = call(q[0], vmin[0].reshape(S, 1), scale[0].reshape(S, 1),
+        for blk, rows, n, gids in zip(slot_blocks, slot_rows, slot_ns,
+                                      slot_gids):
+            o = call(blk[0], *(r[0].reshape(S, 1) for r in rows),
                      n[0].astype(jnp.int32).reshape(S, 1),
                      gids[0].astype(jnp.int32).reshape(S, 1),
                      band, ohlo, lo, hi, rel)
@@ -647,12 +664,11 @@ def _dist_fused_narrow_impl(fn: str, op: str, num_groups: int, mesh: Mesh,
 
     return _shard_map(
         per_device, mesh=mesh,
-        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard"),
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P(), P(), P(), P(), P()),
         out_specs=P("shard"),
         check_vma=False,
-    )(slot_qs, slot_vmins, slot_scales, slot_ns, slot_gids,
-      band, ohlo, lo, hi, rel)
+    )(slot_blocks, slot_rows, slot_ns, slot_gids, band, ohlo, lo, hi, rel)
 
 
 class LazyMeshResult:
@@ -749,28 +765,33 @@ class MeshQueryExecutor:
         if grid is not None:
             base_ts, interval_ms = grid
             Tp = (max(T, 1) + 127) // 128 * 128
+            # narrow-resident shards stream their 1-2B/sample state through
+            # the fused kernel; stores with cohort-pool rows (or raw
+            # residency) feed it the f32 view instead (a transient decode
+            # per shard when compressed — bit-identical by the round-trip
+            # contract). Resolved BEFORE the band operands: delta variants
+            # decode via a column-prefix cumsum, so they pin full columns
+            narrow = self.dstore.narrow_arrays()
+            kind = narrow[0] if narrow is not None else "raw"
+            from ..ops import decodereg
             # cached per query shape — repeated [C, Tp] band uploads would
             # dominate on a tunneled device link (same cache as single-chip)
             band, ohlo, lo, hi, rel, c0, Ck = fusedgrid._device_operands(
                 C, Tp, np.ascontiguousarray(np.asarray(out_ts, np.int64)).tobytes(),
                 int(window_ms), base_ts, int(interval_ms),
-                "window" if fn in fusedgrid.FUSED_WINDOW_FNS else "rate")
-            # narrow-resident shards stream their i16 state through the
-            # fused kernel; stores with cohort-pool rows (or raw residency)
-            # feed it the f32 view instead (a transient decode per shard
-            # when compressed — bit-identical by the round-trip contract)
-            narrow = self.dstore.narrow_arrays()
+                "window" if fn in fusedgrid.FUSED_WINDOW_FNS else "rate",
+                decodereg.variant(kind).full_columns)
             from ..utils import enable_x64
             with enable_x64(False):
                 if narrow is not None:
+                    slots = narrow[1]
                     out = dist_fused_aggregate_narrow(
-                        tuple(t[0] for t in narrow),
-                        tuple(t[1] for t in narrow),
-                        tuple(t[2] for t in narrow),
-                        tuple(t[3] for t in narrow),
+                        tuple(t[0] for t in slots),
+                        tuple(t[1] for t in slots),
+                        tuple(t[2] for t in slots),
                         slot_gids, band, ohlo, lo, hi, rel,
                         fn, op, G, self.dstore.mesh, int(window_ms),
-                        int(interval_ms), S, C, Tp, c0, Ck, variant)
+                        int(interval_ms), S, C, Tp, kind, c0, Ck, variant)
                 else:
                     slot_vn = tuple(self.dstore.value_arrays())
                     out = dist_fused_aggregate(
@@ -864,13 +885,17 @@ class MeshQueryExecutor:
 
 def warm_mesh_shape(fn: str, op: str, S: int, C: int, steps: int,
                     step_ms: int, window_ms: int, interval_ms: int,
-                    groups: int, dtype, grid: bool = True) -> None:
+                    groups: int, dtype, grid: bool = True,
+                    residency: str = "raw") -> None:
     """Pre-trace the mesh ``dist_*`` programs for one dashboard shape
     (``query.warmup_shapes`` entries with ``mesh: true`` — plancache.warmup
     calls this). Warms the general two-step program always and the fused
     program (the ACTIVE ``query.fused_kernels`` variant) when the shape
     qualifies — under the RESOLVED mesh mode, so the warmed executable is
-    the serving executable."""
+    the serving executable. ``residency`` names a decode variant
+    (ops/decodereg.py) to warm the narrow-streaming program for in addition
+    to the raw one — the first dashboard hit on a compressed-resident fleet
+    then compiles nothing."""
     from ..ops import fusedresident
     from ..query.exec import _pad_steps
     mesh = make_mesh()
@@ -916,6 +941,23 @@ def warm_mesh_shape(fn: str, op: str, S: int, C: int, steps: int,
                 (val,), (n,), (gids(),), band, ohlo, lo, hi, rel,
                 fn, op, Gp, mesh, int(window_ms), int(interval_ms),
                 S, C, Tp, c0, Ck, variant)
+            if residency != "raw":
+                from ..ops import decodereg
+                var = decodereg.variant(residency)
+                bandn, ohlon, lon, hin, reln, c0n, Ckn = (
+                    fusedgrid._device_operands(
+                        C, Tp, np.ascontiguousarray(out_ts).tobytes(),
+                        int(window_ms), 0, int(interval_ms),
+                        "window" if fn in fusedgrid.FUSED_WINDOW_FNS
+                        else "rate", var.full_columns))
+                blk = gput((S, C), var.block_dtype)
+                rows = tuple(gput((S,), jnp.float32)
+                             for _ in range(var.row_operands))
+                dist_fused_aggregate_narrow(
+                    (blk,), (rows,), (n,), (gids(),),
+                    bandn, ohlon, lon, hin, reln,
+                    fn, op, Gp, mesh, int(window_ms), int(interval_ms),
+                    S, C, Tp, residency, c0n, Ckn, variant)
 
 
 def _pow2(n: int, floor: int = 8) -> int:

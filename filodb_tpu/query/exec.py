@@ -78,10 +78,13 @@ class SeriesSelection:
     # the majority cohort grid/base_ts was shifted to (churn): the grid kernel
     # result is wrong for exactly these rows; PSM recomputes them generally
     grid_minority: np.ndarray | None = None
-    # u16 quantized mirror (q, vmin, scale) of the FULL store value column
-    # (ops/narrow.py): the fused kernel streams it instead of val — half the
-    # HBM bytes. Rows whose mirror is not bit-exact are already folded into
-    # grid_minority by the leaf. Wide selections only.
+    # narrow operands (kind, operands, bad_rows) of the FULL store value
+    # column: ``kind`` names the decode variant (ops/decodereg.py —
+    # "quant16" for the mirror/quantized store, "delta16"/"delta8" for
+    # delta-resident counters) and ``operands = (block, *row_operands)``;
+    # the fused kernel streams them instead of val — 1/4 to 1/2 the HBM
+    # bytes. ``bad_rows`` (store rows that are not bit-exact under the
+    # encoding) fold into grid_minority. Wide selections only.
     narrow: tuple | None = None
     # hist-resident twin: (dd, first_d, bad_rows) of the FULL [S, C, B]
     # bucket block (ops/narrow.py build_narrow_hist) — the narrow hist grid
@@ -586,11 +589,11 @@ class AggregateMapReduce(Transformer):
         minority = sel.grid_minority
         narrow = None
         if sel.narrow is not None:
-            # u16 mirror: rows that don't round-trip bit-exactly join the
-            # minority set — excluded from the kernel and recomputed via the
-            # general path below, exactly like churned cohorts
-            q, vmin, scale, bad = sel.narrow
-            narrow = (q, vmin, scale)
+            # narrow store/mirror: rows that don't round-trip bit-exactly
+            # join the minority set — excluded from the kernel and recomputed
+            # via the general path below, exactly like churned cohorts
+            kind, nops, bad = sel.narrow
+            narrow = (kind, nops)
             if len(bad):
                 minority = (bad if minority is None or not len(minority)
                             else np.union1d(np.asarray(minority), bad))
@@ -1351,17 +1354,20 @@ class SelectRawPartitionsExec(ExecPlan):
         if (grid is not None and col is None and les is None
                 and (store.S % 512 == 0 or store.S <= 512)
                 and val.ndim == 2):
-            # narrow-resident state first (the i16 form IS the store), then
-            # the optional mirror (an extra copy alongside f32)
+            # narrow-resident state first (the narrow form IS the store),
+            # then the optional mirror (an extra quant16 copy alongside f32)
             nd = store.narrow_operands()
             if nd is None and shard.config.narrow_mirror:
-                nd = store.narrow.get(store)
+                md = store.narrow.get(store)
+                if md is not None:
+                    q, vmin, scale, ok_host = md
+                    nd = ("quant16", (q, vmin, scale), ok_host)
             if nd is not None:
-                q, vmin, scale, ok_host = nd
+                kind, nops, ok_host = nd
                 bad = pids[~ok_host[pids]].astype(np.int32)
                 # mostly-inexact data: raw f32 is cheaper than correcting
-                if len(bad) <= 0.25 * max(len(pids), 1):
-                    narrow = (q, vmin, scale, bad)
+                if len(bad) <= store.cohort_gate * max(len(pids), 1):
+                    narrow = (kind, nops, bad)
         hist_narrow = None
         if (grid is not None and les is not None
                 and getattr(val, "ndim", 2) == 3):

@@ -175,7 +175,11 @@ def warmup(shapes: list) -> dict:
     cardinality), ``dtype`` ("float32"/"float64"), ``grid`` (False to
     warm only the general searchsorted path), ``buckets`` (>0 warms the
     fused hist-resident quantile variant for that bucket count too, with
-    ``dd_dtype`` "int16"/"int8"). Fused-tier shapes warm the variant the
+    ``dd_dtype`` "int16"/"int8"), ``residency`` (a scalar decode-variant
+    name — "quant16"/"delta16"/"delta8", ops/decodereg.py — to warm the
+    narrow-streaming fused program for in ADDITION to the raw one, so a
+    compressed-resident fleet's first dashboard hit compiles nothing; the
+    mesh warm inherits it). Fused-tier shapes warm the variant the
     ACTIVE ``query.fused_kernels`` mode will serve (pallas or the XLA
     twin) — set_mode runs before warmup at server startup exactly so the
     warmed program is the serving program. ``mesh`` (True warms the mesh
@@ -237,6 +241,21 @@ def warmup(shapes: list) -> dict:
                 fusedgrid.fused_grid_aggregate(op, fn, val, n, g_dev,
                                                groups, out_ts, window, 0, iv,
                                                variant=fmode)
+                res = str(spec.get("residency", "raw") or "raw")
+                if res != "raw":
+                    # narrow-streaming twin: zero blocks of the variant's
+                    # dtype trace the same program the compressed store
+                    # will serve through (kind rides the plan key)
+                    from ..ops import decodereg
+                    dvar = decodereg.variant(res)
+                    blk = jax.device_put(
+                        jnp.zeros((R, C), dvar.block_dtype), dev)
+                    rows = tuple(jax.device_put(jnp.zeros(R, jnp.float32),
+                                                dev)
+                                 for _ in range(dvar.row_operands))
+                    fusedgrid.fused_grid_aggregate(
+                        op, fn, None, n, g_dev, groups, out_ts, window,
+                        0, iv, narrow=(res, (blk,) + rows), variant=fmode)
         B = int(spec.get("buckets", 0) or 0)
         if spec.get("grid", True) and B and fmode != "off":
             # fused hist-resident quantile variant: serve-time shapes are
@@ -261,6 +280,8 @@ def warmup(shapes: list) -> dict:
         if spec.get("mesh"):
             from ..parallel.distributed import warm_mesh_shape
             warm_mesh_shape(fn, op, R, C, steps, step_ms, window, iv,
-                            groups, dtype, grid=bool(spec.get("grid", True)))
+                            groups, dtype, grid=bool(spec.get("grid", True)),
+                            residency=str(spec.get("residency", "raw")
+                                          or "raw"))
     return {"programs": plan_cache.traces - before,
             "ms": round((time.perf_counter() - t0) * 1000.0, 3)}

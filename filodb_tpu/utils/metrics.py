@@ -81,6 +81,7 @@ FILODB_RETENTION_ROUTED_QUERIES = "filodb_retention_routed_queries"
 FILODB_RETENTION_ODP_ROWS = "filodb_retention_odp_rows"
 FILODB_RETENTION_REPLICA_FAILOVER = "filodb_retention_replica_failover"
 FILODB_RETENTION_AGED_OUT_ROWS = "filodb_retention_aged_out_rows"
+FILODB_STORE_RESIDENCY_FALLBACK = "filodb_store_residency_fallback"
 FILODB_RULES_EVALUATIONS = "filodb_rules_evaluations"
 FILODB_RULES_EVAL_FAILURES = "filodb_rules_eval_failures"
 FILODB_RULES_EVAL_LATENCY_MS = "filodb_rules_eval_latency_ms"
@@ -282,6 +283,12 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "counter", "Raw samples aged out of the durable tier past "
                    "retention.raw_ttl (each pass also bumps the shard's "
                    "data_epoch so cached results invalidate)."),
+    FILODB_STORE_RESIDENCY_FALLBACK: (
+        "counter", "Flushes where a store configured for compressed "
+                   "residency tried to compress and the data refused the "
+                   "ok-contract (cohort gate breached), tagged "
+                   "reason=resets|non-integer|range — distinguishes "
+                   "\"compressed\" from \"tried and fell back to raw\"."),
     FILODB_RULES_EVALUATIONS: (
         "counter", "Rule evaluations completed, tagged group= and rule= "
                    "(one per rule per scheduler tick)."),

@@ -23,6 +23,8 @@ Suites (reference file in parens):
                 zero-duplicate audit  (ISSUE 12; ClusterRecoverySpec analog)
   mesh_query    one-program mesh vs host shard loop dispatch floor, bit
                 parity + warmup compile-count audit  (ISSUE 16)
+  scalar_residency  delta8/quant16/delta16 ladder: retention at fixed HBM,
+                fused bytes/sample A/B, encode-at-flush cost  (ISSUE 17)
 
 ``--full`` uses reference-scale sizes (1M index series etc.); default sizes are
 CI-friendly. ``--suite name`` runs one suite. The north-star query benchmark
@@ -1128,6 +1130,151 @@ def bench_narrow_resident(full: bool) -> None:
     emit("narrow_resident", "fused_ms_narrow", nr_ms, "ms/query")
     emit("narrow_resident", "fused_ratio_narrow_vs_f32", nr_ms / f32_ms, "x")
     emit("narrow_resident", "bit_parity", 1.0, "bool")
+
+
+def bench_scalar_residency(full: bool) -> None:
+    """Scalar narrow residency v2 (ISSUE 17): the delta8/quant16/delta16
+    preference ladder on gauge/counter stores. Measures retention at fixed
+    HBM for the counter-shaped delta8 path (bar: >= 3x vs the 12B/sample
+    raw f32+i64 store), the fused query's device-marginal ms A/B (the
+    bytes/sample effect on the streamed operand), per-kind resident
+    bytes/sample, and the encode-at-flush device cost (compress_prepare —
+    the donated flush-path encode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.core.chunkstore import TS_PAD
+    from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
+    from filodb_tpu.query.engine import QueryEngine
+
+    S = (1 << 20) if full else (1 << 14)
+    C = 768 if full else 256
+    NS = 720 if full else 200
+
+    def build(shape: str, narrow: bool):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(max_series_per_shard=S, samples_per_series=C,
+                          flush_batch_size=10**9, dtype="float32",
+                          narrow_resident=narrow)
+        sh = ms.setup("prometheus", "gauge", 0, cfg)
+        from filodb_tpu.core.record import RecordBuilder
+        from filodb_tpu.core.schemas import GAUGE
+        b = RecordBuilder(GAUGE)
+        b.add_series_batch({"_metric_": "m",
+                            "host": [f"h{i}" for i in range(S)]}, BASE, 0.0)
+        sh.ingest(b.build())
+        with sh.lock:
+            sh._stage_pid.clear(); sh._stage_ts.clear()
+            sh._stage_val.clear(); sh._staged = 0
+        st = sh.store
+        st.ts = st.val = st.n = None
+
+        @jax.jit
+        def mk(key):
+            if shape == "counter":      # small int increments -> delta8
+                inc = jax.random.randint(key, (S, NS), 1, 50)
+                v = jnp.cumsum(inc, axis=1).astype(jnp.float32)
+            elif shape == "halfint":    # 0.5 steps: non-integral -> quant16
+                a0 = jax.random.randint(key, (S, 1), 0, 1000)
+                v = a0.astype(jnp.float32) + 0.5 * jnp.arange(NS)
+            else:                       # big odd increments -> delta16
+                inc = jax.random.randint(key, (S, NS), 100, 3000) * 2 + 1
+                v = jnp.cumsum(inc, axis=1).astype(jnp.float32)
+            return jnp.zeros((st.S, C), jnp.float32).at[:S, :NS].set(v)
+
+        st.val = mk(jax.random.PRNGKey(17))
+        ts_row = np.full(C, TS_PAD, np.int64)
+        ts_row[:NS] = BASE + np.arange(NS, dtype=np.int64) * IV
+        st.ts = jnp.tile(jnp.asarray(ts_row), (st.S, 1))
+        st.n = jnp.full(st.S, NS, jnp.int32)
+        st.n_host = np.full(st.S, NS, np.int32)
+        st.first_ts = np.full(st.S, BASE, np.int64)
+        st.last_ts = np.full(st.S, BASE + (NS - 1) * IV, np.int64)
+        st.grid_base, st.grid_interval, st.grid_ok = BASE, IV, True
+        st._cohorts = None
+        if narrow:
+            with sh.lock:
+                assert st.compress_resident(hist=False), \
+                    f"{shape} data must compress"
+        return ms, sh
+
+    def teardown(ms, sh):
+        st = sh.store
+        st.ts = st.val = st.n = None
+        st._narrow = None
+
+    start = BASE + 300_000
+    end = BASE + (NS - 1) * IV
+    q = "sum(rate(m[5m]))"
+
+    def marginal_ms(eng, K=24, reps=3):
+        eng.query_range(q, start, end, 150_000)       # warm compile
+        outs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(K):
+                eng.query_range(q, start, end, 150_000)
+            outs.append((time.perf_counter() - t0) / K * 1000)
+        return sorted(outs)[len(outs) // 2]
+
+    # ---- raw f32 A-side: fused ms, bytes, parity sample, encode cost
+    ms_f32, sh_f32 = build("counter", False)
+    st0 = sh_f32.store
+    f32_ms = marginal_ms(QueryEngine(ms_f32, "prometheus"))
+    f32_bytes = st0.resident_sample_bytes()
+    r = QueryEngine(ms_f32, "prometheus").query_range(q, start, end, 150_000)
+    (_k, _t, a), = list(r.matrix.iter_series())
+    a = np.asarray(a).copy()
+    # encode-at-flush: compress_prepare is the lock-free device encode the
+    # flush path pays; time it hot (prep discarded, store stays raw)
+    dt, it = timed(lambda: jax.block_until_ready(
+        st0.compress_prepare(hist=False)), min_s=0.5, max_iters=20)
+    enc_ms = dt / it * 1000
+    emit("scalar_residency", "encode_flush_ms", enc_ms, "ms")
+    emit("scalar_residency", "encode_flush_throughput",
+         st0.val.size * 4 / (dt / it) / 1e9, "GB/s")
+    teardown(ms_f32, sh_f32)
+    del ms_f32, sh_f32, st0, r
+
+    # ---- narrow B-side: counter data lands on delta8 (1B/sample values)
+    ms_nr, sh_nr = build("counter", True)
+    st = sh_nr.store
+    assert st.is_narrow_resident and st.val is None and st.ts is None
+    kind = st.narrow_operands()[0]
+    assert kind == "delta8", f"counter data must land on delta8, got {kind}"
+    e_nr = QueryEngine(ms_nr, "prometheus")
+    nr_ms = marginal_ms(e_nr)
+    nr_bytes = st.resident_sample_bytes()
+    r = e_nr.query_range(q, start, end, 150_000)
+    (_k, _t, bvals), = list(r.matrix.iter_series())
+    assert np.array_equal(a, bvals), "delta8-resident query diverged"
+    teardown(ms_nr, sh_nr)
+    del ms_nr, sh_nr, st, e_nr, r
+
+    retention = f32_bytes / max(nr_bytes, 1)
+    assert retention >= 3.0, f"retention multiple {retention:.2f} < 3x"
+    emit("scalar_residency", "resident_bytes_f32", f32_bytes, "bytes")
+    emit("scalar_residency", "resident_bytes_delta8", nr_bytes, "bytes")
+    emit("scalar_residency", "retention_multiple_at_fixed_hbm", retention, "x")
+    emit("scalar_residency", "fused_ms_f32", f32_ms, "ms/query")
+    emit("scalar_residency", "fused_ms_delta8", nr_ms, "ms/query")
+    emit("scalar_residency", "fused_ratio_delta8_vs_f32", nr_ms / f32_ms, "x")
+    emit("scalar_residency", "bit_parity", 1.0, "bool")
+
+    # ---- the rest of the ladder: adopted kind + resident bytes/sample
+    for shape, want in (("halfint", "quant16"), ("bigodd", "delta16")):
+        ms_k, sh_k = build(shape, True)
+        stk = sh_k.store
+        kind = stk.narrow_operands()[0]
+        assert kind == want, f"{shape} data must land on {want}, got {kind}"
+        emit("scalar_residency", f"bytes_per_sample_{want}",
+             stk.resident_sample_bytes() / (S * NS), "B/sample")
+        teardown(ms_k, sh_k)
+        del ms_k, sh_k, stk
+    emit("scalar_residency", "bytes_per_sample_delta8",
+         nr_bytes / (S * NS), "B/sample")
+    emit("scalar_residency", "bytes_per_sample_f32",
+         f32_bytes / (S * NS), "B/sample")
 
 
 def bench_hist_retention(full: bool) -> None:
@@ -2612,6 +2759,7 @@ SUITES = {
     "retention": bench_retention,
     "count_values": bench_count_values,
     "narrow_resident": bench_narrow_resident,
+    "scalar_residency": bench_scalar_residency,
     "hist_retention": bench_hist_retention,
     "encoding": bench_encoding,
     "partkey_index": bench_partkey_index,

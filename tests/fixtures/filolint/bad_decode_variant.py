@@ -1,0 +1,21 @@
+"""Bad twin: decode variants registered one-sided — a missing xla= twin and
+a pallas=None placeholder both defeat the fused variant-parity contract."""
+
+
+def register_variant(name, **kw):
+    return (name, kw)
+
+
+def decode_fancy(q, vmin, scale):
+    return vmin + q * scale
+
+
+def register_all():
+    # missing xla= twin: only the Pallas backend can serve this variant
+    register_variant("fancy16", pallas=decode_fancy,
+                     row_operands=2, block_dtype="int16",
+                     full_columns=False, value_bytes=2)
+    # pallas=None placeholder: "wire it later" reaches production
+    register_variant("fancy8", pallas=None, xla=decode_fancy,
+                     row_operands=2, block_dtype="int8",
+                     full_columns=False, value_bytes=1)

@@ -86,7 +86,7 @@ def test_mixed_rows_take_the_pool_bit_exact():
     ms, sh = _build(True, mixed=True)
     st = sh.store
     assert st.is_narrow_resident
-    q, vmin, scale, ok = st.narrow_operands()
+    _kind, _ops, ok = st.narrow_operands()
     assert (~ok[:12]).sum() >= 3          # the continuous rows are in the pool
     dec = np.asarray(st.value_block())
     ms2, sh2 = _build(False, mixed=True)

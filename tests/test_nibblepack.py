@@ -1,6 +1,8 @@
 """Codec tests incl. the worked golden example from the reference spec
 (doc/compression.md "Predictive NibblePacking" Example)."""
 
+import struct
+
 import numpy as np
 import pytest
 
@@ -89,3 +91,67 @@ def test_deltadelta_roundtrip_jittered(n, rng):
 def test_deltadelta_negative_values(rng):
     v = rng.integers(-(2**40), 2**40, size=100).astype(np.int64)
     np.testing.assert_array_equal(deltadelta.decode(deltadelta.encode(v)), v)
+
+
+# -- ISSUE 17 satellite: golden byte-level vectors --------------------------
+#
+# Bit-for-bit wire stability of the flush codecs: these buffers are what a
+# durable time-bucket written today must still decode to tomorrow, so the
+# exact bytes (not just the round-trip) are pinned. Each vector is derived
+# by hand from the format comments at the top of memory/nibblepack.py and
+# memory/deltadelta.py.
+
+def test_golden_u64_two_groups_with_partial_tail():
+    # group 1 is the spec example (0x123000, 0x456000 -> "03 23 | 23 61 45");
+    # group 2 holds one value 0xAB in lane 1 of a zero-padded partial tail:
+    # bitmask 0b10, trail=0 nibbles, nnib=2 -> header 0x10, nibbles B,A
+    # packed LSB-first into one byte 0xAB
+    vals = np.array([0x123000, 0x456000, 0, 0, 0, 0, 0, 0, 0, 0xAB],
+                    dtype=np.uint64)
+    assert nibblepack.pack_u64(vals) == bytes.fromhex("03232361450210ab")
+
+
+def test_golden_delta_with_negative_clamp():
+    # [100, 200, 150, 300] -> deltas [100, 100, 0, 150] (the decrease clamps
+    # to 0): bitmask 0b1011, all nonzero deltas span 2 low nibbles ->
+    # header 0x10; streams 0x64, 0x64, 0x96 LSB-first
+    vals = np.array([100, 200, 150, 300], dtype=np.int64)
+    assert nibblepack.pack_delta(vals) == bytes.fromhex("0b10646496")
+
+
+def test_golden_doubles_xor_path():
+    # pack_doubles' XOR predictor: head is 2.0's raw LE bits
+    # (0x4000000000000000); 3.0 XOR 2.0 = 0x0008000000000000 -> one nonzero
+    # lane (bitmask 0x01), 12 trailing zero nibbles, 1 stored nibble ->
+    # header 0x0C, nibble stream "8"
+    out = nibblepack.pack_doubles(np.array([2.0, 3.0]))
+    assert out == bytes.fromhex("0000000000000040" "010c08")
+
+
+def test_golden_deltadelta_pure_line():
+    # perfectly regular timestamps: residuals are all zero, so the payload
+    # is exactly one 0x00 bitmask byte per 8-group — the wire layout is
+    # u32 n | i64 first | i64 slope | packed residuals
+    ts = 1000 + 10 * np.arange(16, dtype=np.int64)
+    want = struct.Struct("<Iqq").pack(16, 1000, 10) + b"\x00\x00"
+    assert deltadelta.encode_py(ts) == want
+    np.testing.assert_array_equal(deltadelta.decode_py(want), ts)
+
+
+def test_golden_deltadelta_residuals_zigzag():
+    # [0, 7, 10]: slope = round(10/2) = 5, line [0, 5, 10], residuals
+    # [0, 2, 0] zigzag to [0, 4, 0] -> bitmask 0b10, header 0x00 (no
+    # trailing zeros, 1 nibble), nibble stream "4"
+    want = struct.Struct("<Iqq").pack(3, 0, 5) + bytes.fromhex("020004")
+    assert deltadelta.encode_py(np.array([0, 7, 10], np.int64)) == want
+    np.testing.assert_array_equal(deltadelta.decode_py(want), [0, 7, 10])
+
+
+def test_golden_vectors_match_bound_codec():
+    # the bound encode/decode (native when available) must produce the
+    # SAME bytes as the numpy spec implementation pinned above
+    for vals in (1000 + 10 * np.arange(16, dtype=np.int64),
+                 np.array([0, 7, 10], np.int64)):
+        assert deltadelta.encode(vals) == deltadelta.encode_py(vals)
+        np.testing.assert_array_equal(
+            deltadelta.decode(deltadelta.encode(vals)), vals)

@@ -81,6 +81,11 @@ BAD_FIXTURES = {
     # crossed by sharded store operands must declare BOTH in_shardings and
     # out_shardings, or jax silently re-gathers the globals per dispatch
     "bad_mesh_sharding.py": {"mesh-sharding-undeclared"},
+    # PR 17: universal compressed residency — every decode variant in
+    # ops/decodereg.py must register BOTH backend twins (pallas= and xla=,
+    # neither None), or variant parity breaks when query.fused_kernels
+    # flips the serving backend
+    "bad_decode_variant.py": {"surface-decode-variant-twin"},
 }
 
 

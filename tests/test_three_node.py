@@ -200,20 +200,23 @@ def test_kill_one_node_splits_shards_and_replans(three_node):
 # partial dicts to the same numpy presenter the scatter-gather path uses —
 # so the mesh answer is bit-identical to the host loop BY CONSTRUCTION, not
 # within a tolerance. This grid proves it end to end: every dist_* shape,
-# on raw f32 and i16 narrow-resident gauge stores, pjit mesh == three-node
+# on raw f32 and narrow-resident gauge stores, pjit mesh == three-node
 # host loop == single-node oracle under exact `_as_comparable` equality.
 #
-# The third residency tier of the matrix — i8 — exists only as the 2D-delta
-# histogram form (`compressed_residency="all"`; gauge narrow blocks are
-# always i16, ops/narrow.py build_narrow). Histogram stores are host-merged
-# by design (engine._mesh_executor refuses bucketed stores), so the i8 leg
-# asserts the CLEAN FALLBACK plus exact parity instead of a mesh tag.
+# Scalar narrow blocks are KIND-tagged since ISSUE 17 (ops/decodereg.py:
+# quant16 i16, delta16 i16, delta8 i8 — the encoder prefers the narrowest
+# that round-trips, so this leg's small-integer counters land on delta8 and
+# the mesh streams i8 blocks through dist_fused_aggregate_narrow). The
+# histogram i8 tier is the 2D-delta form (`compressed_residency="all"`);
+# histogram stores are host-merged by design (engine._mesh_executor refuses
+# bucketed stores), so the hist leg asserts the CLEAN FALLBACK plus exact
+# parity instead of a mesh tag.
 
 MESH_IV = 10_000
 MESH_N = 64
 
 # per-residency query plans: route coverage × what each leaf kernel can
-# answer BIT-equally on both sides of the comparison. Grid-aligned f32/i16
+# answer BIT-equally on both sides of the comparison. Grid-aligned f32/narrow
 # drive the fused map phase for the windowed functions (the host loop serves
 # those through the identical fusedgrid kernel); their twostep/topk/sketch
 # legs use instant selectors, whose leaf values are exact sample COPIES on
@@ -225,9 +228,9 @@ MESH_PARITY_QUERIES = {
     "f32": ('sum(rate(m[2m]))', 'avg by (grp) (rate(m[2m]))',
             'stddev by (grp) (rate(m[2m]))', 'max by (grp) (m)',
             'topk(2, m)', 'quantile(0.5, m)'),
-    "i16": ('sum(rate(m[2m]))', 'avg by (grp) (rate(m[2m]))',
-            'stddev by (grp) (rate(m[2m]))', 'max by (grp) (m)',
-            'topk(2, m)', 'quantile(0.5, m)'),
+    "narrow": ('sum(rate(m[2m]))', 'avg by (grp) (rate(m[2m]))',
+               'stddev by (grp) (rate(m[2m]))', 'max by (grp) (m)',
+               'topk(2, m)', 'quantile(0.5, m)'),
     "f64": ('sum(sum_over_time(m[2m]))', 'max by (grp) (avg_over_time(m[2m]))',
             'topk(2, rate(m[2m]))', 'quantile(0.5, rate(m[2m]))'),
 }
@@ -235,8 +238,9 @@ MESH_PARITY_QUERIES = {
 
 def _mesh_parity_rows():
     rng = np.random.default_rng(16)
-    # integer cumsums: exactly representable in f32 AND in the i16 narrow
-    # quantization's (q, vmin, scale) round-trip domain checked at flush
+    # integer cumsums: exactly representable in f32 AND in the narrow
+    # encoders' round-trip domains checked at flush (increments 1..49 fit
+    # i8 deltas, so the preference ladder lands these rows on delta8)
     return [np.cumsum(rng.integers(1, 50, MESH_N)).astype(np.float64)
             for _ in range(24)]
 
@@ -254,7 +258,7 @@ def _mesh_parity_fill(ms, rows, jitter=None):
     ms.flush_all()
 
 
-@pytest.mark.parametrize("residency", ["f32", "i16", "f64"])
+@pytest.mark.parametrize("residency", ["f32", "narrow", "f64"])
 def test_mesh_bit_parity_grid_vs_host_loop_and_oracle(residency):
     """ISSUE 16 satellite: every dist_* shape (fused / fused-narrow,
     twostep, topk, sketch), pjit mesh == 3-node host loop == single-node
@@ -268,7 +272,7 @@ def test_mesh_bit_parity_grid_vs_host_loop_and_oracle(residency):
                            flush_batch_size=10**9,
                            dtype="float64" if residency == "f64"
                            else "float32",
-                           narrow_resident=(residency == "i16"))
+                           narrow_resident=(residency == "narrow"))
 
     rows = _mesh_parity_rows()
     jitter = (np.random.default_rng(17).integers(0, MESH_IV // 2,
@@ -294,9 +298,13 @@ def test_mesh_bit_parity_grid_vs_host_loop_and_oracle(residency):
     _mesh_parity_fill(oracle_ms, rows, jitter)
     for n in NODES:
         _mesh_parity_fill(stores[n], rows, jitter)
-    if residency == "i16":
+    if residency == "narrow":
         assert all(sh.store.is_narrow_resident
                    for sh in mesh_ms.shards_of(DATASET))
+        # the small-integer counters must land on the NARROWEST variant —
+        # the mesh leg below streams i8 blocks, not the quant16 i16 form
+        assert {sh.store.narrow_operands()[0]
+                for sh in mesh_ms.shards_of(DATASET)} == {"delta8"}
 
     eps: dict[str, str] = {}
     engines = {n: QueryEngine(stores[n], DATASET, ShardMapper(NSHARDS),
@@ -328,7 +336,7 @@ def test_mesh_bit_parity_grid_vs_host_loop_and_oracle(residency):
         for srv in servers.values():
             srv.stop()
     if residency != "f64":
-        fused_tag = ("mesh[pjit]-fused-narrow" if residency == "i16"
+        fused_tag = ("mesh[pjit]-fused-narrow" if residency == "narrow"
                      else "mesh[pjit]-fused")
         assert fused_tag in tags, tags
     assert {"mesh[pjit]-twostep", "mesh[pjit]-topk",
