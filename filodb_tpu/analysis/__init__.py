@@ -42,7 +42,7 @@ And the v2 families (PR 5 — the ingest plane is thread/socket-heavy):
 
 Everything is pure ``ast`` — no jax import, no device, safe under
 ``JAX_PLATFORMS=cpu`` and in CI. Findings are suppressible inline with
-``# filolint: ignore[rule]`` on the flagged line, or via the checked-in
+an inline ``filolint: ignore[<rule>]`` comment on the flagged line, or via the checked-in
 baseline file (``filolint_baseline.json`` at the repo root, one entry per
 intentionally-kept finding with a reason).
 

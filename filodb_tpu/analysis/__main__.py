@@ -26,9 +26,11 @@ from .runner import ALL_RULES, DEFAULT_BASELINE, run_analysis
 
 # modules the cross-file rules need in scope even when unchanged: the wire
 # codec + its HTTP classifier, the typed-error bases, the broker op spec,
-# and the two declared-surface dicts
+# the declared-surface dicts, and the epoch-visibility spec (EPOCH_SPEC —
+# the epoch rules judge every mutator against it)
 ANCHOR_MODULES = (
     "filodb_tpu/config.py",
+    "filodb_tpu/core/memstore.py",
     "filodb_tpu/utils/metrics.py",
     "filodb_tpu/query/wire.py",
     "filodb_tpu/query/rangevector.py",
@@ -36,12 +38,18 @@ ANCHOR_MODULES = (
     "filodb_tpu/ingest/broker.py",
 )
 
+# a change to any of these invalidates every scoped judgement: the checkers
+# themselves, or the fixture twins that pin their behavior — escalate a
+# --changed-only run to a full one instead of lint-checking the new rules
+# against a partial corpus
+_ESCALATE_PREFIXES = ("filodb_tpu/analysis/", "tests/fixtures/filolint/")
 
-def _changed_files(root: Path) -> list[str] | None:
-    """Root-relative .py paths under filodb_tpu/ that git reports changed
-    (staged, unstaged or untracked). None on git failure. Porcelain paths
-    are TOPLEVEL-relative; when ``root`` sits below the git toplevel (a
-    vendored checkout), they are rebased via ``--show-prefix`` so a
+
+def _porcelain_paths(root: Path) -> list[str] | None:
+    """Root-relative paths git reports changed (staged, unstaged or
+    untracked), any extension/location. None on git failure. Porcelain
+    paths are TOPLEVEL-relative; when ``root`` sits below the git toplevel
+    (a vendored checkout), they are rebased via ``--show-prefix`` so a
     changed-only run never silently analyzes nothing."""
     try:
         out = subprocess.run(
@@ -60,10 +68,19 @@ def _changed_files(root: Path) -> list[str] | None:
             if not p.startswith(prefix):
                 continue                    # outside the analysis root
             p = p[len(prefix):]
-        if p.endswith(".py") and p.startswith("filodb_tpu/") \
-                and (root / p).exists():
-            paths.append(p)
+        paths.append(p)
     return paths
+
+
+def _changed_files(root: Path) -> list[str] | None:
+    """Root-relative changed .py paths under filodb_tpu/ (the analyzable
+    subset of :func:`_porcelain_paths`)."""
+    raw = _porcelain_paths(root)
+    if raw is None:
+        return None
+    return [p for p in raw
+            if p.endswith(".py") and p.startswith("filodb_tpu/")
+            and (root / p).exists()]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -95,6 +112,13 @@ def main(argv: list[str] | None = None) -> int:
                          "(required by --update-baseline)")
     ap.add_argument("--quiet", action="store_true",
                     help="summary only, no per-finding lines (text format)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule-family timings and shared-corpus "
+                         "build/hit counters to stderr")
+    ap.add_argument("--no-shared-corpus", action="store_true",
+                    help="re-parse the package and rebuild the index per "
+                         "rule family (the pre-sharing cost model; findings "
+                         "are identical — kept for benchmarking)")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else \
@@ -106,10 +130,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.changed_only:
         if paths:
             ap.error("--changed-only and explicit paths are exclusive")
-        changed = _changed_files(root)
+        raw = _porcelain_paths(root)
+        changed = None if raw is None else [
+            p for p in raw if p.endswith(".py")
+            and p.startswith("filodb_tpu/") and (root / p).exists()]
         if changed is None:
             print("filolint: git unavailable; falling back to a full run",
                   file=sys.stderr)
+        elif any(p.startswith(_ESCALATE_PREFIXES) for p in raw):
+            print("filolint: analysis code or fixture twins changed — "
+                  "escalating --changed-only to a full run", file=sys.stderr)
         elif not changed:
             print("filolint: no changed files under filodb_tpu/ — nothing "
                   "to analyze")
@@ -118,7 +148,11 @@ def main(argv: list[str] | None = None) -> int:
             anchors = [a for a in ANCHOR_MODULES if (root / a).exists()]
             paths = sorted(set(changed) | set(anchors))
 
-    report = run_analysis(root, paths, baseline_path=baseline_path)
+    report = run_analysis(root, paths, baseline_path=baseline_path,
+                          shared_corpus=not args.no_shared_corpus)
+    if args.stats:
+        for line in report.stats_lines():
+            print(line, file=sys.stderr)
 
     if args.update_baseline:
         if report.new and not (args.reason and args.reason.strip()):

@@ -432,6 +432,53 @@ class PackageIndex:
             return True
         return bool(self.ancestry(exc_name) & caught)
 
+    # -- reverse call graph ---------------------------------------------------
+
+    def callers_of(self) -> dict:
+        """Callee FuncUnit key -> set of caller keys (sound edges only —
+        same under-approximation as the forward graph). Cached."""
+        cached = getattr(self, "_callers_cache", None)
+        if cached is not None:
+            return cached
+        out: dict = {}
+        for key, u in self.funcs.items():
+            for site in u.calls:
+                out.setdefault(site.callee_key, set()).add(key)
+        self._callers_cache = out
+        return out
+
+    def reachable_only_from(self, key: str, sanctioned: set) -> bool:
+        """True iff every reverse-call chain from ``key`` hits a function in
+        ``sanctioned`` before it hits an unsanctioned root (a function with
+        no in-package callers — a thread entry, an HTTP handler, a public
+        API). A sanctioned ancestor terminates its chain: whatever it does
+        around the call is its declared responsibility. A chain that ends
+        in an unsanctioned root means ``key`` can run with no declared
+        site above it. Pure cycles with no outside entry are vacuously
+        sanctioned (nothing can invoke them). Under-approximated edges
+        (getattr dispatch, third-party callbacks) make this lenient, never
+        falsely loud — consistent with the forward graph's contract."""
+        if key in sanctioned:
+            return True
+        callers = self.callers_of()
+        seen: set = set()
+        todo = [key]
+        while todo:
+            k = todo.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            ups = callers.get(k, ())
+            if not ups and k != key:
+                return False          # unsanctioned root reached
+            if not ups and k == key:
+                return False          # key itself is a root
+            for up in ups:
+                if up in sanctioned:
+                    continue          # this chain is accounted for
+                todo.append(up)
+        return True
+
     # -- may-raise fixpoint ---------------------------------------------------
 
     def may_raise(self, typed_only: set | None = None) -> dict[str, frozenset]:

@@ -267,3 +267,47 @@ def releases_on_all_paths(cfg: CFG, acquire_idx: int, release) -> bool:
         todo.extend(cfg.succ.get(n, ()))
         todo.extend(cfg.exc_succ.get(n, ()))
     return True
+
+
+def dominated_from_entry(cfg: CFG, idx: int, pred) -> bool:
+    """True iff every CFG path from function ENTRY to ``idx`` passes a
+    statement for which ``pred(stmt)`` is True — classical dominance of a
+    predicate over ``idx``. Walks forward from node 0 (the first statement
+    is always node 0: the builder numbers statements in visit order),
+    stopping at pred-satisfying nodes; if ``idx`` is still reachable, some
+    path avoids the predicate."""
+    if not cfg.stmts:
+        return False
+    if pred(cfg.stmts[0]) and idx != 0:
+        return True
+    if idx == 0:
+        return False
+    seen = {0}
+    todo = list(cfg.succ.get(0, ())) + list(cfg.exc_succ.get(0, ()))
+    while todo:
+        n = todo.pop()
+        if n in seen or n == EXIT:
+            continue
+        seen.add(n)
+        if n == idx:
+            return False
+        if pred(cfg.stmts[n]):
+            continue
+        todo.extend(cfg.succ.get(n, ()))
+        todo.extend(cfg.exc_succ.get(n, ()))
+    return True
+
+
+def covered_on_all_paths(cfg: CFG, idx: int, pred) -> bool:
+    """True iff the statement at ``idx`` is *fenced* by the predicate: every
+    path from ENTRY to ``idx`` passes a pred statement, OR every path from
+    ``idx`` to EXIT does. This is the epoch-bump coverage query — a
+    visibility mutation is safe whether the bump precedes it (flush stages:
+    bump, then scatter) or follows it (compaction: compact, then bump), as
+    long as both run under one lock hold. Mixed coverage (some paths fenced
+    before, the rest after) is deliberately NOT accepted: it would be
+    correct only if no path exists that misses both, and proving that
+    needs a per-path product the repo's idioms never require — the
+    over-approximation can only produce findings, never silence."""
+    return dominated_from_entry(cfg, idx, pred) \
+        or releases_on_all_paths(cfg, idx, pred)

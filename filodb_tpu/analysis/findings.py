@@ -16,6 +16,10 @@ from pathlib import Path
 SUPPRESS_RE = re.compile(r"#\s*filolint:\s*ignore\[([A-Za-z0-9_\-*,\s]+)\]")
 SKIP_FILE_RE = re.compile(r"#\s*filolint:\s*skip-file")
 
+# the meta-rule reported when an inline ignore no longer suppresses
+# anything (see runner._stale_ignores)
+STALE_IGNORE_RULE = "filolint-stale-ignore"
+
 
 @dataclass(frozen=True)
 class Finding:
@@ -55,7 +59,15 @@ def is_suppressed(f: Finding, supp: dict[int, set[str]]) -> bool:
     if 0 in supp:
         return True
     rules = supp.get(f.line)
-    return bool(rules and ("*" in rules or f.rule in rules))
+    if not rules:
+        return False
+    if f.rule == STALE_IGNORE_RULE:
+        # a stale-ignore finding points AT an ignore comment; letting that
+        # comment's own ``*`` (or the stale rule name it carries) swallow
+        # the finding would make the rule unfireable. Only an ignore that
+        # names the meta-rule explicitly counts as an accepted exception.
+        return STALE_IGNORE_RULE in rules
+    return "*" in rules or f.rule in rules
 
 
 class Baseline:

@@ -27,15 +27,15 @@ expensive ones (accelerator guide: host/device boundary):
     donating it allocates a full copy of the buffer per call — on the
     memstore flush path that is a store-sized allocation per staged-row
     commit (core/chunkstore.py's scatter jits donate for exactly this
-    reason). Deliberate copies suppress with
-    ``# filolint: ignore[jit-donation-unused]`` + reason.
+    reason). Deliberate copies suppress with an inline
+    ``filolint: ignore[jit-donation-unused]`` comment + reason.
 
 Jitted functions are recognized by decorator (``@jax.jit``,
 ``@functools.partial(jax.jit, ...)``), by wrapping assignment
 (``g = jax.jit(f, ...)``), and by factory return (``return jax.jit(f)``).
 Cross-function flows (a jitted fn calling a helper that syncs) are out of
 scope — keep helpers either pure or inline. Suppress deliberate host code
-with ``# filolint: ignore[jit-host-sync]``.
+with an inline ``filolint: ignore[jit-host-sync]`` comment.
 """
 
 from __future__ import annotations

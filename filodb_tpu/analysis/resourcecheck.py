@@ -283,6 +283,7 @@ class ResourceChecker:
     def __init__(self):
         self._modules: dict[str, ast.Module] = {}
         self.project: PackageIndex | None = None
+        self.corpus = None           # shared CFG memo, set by the runner
 
     def check_module(self, path: str, tree: ast.Module) -> list[Finding]:
         self._modules[path] = tree
@@ -504,7 +505,8 @@ class ResourceChecker:
                     isinstance(node.value, ast.Name) and \
                     any(_attr_root(t) for t in node.targets):
                 escaped.add(node.value.id)
-        cfg = build_cfg(fn)
+        cfg = self.corpus.cfg(fn) if self.corpus is not None \
+            else build_cfg(fn)
         findings = []
         for stmt, var, line in acquires:
             if var in escaped:

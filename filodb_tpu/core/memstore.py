@@ -34,6 +34,70 @@ log = logging.getLogger(__name__)
 # invalidate whole (query/incremental.py stable_before).
 EPOCH_AFFECTS_ALL = -(1 << 62)
 
+# The declared visibility surface (filolint epochcheck — analysis/
+# epochcheck.py reads this dict from the AST; keep it a pure literal).
+# Every function where query-visible store state changes must be named
+# here, with the affected-timestamp class its bump records:
+#   "batch_min_ts"      — the bump logs the minimum data timestamp the
+#                         mutation touched (staged flush, recovery chunk
+#                         load, purge end-time marks); per-step fragment
+#                         validity survives for steps before it
+#   "EPOCH_AFFECTS_ALL" — destructive: rows at arbitrary timestamps
+#                         vanished (release/eviction, retention compaction,
+#                         durable age-out); caches invalidate whole
+#   "admit"             — series admission only: a partition with zero
+#                         visible samples changes no query result, so no
+#                         bump is required until the first staged flush
+#                         lands its data (which bumps)
+# ``visible_calls`` are the field-sensitive mutator shapes the checker
+# hunts (self.<attr>.<method> / a local alias of self.<attr>): mutations
+# of the arrays the query read path scans. ``admit_calls``/``admit_maps``
+# are the admission-only shapes (declaration required, bump not).
+# Undeclared mutation sites, bumps outside the shard lock, and
+# EPOCH_AFFECTS_ALL bumps where a batch minimum is in scope are tier-1
+# failures — see ANALYSIS.md "Epoch & visibility contracts".
+EPOCH_SPEC = {
+    "class": "TimeSeriesShard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {
+        "store": ("append", "compact", "free_rows"),
+        "index": ("remove_part_keys", "update_end_time"),
+        "sink": ("age_out",),
+    },
+    "admit_calls": {
+        "index": ("add_part_key", "add_part_keys_bulk",
+                  "add_part_keys_columnar"),
+    },
+    "admit_maps": ("_part_key_of_id", "_part_key_to_id"),
+    "sites": {
+        "staged_flush": {
+            "fn": "TimeSeriesShard._flush_staged_locked",
+            "affects": "batch_min_ts"},
+        "partition_release": {
+            "fn": "TimeSeriesShard._release_partitions_locked",
+            "affects": "EPOCH_AFFECTS_ALL"},
+        "purge_mark_ended": {
+            "fn": "TimeSeriesShard.purge_expired_partitions",
+            "affects": "batch_min_ts"},
+        "compaction": {
+            "fn": "TimeSeriesShard.flush",
+            "affects": "EPOCH_AFFECTS_ALL"},
+        "age_out": {
+            "fn": "TimeSeriesShard.age_out_durable",
+            "affects": "EPOCH_AFFECTS_ALL"},
+        "recovery_chunk_load": {
+            "fn": "TimeSeriesShard._recover_inner",
+            "affects": "batch_min_ts"},
+        "series_admit": {
+            "fn": "TimeSeriesShard._create_series_locked",
+            "affects": "admit"},
+        "series_admit_bulk": {
+            "fn": "TimeSeriesShard._bulk_create_locked",
+            "affects": "admit"},
+    },
+}
+
 from .chunkstore import SeriesStore
 from .eviction import BloomFilter, CapacityEvictionPolicy, EvictionPolicy
 from .filters import Filter
@@ -1331,9 +1395,20 @@ class TimeSeriesShard:
             # when a partition goes quiet; the host last_ts mirror is authoritative)
             last = self.store.last_ts
             inactive = np.nonzero((self.store.n_host > 0) & (last < cutoff_ms))[0]
-            for pid in inactive.tolist():
-                if self.index.is_live(pid):
-                    self.index.update_end_time(pid, int(last[pid]))
+            ended = {pid: int(last[pid]) for pid in inactive.tolist()
+                     if self.index.is_live(pid)}
+            if ended:
+                # the marks alone are query-visible — a series ended at T
+                # drops out of selections for windows past T even when the
+                # pending-flush filter below vetoes the actual purge — so
+                # they need their own bump: steps at or before the earliest
+                # mark are provably unaffected (batch_min_ts class). Bump
+                # BEFORE applying the marks (the flush/release pattern): a
+                # mid-loop fault can then never leave marks visible under a
+                # stale epoch
+                self._bump_epoch_locked(min(ended.values()))
+                for pid, end_ts in ended.items():
+                    self.index.update_end_time(pid, end_ts)
             purged = self.index.part_ids_ended_before(cutoff_ms)
             # never purge series with data still staged for a pending flush
             # group, nor pids of a snapshot currently being written

@@ -1250,6 +1250,14 @@ class QueryEngine:
             G = len(uniq)
             a0 = args[0] if len(args) > 0 else 0.0
             a1 = args[1] if len(args) > 1 else 0.0
+            # any partition release invalidates (shard, row) -> key
+            # resolution after the fetch: capture the coarse release epochs
+            # BEFORE any kernel dispatch (the read-side epoch contract —
+            # a capture taken after dispatch could already include a
+            # release that re-assigned rows between the gid build above
+            # and the capture, and the post-fetch validation in
+            # _present_mesh_topk would then pass vacuously)
+            epochs = [sh._release_epoch for sh in shards]
             # dispatch under the locks; the blocking host fetch happens after
             # they release (same rule as the in-process leaf) so a slow
             # collective never stalls ingest across every shard. The FIRST
@@ -1277,9 +1285,6 @@ class QueryEngine:
                     return None
                 lazy = ex.topk(fn, out_ts, window, gids_list, G, k,
                                op == "bottomk", args=(a0, a1))
-                # any partition release invalidates (shard, row) -> key
-                # resolution after the fetch; capture the coarse epochs now
-                epochs = [sh._release_epoch for sh in shards]
             else:
                 lazy = ex.aggregate(fn, op, out_ts, window, gids_list,
                                     G, args=(a0, a1), fetch=False)

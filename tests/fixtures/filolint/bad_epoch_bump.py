@@ -1,0 +1,25 @@
+"""MUST flag epoch-bump-unlocked (bump outside the shard lock tears the
+epoch/log pair against epoch_state() readers) and epoch-bump-overclaim
+(EPOCH_AFFECTS_ALL recorded while the batch minimum sits in scope)."""
+
+EPOCH_AFFECTS_ALL = -(1 << 62)
+
+EPOCH_SPEC = {
+    "class": "Shard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {"store": ("append", "compact")},
+    "sites": {
+        "staged_flush": {"fn": "Shard.flush", "affects": "batch_min_ts"},
+    },
+}
+
+
+class Shard:
+    def flush(self, batch):
+        batch_min = int(batch.ts.min())
+        self.store.append(batch.ids, batch.ts)
+        # BAD: no enclosing `with self.lock:`, no *_locked contract, no
+        # assert_owned — and the destructive ALL sentinel while batch_min
+        # is right there (full invalidation instead of per-step validity)
+        self._bump_epoch_locked(EPOCH_AFFECTS_ALL)

@@ -1,0 +1,24 @@
+"""MUST flag epoch-capture-after-execute (the vector is read after the
+kernel already ran — a concurrent flush between the data read and the
+capture makes every later validation pass vacuously) and
+epoch-validate-refetched (the probe rebuilds the vector inline instead of
+passing the pre-execution capture)."""
+
+
+class Engine:
+    def serve(self, expr, start, end, step):
+        result = self._exec_plan(expr, start, end, step)
+        # BAD: capture AFTER dispatch — the cached entry claims the epochs
+        # of a world the kernel never saw
+        epochs = [sh.data_epoch for sh in self.shards]
+        self.result_cache.put((expr, start, end, step), result, epochs)
+        return result
+
+    def serve_cached(self, key):
+        # BAD: validating against a vector refetched at probe time accepts
+        # entries the mutation since their capture invalidated
+        hit = self.result_cache.get(
+            key, [sh.data_epoch for sh in self.shards])
+        if hit is not None:
+            return hit
+        return None

@@ -1,0 +1,35 @@
+"""MUST flag epoch-undeclared-visibility (a mutator the spec does not
+know about) and epoch-bump-uncovered (a declared site with a bump-free
+path past its mutation)."""
+
+EPOCH_AFFECTS_ALL = -(1 << 62)
+
+EPOCH_SPEC = {
+    "class": "Shard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {"store": ("append", "compact"),
+                      "index": ("remove_part_keys", "update_end_time")},
+    "admit_calls": {"index": ("add_part_key",)},
+    "admit_maps": ("_part_key_of_id",),
+    "sites": {
+        "staged_flush": {"fn": "Shard.flush_locked",
+                         "affects": "batch_min_ts"},
+    },
+}
+
+
+class Shard:
+    def flush_locked(self, batch):
+        # BAD: epoch-bump-uncovered — the early return skips the bump, so
+        # the appended rows are query-visible under the old epoch forever
+        self.store.append(batch.ids, batch.ts)
+        if batch.defer_accounting:
+            return
+        self._bump_epoch_locked(batch.min_ts)
+
+    def sweep(self, cutoff):
+        # BAD: epoch-undeclared-visibility — removes live postings (query
+        # results change) but is not a declared EPOCH_SPEC site and is
+        # callable from anywhere
+        self.index.remove_part_keys(cutoff)

@@ -1,0 +1,12 @@
+"""MUST flag filolint-stale-ignore: both comments excuse findings that do
+not exist — one names a rule that never fires here, one blanket-ignores a
+line with nothing to ignore. Either would silently swallow whatever fires
+on its line next."""
+
+
+def healthy(values):
+    return sum(values)  # filolint: ignore[jit-host-sync]
+
+
+def also_healthy(n):
+    return n + 1  # filolint: ignore[*]

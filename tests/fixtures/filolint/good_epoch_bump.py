@@ -1,0 +1,31 @@
+"""Clean twin of bad_epoch_bump: bumps under the lock with an honest
+affected-ts — the batch minimum on the flush path, the ALL sentinel only
+where rows genuinely move (compaction), in a *_locked method."""
+
+EPOCH_AFFECTS_ALL = -(1 << 62)
+
+EPOCH_SPEC = {
+    "class": "Shard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {"store": ("append", "compact")},
+    "sites": {
+        "staged_flush": {"fn": "Shard.flush", "affects": "batch_min_ts"},
+        "compaction": {"fn": "Shard.compact_locked",
+                       "affects": "EPOCH_AFFECTS_ALL"},
+    },
+}
+
+
+class Shard:
+    def flush(self, batch):
+        batch_min = int(batch.ts.min())
+        with self.lock:
+            self.store.append(batch.ids, batch.ts)
+            self._bump_epoch_locked(batch_min)
+
+    def compact_locked(self, seg):
+        # caller holds the shard lock (*_locked contract); compaction moves
+        # every row, so the ALL sentinel is the honest claim here
+        self.store.compact(seg.ids)
+        self._bump_epoch_locked(EPOCH_AFFECTS_ALL)

@@ -1,0 +1,15 @@
+"""Clean twin of bad_epoch_probe: capture once BEFORE execution, probe
+with that capture, store the result under that same capture — the entry's
+epochs describe exactly the world the kernel read."""
+
+
+class Engine:
+    def serve(self, expr, start, end, step):
+        key = (expr, start, end, step)
+        epochs = [sh.data_epoch for sh in self.shards]
+        hit = self.result_cache.get(key, epochs)
+        if hit is not None:
+            return hit
+        result = self._exec_plan(expr, start, end, step)
+        self.result_cache.put(key, result, epochs)
+        return result

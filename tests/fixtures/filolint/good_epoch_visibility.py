@@ -1,0 +1,40 @@
+"""Clean twin of bad_epoch_visibility: every visible mutation is either a
+declared site with a dominating bump, a helper reachable ONLY from a
+declared site (the caller fences the call), or an admission-class write
+(a zero-sample series changes no query result — declared, no bump)."""
+
+EPOCH_AFFECTS_ALL = -(1 << 62)
+
+EPOCH_SPEC = {
+    "class": "Shard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {"store": ("append", "compact"),
+                      "index": ("remove_part_keys", "update_end_time")},
+    "admit_calls": {"index": ("add_part_key",)},
+    "admit_maps": ("_part_key_of_id",),
+    "sites": {
+        "staged_flush": {"fn": "Shard.flush_locked",
+                         "affects": "batch_min_ts"},
+        "series_admit": {"fn": "Shard.admit_locked", "affects": "admit"},
+    },
+}
+
+
+class Shard:
+    def flush_locked(self, batch):
+        # bump BEFORE the writes: a reader racing the append invalidates
+        # conservatively, never stales
+        self._bump_epoch_locked(batch.min_ts)
+        self._apply(batch)
+
+    def _apply(self, batch):
+        # helper with no bump of its own — legal because its ONLY caller
+        # is the declared staged_flush site, which bump-fences the call
+        self.store.append(batch.ids, batch.ts)
+
+    def admit_locked(self, key):
+        # admission-class: registers the series but no samples exist yet,
+        # so no query result changes and no data bump is owed
+        self.index.add_part_key(key.raw)
+        self._part_key_of_id[key.pid] = key.raw

@@ -1,0 +1,11 @@
+"""Clean twin of bad_stale_ignore: the ignore earns its keep — the rule it
+names actually fires on that line (a deliberate best-effort swallow), so
+the suppression is live, not stale."""
+
+
+def tolerant(op):
+    try:
+        return op()
+    except Exception:  # filolint: ignore[except-swallow]
+        pass
+    return None

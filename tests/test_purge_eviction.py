@@ -58,6 +58,33 @@ def test_purge_removes_expired_and_reuses_slots():
     assert len(ts) == 5 and (ts >= BASE + 10_000_000).all()
 
 
+def test_purge_endtime_marks_bump_epoch_even_without_purge(tmp_path):
+    """PR 18 regression (found by filolint epoch-bump-uncovered): the
+    end-time marks purge writes are query-visible on their own — a series
+    ended at T drops out of selections for windows past T even when the
+    pending-flush filter vetoes the actual purge — so they need their own
+    epoch bump with the earliest mark as the affected floor, or result/
+    fragment caches keep validating stale matches forever."""
+    from filodb_tpu.core.memstore import EPOCH_AFFECTS_ALL
+    ms, shard = _mk_shard(tmp_path)
+    _ingest(shard, ["old"], BASE)        # staged for the sink -> purge vetoed
+    e0 = shard.data_epoch
+    assert shard.purge_expired_partitions(BASE + 5_000_000) == 0
+    assert shard.data_epoch > e0, \
+        "end-time marks applied without a data-epoch bump"
+    epoch, min_affected = shard._epoch_log[-1]
+    assert epoch == shard.data_epoch
+    # batch_min_ts class: the mark's end time, NOT the destructive sentinel
+    assert min_affected == BASE + 4 * 10_000
+    assert min_affected != EPOCH_AFFECTS_ALL
+    # and the marks really are query-visible: windows past the end time no
+    # longer match the series
+    from filodb_tpu.core.filters import Equals
+    pids = shard.part_ids_from_filters([Equals("host", "old")],
+                                       BASE + 1_000_000, 1 << 60)
+    assert len(pids) == 0
+
+
 def test_purge_detects_returning_series():
     ms, shard = _mk_shard()
     _ingest(shard, ["ghost"], BASE)

@@ -86,6 +86,19 @@ BAD_FIXTURES = {
     # neither None), or variant parity breaks when query.fused_kernels
     # flips the serving backend
     "bad_decode_variant.py": {"surface-decode-variant-twin"},
+    # PR 18: epoch & visibility contracts — every mutation of query-visible
+    # store state must be a declared EPOCH_SPEC site (or reachable only
+    # from one), bump-fenced on every CFG path, under the shard lock, with
+    # an honest affected-ts; the read side must capture the epoch vector
+    # BEFORE execution and validate with that capture
+    "bad_epoch_visibility.py": {"epoch-undeclared-visibility",
+                                "epoch-bump-uncovered"},
+    "bad_epoch_bump.py": {"epoch-bump-unlocked", "epoch-bump-overclaim"},
+    "bad_epoch_probe.py": {"epoch-capture-after-execute",
+                           "epoch-validate-refetched"},
+    # PR 18: an inline ignore whose rule no longer fires is itself a
+    # finding — it would silently swallow whatever fires there next
+    "bad_stale_ignore.py": {"filolint-stale-ignore"},
 }
 
 
@@ -789,6 +802,88 @@ def test_repo_has_zero_unsuppressed_findings():
 def test_cli_exit_status():
     from filodb_tpu.analysis.__main__ import main
     assert main(["--root", str(REPO), "--quiet"]) == 0
+
+
+def test_shared_corpus_matches_and_beats_per_family():
+    """PR 18 satellite: all rule families run over ONE parsed corpus with
+    one PackageIndex and memoized CFGs. The legacy per-family mode (each
+    family re-parses and re-indexes) must produce fingerprint-identical
+    findings — and measurably slower, or the sharing rotted away."""
+    shared = run_analysis(REPO, shared_corpus=True)
+    legacy = run_analysis(REPO, shared_corpus=False)
+    fps = sorted(f.fingerprint for f in shared.all_findings)
+    assert fps == sorted(f.fingerprint for f in legacy.all_findings)
+    assert shared.corpus_stats["index_builds"] == 1
+    # the tier-1 latency guard: a full-repo run stays interactive
+    assert shared.wall_s < 10.0, f"full-repo filolint run {shared.wall_s:.2f}s"
+    assert shared.wall_s < legacy.wall_s, (
+        f"shared corpus ({shared.wall_s:.2f}s) must beat per-family "
+        f"parsing ({legacy.wall_s:.2f}s)")
+
+
+def test_sarif_artifact_is_current():
+    """The committed SARIF artifact (CI code-scanning upload) declares
+    every rule — including the PR 18 epoch family and the stale-ignore
+    meta-rule — and carries zero results (the repo is clean)."""
+    import json
+    from filodb_tpu.analysis.runner import ALL_RULES
+    art = json.loads((REPO / "filolint.sarif").read_text())
+    driver = art["runs"][0]["tool"]["driver"]
+    assert tuple(r["id"] for r in driver["rules"]) == ALL_RULES
+    assert art["runs"][0]["results"] == []
+    for rule in ("epoch-undeclared-visibility", "epoch-bump-uncovered",
+                 "epoch-bump-unlocked", "epoch-bump-overclaim",
+                 "epoch-capture-after-execute", "epoch-validate-refetched",
+                 "filolint-stale-ignore"):
+        assert rule in ALL_RULES, rule
+
+
+def test_stale_ignore_only_suppressed_by_naming_itself(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("def f():\n"
+                 "    return 1  # filolint: ignore[jit-host-sync]\n")
+    assert any(f.rule == "filolint-stale-ignore"
+               for f in analyze_file(p, root=tmp_path))
+    # a blanket ignore[*] cannot swallow the meta-finding about itself...
+    p.write_text("def f():\n"
+                 "    return 1  # filolint: ignore[jit-host-sync, *]\n")
+    assert any(f.rule == "filolint-stale-ignore"
+               for f in analyze_file(p, root=tmp_path))
+    # ...but explicitly accepting the meta-rule by name works
+    p.write_text("def f():\n"
+                 "    return 1  "
+                 "# filolint: ignore[jit-host-sync, filolint-stale-ignore]\n")
+    assert analyze_file(p, root=tmp_path) == []
+
+
+def test_stale_ignore_skipped_in_scoped_runs():
+    """cli.py's except-swallow suppression is live in a full run but its
+    rule is interprocedural — a scoped run must not call it stale."""
+    report = run_analysis(REPO, paths=["filodb_tpu/cli.py"])
+    assert not any(f.rule == "filolint-stale-ignore"
+                   for f in report.all_findings)
+
+
+def test_changed_only_escalates_on_analysis_changes(tmp_path, capsys):
+    """A change under filodb_tpu/analysis/ (or to the fixture twins)
+    invalidates every scoped judgement — --changed-only must escalate to
+    a full run instead of linting new rules against a partial corpus."""
+    import subprocess
+    from filodb_tpu.analysis.__main__ import main
+    (tmp_path / "filodb_tpu" / "analysis").mkdir(parents=True)
+    subprocess.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    (tmp_path / "filodb_tpu" / "analysis" / "newrule.py").write_text("x = 1\n")
+    subprocess.run(["git", "add", "-A"], cwd=tmp_path, check=True)
+    rc = main(["--root", str(tmp_path), "--changed-only", "--quiet"])
+    assert rc == 0
+    assert "escalating" in capsys.readouterr().err
+
+
+def test_epoch_spec_module_is_changed_only_anchor():
+    """The epoch rules judge every mutator against core/memstore.py's
+    EPOCH_SPEC — a scoped run must always carry it."""
+    from filodb_tpu.analysis.__main__ import ANCHOR_MODULES
+    assert "filodb_tpu/core/memstore.py" in ANCHOR_MODULES
 
 
 # -- 3. runtime hook parity ---------------------------------------------------

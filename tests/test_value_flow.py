@@ -1,0 +1,229 @@
+"""Value-flow engine unit tests (tier-1, pure AST — no device).
+
+The epoch rules (analysis/epochcheck.py) ride on three reusable pieces:
+field-sensitive mutation tracking through local aliases and helper
+methods, CFG bump-coverage queries (dominance from entry OR on every
+path to exit, across try/finally and loop back-edges), and the declared-
+site reverse-reachability closure on the PackageIndex call graph. Each is
+pinned here in isolation so a regression points at the engine, not at
+whichever rule happened to notice."""
+
+from __future__ import annotations
+
+import ast
+
+from filodb_tpu.analysis.callgraph import PackageIndex
+from filodb_tpu.analysis.cfg import (build_cfg, covered_on_all_paths,
+                                     dominated_from_entry)
+from filodb_tpu.analysis.epochcheck import EpochChecker
+
+SPEC = """
+EPOCH_AFFECTS_ALL = -(1 << 62)
+EPOCH_SPEC = {
+    "class": "Shard",
+    "bump": "_bump_epoch_locked",
+    "lock": "lock",
+    "visible_calls": {"store": ("append", "compact"),
+                      "index": ("update_end_time",),
+                      "sink": ("age_out",)},
+    "sites": {
+        "staged_flush": {"fn": "Shard.flush_locked",
+                         "affects": "batch_min_ts"},
+        "age_out": {"fn": "Shard.drain_locked",
+                    "affects": "EPOCH_AFFECTS_ALL"},
+    },
+}
+"""
+
+
+def _epoch_findings(src: str):
+    checker = EpochChecker()
+    tree = ast.parse(src)
+    checker.check_module("m.py", tree)
+    checker.project = PackageIndex({"m.py": tree})
+    return checker.finalize()
+
+
+def _stmt_of(cfg, needle: str) -> int:
+    return next(i for i, s in enumerate(cfg.stmts)
+                if not isinstance(s, (ast.If, ast.For, ast.While, ast.Try,
+                                      ast.With))
+                and needle in ast.dump(s))
+
+
+def _bump_pred(s: ast.stmt) -> bool:
+    return not isinstance(s, (ast.If, ast.For, ast.While, ast.Try,
+                              ast.With)) and "_bump_epoch_locked" in \
+        ast.dump(s)
+
+
+# -- field-sensitive mutation tracking ----------------------------------------
+
+def test_mutation_through_local_alias_is_tracked():
+    src = SPEC + (
+        "class Shard:\n"
+        "    def sweep(self):\n"
+        "        sink = self.sink\n"
+        "        sink.age_out(123)\n")
+    got = _epoch_findings(src)
+    assert any(f.rule == "epoch-undeclared-visibility"
+               and f.detail == "sink.age_out" for f in got), \
+        [f.render() for f in got]
+
+
+def test_helper_chain_fenced_at_declared_root_is_clean():
+    # the mutation lives two calls below the declared site; the site's
+    # dominating bump fences the whole chain
+    src = SPEC + (
+        "class Shard:\n"
+        "    def flush_locked(self, batch):\n"
+        "        self._bump_epoch_locked(batch.min_ts)\n"
+        "        self._mid(batch)\n"
+        "    def _mid(self, batch):\n"
+        "        self._leaf(batch)\n"
+        "    def _leaf(self, batch):\n"
+        "        self.store.append(batch.ids, batch.ts)\n")
+    assert _epoch_findings(src) == [], \
+        [f.render() for f in _epoch_findings(src)]
+
+
+def test_unfenced_helper_obligation_propagates_to_declared_caller():
+    # same chain, bump deleted: the obligation surfaces at the declared
+    # site's call into the chain, not at some arbitrary leaf
+    src = SPEC + (
+        "class Shard:\n"
+        "    def flush_locked(self, batch):\n"
+        "        self._mid(batch)\n"
+        "    def _mid(self, batch):\n"
+        "        self._leaf(batch)\n"
+        "    def _leaf(self, batch):\n"
+        "        self.store.append(batch.ids, batch.ts)\n")
+    got = _epoch_findings(src)
+    assert any(f.rule == "epoch-bump-uncovered"
+               and f.symbol == "Shard.flush_locked"
+               and f.detail == "call:Shard._mid" for f in got), \
+        [f.render() for f in got]
+
+
+def test_result_guarded_bump_is_coverage():
+    # the age_out_durable idiom: the bump is conditional on the mutation's
+    # own result — the skipped branch is the nothing-changed case
+    src = SPEC + (
+        "class Shard:\n"
+        "    def drain_locked(self, sink):\n"
+        "        dropped = sink.age_out(123)\n"
+        "        if dropped:\n"
+        "            self._bump_epoch_locked(EPOCH_AFFECTS_ALL)\n")
+    assert not any(f.rule == "epoch-bump-uncovered"
+                   for f in _epoch_findings(src))
+    # guarding on an UNRELATED name is not coverage
+    src2 = src.replace("if dropped:", "if sink.armed:")
+    assert any(f.rule == "epoch-bump-uncovered"
+               for f in _epoch_findings(src2))
+
+
+# -- CFG coverage queries -----------------------------------------------------
+
+def test_dominated_from_entry_requires_every_path():
+    fn = ast.parse("def f(self, batch):\n"
+                   "    self._bump_epoch_locked(batch.min_ts)\n"
+                   "    self.store.append(batch)\n").body[0]
+    cfg = build_cfg(fn)
+    assert dominated_from_entry(cfg, _stmt_of(cfg, "append"), _bump_pred)
+    fn2 = ast.parse("def f(self, batch, x):\n"
+                    "    if x:\n"
+                    "        self._bump_epoch_locked(batch.min_ts)\n"
+                    "    self.store.append(batch)\n").body[0]
+    cfg2 = build_cfg(fn2)
+    assert not dominated_from_entry(cfg2, _stmt_of(cfg2, "append"),
+                                    _bump_pred)
+
+
+def test_coverage_across_try_finally():
+    # bump in a finally covers both the normal and the exceptional exit
+    fn = ast.parse("def f(self, batch):\n"
+                   "    try:\n"
+                   "        self.store.append(batch)\n"
+                   "    finally:\n"
+                   "        self._bump_epoch_locked(batch.min_ts)\n").body[0]
+    cfg = build_cfg(fn)
+    assert covered_on_all_paths(cfg, _stmt_of(cfg, "append"), _bump_pred)
+    # the mutation's OWN exception edge is excluded (a raising append
+    # fails its batch atomically), but a LATER statement raising between
+    # the mutation and the bump strands visible data under a stale epoch
+    fn2 = ast.parse("def f(self, batch):\n"
+                    "    self.store.append(batch)\n"
+                    "    self.validate(batch)\n"
+                    "    self._bump_epoch_locked(batch.min_ts)\n").body[0]
+    cfg2 = build_cfg(fn2)
+    assert not covered_on_all_paths(cfg2, _stmt_of(cfg2, "append"),
+                                    _bump_pred)
+
+
+def test_loop_iteration_fault_breaks_trailing_coverage():
+    # the purge_expired_partitions lesson: a second loop iteration can
+    # raise AFTER the first already mutated, skipping a bump placed after
+    # the loop — bumping BEFORE the loop is the provable shape
+    fn = ast.parse("def f(self, marks):\n"
+                   "    for pid in marks:\n"
+                   "        self.index.update_end_time(pid)\n"
+                   "    self._bump_epoch_locked(min(marks))\n").body[0]
+    cfg = build_cfg(fn)
+    assert not covered_on_all_paths(cfg, _stmt_of(cfg, "update_end_time"),
+                                    _bump_pred)
+    fn2 = ast.parse("def f(self, marks):\n"
+                    "    self._bump_epoch_locked(min(marks))\n"
+                    "    for pid in marks:\n"
+                    "        self.index.update_end_time(pid)\n").body[0]
+    cfg2 = build_cfg(fn2)
+    assert covered_on_all_paths(cfg2, _stmt_of(cfg2, "update_end_time"),
+                                _bump_pred)
+
+
+# -- declared-site reachability closure ---------------------------------------
+
+def _idx(src: str) -> PackageIndex:
+    return PackageIndex({"m.py": ast.parse(src)})
+
+
+def test_reachable_only_from_transitive_chain():
+    idx = _idx("class A:\n"
+               "    def root(self):\n"
+               "        self.helper()\n"
+               "    def helper(self):\n"
+               "        self.leaf()\n"
+               "    def leaf(self):\n"
+               "        pass\n")
+    assert idx.reachable_only_from("m.py::A.leaf", {"m.py::A.root"})
+    # a sanctioned INTERMEDIATE dominator closes the chain just as well
+    assert idx.reachable_only_from("m.py::A.leaf", {"m.py::A.helper"})
+    # a sanctioned set crossing no caller chain does not
+    assert not idx.reachable_only_from("m.py::A.leaf", {"m.py::A.other"})
+
+
+def test_reachable_only_from_second_caller_breaks_closure():
+    idx = _idx("class A:\n"
+               "    def root(self):\n"
+               "        self.leaf()\n"
+               "    def rogue(self):\n"
+               "        self.leaf()\n"
+               "    def leaf(self):\n"
+               "        pass\n")
+    # rogue is itself a callerless entry point, so leaf is reachable
+    # outside the sanctioned set
+    assert not idx.reachable_only_from("m.py::A.leaf", {"m.py::A.root"})
+    assert idx.reachable_only_from("m.py::A.leaf",
+                                   {"m.py::A.root", "m.py::A.rogue"})
+
+
+def test_reachable_only_from_handles_cycles():
+    idx = _idx("class B:\n"
+               "    def root(self):\n"
+               "        self.a()\n"
+               "    def a(self):\n"
+               "        self.b()\n"
+               "    def b(self):\n"
+               "        self.a()\n")
+    assert idx.reachable_only_from("m.py::B.b", {"m.py::B.root"})
+    # a callerless function is its own (unsanctioned) entry point
+    assert not idx.reachable_only_from("m.py::B.root", set())
