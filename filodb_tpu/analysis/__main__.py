@@ -21,17 +21,23 @@ import subprocess
 import sys
 from pathlib import Path
 
-from .findings import Baseline, report_json, report_sarif
+from .corpus import parse_corpus
+from .findings import (Baseline, is_suppressed, load_suppressions,
+                       report_json, report_sarif)
+from .livecheck import LiveChecker
 from .runner import ALL_RULES, DEFAULT_BASELINE, run_analysis
 
 # modules the cross-file rules need in scope even when unchanged: the wire
 # codec + its HTTP classifier, the typed-error bases, the broker op spec,
-# the declared-surface dicts, and the epoch-visibility spec (EPOCH_SPEC —
-# the epoch rules judge every mutator against it)
+# the declared-surface dicts, the epoch-visibility spec (EPOCH_SPEC — the
+# epoch rules judge every mutator against it), and the liveness contract
+# (LATENCY_SPEC in utils/diagnostics.py — livecheck judges lock-held spans
+# and waits against it)
 ANCHOR_MODULES = (
     "filodb_tpu/config.py",
     "filodb_tpu/core/memstore.py",
     "filodb_tpu/utils/metrics.py",
+    "filodb_tpu/utils/diagnostics.py",
     "filodb_tpu/query/wire.py",
     "filodb_tpu/query/rangevector.py",
     "filodb_tpu/http/api.py",
@@ -83,6 +89,51 @@ def _changed_files(root: Path) -> list[str] | None:
             and (root / p).exists()]
 
 
+def _tools_audit(root: Path) -> list[str]:
+    """Liveness audit of the operational entry points (``stress/*.py``,
+    ``scripts/*.py``) that sit OUTSIDE the package the main run analyzes.
+    Tool code still deadlocks and still hangs CI, but it has no baseline
+    and no fixture twins — so findings here are WARNINGS only: printed,
+    never counted toward the exit status. The LATENCY_SPEC anchor module
+    rides along so the livecheck rules have a contract in scope; findings
+    in the anchor itself are the main run's business and are dropped."""
+    anchor_rel = "filodb_tpu/utils/diagnostics.py"
+    files: list[Path] = []
+    for sub in ("stress", "scripts"):
+        d = root / sub
+        if d.is_dir():
+            files.extend(sorted(d.glob("*.py")))
+    if (root / anchor_rel).exists():
+        files.append(root / anchor_rel)
+    pairs = []
+    for p in files:
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        pairs.append((rel, p))
+    corpus, errors = parse_corpus(pairs)
+    checker = LiveChecker()
+    findings = []
+    for rel, tree in corpus.modules.items():
+        findings += checker.check_module(rel, tree)
+    if hasattr(checker, "project"):
+        checker.project = corpus.index
+    if hasattr(checker, "corpus"):
+        checker.corpus = corpus
+    findings += checker.finalize()
+    lines = [f"filolint: tools-audit parse error in {rel}: {e}"
+             for rel, e in errors]
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        if f.path == anchor_rel:
+            continue
+        supp = load_suppressions(corpus.sources.get(f.path, ""))
+        if is_suppressed(f, supp):
+            continue
+        lines.append(f"filolint: tools-audit warning: {f.render()}")
+    return lines
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m filodb_tpu.analysis",
@@ -115,6 +166,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print per-rule-family timings and shared-corpus "
                          "build/hit counters to stderr")
+    ap.add_argument("--include-tools", action="store_true",
+                    help="additionally audit stress/ and scripts/ entry "
+                         "points with the liveness rules — warnings only, "
+                         "never affects the exit status")
     ap.add_argument("--no-shared-corpus", action="store_true",
                     help="re-parse the package and rebuild the index per "
                          "rule family (the pre-sharing cost model; findings "
@@ -150,6 +205,9 @@ def main(argv: list[str] | None = None) -> int:
 
     report = run_analysis(root, paths, baseline_path=baseline_path,
                           shared_corpus=not args.no_shared_corpus)
+    if args.include_tools:
+        for line in _tools_audit(root):
+            print(line, file=sys.stderr)
     if args.stats:
         for line in report.stats_lines():
             print(line, file=sys.stderr)

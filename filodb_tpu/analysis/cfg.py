@@ -298,6 +298,53 @@ def dominated_from_entry(cfg: CFG, idx: int, pred) -> bool:
     return True
 
 
+def backedge_dominated(cfg: CFG, head: int, pred) -> bool:
+    """True iff every CFG path from the loop statement at ``head`` BACK to
+    itself passes a statement for which ``pred(stmt)`` is True — the
+    value-flow question behind bounded-retry checking: an attempt-count
+    guard (``if attempt >= max: raise``) bounds the loop only when the
+    guard is evaluated on every iteration, i.e. it dominates the back
+    edge. Paths that LEAVE the loop (break / return / the loop-exit
+    continuation) never re-reach ``head`` and are vacuously fine. A loop
+    whose back edge is unreachable (every iteration returns or raises)
+    is vacuously dominated."""
+    seen: set = set()
+    todo = list(cfg.succ.get(head, ())) + list(cfg.exc_succ.get(head, ()))
+    while todo:
+        n = todo.pop()
+        if n == head:
+            return False            # completed an iteration pred-free
+        if n in seen or n == EXIT:
+            continue
+        seen.add(n)
+        if pred(cfg.stmts[n]):
+            continue
+        todo.extend(cfg.succ.get(n, ()))
+        todo.extend(cfg.exc_succ.get(n, ()))
+    return True
+
+
+def guarded_between(cfg: CFG, frm: int, target_pred, guard_pred) -> bool:
+    """True iff every CFG path from ``frm`` to a target-matching statement
+    passes a guard statement first — the deadline-bounds-the-socket query:
+    from the socket's creation, every path to its first blocking op must
+    cross a ``settimeout``. Unreachable targets are vacuously guarded."""
+    seen: set = set()
+    todo = list(cfg.succ.get(frm, ())) + list(cfg.exc_succ.get(frm, ()))
+    while todo:
+        n = todo.pop()
+        if n in seen or n == EXIT:
+            continue
+        seen.add(n)
+        if guard_pred(cfg.stmts[n]):
+            continue
+        if target_pred(cfg.stmts[n]):
+            return False
+        todo.extend(cfg.succ.get(n, ()))
+        todo.extend(cfg.exc_succ.get(n, ()))
+    return True
+
+
 def covered_on_all_paths(cfg: CFG, idx: int, pred) -> bool:
     """True iff the statement at ``idx`` is *fenced* by the predicate: every
     path from ENTRY to ``idx`` passes a pred statement, OR every path from

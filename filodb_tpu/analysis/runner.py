@@ -27,6 +27,7 @@ from .findings import (Baseline, Finding, STALE_IGNORE_RULE, is_suppressed,
                        load_suppressions)
 from .indexcheck import IndexChecker
 from .jitcheck import JitChecker
+from .livecheck import LiveChecker
 from .lockcheck import LockChecker
 from .meshcheck import MeshChecker
 from .resourcecheck import ResourceChecker
@@ -41,7 +42,8 @@ ALL_RULES = tuple(sorted(
     | set(ResourceChecker.rules) | set(ExceptChecker.rules)
     | set(SurfaceChecker.rules) | set(IndexChecker.rules)
     | set(MeshChecker.rules) | set(DecodeChecker.rules)
-    | set(EpochChecker.rules) | {STALE_IGNORE_RULE}))
+    | set(EpochChecker.rules) | set(LiveChecker.rules)
+    | {STALE_IGNORE_RULE}))
 
 DEFAULT_BASELINE = "filolint_baseline.json"
 
@@ -135,9 +137,15 @@ def analyze_file(path: Path, root: Path | None = None,
 def _default_checkers(wire_spec: dict | None = None, full_scope: bool = True):
     surface = SurfaceChecker()
     surface.full_scope = full_scope
+    live = LiveChecker()
+    # unresolved-sanction errors need the whole package in view — a scoped
+    # run would call a live sanction stale just because its target module
+    # wasn't analyzed
+    live.full_scope = full_scope
     return [LockChecker(), JitChecker(), WireChecker(spec=wire_spec),
             ResourceChecker(), ExceptChecker(), IndexChecker(),
-            MeshChecker(), DecodeChecker(), EpochChecker(), surface]
+            MeshChecker(), DecodeChecker(), EpochChecker(), live,
+            surface]
 
 
 def _finalize(checkers, modules: dict, corpus: Corpus | None = None,
@@ -161,6 +169,10 @@ def _finalize(checkers, modules: dict, corpus: Corpus | None = None,
             name = type(c).__name__
             timings[name] = timings.get(name, 0.0) + \
                 (time.perf_counter() - t0)
+            # per-rule sub-timings (livecheck reports its four passes)
+            for sub, secs in getattr(c, "sub_timings", {}).items():
+                timings[f"{name}.{sub}"] = \
+                    timings.get(f"{name}.{sub}", 0.0) + secs
     return findings
 
 

@@ -33,6 +33,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -493,6 +494,12 @@ class ReplicatedColumnStore(ChunkSink):
                     last_err = e
                     log.warning("replica write %s failed on %r "
                                 "(attempt %d): %s", fn_name, b, attempt + 1, e)
+                    if attempt + 1 < attempts:
+                        # brief linear backoff before the same-replica
+                        # retry: the transient fault (GC pause, fd churn)
+                        # needs a beat to clear, and a hot re-send burns
+                        # the attempt budget in microseconds
+                        time.sleep(0.05 * (attempt + 1))
         if wrote == 0:
             raise IOError(f"all {self.replication} replicas failed") from last_err
         return wrote

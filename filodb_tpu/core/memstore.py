@@ -63,7 +63,7 @@ EPOCH_SPEC = {
     "visible_calls": {
         "store": ("append", "compact", "free_rows"),
         "index": ("remove_part_keys", "update_end_time"),
-        "sink": ("age_out",),
+        "sink": ("age_out", "age_out_commit"),
     },
     "admit_calls": {
         "index": ("add_part_key", "add_part_keys_bulk",
@@ -1469,18 +1469,34 @@ class TimeSeriesShard:
     def age_out_durable(self, cutoff_ms: int) -> int:
         """Durable raw retention (retention.raw_ttl): drop sink samples older
         than ``cutoff_ms`` and bump ``data_epoch`` so cached results over the
-        aged-out range invalidate. All group flush locks are held across the
-        log rewrite — flush_group appends are serialized per group through
-        them, so the rewrite can never lose a concurrent append."""
+        aged-out range invalidate. The heavy read-decode-rewrite half runs
+        with NO locks held (copy-out); only the commit — splicing the tail
+        appended since the snapshot, bounded by one flush batch per group,
+        then an atomic rename — runs under all group flush locks, so the
+        rewrite can never lose a concurrent append yet flushes stall only
+        for the splice. Sinks without the prepare/commit split (the remote
+        store client, whose age_out is one deadline-bounded RPC) keep the
+        single-call form under the locks — the declared LATENCY_SPEC
+        sanction."""
         import contextlib
         sink = self.sink
         if sink is None or not hasattr(sink, "age_out"):
             return 0
-        with contextlib.ExitStack() as stack:
-            for lk in self._group_flush_locks:   # ascending index: in-order
-                stack.enter_context(lk)
-            dropped = int(sink.age_out(self.dataset, self.shard_num,
-                                       cutoff_ms))
+        prepare = getattr(sink, "age_out_prepare", None)
+        if prepare is not None:
+            token = prepare(self.dataset, self.shard_num, cutoff_ms)
+            if token is None:
+                return 0
+            with contextlib.ExitStack() as stack:
+                for lk in self._group_flush_locks:   # ascending: in-order
+                    stack.enter_context(lk)
+                dropped = int(sink.age_out_commit(token))
+        else:
+            with contextlib.ExitStack() as stack:
+                for lk in self._group_flush_locks:   # ascending: in-order
+                    stack.enter_context(lk)
+                dropped = int(sink.age_out(self.dataset, self.shard_num,
+                                           cutoff_ms))
         if dropped:
             with self.lock:
                 # result-cache watermark: rows aged out (destructive)

@@ -15,8 +15,10 @@ The FileColumnStore keeps, per (dataset, shard):
 
 from __future__ import annotations
 
+import io
 import json
 import os
+import shutil
 import struct
 import threading
 from dataclasses import dataclass
@@ -243,6 +245,25 @@ def encode_age_out(chunksets, cutoff_ms: int) -> tuple[bytes, int]:
     return b"".join(frames), dropped
 
 
+def _good_frame_prefix_len(data: bytes) -> int:
+    """Byte length of the longest structurally complete frame prefix of a
+    chunk log. The lock-free half of the age-out split snapshots the log
+    while a flush append may be mid-write; cutting anywhere but a frame
+    boundary would splice half a frame in front of the appends that land
+    after the snapshot, and the WAL reader would truncate every one of
+    them at the torn half."""
+    off = 0
+    hdr = _CHUNK_HDR.size
+    while True:
+        if off + hdr + 4 > len(data):
+            return off
+        (plen,) = struct.unpack_from("<I", data, off + hdr)
+        end = off + hdr + 4 + plen
+        if end > len(data):
+            return off
+        off = end
+
+
 # ---------------------------------------------------------------------------
 # Part-key index time buckets (ref: the reference persists its Lucene index
 # as time-bucket blobs and recovers from them instead of re-indexing raw
@@ -371,30 +392,57 @@ class FileColumnStore(ChunkSink):
         with open(path, "rb") as f:
             yield from iter_chunksets(f, start_ms, end_ms)
 
+    def age_out_prepare(self, dataset, shard, cutoff_ms: int):
+        """Heavy half of durable raw retention, safe to run with NO locks
+        held: snapshot the chunk log's good-frame prefix, read, decode and
+        re-encode it dropping samples older than ``cutoff_ms``. Returns an
+        opaque token for ``age_out_commit``, or None when nothing would
+        drop (empty/absent log, or the head-frame probe shows the cutoff
+        has not reached the oldest frame). Frames appended after the
+        snapshot hold fresh samples by construction and are preserved
+        verbatim by the commit's splice."""
+        path = os.path.join(self._dir(dataset, shard), "chunks.log")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            data = f.read()
+        # cut at a frame boundary: a flush append may be mid-write while we
+        # read (prepare holds no locks), and splicing half a frame in front
+        # of later appends would truncate every frame behind it at read
+        snap = _good_frame_prefix_len(data)
+        bio = io.BytesIO(data[:snap])
+        head = head_frame_min_ts(bio)
+        if head is None or head >= cutoff_ms:
+            return None
+        bio.seek(0)
+        buf, dropped = encode_age_out(list(iter_chunksets(bio)), cutoff_ms)
+        if not dropped:
+            return None
+        return (path, snap, buf, dropped)
+
+    def age_out_commit(self, token) -> int:
+        """Cheap half of durable raw retention, run under the group flush
+        locks (see TimeSeriesShard.age_out_durable): splice the rewritten
+        prefix with whatever was appended since the prepare snapshot —
+        bounded by one flush batch per group, since the locks serialize
+        appends — and atomically swap the log. Returns samples dropped."""
+        path, snap, buf, dropped = token
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as out:
+            out.write(buf)
+            with open(path, "rb") as f:
+                f.seek(snap)
+                shutil.copyfileobj(f, out)
+        os.replace(tmp, path)   # atomic commit
+        return dropped
+
     def age_out(self, dataset, shard, cutoff_ms: int) -> int:
         """Durable raw retention: atomically rewrite the chunk log dropping
         samples older than ``cutoff_ms`` (caller serializes against
         concurrent flush appends — see TimeSeriesShard.age_out_durable).
         Returns samples dropped."""
-        path = os.path.join(self._dir(dataset, shard), "chunks.log")
-        if not os.path.exists(path):
-            return 0
-        # steady-state skip: when the head frame holds nothing past the
-        # cutoff, the full pass would read/decode/re-encode the whole log
-        # to drop zero samples (see head_frame_min_ts)
-        with open(path, "rb") as f:
-            head = head_frame_min_ts(f)
-        if head is None or head >= cutoff_ms:
-            return 0
-        # materialize BEFORE replacing: read_chunksets streams the same file
-        buf, dropped = encode_age_out(
-            list(self.read_chunksets(dataset, shard)), cutoff_ms)
-        if dropped:
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(buf)
-            os.replace(tmp, path)   # atomic commit
-        return dropped
+        token = self.age_out_prepare(dataset, shard, cutoff_ms)
+        return self.age_out_commit(token) if token is not None else 0
 
     # -- part keys ------------------------------------------------------------
 

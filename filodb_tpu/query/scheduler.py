@@ -230,7 +230,11 @@ class QueryScheduler:
             try:
                 with self._cv:
                     while not self._heap and not self._shutdown:
-                        self._cv.wait()
+                        # bounded park: a lost notify (or a shutdown racing
+                        # the wait) re-checks the predicate within a second
+                        # instead of stranding the worker forever
+                        # (filolint: live-wait-no-timeout)
+                        self._cv.wait(timeout=1.0)
                     if self._shutdown and not self._heap:
                         return
                     _, _, fut, fn = heapq.heappop(self._heap)

@@ -48,8 +48,9 @@ class _DecodeAhead:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
         self._err: BaseException | None = None
         self._closed = False
-        threading.Thread(target=self._fill, args=(it,), daemon=True,
-                         name="ingest-decode").start()
+        self._thread = threading.Thread(target=self._fill, args=(it,),
+                                        daemon=True, name="ingest-decode")
+        self._thread.start()
 
     def _fill(self, it) -> None:
         try:
@@ -79,12 +80,24 @@ class _DecodeAhead:
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is self._END:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        # timed get + liveness check: _fill guarantees the _END sentinel on
+        # every normal exit path, but a fill thread killed uncleanly (or a
+        # bug there) must not park this consumer forever on a bare get
+        # (filolint: live-wait-no-timeout)
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    if self._err is not None:
+                        raise self._err
+                    raise StopIteration
+                continue
+            if item is self._END:
+                if self._err is not None:
+                    raise self._err
+                raise StopIteration
+            return item
 
     def close(self) -> None:
         """Unblock and retire the fill thread after an early exit."""

@@ -44,6 +44,99 @@ LOCK_ORDER = ("group_flush", "sink", "shard")
 
 _LOCK_RANK = {c: i for i, c in enumerate(LOCK_ORDER)}
 
+# Liveness contract surface, enforced by filodb_tpu/analysis/livecheck.py
+# (pure literal — the checker reads it from the AST like EPOCH_SPEC).
+#   "locks"      — owner-attribute name -> lock class: the lock shapes the
+#                  live-block-under-lock rule tracks (lexical `with`,
+#                  enter_context over one or all of them, assert_owned,
+#                  and the `_locked`-suffix caller-holds contract on
+#                  classes that own one of these attributes).
+#   "blocking"   — leaf callee name -> kind: the blocking-call taxonomy.
+#                  A call with one of these leaves can park the calling
+#                  thread on I/O, a peer, or the clock.
+#   "blocking_attr_calls" — the sink protocol's blocking surface:
+#                  ``self.sink.*`` resolves to nothing in the call graph
+#                  (duck-typed), so its file/network methods are declared
+#                  here the way EPOCH_SPEC declares visible_calls.
+#   "sites"      — sanctioned block-under-lock sites. Every entry carries
+#                  a REQUIRED reason string saying what bounds the block
+#                  and who guarantees progress; a reason-less entry is
+#                  itself a finding. Sanction extends to helpers reachable
+#                  ONLY from declared sites (reverse-call closure).
+#   "wait_ok"    — declared shutdown-aware wait wrappers exempt from
+#                  live-wait-no-timeout (same shape + reason rule).
+#   "retry_ok"   — sanctioned serve loops exempt from live-unbounded-retry
+#                  ONLY (same shape + reason rule): a loop whose "retry" is
+#                  answering the next request, bounded by connection
+#                  lifetime rather than an attempt counter. The sanction
+#                  does NOT extend to blocking under locks.
+#   "pacing_calls" — leaf callee names that pace a bounded retry loop the
+#                  way a sleep would: waits on the device/kernel, not a
+#                  hot spin (block_until_ready retires in-flight device
+#                  work; a timed select parks in the kernel).
+# Undeclared blocking under a lock, unbounded socket I/O, bound-less or
+# backoff-less retry loops, and timeout-less waits are tier-1 failures —
+# see ANALYSIS.md "Liveness & bounded-wait contracts".
+LATENCY_SPEC = {
+    "locks": {
+        "lock": "shard",
+        "owner_lock": "shard",
+        "_sink_lock": "sink",
+        "_group_flush_locks": "group_flush",
+    },
+    "blocking": {
+        "sleep": "sleep", "_sleep": "sleep",
+        "connect": "socket", "accept": "socket",
+        "recv": "socket", "recv_into": "socket", "recvfrom": "socket",
+        "send": "socket", "sendall": "socket",
+        "create_connection": "socket",
+        "urlopen": "http",
+        "check_call": "subprocess", "check_output": "subprocess",
+        "Popen": "subprocess", "communicate": "subprocess",
+        "open": "file",
+        "join": "thread-join",
+    },
+    "blocking_attr_calls": {
+        "sink": ("age_out", "age_out_prepare", "age_out_commit",
+                 "write_chunkset", "write_meta", "write_part_keys",
+                 "write_index_bucket", "write_checkpoint",
+                 "read_chunksets", "read_part_keys", "read_meta",
+                 "read_checkpoints", "read_index_frames"),
+    },
+    "sites": {
+        "partkey_drain": {
+            "fn": "TimeSeriesShard._flush_partkey_log",
+            "reason": "the sink lock exists to serialize exactly this "
+                      "bounded batch write (part-key event order on disk); "
+                      "ingest and query threads never take it, so the "
+                      "write stalls only a concurrent drain"},
+        "group_flush": {
+            "fn": "TimeSeriesShard.flush_group",
+            "reason": "one group's flush batch written under that group's "
+                      "lock; the lock serializes same-group flushes only — "
+                      "ingest staging and the query read path never "
+                      "take it"},
+        "age_out_commit": {
+            "fn": "TimeSeriesShard.age_out_durable",
+            "reason": "commit half only: the heavy log rewrite ran "
+                      "lock-free on a snapshot; under the group locks the "
+                      "sink splices the tail appended since (bounded by "
+                      "one flush batch per group) and renames. Remote "
+                      "sinks run one deadline-bounded RPC instead"},
+    },
+    "wait_ok": {},
+    "retry_ok": {
+        "dist_serve_frame_loop": {
+            "fn": "StoreServer.__init__.handle",
+            "reason": "per-connection serve loop: one request frame per "
+                      "iteration, errors are replied to the client and the "
+                      "next frame served; bounded by connection lifetime — "
+                      "recv raises when the peer closes, and stop() closes "
+                      "every tracked connection to unblock it"},
+    },
+    "pacing_calls": ("block_until_ready", "select"),
+}
+
 # opt-in runtime lock-order assertions (cheap thread-local bookkeeping, but
 # still off by default on hot ingest paths)
 lock_debug = os.environ.get("FILODB_LOCK_DEBUG", "") == "1"
@@ -86,6 +179,51 @@ def assert_owned(lock, what: str) -> None:
             f"{threading.current_thread().name})")
 
 
+class _HoldWatchdog:
+    """Background scan catching the long hold the release-time check cannot:
+    a WEDGED holder whose release never comes (the exact failure
+    live-block-under-lock exists to prevent — a blocking call under the
+    lock that never returns). Locks register at first-depth acquire under
+    FILODB_LOCK_DEBUG=1; a daemon thread scans the held set every
+    HOLD_WARN_S/4 (re-read each cycle so tests can lower the threshold)
+    and warns + counts a long hold for any lock still held past
+    HOLD_WARN_S — while it is still held, not after the fact."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._held: dict[int, "TimedRLock"] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, lk: "TimedRLock") -> None:
+        with self._lock:
+            self._held[id(lk)] = lk
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._scan_loop, daemon=True,
+                    name="lock-hold-watchdog")
+                self._thread.start()
+
+    def unregister(self, lk: "TimedRLock") -> None:
+        with self._lock:
+            self._held.pop(id(lk), None)
+
+    def _scan_loop(self) -> None:
+        while not self._stop.wait(max(0.05, HOLD_WARN_S / 4.0)):
+            try:
+                now = time.monotonic()
+                with self._lock:
+                    held = list(self._held.values())
+                for lk in held:
+                    lk._watchdog_check(now)
+            except Exception:   # noqa: BLE001 — watchdog must outlive faults
+                log.exception("lock-hold watchdog scan failed; retrying "
+                              "next period")
+
+
+_watchdog = _HoldWatchdog()
+
+
 class TimedRLock:
     """RLock wrapper counting contentions and warning on long holds.
 
@@ -114,6 +252,9 @@ class TimedRLock:
         self.long_holds = 0
         self._acquired_at = 0.0
         self._depth = 0
+        self._registered = False        # in the hold watchdog's held set
+        self._warned_hold = 0.0         # _acquired_at already flagged
+        self._hold_hist = None          # lazy filodb_lock_hold_ms handle
         # serializes the contention/long-hold counter RMWs: contentions is
         # bumped precisely when the main lock is NOT held, so `+= 1` there
         # races every other contending thread (found by filolint's
@@ -162,14 +303,48 @@ class TimedRLock:
         self._depth += 1
         if self._depth == 1:
             self._acquired_at = time.monotonic()
+            if debug:
+                _watchdog.register(self)
+                self._registered = True
         if debug:
             _held_locks().append(self)
         return True
 
+    def _watchdog_check(self, now: float) -> None:
+        """Called by the hold watchdog's scan thread. Reads are racy by
+        design (no lock shared with the hot path); the worst outcome of a
+        torn read is one spurious or missed warning."""
+        at = self._acquired_at
+        if self._depth <= 0 or at == 0.0 or self._warned_hold == at:
+            return
+        held = now - at
+        if held > HOLD_WARN_S:
+            self._warned_hold = at
+            with self._stats_lock:
+                self.long_holds += 1
+            log.warning("%s STILL held after %.1fs (> %.1fs) — wedged "
+                        "holder? (watchdog; the release-time check cannot "
+                        "see a hold that never releases)",
+                        self.name, held, HOLD_WARN_S)
+
     def release(self):
         if self._depth == 1:
             held = time.monotonic() - self._acquired_at
-            if held > HOLD_WARN_S:
+            if self._registered:
+                _watchdog.unregister(self)
+                self._registered = False
+            if lock_debug:
+                hist = self._hold_hist
+                if hist is None:
+                    # deferred import: metrics is a leaf module but the
+                    # lock is constructed on paths that must not pay for
+                    # registry wiring unless debug is on
+                    from .metrics import FILODB_LOCK_HOLD_MS, registry
+                    hist = self._hold_hist = registry.histogram(
+                        FILODB_LOCK_HOLD_MS,
+                        {"class": self.order_class or "other"})
+                hist.record(held * 1000.0)
+            if held > HOLD_WARN_S and self._warned_hold != self._acquired_at:
                 with self._stats_lock:
                     self.long_holds += 1
                 if enabled:

@@ -44,6 +44,7 @@ FILODB_SHARD_STATUS = "filodb_shard_status"
 FILODB_SHARD_NUM_SERIES = "filodb_shard_num_series"
 FILODB_SHARD_LOCK_CONTENTIONS = "filodb_shard_lock_contentions"
 FILODB_SHARD_LOCK_LONG_HOLDS = "filodb_shard_lock_long_holds"
+FILODB_LOCK_HOLD_MS = "filodb_lock_hold_ms"
 FILODB_QUERY_LATENCY_MS = "filodb_query_latency_ms"
 FILODB_QUERY_SLOW = "filodb_query_slow"
 FILODB_QUERY_COMPILE_CACHE_HITS = "filodb_query_compile_cache_hits"
@@ -154,6 +155,11 @@ METRICS_SPEC: dict[str, tuple[str, str]] = {
         "gauge", "TimedRLock contention count per shard (diagnostics)."),
     FILODB_SHARD_LOCK_LONG_HOLDS: (
         "gauge", "TimedRLock long-hold count per shard (diagnostics)."),
+    FILODB_LOCK_HOLD_MS: (
+        "histogram", "TimedRLock hold time per lock class, recorded under "
+                     "FILODB_LOCK_DEBUG=1 — the runtime twin of filolint's "
+                     "live-block-under-lock rule; soak runs alert on "
+                     "hold-time regressions the static pass cannot see."),
     FILODB_QUERY_LATENCY_MS: (
         "histogram", "End-to-end PromQL latency per dataset; the /metrics "
                      "rendering carries the last query's trace id as an "

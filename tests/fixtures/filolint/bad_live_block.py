@@ -1,0 +1,43 @@
+"""MUST flag live-block-under-lock: a sink write under the group-flush
+lock, a file write reached through an undeclared helper while the shard
+lock is held (obligation propagation), and a sleep inside a ``_locked``
+caller-holds method on a lock-owner class — none declared in
+LATENCY_SPEC["sites"]."""
+
+import time
+
+LATENCY_SPEC = {
+    "locks": {"lock": "shard", "_group_flush_locks": "group_flush"},
+    "blocking": {"sleep": "sleep", "open": "file"},
+    "blocking_attr_calls": {"sink": ("write_chunkset",)},
+    "sites": {},
+    "wait_ok": {},
+}
+
+
+class Shard:
+    def __init__(self, lock, group_locks, sink):
+        self.lock = lock
+        self._group_flush_locks = group_locks
+        self.sink = sink
+
+    def flush_group(self, group, records):
+        with self._group_flush_locks[group]:
+            # BAD: network/file write while every same-group flusher
+            # queues behind this lock — undeclared, no reason recorded
+            self.sink.write_chunkset(group, records)
+
+    def checkpoint(self, payload):
+        with self.lock:
+            # BAD: the blocking obligation propagates through the
+            # undeclared helper — the open/write runs while held
+            self._journal_append(payload)
+
+    def _journal_append(self, payload):
+        with open("journal.bin", "ab") as f:
+            f.write(payload)
+
+    def _rebalance_locked(self):
+        # BAD: `_locked` caller-holds contract on a lock-owner class —
+        # the shard lock is held across the clock
+        time.sleep(0.1)

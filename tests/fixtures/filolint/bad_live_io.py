@@ -1,0 +1,35 @@
+"""MUST flag live-unbounded-io: a create_connection with no timeout
+argument (the connect AND every later recv inherit the kernel default),
+and a raw socket whose connect runs before settimeout on the only CFG
+path."""
+
+import socket
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"connect": "socket", "recv": "socket",
+                 "create_connection": "socket"},
+    "sites": {},
+    "wait_ok": {},
+}
+
+
+def fetch_status(addr):
+    # BAD: no timeout= — a SYN-blackholed peer parks this thread for
+    # the kernel default (minutes)
+    s = socket.create_connection(addr)
+    try:
+        return s.recv(512)
+    finally:
+        s.close()
+
+
+def probe(host, port):
+    s = socket.socket()
+    try:
+        # BAD: the connect runs before settimeout on this path
+        s.connect((host, port))
+        s.settimeout(2.0)
+        return s.recv(64)
+    finally:
+        s.close()

@@ -1,0 +1,38 @@
+"""MUST flag live-unbounded-retry twice: a while-True retry with no
+statically visible attempt bound or deadline, and a bounded for-range
+retry whose re-attempts run back-to-back with no backoff."""
+
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"sleep": "sleep"},
+    "sites": {},
+    "wait_ok": {},
+}
+
+
+def push_forever(conn, payload):
+    # BAD: no attempt bound or deadline — a dead peer spins this forever
+    while True:
+        try:
+            conn.send(payload)
+            return True
+        except ConnectionError:
+            log.warning("send failed; retrying")
+            time.sleep(0.1)
+
+
+def push_hot(conn, payload):
+    # BAD: bounded by the range, but the re-attempts are back-to-back —
+    # the whole budget burns in microseconds against a failing peer
+    for attempt in range(5):
+        try:
+            conn.send(payload)
+            return True
+        except ConnectionError:
+            log.warning("send failed (attempt %d)", attempt)
+    return False

@@ -1,0 +1,38 @@
+"""MUST flag live-wait-no-timeout three ways: a Condition.wait with no
+timeout (lost notify parks the waiter forever), a bare Queue.get (a
+producer that dies without its sentinel never unblocks the consumer),
+and a timeout-less Thread.join (a wedged worker blocks shutdown)."""
+
+import queue
+import threading
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"join": "thread-join"},
+    "sites": {},
+    "wait_ok": {},
+}
+
+
+class Drain:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._q = queue.Queue()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                # BAD: one lost notify parks this thread forever
+                self._cv.wait()
+
+    def next_item(self):
+        # BAD: a producer that dies without its sentinel never unblocks
+        return self._q.get()
+
+
+def run_worker(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    # BAD: a wedged worker blocks shutdown indefinitely
+    t.join()

@@ -1,0 +1,44 @@
+"""Good twin of bad_live_io: the timeout rides the create_connection
+call, settimeout dominates every blocking op on the raw socket, and a
+bind-only socket (never talks to a peer) is vacuously bounded."""
+
+import socket
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"connect": "socket", "recv": "socket",
+                 "create_connection": "socket"},
+    "sites": {},
+    "wait_ok": {},
+}
+
+
+def fetch_status(addr):
+    # the timeout applies to the connect AND every later recv/send on
+    # the returned socket
+    s = socket.create_connection(addr, timeout=2.0)
+    try:
+        return s.recv(512)
+    finally:
+        s.close()
+
+
+def probe(host, port):
+    s = socket.socket()
+    try:
+        s.settimeout(2.0)       # deadline set before any blocking op
+        s.connect((host, port))
+        return s.recv(64)
+    finally:
+        s.close()
+
+
+def free_port():
+    # bind/getsockname never wait on a peer: no blocking op is ever
+    # reached, so no settimeout is owed
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()

@@ -1,0 +1,48 @@
+"""Good twin of bad_live_retry: an attempt counter guards the back edge
+on EVERY path (raise past the bound) with linear backoff between
+attempts, and a monotonic deadline compared in the loop test bounds the
+second loop."""
+
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"sleep": "sleep"},
+    "sites": {},
+    "wait_ok": {},
+}
+
+MAX_ATTEMPTS = 5
+
+
+def push_bounded(conn, payload):
+    attempt = 0
+    while True:
+        try:
+            conn.send(payload)
+            return True
+        except ConnectionError:
+            # the counter guard dominates the back edge: no iteration
+            # completes without passing it
+            attempt += 1
+            if attempt >= MAX_ATTEMPTS:
+                raise
+            log.warning("send failed (attempt %d); backing off", attempt)
+            time.sleep(0.05 * attempt)
+
+
+def push_deadlined(conn, payload, budget_s=2.0):
+    # monotonic deadline in the loop test: the retries stop when the
+    # budget runs out no matter how the peer fails
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            conn.send(payload)
+            return True
+        except ConnectionError:
+            log.warning("send failed; retrying until deadline")
+            time.sleep(0.05)
+    return False

@@ -1,0 +1,52 @@
+"""Good twin of bad_live_wait: every wait carries a timeout and
+re-checks its predicate, and the one deliberately bare get lives in a
+wrapper declared in LATENCY_SPEC["wait_ok"] with the reason that bounds
+it."""
+
+import queue
+import threading
+
+LATENCY_SPEC = {
+    "locks": {},
+    "blocking": {"join": "thread-join"},
+    "sites": {},
+    "wait_ok": {
+        "sentinel_drain": {
+            "fn": "Drain.wait_for_sentinel",
+            "reason": "the producer enqueues the sentinel in a finally "
+                      "block, so the get is bounded by producer lifetime; "
+                      "callers own the shutdown path"},
+    },
+}
+
+_END = object()
+
+
+class Drain:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._q = queue.Queue()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                # bounded park: re-checks the predicate every second
+                # even if the notify was lost
+                self._cv.wait(timeout=1.0)
+
+    def next_item(self):
+        return self._q.get(timeout=5.0)
+
+    def wait_for_sentinel(self):
+        # declared shutdown-aware wrapper — see LATENCY_SPEC["wait_ok"]
+        while True:
+            item = self._q.get()
+            if item is _END:
+                return
+
+
+def run_worker(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout=5.0)

@@ -2,6 +2,7 @@
 ChunkMap lock-leak counters, BlockDetective use-after-reclaim reports)."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -82,3 +83,42 @@ def test_donation_detective_explains(diag):
         diagnostics.explain_deleted_buffer(
             RuntimeError("Array has been deleted with shape=int32[16]"), det)
     assert diagnostics.explain_deleted_buffer(RuntimeError("other"), det) is False
+
+
+# ------------------------------------------------- lock-hold watchdog (PR 20)
+
+def test_lock_hold_watchdog_flags_wedged_holder(monkeypatch):
+    """The watchdog counts a long hold WHILE the lock is still held — the
+    release-time check alone never fires for a wedged holder whose release
+    never comes (the runtime twin of live-block-under-lock)."""
+    monkeypatch.setattr(diagnostics, "HOLD_WARN_S", 0.2)
+    was = diagnostics.lock_debug
+    diagnostics.enable_lock_debug(True)
+    try:
+        lk = diagnostics.TimedRLock("wedge-test", order_class="shard")
+        with lk:
+            deadline = time.monotonic() + 5.0
+            while lk.long_holds == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert lk.long_holds >= 1    # flagged before release
+    finally:
+        diagnostics.enable_lock_debug(was)
+
+
+def test_lock_hold_histogram_records():
+    """Under FILODB_LOCK_DEBUG=1 every first-depth release lands one
+    observation in filodb_lock_hold_ms tagged with the lock class."""
+    from filodb_tpu.utils.metrics import FILODB_LOCK_HOLD_MS, registry
+
+    was = diagnostics.lock_debug
+    diagnostics.enable_lock_debug(True)
+    try:
+        h = registry.histogram(FILODB_LOCK_HOLD_MS, {"class": "sink"})
+        before = h.count
+        lk = diagnostics.TimedRLock("hist-test", order_class="sink")
+        with lk:
+            with lk:        # reentrant acquire must not double-record
+                pass
+        assert h.count == before + 1
+    finally:
+        diagnostics.enable_lock_debug(was)

@@ -252,6 +252,32 @@ def test_decode_ahead_yields_all_and_propagates_errors():
     assert got == items[:5]     # everything before the fault was delivered
 
 
+def test_decode_ahead_ends_when_fill_thread_dies_without_sentinel():
+    """The timed-get consumer (runtime twin of live-wait-no-timeout): a
+    fill thread that dies without managing to enqueue its end sentinel —
+    killed process pool, interpreter teardown — must not park the consumer
+    forever. The bounded get re-checks producer liveness and ends the
+    stream instead."""
+    import queue
+
+    from filodb_tpu.standalone import _DecodeAhead
+
+    src = _DecodeAhead(iter([]), depth=2)
+    src._thread.join(timeout=5.0)
+    assert not src._thread.is_alive()
+    # simulate the unclean death: swallow the sentinel the thread DID
+    # write, leaving an empty queue and a dead producer
+    while True:
+        try:
+            src._q.get_nowait()
+        except queue.Empty:
+            break
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        src.__next__()
+    assert time.monotonic() - t0 < 5.0      # bounded, not parked forever
+
+
 def test_config_wired_gateway_end_to_end(tmp_path):
     """ingest.gateway_port wires the Influx TCP gateway into FiloServer:
     lines in over TCP, PromQL out over HTTP — through the windowed broker

@@ -12,7 +12,7 @@ import pytest
 from filodb_tpu.core.memstore import StoreConfig, TimeSeriesMemStore
 from filodb_tpu.core.record import RecordBuilder
 from filodb_tpu.core.schemas import GAUGE
-from filodb_tpu.core.store import FileColumnStore
+from filodb_tpu.core.store import ChunkSetRecord, FileColumnStore
 from filodb_tpu.jobs.batch_downsampler import (load_downsampled,
                                                run_batch_downsample)
 from filodb_tpu.query.engine import QueryEngine
@@ -348,6 +348,38 @@ def test_age_out_durable_drops_and_bumps_epoch(tmp_path):
             assert (r.ts >= cutoff).all()
     # idempotent: a second pass at the same cutoff drops nothing
     assert shard.age_out_durable(cutoff) == 0
+
+
+def test_age_out_commit_preserves_frames_appended_after_prepare(tmp_path):
+    """Tail-splice safety of the two-phase age-out (PR 20): a flush frame
+    that lands between the lock-free prepare (heavy rewrite off a
+    good-frame-prefix snapshot) and the commit (splice + atomic rename)
+    must survive the swap verbatim."""
+    _raw, _fams, sink, _shard = _build_tiers(tmp_path)
+    lead = BASE + (N_SAMPLES - 1) * IV
+    cutoff = lead - 4 * H1
+    token = sink.age_out_prepare("prometheus", 0, cutoff)
+    assert token is not None
+    # simulate the concurrent flush: an all-recent frame appended after
+    # the prepare snapshot was taken
+    g0, recs0 = next(iter(sink.read_chunksets("prometheus", 0)))
+    proto = recs0[0]
+    late_ts = lead + IV * (1 + np.arange(8, dtype=np.int64))
+    late = ChunkSetRecord(
+        part_id=proto.part_id, ts=late_ts,
+        values=np.full((8,) + proto.values.shape[1:], 7.0,
+                       proto.values.dtype),
+        layout=proto.layout)
+    sink.write_chunkset("prometheus", 0, g0, [late])
+    assert sink.age_out_commit(token) > 0
+    seen_late = False
+    for _g, recs in sink.read_chunksets("prometheus", 0):
+        for r in recs:
+            assert (r.ts >= cutoff).all()
+            if r.ts.min() > lead:
+                assert np.array_equal(r.ts, late_ts)
+                seen_late = True
+    assert seen_late    # the post-snapshot append survived the splice
 
 
 def test_age_out_replicated_rewrites_every_replica(tmp_path):

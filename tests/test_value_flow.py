@@ -227,3 +227,265 @@ def test_reachable_only_from_handles_cycles():
     assert idx.reachable_only_from("m.py::B.b", {"m.py::B.root"})
     # a callerless function is its own (unsanctioned) entry point
     assert not idx.reachable_only_from("m.py::B.root", set())
+
+
+# -- livecheck value-flow engine (PR 20) --------------------------------------
+# The liveness rules ride on two new CFG queries (backedge_dominated for
+# retry bounds, guarded_between for socket-timeout domination) plus the
+# retry classifier's value flow: assigned-name extraction through tuple
+# unpacking, the union-of-guards bound, transient-vs-repair handler
+# gating, and cross-module declared-site resolution by qualname.
+
+from filodb_tpu.analysis.cfg import backedge_dominated, guarded_between  # noqa: E402
+from filodb_tpu.analysis.livecheck import LiveChecker  # noqa: E402
+
+LIVE_SPEC = """
+LATENCY_SPEC = {
+    "locks": {"lock": "shard"},
+    "blocking": {"sleep": "sleep", "connect": "socket", "recv": "socket",
+                 "create_connection": "socket"},
+    "blocking_attr_calls": {},
+    "sites": {},
+    "wait_ok": {},
+    "pacing_calls": ("block_until_ready",),
+}
+"""
+
+
+def _live_findings(src: str, path: str = "m.py"):
+    checker = LiveChecker()
+    tree = ast.parse(src)
+    out = list(checker.check_module(path, tree))
+    checker.project = PackageIndex({path: tree})
+    return out + checker.finalize()
+
+
+def _retry_findings(src: str):
+    return [f for f in _live_findings(LIVE_SPEC + src)
+            if f.rule == "live-unbounded-retry"]
+
+
+# -- backedge_dominated / guarded_between directly ----------------------------
+
+def test_backedge_dominated_guard_on_every_path():
+    fn = ast.parse("def f():\n"
+                   "    n = 0\n"
+                   "    while True:\n"
+                   "        n += 1\n"
+                   "        if n > 3:\n"
+                   "            break\n"
+                   "        work()\n").body[0]
+    cfg = build_cfg(fn)
+    loop = next(s for s in cfg.stmts if isinstance(s, ast.While))
+    guard = next(s for s in cfg.stmts if isinstance(s, ast.If))
+    assert backedge_dominated(cfg, cfg.node_of(loop),
+                              lambda s: s is guard)
+
+
+def test_backedge_not_dominated_when_a_path_skips_the_guard():
+    fn = ast.parse("def f(flag):\n"
+                   "    n = 0\n"
+                   "    while True:\n"
+                   "        if flag:\n"
+                   "            n += 1\n"
+                   "            if n > 3:\n"
+                   "                break\n"
+                   "        work()\n").body[0]
+    cfg = build_cfg(fn)
+    loop = next(s for s in cfg.stmts if isinstance(s, ast.While))
+    guard = next(s for s in cfg.stmts
+                 if isinstance(s, ast.If) and "n" in ast.dump(s.test))
+    # the flag-falsy iteration reaches the back edge guard-free
+    assert not backedge_dominated(cfg, cfg.node_of(loop),
+                                  lambda s: s is guard)
+
+
+def test_guarded_between_orders_settimeout_before_blocking_op():
+    fn = ast.parse("def f(s, host):\n"
+                   "    s = make()\n"
+                   "    s.settimeout(2.0)\n"
+                   "    s.connect((host, 1))\n").body[0]
+    cfg = build_cfg(fn)
+
+    def has(attr):
+        def pred(stmt):
+            return any(isinstance(n, ast.Call)
+                       and isinstance(n.func, ast.Attribute)
+                       and n.func.attr == attr for n in ast.walk(stmt))
+        return pred
+
+    start = next(i for i, s in enumerate(cfg.stmts) if "make" in ast.dump(s))
+    assert guarded_between(cfg, start, has("connect"), has("settimeout"))
+    # reversed order: the connect is reached before any settimeout
+    fn2 = ast.parse("def f(s, host):\n"
+                    "    s = make()\n"
+                    "    s.connect((host, 1))\n"
+                    "    s.settimeout(2.0)\n").body[0]
+    cfg2 = build_cfg(fn2)
+    start2 = next(i for i, s in enumerate(cfg2.stmts)
+                  if "make" in ast.dump(s))
+    assert not guarded_between(cfg2, start2, has("connect"),
+                               has("settimeout"))
+
+
+# -- retry classification value flow ------------------------------------------
+
+def test_retry_union_of_guards_bounds_multi_outcome_loop():
+    # no SINGLE guard dominates the back edge (fenced vs shed take
+    # different counters), but their union does — the loop is bounded
+    src = ("import time\n"
+           "def send(conn, chunks):\n"
+           "    fenced = shed = 0\n"
+           "    while True:\n"
+           "        try:\n"
+           "            conn.send(chunks)\n"
+           "            return True\n"
+           "        except ConnectionError:\n"
+           "            if transient(conn):\n"
+           "                fenced += 1\n"
+           "                if fenced > 3:\n"
+           "                    raise\n"
+           "                time.sleep(0.01)\n"
+           "                continue\n"
+           "            shed += 1\n"
+           "            if shed > 3:\n"
+           "                raise\n"
+           "            time.sleep(0.01)\n")
+    assert _retry_findings(src) == []
+
+
+def test_retry_guard_missing_on_one_path_is_unbounded():
+    src = ("import time\n"
+           "def send(conn, payload):\n"
+           "    n = 0\n"
+           "    while True:\n"
+           "        try:\n"
+           "            conn.send(payload)\n"
+           "            return True\n"
+           "        except ConnectionError:\n"
+           "            if recoverable(conn):\n"
+           "                n += 1\n"
+           "                if n > 3:\n"
+           "                    raise\n"
+           "            time.sleep(0.01)\n")
+    got = _retry_findings(src)
+    assert any(f.detail.endswith("no-bound") for f in got), \
+        [f.render() for f in got]
+
+
+def test_retry_counter_through_tuple_unpack_is_tracked():
+    # the bounding name is bound by tuple unpacking (select returns a
+    # triple) — target extraction must see through it
+    src = ("import select, time\n"
+           "def drain(sock):\n"
+           "    while True:\n"
+           "        ready, _w, _x = select.select([sock], [], [], 0.05)\n"
+           "        if not ready:\n"
+           "            break\n"
+           "        try:\n"
+           "            handle(sock)\n"
+           "        except OSError:\n"
+           "            time.sleep(0.01)\n")
+    assert _retry_findings(src) == []
+
+
+def test_retry_pacing_call_counts_as_backoff():
+    src = ("def retire(arr):\n"
+           "    for _ in range(4):\n"
+           "        try:\n"
+           "            arr.block_until_ready()\n"
+           "            break\n"
+           "        except Exception:\n"
+           "            continue\n")
+    assert _retry_findings(src) == []
+
+
+def test_retry_for_range_without_backoff_is_flagged():
+    src = ("def retire(arr):\n"
+           "    for _ in range(4):\n"
+           "        try:\n"
+           "            arr.poke()\n"
+           "            break\n"
+           "        except Exception:\n"
+           "            continue\n")
+    got = _retry_findings(src)
+    assert any(f.detail.endswith("no-backoff") for f in got), \
+        [f.render() for f in got]
+
+
+def test_value_repair_handler_is_not_a_retry():
+    # `except ValueError: v = fallback` repairs a value inside an
+    # ordinary consumption loop — not a retry of a failing peer
+    src = ("def scan(tokens):\n"
+           "    for t in tokens:\n"
+           "        pass\n"
+           "    while tokens.more():\n"
+           "        t = tokens.next()\n"
+           "        try:\n"
+           "            v = float(t)\n"
+           "        except ValueError:\n"
+           "            v = 0.0\n"
+           "        emit(v)\n")
+    assert _retry_findings(src) == []
+
+
+# -- declared-site resolution across modules ----------------------------------
+
+LIVE_SITE_SPEC = """
+LATENCY_SPEC = {
+    "locks": {"_group_flush_locks": "group_flush"},
+    "blocking": {},
+    "blocking_attr_calls": {"sink": ("write_chunkset",)},
+    "sites": {
+        "group_flush": {"fn": "Shard.flush_group",
+                        "reason": "one bounded batch per group"},
+    },
+    "wait_ok": {},
+}
+"""
+
+SHARD_SRC = ("class Shard:\n"
+             "    def __init__(self, locks, sink):\n"
+             "        self._group_flush_locks = locks\n"
+             "        self.sink = sink\n"
+             "    def flush_group(self, g, recs):\n"
+             "        with self._group_flush_locks[g]:\n"
+             "            self.sink.write_chunkset(g, recs)\n")
+
+
+def _two_module_findings(spec_src: str):
+    checker = LiveChecker()
+    spec_tree = ast.parse(spec_src)
+    shard_tree = ast.parse(SHARD_SRC)
+    out = list(checker.check_module("utils/diagnostics.py", spec_tree))
+    out += checker.check_module("core/memstore.py", shard_tree)
+    checker.project = PackageIndex({"utils/diagnostics.py": spec_tree,
+                                    "core/memstore.py": shard_tree})
+    return out + checker.finalize()
+
+
+def test_declared_site_resolves_by_qualname_across_modules():
+    # the spec lives in utils/diagnostics.py but sanctions a function in
+    # core/memstore.py — resolution must go by qualname, not spec path
+    got = _two_module_findings(LIVE_SITE_SPEC)
+    assert got == [], [f.render() for f in got]
+
+
+def test_undeclared_lock_held_sink_write_is_flagged():
+    bare = LIVE_SITE_SPEC.replace(
+        '"group_flush": {"fn": "Shard.flush_group",\n'
+        '                        "reason": "one bounded batch per group"},',
+        "")
+    got = _two_module_findings(bare)
+    assert any(f.rule == "live-block-under-lock"
+               and f.symbol == "Shard.flush_group" for f in got), \
+        [f.render() for f in got]
+
+
+def test_stale_sanction_names_unknown_function():
+    stale = LIVE_SITE_SPEC.replace("Shard.flush_group", "Shard.gone")
+    got = _two_module_findings(stale)
+    assert any(f.detail == "site:group_flush:unresolved" for f in got), \
+        [f.render() for f in got]
+    # and the now-unsanctioned write is back to being a finding
+    assert any(f.rule == "live-block-under-lock" for f in got)
